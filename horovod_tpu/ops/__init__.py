@@ -1,0 +1,18 @@
+"""Collective ops package.
+
+- :mod:`collective` — eager enqueue API, async handles, fusion cycle
+  (reference: horovod/common/operations.cc enqueue + torch/mpi_ops.py).
+- :mod:`injit` — collectives for use *inside* jitted SPMD programs
+  (psum/all_gather/ppermute over mesh axes) — the path XLA fuses itself.
+"""
+
+from .collective import (Handle, allgather, allgather_async, allreduce,
+                         allreduce_async, broadcast, broadcast_async,
+                         engine, grouped_allreduce, poll, reset_engine,
+                         synchronize, HorovodInternalError)
+
+__all__ = [
+    "Handle", "allreduce", "allreduce_async", "allgather", "allgather_async",
+    "broadcast", "broadcast_async", "grouped_allreduce", "poll",
+    "synchronize", "engine", "reset_engine", "HorovodInternalError",
+]

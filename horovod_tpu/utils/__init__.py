@@ -1,0 +1,4 @@
+from . import env
+from .logging import get_logger
+
+__all__ = ["env", "get_logger"]

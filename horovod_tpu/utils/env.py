@@ -1,0 +1,78 @@
+"""Environment-variable config system.
+
+The reference configures its runtime entirely via ``HOROVOD_*`` env vars
+read once at background-thread start (horovod/common/operations.cc:1824-1909,
+operations.h:57-66). We honor the same names (for drop-in compatibility)
+plus ``HOROVOD_TPU_*`` overrides.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+# Defaults — operations.cc:1838 (64 MiB) and :1846 (5 ms). The TPU engine
+# defaults the cycle to 1 ms: there is no MPI negotiation round-trip to
+# amortize within a single-controller process.
+DEFAULT_FUSION_THRESHOLD_MB = 64
+DEFAULT_CYCLE_TIME_MS = 1.0
+DEFAULT_STALL_WARNING_SECS = 60  # STALL_WARNING_TIME, operations.cc:258
+
+
+def _get(name: str) -> Optional[str]:
+    v = os.environ.get("HOROVOD_TPU_" + name)
+    if v is None:
+        v = os.environ.get("HOROVOD_" + name)
+    return v
+
+
+def fusion_threshold_bytes() -> int:
+    v = _get("FUSION_THRESHOLD")
+    if v is not None:
+        return int(v)
+    return DEFAULT_FUSION_THRESHOLD_MB * 1024 * 1024
+
+
+def cycle_time_ms() -> float:
+    v = _get("CYCLE_TIME")
+    if v is not None:
+        return float(v)
+    return DEFAULT_CYCLE_TIME_MS
+
+
+def stall_warning_secs() -> float:
+    if _get("STALL_CHECK_DISABLE") not in (None, "", "0"):
+        return 0.0
+    return DEFAULT_STALL_WARNING_SECS
+
+
+def timeline_path() -> Optional[str]:
+    return _get("TIMELINE")
+
+
+def timeline_mark_cycles() -> bool:
+    return _get("TIMELINE_MARK_CYCLES") not in (None, "", "0")
+
+
+def hierarchical_allreduce() -> bool:
+    return _get("HIERARCHICAL_ALLREDUCE") not in (None, "", "0")
+
+
+def hierarchical_allgather() -> bool:
+    return _get("HIERARCHICAL_ALLGATHER") not in (None, "", "0")
+
+
+def autotune() -> bool:
+    return _get("AUTOTUNE") not in (None, "", "0")
+
+
+def autotune_log() -> Optional[str]:
+    return _get("AUTOTUNE_LOG")
+
+
+def log_level() -> str:
+    return (_get("LOG_LEVEL") or "warning").lower()
+
+
+def log_hide_time() -> bool:
+    return _get("LOG_HIDE_TIME") not in (None, "", "0")

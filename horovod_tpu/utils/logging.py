@@ -1,0 +1,51 @@
+"""Logging — equivalent of horovod/common/logging.{h,cc}.
+
+The reference provides stream-style ``LOG(severity[, rank])`` macros with
+levels TRACE…FATAL controlled by ``HOROVOD_LOG_LEVEL`` and timestamp
+suppression via ``HOROVOD_LOG_HIDE_TIME`` (logging.cc:76-92). The Python
+layer keeps the same env controls on top of stdlib logging; the native
+runtime has its own C++ mirror (runtime/src/logging.h).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from . import env as _env
+
+_LEVELS = {
+    "trace": logging.DEBUG - 5,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+}
+
+logging.addLevelName(_LEVELS["trace"], "TRACE")
+
+_configured = False
+
+
+def _configure():
+    global _configured
+    if _configured:
+        return
+    root = logging.getLogger("horovod_tpu")
+    handler = logging.StreamHandler(sys.stderr)
+    if _env.log_hide_time():
+        fmt = "[%(levelname)s] %(name)s: %(message)s"
+    else:
+        fmt = "%(asctime)s [%(levelname)s] %(name)s: %(message)s"
+    handler.setFormatter(logging.Formatter(fmt))
+    root.addHandler(handler)
+    root.setLevel(_LEVELS.get(_env.log_level(), logging.WARNING))
+    root.propagate = False
+    _configured = True
+
+
+def get_logger(name: str = "") -> logging.Logger:
+    _configure()
+    return logging.getLogger(
+        "horovod_tpu" + ("." + name if name else ""))

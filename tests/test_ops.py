@@ -1,0 +1,242 @@
+"""Collective op correctness — structural mirror of
+test/test_tensorflow.py (MPITests) and test/test_torch.py:
+
+  - dtype × dimension sweeps asserting allreduce == tensor * size with
+    size-dependent float thresholds (test_tensorflow.py:77-139),
+  - fusion tests batching many ops at once (test_tensorflow.py:107-139),
+  - allgather incl. variable first dims (test_tensorflow.py:406-510),
+  - broadcast from every root (test_tensorflow.py:645-673),
+  - error tests: duplicate names, mismatched shapes
+    (test_torch.py duplicate-name test; test_tensorflow.py:265-333),
+  - async handle poll/synchronize (test_torch.py).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+
+DTYPES = [np.uint8, np.int8, np.int32, np.int64, np.float16, np.float32,
+          np.float64, "bfloat16"]
+DIMS = [1, 2, 3]
+
+
+def _threshold(dtype, size):
+    # test_tensorflow.py:84-97: fp16 loose, fp32/64 tight w/ size scaling.
+    if str(dtype) in ("float16", "bfloat16"):
+        return size
+    return size * 1e-4 if str(dtype) in ("float32",) else 1e-6 * size
+
+
+def _rand(dtype, dim, seed=1234):
+    rng = np.random.RandomState(seed)
+    shape = [17] * dim
+    if str(dtype) == "bfloat16":
+        x = rng.uniform(-100, 100, size=shape).astype(np.float32)
+        return jnp.asarray(x, dtype=jnp.bfloat16)
+    if np.issubdtype(np.dtype(dtype), np.floating):
+        return jnp.asarray(rng.uniform(-100, 100, size=shape).astype(dtype))
+    return jnp.asarray(rng.randint(0, 100, size=shape).astype(dtype))
+
+
+class TestAllreduce:
+    @pytest.mark.parametrize("dtype", DTYPES)
+    @pytest.mark.parametrize("dim", DIMS)
+    def test_allreduce_replicated(self, dtype, dim):
+        """Every rank contributes the same tensor → sum = tensor * size
+        (test_tensorflow.py:77-106)."""
+        size = hvd.size()
+        x = _rand(dtype, dim)
+        out = hvd.allreduce(x, average=False)
+        if np.issubdtype(np.dtype(x.dtype), np.integer):
+            # Integer sums wrap in-dtype, as MPI_SUM does.
+            expected = (np.asarray(x, np.int64) * size).astype(x.dtype)
+            assert np.array_equal(np.asarray(out), expected)
+        else:
+            expected = np.asarray(x, dtype=np.float64) * size
+            got = np.asarray(out, dtype=np.float64)
+            assert np.allclose(got, expected, atol=_threshold(dtype, size))
+        assert out.shape == x.shape
+
+    def test_allreduce_average(self):
+        x = _rand(np.float32, 2)
+        out = hvd.allreduce(x, average=True)
+        assert np.allclose(np.asarray(out), np.asarray(x), atol=1e-5)
+
+    def test_allreduce_sharded_per_rank(self):
+        """Per-rank distinct values via a 'dp'-sharded leading axis."""
+        size = hvd.size()
+        x = np.arange(size * 4, dtype=np.float32).reshape(size, 4)
+        xs = jax.device_put(x, NamedSharding(hvd.mesh(), P("dp")))
+        out = hvd.allreduce(xs, average=False)
+        assert np.allclose(np.asarray(out), x.sum(axis=0))
+
+    def test_allreduce_fusion_many(self):
+        """Many ops in one batch exercise the fusion planner
+        (test_tensorflow.py:107-139)."""
+        size = hvd.size()
+        xs = [jnp.full((5, 5), float(i + 1), jnp.float32) for i in range(16)]
+        handles = [hvd.allreduce_async(x, average=False) for x in xs]
+        for i, h in enumerate(handles):
+            out = hvd.synchronize(h)
+            assert np.allclose(np.asarray(out), (i + 1) * size)
+
+    def test_grouped_allreduce(self):
+        size = hvd.size()
+        xs = [jnp.ones((3,), jnp.float32) * i for i in range(4)]
+        outs = hvd.grouped_allreduce(xs, average=False)
+        for i, o in enumerate(outs):
+            assert np.allclose(np.asarray(o), i * size)
+
+    def test_allreduce_async_poll(self):
+        h = hvd.allreduce_async(jnp.ones((8,)), average=False)
+        out = hvd.synchronize(h)
+        assert hvd.poll(h)
+        assert np.allclose(np.asarray(out), hvd.size())
+
+    def test_allreduce_prescale_postscale(self):
+        size = hvd.size()
+        x = jnp.ones((4,), jnp.float32)
+        out = hvd.allreduce(x, average=False, prescale_factor=2.0)
+        assert np.allclose(np.asarray(out), 2.0 * size)
+        out = hvd.allreduce(x, average=False, postscale_factor=0.5)
+        assert np.allclose(np.asarray(out), 0.5 * size)
+
+    def test_duplicate_name_error(self, monkeypatch):
+        """In-flight duplicate names must be rejected
+        (DUPLICATE_NAME_ERROR, operations.cc:270-273; test_torch.py
+        test_duplicate_names)."""
+        import threading
+        from horovod_tpu.ops import collective
+        eng = collective.engine()
+        gate = threading.Event()
+        orig = eng._dispatch
+
+        def slow_dispatch(batch):
+            gate.wait(10)
+            orig(batch)
+
+        monkeypatch.setattr(eng, "_dispatch", slow_dispatch)
+        h1 = hvd.allreduce_async(jnp.ones((4,)), name="dup.name")
+        try:
+            with pytest.raises(ValueError, match="same name"):
+                hvd.allreduce_async(jnp.ones((4,)), name="dup.name")
+        finally:
+            gate.set()
+            hvd.synchronize(h1)
+
+
+class TestAllgather:
+    @pytest.mark.parametrize("dtype", [np.int32, np.float32, np.float64])
+    @pytest.mark.parametrize("dim", DIMS)
+    def test_allgather_replicated(self, dtype, dim):
+        """All ranks same tensor → size stacked copies
+        (test_tensorflow.py:370-405)."""
+        size = hvd.size()
+        x = _rand(dtype, dim)
+        out = hvd.allgather(x)
+        assert out.shape[0] == x.shape[0] * size
+        expected = np.concatenate([np.asarray(x)] * size, axis=0)
+        assert np.allclose(np.asarray(out, np.float64),
+                           expected.astype(np.float64))
+
+    def test_allgather_variable_first_dim(self):
+        """Per-rank different first dims — MPI_Allgatherv parity
+        (test_tensorflow.py:406-510)."""
+        size = hvd.size()
+        per_rank = [jnp.full((i + 1, 3), float(i), jnp.float32)
+                    for i in range(size)]
+        out = hvd.allgather(per_rank)
+        assert out.shape[0] == sum(i + 1 for i in range(size))
+        expected = np.concatenate([np.asarray(t) for t in per_rank], axis=0)
+        assert np.allclose(np.asarray(out), expected)
+
+    def test_allgather_mismatched_shape_error(self):
+        """Ranks disagreeing on non-first dims must error
+        (test_tensorflow.py:558-591)."""
+        size = hvd.size()
+        per_rank = [jnp.zeros((2, 3)) for _ in range(size - 1)]
+        per_rank.append(jnp.zeros((2, 4)))
+        with pytest.raises(ValueError):
+            hvd.allgather(per_rank)
+
+
+class TestBroadcast:
+    @pytest.mark.parametrize("dtype", [np.int32, np.float32, "bfloat16"])
+    @pytest.mark.parametrize("root", [0, 3, 7])
+    def test_broadcast_from_root(self, dtype, root):
+        """Broadcast returns root's tensor on every rank
+        (test_tensorflow.py:645-673)."""
+        size = hvd.size()
+        per_rank = np.stack(
+            [np.full((4, 4), float(r), np.float32) for r in range(size)])
+        x = jax.device_put(
+            jnp.asarray(per_rank, dtype=(
+                jnp.bfloat16 if dtype == "bfloat16" else dtype)),
+            NamedSharding(hvd.mesh(), P("dp")))
+        out = hvd.broadcast(x, root_rank=root)
+        assert np.allclose(np.asarray(out, np.float64), float(root))
+
+    def test_broadcast_replicated_identity(self):
+        x = jnp.arange(10.0)
+        out = hvd.broadcast(x, root_rank=2)
+        assert np.allclose(np.asarray(out), np.asarray(x))
+
+
+class TestStateSync:
+    def test_broadcast_parameters_tree(self):
+        params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,)),
+                  "nested": {"x": jnp.full((2,), 7.0)}}
+        synced = hvd.broadcast_parameters(params, root_rank=0)
+        assert set(synced) == set(params)
+        for k in ("w", "b"):
+            assert np.allclose(np.asarray(synced[k]), np.asarray(params[k]))
+        assert np.allclose(np.asarray(synced["nested"]["x"]), 7.0)
+
+    def test_broadcast_optimizer_state(self):
+        import optax
+        opt = optax.adam(1e-3)
+        params = {"w": jnp.ones((3,))}
+        state = opt.init(params)
+        synced = hvd.broadcast_optimizer_state(state, root_rank=0)
+        l1 = jax.tree_util.tree_leaves(state)
+        l2 = jax.tree_util.tree_leaves(synced)
+        assert len(l1) == len(l2)
+        for a, b in zip(l1, l2):
+            assert np.allclose(np.asarray(a, np.float64),
+                               np.asarray(b, np.float64))
+
+    def test_broadcast_object(self):
+        obj = {"lr": 0.1, "sched": [1, 2, 3]}
+        out = hvd.broadcast_object(obj, root_rank=0)
+        assert out == obj
+
+
+class TestHierarchical:
+    def test_hierarchical_allreduce_matches_flat(self):
+        """psum_scatter('ici') + psum('dcn') + all_gather('ici') must equal
+        the flat psum (operations.cc:1284-1436 parity)."""
+        from horovod_tpu.executor import CollectiveExecutor
+        import jax.numpy as jnp
+        ex = CollectiveExecutor(hierarchical_allreduce=True)
+        x = jnp.arange(37.0, dtype=jnp.float32)  # odd length → padding path
+        (out,) = ex.allreduce_fused([x])
+        assert np.allclose(np.asarray(out), np.asarray(x) * hvd.size())
+
+    def test_sharded_prescale(self):
+        size = hvd.size()
+        x = np.ones((size, 4), np.float32)
+        xs = jax.device_put(x, NamedSharding(hvd.mesh(), P("dp")))
+        out = hvd.allreduce(xs, average=False, prescale_factor=2.0)
+        assert np.allclose(np.asarray(out), 2.0 * size)
+
+    def test_non_leading_axis_sharding_rejected(self):
+        size = hvd.size()
+        x = np.arange(size * size, dtype=np.float32).reshape(size, size)
+        xs = jax.device_put(x, NamedSharding(hvd.mesh(), P(None, "dp")))
+        with pytest.raises(ValueError, match="LEADING"):
+            hvd.allreduce(xs, average=False)

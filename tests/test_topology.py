@@ -1,0 +1,56 @@
+"""Topology/init tests — parity with the reference's rank/size assertions
+(test/test_tensorflow.py:44-57 ``test_horovod_rank``/``test_horovod_size``
+against the launcher env)."""
+
+import jax
+import pytest
+
+import horovod_tpu as hvd
+
+
+def test_size_matches_devices():
+    assert hvd.size() == len(jax.devices()) == 8
+
+
+def test_local_size_single_process():
+    assert hvd.local_size() == 8
+    assert hvd.process_count() == 1
+    assert hvd.process_rank() == 0
+
+
+def test_rank_is_leader_device():
+    assert hvd.rank() == 0
+    assert hvd.local_rank() == 0
+
+
+def test_mesh_axes():
+    m = hvd.mesh()
+    assert m.axis_names == ("dp",)
+    assert m.devices.size == 8
+    hm = hvd.hierarchical_mesh()
+    assert hm.axis_names == ("dcn", "ici")
+    assert hm.devices.size == 8
+
+
+def test_mpi_threads_supported():
+    assert hvd.mpi_threads_supported() is True
+
+
+def test_uninitialized_raises():
+    # A pristine module must raise before init (common/__init__.py:90-154).
+    import horovod_tpu.topology as topo
+    saved = topo._topology
+    topo._topology = None
+    try:
+        with pytest.raises(hvd.NotInitializedError):
+            hvd.rank()
+        with pytest.raises(hvd.NotInitializedError):
+            hvd.size()
+    finally:
+        topo._topology = saved
+
+
+def test_init_idempotent():
+    t1 = hvd.init()
+    t2 = hvd.init()
+    assert t1 is t2
