@@ -148,10 +148,16 @@ class CollectiveEngine:
         # no MPI round-trip to amortize on the single-controller path.
         self.fusion_threshold = _env.fusion_threshold_bytes()
         self.cycle_time_s = _env.cycle_time_ms() / 1000.0
-        self.timeline = None          # attached by horovod_tpu.timeline
+        self.timeline = None          # Python-mode timeline (fallback path)
         self.stall_warning_s = _env.stall_warning_secs()
         self._last_stall_check = time.monotonic()
-        self._native = None           # native control plane, attached later
+        # Native control plane (C++ core, runtime/src/core.cc). When it
+        # loads, the background cycle / tensor table / fusion planning /
+        # timeline / stall check / autotune all run natively and this class
+        # only executes the planned groups as XLA programs.
+        self._native_core = None
+        self._native_tried = False
+        self._native_pending: Dict[int, _Request] = {}
 
     # ------------------------------------------------------------- lifecycle
 
@@ -170,9 +176,48 @@ class CollectiveEngine:
                     daemon=True)
                 self._thread.start()
 
+    def _ensure_native(self):
+        """Load + initialize the native control plane once (equivalent of
+        InitializeHorovodOnce spawning the C++ background thread,
+        operations.cc:2384-2402). Falls back to the Python control plane
+        when the toolchain is unavailable or it is disabled via
+        HOROVOD_TPU_DISABLE_NATIVE=1."""
+        if self._native_tried:
+            return self._native_core
+        self._native_tried = True
+        if os.environ.get("HOROVOD_TPU_DISABLE_NATIVE") == "1":
+            return None
+        try:
+            from ..runtime import native as _native_mod
+            core = _native_mod.load()
+            if core is None:
+                return None
+            topo = _topo._get()
+            core.init(topo.process_index, topo.process_count,
+                      topo.local_size, topo.size)
+            core.set_execute_callback(self._on_native_execute)
+            self._native_core = core
+        except Exception as e:  # pragma: no cover - degraded path
+            _log.warning("native control plane init failed: %s", e)
+            self._native_core = None
+        return self._native_core
+
     def shutdown(self):
         """Drain and stop; outstanding handles get SHUT_DOWN_ERROR
         (operations.cc:1942-1998)."""
+        core = self._native_core
+        if core is not None:
+            # Native path: the C++ shutdown drains its queue (the execute
+            # callback keeps firing until empty), then joins the background
+            # thread and flushes the timeline.
+            core.shutdown()
+            self._native_core = None  # _native_tried stays True: terminal
+            with self._lock:
+                native_pending = list(self._native_pending.values())
+                self._native_pending.clear()
+            for req in native_pending:
+                req.handle._fulfill(error=HorovodInternalError(
+                    SHUT_DOWN_ERROR.format(op=_op_name(req.op))))
         with self._lock:
             self._shutdown = True
             pending = list(self._queue) + list(self._in_flight.values())
@@ -195,6 +240,14 @@ class CollectiveEngine:
             return f"{prefix}.noname.{self._name_counter}"
 
     def enqueue(self, req: _Request) -> Handle:
+        if self._shutdown:
+            # Terminal for this engine instance (operations.cc:2374-2377);
+            # tests use reset_engine() to get a fresh one.
+            raise HorovodInternalError(
+                SHUT_DOWN_ERROR.format(op=_op_name(req.op)))
+        core = self._ensure_native()
+        if core is not None:
+            return self._enqueue_native(core, req)
         with self._lock:
             if self._shutdown:
                 raise HorovodInternalError(
@@ -209,6 +262,78 @@ class CollectiveEngine:
         self._ensure_thread()
         self._wake.set()
         return req.handle
+
+    # ----------------------------------------------------- native delegation
+
+    def _enqueue_native(self, core, req: _Request) -> Handle:
+        """EnqueueTensor* through the C++ tensor table
+        (operations.cc:2472-2591)."""
+        t = req.tensor if req.tensor is not None else req.per_rank[0]
+        shape = list(t.shape)
+        dtype = str(t.dtype)
+        # Hold the engine lock across enqueue + registration: the native
+        # cycle can fire the execute callback for this id before we return,
+        # and the callback takes the same lock to pop the request — so it
+        # blocks until registration is visible rather than dropping the op.
+        with self._lock:
+            native_id = core.enqueue(req.op, req.name, dtype, shape,
+                                     root_rank=req.root_rank, device=-1,
+                                     nbytes=req.nbytes)
+            if native_id == -1:
+                raise ValueError(DUPLICATE_NAME_ERROR.format(
+                    op=_op_name(req.op)))
+            if native_id == -2:
+                raise HorovodInternalError(
+                    SHUT_DOWN_ERROR.format(op=_op_name(req.op)))
+            self._native_pending[native_id] = req
+        return req.handle
+
+    def _on_native_execute(self, op: int, native_ids: List[int], err: str):
+        """Execute callback from the native background thread: the group was
+        negotiated + fusion-planned in C++ (the PerformOperation dispatch
+        point, operations.cc:768-791); run it as XLA programs."""
+        core = self._native_core
+        with self._lock:
+            pairs = [(i, self._native_pending.pop(i))
+                     for i in native_ids if i in self._native_pending]
+        if not pairs:
+            return
+        if err:
+            core.complete([i for i, _ in pairs], 2, err)
+            for i, r in pairs:
+                core.release(i)
+                r.handle._fulfill(error=HorovodInternalError(err))
+            return
+        # The native planner fuses on (op, dtype, bytes); execution-semantic
+        # knobs the planner doesn't track (sharded-ness, averaging, scaling,
+        # ragged gathers) subdivide the group here.
+        subgroups: Dict[tuple, List] = {}
+        for i, r in pairs:
+            k = (r.sharded, r.average, r.prescale, r.postscale,
+                 r.per_rank is None, r.root_rank)
+            subgroups.setdefault(k, []).append((i, r))
+        ex = self.executor
+        tl = core.timeline_enabled()
+        for sub in subgroups.values():
+            ids = [i for i, _ in sub]
+            reqs = [r for _, r in sub]
+            if tl:
+                for r in reqs:
+                    core.timeline_activity_end(r.name)       # close QUEUE
+                    core.timeline_activity_start(r.name, _xla_activity(op))
+            try:
+                results = self._execute_group(ex, reqs)
+            except BaseException as e:
+                msg = str(e)
+                core.complete(ids, 2, msg)
+                for (i, r) in sub:
+                    core.release(i)
+                    r.handle._fulfill(error=_as_error(e))
+                continue
+            core.complete(ids, 0, "")
+            for (i, r), out in zip(sub, results):
+                core.release(i)
+                r.handle._fulfill(result=out)
 
     def make_handle(self, name: str) -> Handle:
         with self._lock:
@@ -268,8 +393,6 @@ class CollectiveEngine:
         look-ahead over `skipped` responses). Delegates to the native
         planner when attached.
         """
-        if self._native is not None:
-            return self._native.plan(batch, self.fusion_threshold)
         groups: List[List[_Request]] = []
         remaining = list(batch)
         while remaining:

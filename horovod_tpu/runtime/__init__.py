@@ -1,0 +1,9 @@
+"""Native runtime package: C++ control-plane core + ctypes binding.
+
+See ``src/`` for the C++ sources (equivalents of reference components
+N1-N10, SURVEY.md §2.1) and :mod:`native` for the Python binding.
+"""
+
+from .native import NativeCore, load
+
+__all__ = ["NativeCore", "load"]

@@ -1,0 +1,69 @@
+"""Native runtime build — the role of the reference's probing setup.py
+(setup.py:294-553), reduced to what the TPU path needs: a plain g++ shared
+object with no MPI/CUDA/NCCL discovery (XLA is the data plane). Invoked
+lazily on first import and cached by source mtime.
+
+Usage: ``python -m horovod_tpu.runtime.build [--force]``
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
+_OUT = os.path.join(os.path.dirname(__file__), "libhorovod_tpu_core.so")
+
+SOURCES = [
+    "message.cc",
+    "coordinator.cc",
+    "fusion_buffer.cc",
+    "logging.cc",
+    "half.cc",
+    "timeline.cc",
+    "gaussian_process.cc",
+    "bayesian_optimization.cc",
+    "parameter_manager.cc",
+    "core.cc",
+]
+
+
+def _stale() -> bool:
+    if not os.path.exists(_OUT):
+        return True
+    out_mtime = os.path.getmtime(_OUT)
+    for fn in os.listdir(_SRC_DIR):
+        if fn.endswith((".cc", ".h")):
+            if os.path.getmtime(os.path.join(_SRC_DIR, fn)) > out_mtime:
+                return True
+    return False
+
+
+def build(force: bool = False, verbose: bool = False) -> str:
+    """Compile the native core if missing/stale; returns the .so path."""
+    if not force and not _stale():
+        return _OUT
+    srcs = [os.path.join(_SRC_DIR, s) for s in SOURCES]
+    cmd = [
+        "g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
+        "-Wall", "-Wno-unused-function",
+        # Version script exports only the hvdtpu_* C API — the role of
+        # horovod.lds (reference N15): internal symbols stay local so the
+        # .so coexists with other native extensions.
+        f"-Wl,--version-script={os.path.join(_SRC_DIR, 'core.lds')}",
+        "-o", _OUT, *srcs,
+    ]
+    if verbose:
+        print(" ".join(cmd), file=sys.stderr)
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native core build failed:\n{proc.stderr[-4000:]}")
+    return _OUT
+
+
+if __name__ == "__main__":
+    force = "--force" in sys.argv
+    path = build(force=force, verbose=True)
+    print(path)

@@ -1,0 +1,281 @@
+"""ctypes binding to the native runtime core — the equivalent of the
+reference's ``HorovodBasics`` ctypes loader (horovod/common/__init__.py:
+23-154), which loads the framework .so and exposes the C init/rank API.
+
+Loads (building on demand) ``libhorovod_tpu_core.so`` and exposes a typed
+wrapper. The native core owns the background cycle, tensor table, fusion
+planning, timeline, stall detection and autotuner; Python registers an
+execute callback that runs the planned groups as XLA programs.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from ..utils.logging import get_logger
+
+_log = get_logger("native")
+
+# Wire dtype enum — runtime/src/common.h DataType.
+DTYPE_TO_ENUM = {
+    np.dtype(np.uint8): 0,
+    np.dtype(np.int8): 1,
+    np.dtype(np.uint16): 2,
+    np.dtype(np.int16): 3,
+    np.dtype(np.int32): 4,
+    np.dtype(np.int64): 5,
+    np.dtype(np.float16): 6,
+    np.dtype(np.float32): 7,
+    np.dtype(np.float64): 8,
+    np.dtype(bool): 9,
+}
+BFLOAT16_ENUM = 10
+
+EXECUTE_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_int32,
+                              ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+                              ctypes.c_char_p)
+
+
+class NativeCore:
+    """Typed wrapper over the hvdtpu_* C API."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        self._cb_ref = None  # keep callback alive (ctypes requirement)
+        self._configure()
+
+    def _configure(self):
+        lib = self._lib
+        lib.hvdtpu_init.argtypes = [ctypes.c_int] * 4
+        lib.hvdtpu_init.restype = ctypes.c_int
+        lib.hvdtpu_initialized.restype = ctypes.c_int
+        lib.hvdtpu_shutdown.restype = None
+        lib.hvdtpu_enqueue.argtypes = [
+            ctypes.c_int32, ctypes.c_char_p, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int64]
+        lib.hvdtpu_enqueue.restype = ctypes.c_int64
+        lib.hvdtpu_complete.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_char_p]
+        lib.hvdtpu_poll.argtypes = [ctypes.c_int64]
+        lib.hvdtpu_poll.restype = ctypes.c_int32
+        lib.hvdtpu_release_handle.argtypes = [ctypes.c_int64]
+        lib.hvdtpu_set_execute_callback.argtypes = [EXECUTE_CB,
+                                                    ctypes.c_void_p]
+        lib.hvdtpu_set_fusion_threshold.argtypes = [ctypes.c_int64]
+        lib.hvdtpu_get_fusion_threshold.restype = ctypes.c_int64
+        lib.hvdtpu_set_cycle_time_ms.argtypes = [ctypes.c_double]
+        lib.hvdtpu_get_cycle_time_ms.restype = ctypes.c_double
+        lib.hvdtpu_timeline_activity_start.argtypes = [ctypes.c_char_p,
+                                                       ctypes.c_char_p]
+        lib.hvdtpu_timeline_activity_end.argtypes = [ctypes.c_char_p]
+        lib.hvdtpu_timeline_enabled.restype = ctypes.c_int
+        lib.hvdtpu_autotune_active.restype = ctypes.c_int
+        lib.hvdtpu_wire_make_request.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_char_p,
+            ctypes.c_int32, ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
+        lib.hvdtpu_wire_make_request.restype = ctypes.c_int64
+        lib.hvdtpu_wire_roundtrip_request_list.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
+        lib.hvdtpu_wire_roundtrip_request_list.restype = ctypes.c_int64
+        lib.hvdtpu_negotiate.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32]
+        lib.hvdtpu_negotiate.restype = ctypes.c_int32
+        for name in ("hvdtpu_half_to_float", "hvdtpu_float_to_half",
+                     "hvdtpu_halfsum", "hvdtpu_bf16sum"):
+            getattr(lib, name).restype = None
+        lib.hvdtpu_half_to_float.argtypes = [
+            ctypes.POINTER(ctypes.c_uint16), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64]
+        lib.hvdtpu_float_to_half.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_uint16),
+            ctypes.c_int64]
+        lib.hvdtpu_halfsum.argtypes = [
+            ctypes.POINTER(ctypes.c_uint16), ctypes.POINTER(ctypes.c_uint16),
+            ctypes.c_int64]
+        lib.hvdtpu_bf16sum.argtypes = lib.hvdtpu_halfsum.argtypes
+
+    # ------------------------------------------------------------------ api
+
+    def init(self, rank: int, size: int, local_size: int,
+             virtual_size: int = 0) -> None:
+        self._lib.hvdtpu_init(rank, size, local_size, virtual_size)
+
+    def initialized(self) -> bool:
+        return bool(self._lib.hvdtpu_initialized())
+
+    def shutdown(self) -> None:
+        self._lib.hvdtpu_shutdown()
+        self._cb_ref = None
+
+    def set_execute_callback(
+            self, fn: Callable[[int, list, str], None]) -> None:
+        """``fn(op, handle_ids, error_message)`` — called from the native
+        background thread (ctypes re-acquires the GIL)."""
+
+        @EXECUTE_CB
+        def trampoline(_user, op, handles_ptr, count, err):
+            ids = [handles_ptr[i] for i in range(count)]
+            try:
+                fn(int(op), ids, err.decode() if err else "")
+            except BaseException as e:  # never let exceptions cross into C
+                _log.error("execute callback raised: %s", e)
+
+        self._cb_ref = trampoline
+        self._lib.hvdtpu_set_execute_callback(trampoline, None)
+
+    def enqueue(self, op: int, name: str, dtype, shape: Sequence[int],
+                root_rank: int = -1, device: int = -1,
+                nbytes: int = 0) -> int:
+        if str(dtype) == "bfloat16":
+            enum = BFLOAT16_ENUM
+        else:
+            enum = DTYPE_TO_ENUM[np.dtype(dtype)]
+        arr = (ctypes.c_int64 * max(len(shape), 1))(*shape)
+        return int(self._lib.hvdtpu_enqueue(
+            op, name.encode(), enum, arr, len(shape), root_rank, device,
+            nbytes))
+
+    def complete(self, handles: Sequence[int], status: int = 0,
+                 reason: str = "") -> None:
+        arr = (ctypes.c_int64 * max(len(handles), 1))(*handles)
+        self._lib.hvdtpu_complete(arr, len(handles), status, reason.encode())
+
+    def poll(self, handle: int) -> int:
+        return int(self._lib.hvdtpu_poll(handle))
+
+    def release(self, handle: int) -> None:
+        self._lib.hvdtpu_release_handle(handle)
+
+    # knobs ----------------------------------------------------------------
+
+    @property
+    def fusion_threshold(self) -> int:
+        return int(self._lib.hvdtpu_get_fusion_threshold())
+
+    @fusion_threshold.setter
+    def fusion_threshold(self, v: int) -> None:
+        self._lib.hvdtpu_set_fusion_threshold(v)
+
+    @property
+    def cycle_time_ms(self) -> float:
+        return float(self._lib.hvdtpu_get_cycle_time_ms())
+
+    @cycle_time_ms.setter
+    def cycle_time_ms(self, v: float) -> None:
+        self._lib.hvdtpu_set_cycle_time_ms(v)
+
+    # timeline -------------------------------------------------------------
+
+    def timeline_enabled(self) -> bool:
+        return bool(self._lib.hvdtpu_timeline_enabled())
+
+    def timeline_activity_start(self, tensor: str, activity: str) -> None:
+        self._lib.hvdtpu_timeline_activity_start(tensor.encode(),
+                                                 activity.encode())
+
+    def timeline_activity_end(self, tensor: str) -> None:
+        self._lib.hvdtpu_timeline_activity_end(tensor.encode())
+
+    def autotune_active(self) -> bool:
+        return bool(self._lib.hvdtpu_autotune_active())
+
+    # wire/test surface ----------------------------------------------------
+
+    def wire_make_request(self, rank: int, op: int, dtype_enum: int,
+                          name: str, root_rank: int, device: int,
+                          shape: Sequence[int]) -> bytes:
+        cap = 1024 + len(name)
+        buf = (ctypes.c_uint8 * cap)()
+        arr = (ctypes.c_int64 * max(len(shape), 1))(*shape)
+        n = self._lib.hvdtpu_wire_make_request(
+            rank, op, dtype_enum, name.encode(), root_rank, device, arr,
+            len(shape), buf, cap)
+        if n < 0:
+            raise RuntimeError("wire_make_request failed")
+        return bytes(buf[:n])
+
+    def wire_roundtrip_request_list(self, payload: bytes) -> bytes:
+        src = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+        cap = len(payload) + 64
+        dst = (ctypes.c_uint8 * cap)()
+        n = self._lib.hvdtpu_wire_roundtrip_request_list(
+            src, len(payload), dst, cap)
+        if n < 0:
+            raise RuntimeError("request list did not round-trip")
+        return bytes(dst[:n])
+
+    def negotiate(self, serialized_requests: bytes, nreq: int,
+                  world_size: int):
+        """Run ConstructResponse over serialized requests; returns
+        (response_type, error_message, tensor_sizes)."""
+        src = (ctypes.c_uint8 * len(serialized_requests)).from_buffer_copy(
+            serialized_requests)
+        err = ctypes.create_string_buffer(2048)
+        sizes = (ctypes.c_int64 * world_size)()
+        rtype = self._lib.hvdtpu_negotiate(
+            src, len(serialized_requests), nreq, world_size, err, 2048,
+            sizes, world_size)
+        return int(rtype), err.value.decode(), list(sizes)
+
+    # half -----------------------------------------------------------------
+
+    def half_to_float(self, bits: np.ndarray) -> np.ndarray:
+        bits = np.ascontiguousarray(bits, dtype=np.uint16)
+        out = np.empty(bits.shape, np.float32)
+        self._lib.hvdtpu_half_to_float(
+            bits.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), bits.size)
+        return out
+
+    def float_to_half(self, vals: np.ndarray) -> np.ndarray:
+        vals = np.ascontiguousarray(vals, dtype=np.float32)
+        out = np.empty(vals.shape, np.uint16)
+        self._lib.hvdtpu_float_to_half(
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)), vals.size)
+        return out
+
+    def halfsum(self, src_bits: np.ndarray, dst_bits: np.ndarray) -> None:
+        self._lib.hvdtpu_halfsum(
+            src_bits.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+            dst_bits.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+            src_bits.size)
+
+
+_core: Optional[NativeCore] = None
+_load_failed = False
+_lock = threading.Lock()
+
+
+def load(required: bool = False) -> Optional[NativeCore]:
+    """Load (building if needed) the native core; returns None when the
+    toolchain is unavailable unless ``required``."""
+    global _core, _load_failed
+    with _lock:
+        if _core is not None:
+            return _core
+        if _load_failed and not required:
+            return None
+        try:
+            from . import build as _build
+            path = _build.build()
+            _core = NativeCore(ctypes.CDLL(path))
+            return _core
+        except Exception as e:
+            _load_failed = True
+            if required:
+                raise
+            _log.warning("native core unavailable, using Python control "
+                         "plane: %s", e)
+            return None

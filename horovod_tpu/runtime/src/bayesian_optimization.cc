@@ -1,0 +1,101 @@
+#include "bayesian_optimization.h"
+
+#include <algorithm>
+#include <cmath>
+
+namespace hvdtpu {
+
+namespace {
+// Standard normal pdf/cdf for EI.
+double Pdf(double z) { return std::exp(-0.5 * z * z) / std::sqrt(2 * M_PI); }
+double Cdf(double z) { return 0.5 * std::erfc(-z / std::sqrt(2.0)); }
+}  // namespace
+
+std::vector<double> BayesianOptimization::Normalize(
+    const std::vector<double>& x) const {
+  std::vector<double> out(x.size());
+  for (size_t i = 0; i < x.size(); ++i) {
+    double lo = bounds_[i].first, hi = bounds_[i].second;
+    out[i] = hi > lo ? (x[i] - lo) / (hi - lo) : 0.0;
+  }
+  return out;
+}
+
+std::vector<double> BayesianOptimization::Denormalize(
+    const std::vector<double>& x) const {
+  std::vector<double> out(x.size());
+  for (size_t i = 0; i < x.size(); ++i) {
+    double lo = bounds_[i].first, hi = bounds_[i].second;
+    out[i] = lo + x[i] * (hi - lo);
+  }
+  return out;
+}
+
+void BayesianOptimization::AddSample(const std::vector<double>& x, double y) {
+  raw_xs_.push_back(x);
+  raw_ys_.push_back(y);
+  gp_.AddSample(Normalize(x), y);
+  gp_.Fit();
+}
+
+double BayesianOptimization::ExpectedImprovement(
+    const std::vector<double>& xn) const {
+  // EI(x) = (mu - best - xi) Phi(z) + sigma phi(z)
+  // (reference bayesian_optimization.cc ExpectedImprovement).
+  double mu, var;
+  gp_.Predict(xn, &mu, &var);
+  double sigma = std::sqrt(var);
+  double best = gp_.best_y();
+  double imp = mu - best - xi_;
+  if (sigma < 1e-12) return std::max(imp, 0.0);
+  double z = imp / sigma;
+  return imp * Cdf(z) + sigma * Pdf(z);
+}
+
+std::vector<double> BayesianOptimization::NextSample() {
+  size_t d = bounds_.size();
+  if (gp_.num_samples() == 0) {
+    // No data: center of the space.
+    std::vector<double> mid(d, 0.5);
+    return Denormalize(mid);
+  }
+  std::uniform_real_distribution<double> u(0.0, 1.0);
+  std::vector<double> best_x(d, 0.5);
+  double best_ei = -1.0;
+  // Random restarts (reference uses n_iter random restarts + L-BFGS).
+  for (int it = 0; it < 512; ++it) {
+    std::vector<double> x(d);
+    for (size_t i = 0; i < d; ++i) x[i] = u(rng_);
+    double ei = ExpectedImprovement(x);
+    if (ei > best_ei) {
+      best_ei = ei;
+      best_x = x;
+    }
+  }
+  // Local coordinate refinement around the incumbent.
+  double step = 0.05;
+  for (int round = 0; round < 3; ++round, step *= 0.5) {
+    for (size_t i = 0; i < d; ++i) {
+      for (double delta : {-step, step}) {
+        std::vector<double> x = best_x;
+        x[i] = std::min(1.0, std::max(0.0, x[i] + delta));
+        double ei = ExpectedImprovement(x);
+        if (ei > best_ei) {
+          best_ei = ei;
+          best_x = x;
+        }
+      }
+    }
+  }
+  return Denormalize(best_x);
+}
+
+std::vector<double> BayesianOptimization::BestSample() const {
+  if (raw_ys_.empty()) return {};
+  size_t bi = 0;
+  for (size_t i = 1; i < raw_ys_.size(); ++i)
+    if (raw_ys_[i] > raw_ys_[bi]) bi = i;
+  return raw_xs_[bi];
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,53 @@
+// Bayesian optimization — equivalent of
+// horovod/common/optim/bayesian_optimization.{h,cc} (N6): expected-
+// improvement acquisition over a GP surrogate with random restarts
+// (bayesian_optimization.h:45-110). The reference refines EI maxima with
+// L-BFGS; here EI is maximized by dense random sampling plus local
+// coordinate refinement — equivalent behavior for the 2-D (fusion MB,
+// cycle ms) space.
+#ifndef HVD_TPU_BAYESIAN_OPTIMIZATION_H
+#define HVD_TPU_BAYESIAN_OPTIMIZATION_H
+
+#include <cstdint>
+#include <random>
+#include <utility>
+#include <vector>
+
+#include "gaussian_process.h"
+
+namespace hvdtpu {
+
+class BayesianOptimization {
+ public:
+  // bounds: per-dimension [lo, hi].
+  explicit BayesianOptimization(
+      std::vector<std::pair<double, double>> bounds, double xi = 0.01,
+      uint64_t seed = 42)
+      : bounds_(std::move(bounds)), xi_(xi), rng_(seed) {}
+
+  void AddSample(const std::vector<double>& x, double y);
+
+  // Next point to try: argmax of expected improvement.
+  std::vector<double> NextSample();
+
+  // Best observed point so far.
+  std::vector<double> BestSample() const;
+
+  size_t num_samples() const { return gp_.num_samples(); }
+
+ private:
+  std::vector<double> Normalize(const std::vector<double>& x) const;
+  std::vector<double> Denormalize(const std::vector<double>& x) const;
+  double ExpectedImprovement(const std::vector<double>& xn) const;
+
+  std::vector<std::pair<double, double>> bounds_;
+  double xi_;
+  std::mt19937_64 rng_;
+  GaussianProcess gp_;
+  std::vector<std::vector<double>> raw_xs_;
+  std::vector<double> raw_ys_;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_BAYESIAN_OPTIMIZATION_H

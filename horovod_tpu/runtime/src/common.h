@@ -1,0 +1,103 @@
+// Core types — TPU-native equivalent of horovod/common/common.h (N1).
+//
+// The reference defines Status (common.h:33-53), TensorShape (55-75) and the
+// framework-adapter interfaces (Tensor/OpContext/PersistentBuffer/ReadyEvent,
+// 77-110). On the TPU rebuild the framework adapters collapse into JAX
+// arrays, so the native core keeps Status/TensorShape/DataType and drops the
+// per-framework ABI bridge; device readiness is XLA program order.
+#ifndef HVD_TPU_COMMON_H
+#define HVD_TPU_COMMON_H
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+// Mirrors StatusType (reference common.h:33-38).
+enum class StatusType : int32_t {
+  OK = 0,
+  UNKNOWN_ERROR = 1,
+  PRECONDITION_ERROR = 2,
+  ABORTED = 3,
+  INVALID_ARGUMENT = 4,
+};
+
+class Status {
+ public:
+  Status() = default;
+  static Status OK() { return Status(); }
+  static Status UnknownError(std::string msg) {
+    return Status(StatusType::UNKNOWN_ERROR, std::move(msg));
+  }
+  static Status PreconditionError(std::string msg) {
+    return Status(StatusType::PRECONDITION_ERROR, std::move(msg));
+  }
+  static Status Aborted(std::string msg) {
+    return Status(StatusType::ABORTED, std::move(msg));
+  }
+  static Status InvalidArgument(std::string msg) {
+    return Status(StatusType::INVALID_ARGUMENT, std::move(msg));
+  }
+
+  bool ok() const { return type_ == StatusType::OK; }
+  StatusType type() const { return type_; }
+  const std::string& reason() const { return reason_; }
+
+ private:
+  Status(StatusType type, std::string reason)
+      : type_(type), reason_(std::move(reason)) {}
+  StatusType type_ = StatusType::OK;
+  std::string reason_;
+};
+
+// Wire dtypes — reference mpi_message.h:26-37 (10 dtypes) plus BFLOAT16,
+// the TPU-native 16-bit float.
+enum class DataType : int32_t {
+  HVD_UINT8 = 0,
+  HVD_INT8 = 1,
+  HVD_UINT16 = 2,
+  HVD_INT16 = 3,
+  HVD_INT32 = 4,
+  HVD_INT64 = 5,
+  HVD_FLOAT16 = 6,
+  HVD_FLOAT32 = 7,
+  HVD_FLOAT64 = 8,
+  HVD_BOOL = 9,
+  HVD_BFLOAT16 = 10,
+};
+
+const char* DataTypeName(DataType t);
+int64_t DataTypeSize(DataType t);
+
+// Mirrors TensorShape (reference common.h:55-75).
+class TensorShape {
+ public:
+  TensorShape() = default;
+  explicit TensorShape(std::vector<int64_t> dims) : dims_(std::move(dims)) {}
+  void AddDim(int64_t d) { dims_.push_back(d); }
+  int ndims() const { return static_cast<int>(dims_.size()); }
+  int64_t dim_size(int i) const { return dims_[i]; }
+  const std::vector<int64_t>& dims() const { return dims_; }
+  int64_t num_elements() const {
+    int64_t n = 1;
+    for (auto d : dims_) n *= d;
+    return n;
+  }
+  std::string DebugString() const;
+  bool operator==(const TensorShape& o) const { return dims_ == o.dims_; }
+  bool operator!=(const TensorShape& o) const { return dims_ != o.dims_; }
+
+ private:
+  std::vector<int64_t> dims_;
+};
+
+// "Device" for fusion-buffer keying. On TPU every eager tensor stages
+// through host memory before device_put; we keep the reference's convention
+// of CPU_DEVICE_ID = -1 (common.h:28) with non-negative ids meaning a local
+// chip ordinal.
+constexpr int CPU_DEVICE_ID = -1;
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_COMMON_H

@@ -1,0 +1,265 @@
+// See coordinator.h. Citations refer to /root/reference paths.
+#include "coordinator.h"
+
+#include <algorithm>
+#include <sstream>
+
+namespace hvdtpu {
+
+bool MessageTable::Increment(const Request& msg, int size) {
+  auto it = table_.find(msg.tensor_name);
+  if (it == table_.end()) {
+    Entry e;
+    e.first_seen = Clock::now();
+    e.requests.push_back(msg);
+    table_.emplace(msg.tensor_name, std::move(e));
+    return size == 1;
+  }
+  it->second.requests.push_back(msg);
+  return static_cast<int>(it->second.requests.size()) == size;
+}
+
+std::vector<Request> MessageTable::Take(const std::string& name) {
+  auto it = table_.find(name);
+  if (it == table_.end()) return {};
+  auto reqs = std::move(it->second.requests);
+  table_.erase(it);
+  return reqs;
+}
+
+std::vector<std::string> MessageTable::StalledTensors(
+    int size, double warn_after) const {
+  std::vector<std::string> out;
+  auto now = Clock::now();
+  for (const auto& kv : table_) {
+    double age =
+        std::chrono::duration<double>(now - kv.second.first_seen).count();
+    if (age < warn_after) continue;
+    std::vector<bool> seen(size, false);
+    for (const auto& r : kv.second.requests)
+      if (r.request_rank >= 0 && r.request_rank < size)
+        seen[r.request_rank] = true;
+    std::ostringstream os;
+    os << kv.first << " [ready ranks:";
+    for (int i = 0; i < size; ++i)
+      if (seen[i]) os << " " << i;
+    os << "; missing ranks:";
+    for (int i = 0; i < size; ++i)
+      if (!seen[i]) os << " " << i;
+    os << "]";
+    out.push_back(os.str());
+  }
+  std::sort(out.begin(), out.end());
+  return out;
+}
+
+namespace {
+
+Response ErrorResponse(const std::string& name, const std::string& msg) {
+  Response r;
+  r.response_type = Response::ERROR;
+  r.tensor_names = {name};
+  r.error_message = msg;
+  return r;
+}
+
+}  // namespace
+
+Response ConstructResponse(const std::vector<Request>& requests, int size,
+                           int root_bound) {
+  if (root_bound < 0) root_bound = size;
+  // Mirrors ConstructMPIResponse (operations.cc:321-523): every check
+  // produces a response that names the offending ranks' values instead of
+  // letting the collective deadlock or crash.
+  if (requests.empty()) {
+    return ErrorResponse("", "No requests submitted for negotiation.");
+  }
+  const Request& first = requests[0];
+  const std::string& name = first.tensor_name;
+
+  if (static_cast<int>(requests.size()) != size) {
+    std::ostringstream os;
+    os << "Only " << requests.size() << " out of " << size
+       << " ranks submitted tensor " << name << ".";
+    return ErrorResponse(name, os.str());
+  }
+
+  // Rank sanity: request_rank may come off the wire — bound it before it is
+  // used as an index below.
+  for (const auto& r : requests) {
+    if (r.request_rank < 0 || r.request_rank >= size) {
+      std::ostringstream os;
+      os << "Invalid request rank " << r.request_rank << " for tensor "
+         << name << " (world size " << size << ").";
+      return ErrorResponse(name, os.str());
+    }
+  }
+
+  // Op type consistency (operations.cc:341-358).
+  for (const auto& r : requests) {
+    if (r.request_type != first.request_type) {
+      std::ostringstream os;
+      os << "Mismatched collective operations: One rank did an "
+         << RequestTypeName(first.request_type) << ", but another rank did an "
+         << RequestTypeName(r.request_type) << ".";
+      return ErrorResponse(name, os.str());
+    }
+  }
+
+  // Dtype consistency (operations.cc:360-376).
+  for (const auto& r : requests) {
+    if (r.tensor_type != first.tensor_type) {
+      std::ostringstream os;
+      os << "Mismatched data types: One rank had type "
+         << DataTypeName(first.tensor_type) << ", but another rank had type "
+         << DataTypeName(r.tensor_type) << ".";
+      return ErrorResponse(name, os.str());
+    }
+  }
+
+  if (first.request_type == Request::ALLREDUCE ||
+      first.request_type == Request::BROADCAST) {
+    // Full-shape consistency (operations.cc:378-396).
+    for (const auto& r : requests) {
+      if (r.tensor_shape != first.tensor_shape) {
+        std::ostringstream os;
+        os << "Mismatched " << RequestTypeName(first.request_type)
+           << " tensor shapes: One rank sent a tensor of shape "
+           << first.tensor_shape.DebugString()
+           << ", but another rank sent a tensor of shape "
+           << r.tensor_shape.DebugString() << ".";
+        return ErrorResponse(name, os.str());
+      }
+    }
+  }
+
+  std::vector<int64_t> tensor_sizes;
+  if (first.request_type == Request::ALLGATHER) {
+    // All dims but the first must match (operations.cc:398-446); collect
+    // per-rank first dims in rank order for the fused gather.
+    if (first.tensor_shape.ndims() == 0) {
+      return ErrorResponse(name, "Rank zero tried to gather a rank-zero "
+                                 "tensor.");
+    }
+    tensor_sizes.resize(size, 0);
+    for (const auto& r : requests) {
+      if (r.tensor_shape.ndims() != first.tensor_shape.ndims()) {
+        std::ostringstream os;
+        os << "Mismatched allgather tensor shapes: One rank sent a tensor "
+           << "of rank " << first.tensor_shape.ndims()
+           << ", but another rank sent a tensor of rank "
+           << r.tensor_shape.ndims() << ".";
+        return ErrorResponse(name, os.str());
+      }
+      for (int d = 1; d < first.tensor_shape.ndims(); ++d) {
+        if (r.tensor_shape.dim_size(d) != first.tensor_shape.dim_size(d)) {
+          std::ostringstream os;
+          os << "Mismatched allgather tensor shapes: One rank sent a tensor "
+             << "with dimension " << d << " equal to "
+             << first.tensor_shape.dim_size(d)
+             << ", but another rank sent a tensor with dimension " << d
+             << " equal to " << r.tensor_shape.dim_size(d) << ".";
+          return ErrorResponse(name, os.str());
+        }
+      }
+      tensor_sizes[r.request_rank] = r.tensor_shape.dim_size(0);
+    }
+  }
+
+  if (first.request_type == Request::BROADCAST) {
+    // Root rank consistency + validity (operations.cc:448-478).
+    for (const auto& r : requests) {
+      if (r.root_rank != first.root_rank) {
+        std::ostringstream os;
+        os << "Mismatched root ranks: One rank specified root rank "
+           << first.root_rank << ", but another rank specified root rank "
+           << r.root_rank << ".";
+        return ErrorResponse(name, os.str());
+      }
+    }
+    if (first.root_rank < 0 || first.root_rank >= root_bound) {
+      std::ostringstream os;
+      os << "Invalid root rank: " << first.root_rank
+         << " (world size " << root_bound << ").";
+      return ErrorResponse(name, os.str());
+    }
+  }
+
+  // Device consistency (operations.cc:480-497) — all ranks must be on the
+  // same kind of device; record per-rank devices in rank order.
+  std::vector<int32_t> devices(size, CPU_DEVICE_ID);
+  for (const auto& r : requests) devices[r.request_rank] = r.device;
+
+  Response resp;
+  switch (first.request_type) {
+    case Request::ALLREDUCE: resp.response_type = Response::ALLREDUCE; break;
+    case Request::ALLGATHER: resp.response_type = Response::ALLGATHER; break;
+    case Request::BROADCAST: resp.response_type = Response::BROADCAST; break;
+  }
+  resp.tensor_names = {name};
+  resp.devices = std::move(devices);
+  resp.tensor_sizes = std::move(tensor_sizes);
+  return resp;
+}
+
+std::vector<Response> FuseResponses(
+    std::deque<Response> responses,
+    const std::unordered_map<std::string, int64_t>& sizes_bytes,
+    const std::unordered_map<std::string, DataType>& dtypes,
+    int64_t threshold_bytes) {
+  // Mirrors the fusion loop (operations.cc:2149-2265): take the head
+  // response, then scan the remaining queue for joinable responses, keeping
+  // skipped ones (mixed-dtype look-ahead) in order for the next pass.
+  auto bytes_of = [&](const std::string& n) -> int64_t {
+    auto it = sizes_bytes.find(n);
+    return it == sizes_bytes.end() ? 0 : it->second;
+  };
+  auto dtype_of = [&](const std::string& n) -> DataType {
+    auto it = dtypes.find(n);
+    return it == dtypes.end() ? DataType::HVD_FLOAT32 : it->second;
+  };
+
+  std::vector<Response> out;
+  while (!responses.empty()) {
+    Response head = std::move(responses.front());
+    responses.pop_front();
+    if (head.response_type == Response::ERROR) {
+      out.push_back(std::move(head));
+      continue;
+    }
+    int64_t total = bytes_of(head.tensor_names[0]);
+    DataType head_dtype = dtype_of(head.tensor_names[0]);
+
+    std::deque<Response> skipped;
+    while (!responses.empty()) {
+      Response cand = std::move(responses.front());
+      responses.pop_front();
+      bool joinable =
+          cand.response_type == head.response_type &&
+          cand.response_type != Response::ERROR &&
+          dtype_of(cand.tensor_names[0]) == head_dtype &&
+          cand.devices == head.devices &&
+          total + bytes_of(cand.tensor_names[0]) <= threshold_bytes;
+      // Allgather fusion additionally requires matching trailing dims; the
+      // executor re-checks, so here we fuse allgathers only when both have
+      // per-rank sizes recorded (same-shape classes are the common case in
+      // the reference too, operations.cc:2183-2215).
+      if (joinable && cand.response_type == Response::ALLGATHER) {
+        joinable = cand.tensor_sizes.size() == head.tensor_sizes.size();
+      }
+      if (joinable) {
+        total += bytes_of(cand.tensor_names[0]);
+        for (auto& n : cand.tensor_names)
+          head.tensor_names.push_back(std::move(n));
+        for (auto s : cand.tensor_sizes) head.tensor_sizes.push_back(s);
+      } else {
+        skipped.push_back(std::move(cand));
+      }
+    }
+    responses = std::move(skipped);
+    out.push_back(std::move(head));
+  }
+  return out;
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,83 @@
+// Coordinator / negotiation logic — TPU-native equivalent of the
+// coordinator half of horovod/common/operations.cc (N3):
+//   - MessageTable + IncrementTensorCount (operations.cc:287-313)
+//   - ConstructResponse validation with rich mismatch diagnostics
+//     (operations.cc:321-523)
+//   - fusion assembly with look-ahead over skipped responses
+//     (operations.cc:2149-2265)
+//   - stall detection (CheckForStalledTensors, operations.cc:1625-1672)
+//
+// Under XLA's SPMD model a *jitted* collective needs no negotiation (all
+// ranks run one program). Negotiation still matters for the eager path
+// across host processes: frameworks enqueue tensors in nondeterministic
+// order, and a tensor may only be executed once EVERY process has announced
+// it. The coordinator keeps the reference's rank-0 gather/verdict/broadcast
+// design, riding the runner's TCP rendezvous instead of MPI.
+#ifndef HVD_TPU_COORDINATOR_H
+#define HVD_TPU_COORDINATOR_H
+
+#include <chrono>
+#include <deque>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "message.h"
+
+namespace hvdtpu {
+
+// Tracks which ranks have announced each tensor
+// (MessageTable, operations.cc:128-143).
+class MessageTable {
+ public:
+  using Clock = std::chrono::steady_clock;
+
+  struct Entry {
+    std::vector<Request> requests;   // one per reporting rank
+    Clock::time_point first_seen;
+  };
+
+  // Returns true when all `size` ranks have now reported `name`
+  // (IncrementTensorCount, operations.cc:287-313).
+  bool Increment(const Request& msg, int size);
+
+  // The ready request vector for a tensor; empties the entry.
+  std::vector<Request> Take(const std::string& name);
+
+  bool Contains(const std::string& name) const {
+    return table_.count(name) != 0;
+  }
+  size_t size() const { return table_.size(); }
+
+  // Tensors stuck longer than `warn_after` seconds, with the ranks that DID
+  // report and the missing ranks (CheckForStalledTensors,
+  // operations.cc:1625-1672). Returns human-readable report lines.
+  std::vector<std::string> StalledTensors(int size, double warn_after) const;
+
+ private:
+  std::unordered_map<std::string, Entry> table_;
+};
+
+// Validates that all ranks agree and builds the verdict for one ready
+// tensor (ConstructMPIResponse, operations.cc:321-523). Checks, in the
+// reference's order: op type, dtype, shape (allreduce/broadcast: all dims;
+// allgather: all dims but the first), root rank (broadcast), device list.
+// `root_bound` bounds valid broadcast root ranks; the control plane runs at
+// host-process granularity while root ranks are *virtual* (device) ranks,
+// so the bound can exceed `size`. Defaults to `size`.
+Response ConstructResponse(const std::vector<Request>& requests, int size,
+                           int root_bound = -1);
+
+// Greedy same-op/same-dtype fusion under a byte threshold with look-ahead
+// over skipped responses (operations.cc:2149-2265). `sizes_bytes` maps
+// tensor name -> payload bytes. Allgather responses are also fused when
+// their non-first dims match, like the reference's fused allgather.
+std::vector<Response> FuseResponses(std::deque<Response> responses,
+                                    const std::unordered_map<std::string, int64_t>& sizes_bytes,
+                                    const std::unordered_map<std::string, DataType>& dtypes,
+                                    int64_t threshold_bytes);
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_COORDINATOR_H

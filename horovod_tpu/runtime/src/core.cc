@@ -1,0 +1,532 @@
+// Core runtime / background thread — TPU-native equivalent of
+// horovod/common/operations.{h,cc} (N3), exposed as a C API for ctypes.
+//
+// Architecture: the reference's background thread owns negotiation, tensor
+// fusion and the MPI/NCCL calls (operations.cc:1695-1999, 2030-2380). On
+// TPU the data plane is XLA — collectives execute as jitted programs
+// launched from Python — so the native runtime keeps everything *around*
+// the collective: the tensor table with duplicate-name rejection
+// (operations.cc:270-273, 2472-2509), the cycle timer, negotiation via
+// MessageTable + ConstructResponse, fusion planning with look-ahead
+// (operations.cc:2149-2265), the timeline, stall detection, and the
+// autotuner. Execution requests flow to Python through a registered
+// callback (the role the PerformOperation dispatch plays in the reference);
+// Python reports completion back so the runtime can close timeline events,
+// clear in-flight names, and feed the autotuner.
+//
+// Threading: one background thread per process (operations.cc:109-114); a
+// single mutex guards queue+table (operations.cc:120-127); the execute
+// callback is invoked WITHOUT the lock held (it re-enters Python, which
+// takes the GIL).
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+
+#include <algorithm>
+#include <cstdio>
+#include <cstdlib>
+
+#include "common.h"
+#include "coordinator.h"
+#include "half.h"
+#include "fusion_buffer.h"
+#include "logging.h"
+#include "message.h"
+#include "parameter_manager.h"
+#include "timeline.h"
+
+namespace hvdtpu {
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+typedef void (*ExecuteCallback)(void* user, int32_t op,
+                                const int64_t* handles, int32_t count,
+                                const char* error_message);
+
+struct PendingEntry {
+  int64_t handle;
+  Request request;
+  int64_t nbytes;
+  Clock::time_point enqueued;
+};
+
+struct HandleState {
+  std::string name;
+  int32_t status = -1;  // -1 in flight; else StatusType
+  std::string reason;
+};
+
+struct GlobalState {
+  std::mutex mu;
+  std::atomic<bool> initialized{false};
+  std::atomic<bool> shutdown_requested{false};
+  bool background_done = false;
+  std::condition_variable shutdown_cv;
+
+  int rank = 0, size = 1, local_size = 1, virtual_size = 1;
+
+  std::thread background;
+
+  // Message queue + tensor table (operations.cc:120-143).
+  std::deque<PendingEntry> message_queue;
+  std::unordered_map<std::string, PendingEntry> tensor_table;  // in flight
+  std::unordered_map<int64_t, HandleState> handles;
+  int64_t next_handle = 1;
+
+  MessageTable message_table;
+
+  ExecuteCallback execute_cb = nullptr;
+  void* execute_user = nullptr;
+
+  // Knobs (operations.cc:1824-1909).
+  std::atomic<int64_t> fusion_threshold{64LL * 1024 * 1024};
+  std::atomic<int64_t> cycle_time_us{1000};
+  double stall_warning_sec = 60.0;  // STALL_WARNING_TIME operations.cc:258
+  Clock::time_point last_stall_check = Clock::now();
+
+  Timeline timeline;
+  FusionBufferManager fusion_buffers;
+  ParameterManager param_manager;
+
+  // Cycle stats for the autotuner.
+  std::atomic<int64_t> cycle_bytes{0};
+};
+
+GlobalState* g_state = nullptr;
+
+void EmitTimelineStartGroup(GlobalState& st, const Response& resp) {
+  static const char* kOpName[] = {"ALLREDUCE", "ALLGATHER", "BROADCAST"};
+  if (!st.timeline.Initialized()) return;
+  for (const auto& name : resp.tensor_names) {
+    st.timeline.NegotiateEnd(name);
+    if (resp.response_type != Response::ERROR) {
+      st.timeline.Start(name, kOpName[resp.response_type]);
+      st.timeline.ActivityStart(name, "QUEUE");
+    }
+  }
+}
+
+// One cycle of the background loop (RunLoopOnce, operations.cc:2030-2380).
+// Returns false when shutdown was requested and the queue is drained.
+bool RunLoopOnce(GlobalState& st) {
+  auto cycle_start = Clock::now();
+  st.timeline.MarkCycleStart();
+
+  // Drain local queue under lock (operations.cc:2050-2058).
+  std::deque<PendingEntry> batch;
+  {
+    std::lock_guard<std::mutex> lk(st.mu);
+    batch = std::move(st.message_queue);
+    st.message_queue.clear();
+  }
+
+  // Negotiation: every enqueue on the single-controller path announces the
+  // tensor for ALL local virtual ranks at once, so readiness counting runs
+  // at process granularity. With one process (size_procs == 1) tensors are
+  // ready immediately; the multi-host controller feeds remote request
+  // lists into the same MessageTable.
+  std::deque<Response> ready;
+  std::unordered_map<std::string, int64_t> sizes;
+  std::unordered_map<std::string, DataType> dtypes;
+  std::unordered_map<std::string, std::vector<int64_t>> handle_of;
+  {
+    std::lock_guard<std::mutex> lk(st.mu);
+    for (auto& pe : batch) {
+      bool all_ready = st.message_table.Increment(pe.request, /*size=*/1);
+      sizes[pe.request.tensor_name] = pe.nbytes;
+      dtypes[pe.request.tensor_name] = pe.request.tensor_type;
+      handle_of[pe.request.tensor_name].push_back(pe.handle);
+      if (all_ready) {
+        auto reqs = st.message_table.Take(pe.request.tensor_name);
+        ready.push_back(ConstructResponse(reqs, 1, st.virtual_size));
+      }
+    }
+  }
+
+  if (!ready.empty()) {
+    // Fusion planning with look-ahead (operations.cc:2149-2265).
+    auto plans = FuseResponses(std::move(ready), sizes, dtypes,
+                               st.fusion_threshold.load());
+
+    for (auto& resp : plans) {
+      EmitTimelineStartGroup(st, resp);
+      std::vector<int64_t> hs;
+      int64_t bytes = 0;
+      for (const auto& name : resp.tensor_names) {
+        for (int64_t h : handle_of[name]) hs.push_back(h);
+        bytes += sizes.count(name) ? sizes[name] : 0;
+      }
+      st.cycle_bytes.fetch_add(bytes);
+      ExecuteCallback cb;
+      void* user;
+      {
+        std::lock_guard<std::mutex> lk(st.mu);
+        cb = st.execute_cb;
+        user = st.execute_user;
+      }
+      if (resp.response_type == Response::ERROR) {
+        // Mismatch verdicts are delivered to the callback as errors so the
+        // owner can fail the handles (operations.cc:1613-1620 semantics).
+        if (cb) cb(user, static_cast<int32_t>(resp.response_type), hs.data(),
+                   static_cast<int32_t>(hs.size()),
+                   resp.error_message.c_str());
+      } else if (cb) {
+        cb(user, static_cast<int32_t>(resp.response_type), hs.data(),
+           static_cast<int32_t>(hs.size()), "");
+      }
+    }
+  }
+
+  // Stall detection (CheckForStalledTensors, operations.cc:1625-1672).
+  if (st.stall_warning_sec > 0) {
+    auto now = Clock::now();
+    if (std::chrono::duration<double>(now - st.last_stall_check).count() >
+        st.stall_warning_sec) {
+      st.last_stall_check = now;
+      std::vector<std::string> stalled;
+      {
+        std::lock_guard<std::mutex> lk(st.mu);
+        for (const auto& kv : st.tensor_table) {
+          double age = std::chrono::duration<double>(now - kv.second.enqueued)
+                           .count();
+          if (age > st.stall_warning_sec) stalled.push_back(kv.first);
+        }
+      }
+      if (!stalled.empty()) {
+        std::string names;
+        for (const auto& n : stalled) names += (names.empty() ? "" : ", ") + n;
+        HVD_LOG(WARNING)
+            << "One or more tensors were submitted to be reduced, gathered "
+            << "or broadcasted by subset of ranks and are waiting for "
+            << "remainder of ranks for more than " << st.stall_warning_sec
+            << " seconds. Stalled ops: " << names;
+      }
+    }
+  }
+
+  // Autotuner: feed cycle observation (parameter_manager.cc:144-170).
+  double secs =
+      std::chrono::duration<double>(Clock::now() - cycle_start).count();
+  if (st.param_manager.IsAutoTuning()) {
+    if (st.param_manager.Update(st.cycle_bytes.exchange(0), secs)) {
+      st.fusion_threshold.store(st.param_manager.TensorFusionThresholdBytes());
+      st.cycle_time_us.store(
+          static_cast<int64_t>(st.param_manager.CycleTimeMs() * 1000));
+    }
+  } else {
+    st.cycle_bytes.store(0);
+  }
+
+  if (st.shutdown_requested.load()) {
+    std::lock_guard<std::mutex> lk(st.mu);
+    if (st.message_queue.empty()) return false;
+  }
+
+  // Sleep out the remainder of the cycle (operations.cc:2032-2040).
+  auto elapsed = Clock::now() - cycle_start;
+  auto cycle = std::chrono::microseconds(st.cycle_time_us.load());
+  if (elapsed < cycle) std::this_thread::sleep_for(cycle - elapsed);
+  return true;
+}
+
+void BackgroundThreadLoop(GlobalState& st) {
+  // (BackgroundThreadLoop, operations.cc:1695-1999 — minus MPI bring-up,
+  // which jax.distributed handles before this thread starts.)
+  while (RunLoopOnce(st)) {
+  }
+  {
+    std::lock_guard<std::mutex> lk(st.mu);
+    st.background_done = true;
+  }
+  st.shutdown_cv.notify_all();
+}
+
+}  // namespace
+}  // namespace hvdtpu
+
+// ---------------------------------------------------------------------------
+// C API (ctypes surface) — parity with the reference's C init/rank API
+// (operations.cc:2413-2468) plus the enqueue/callback bridge.
+// ---------------------------------------------------------------------------
+
+using namespace hvdtpu;
+
+extern "C" {
+
+int hvdtpu_init(int rank, int size, int local_size, int virtual_size) {
+  // InitializeHorovodOnce (operations.cc:2384-2402). `rank`/`size` are
+  // host-process granular (the negotiation unit); `virtual_size` is the
+  // total device count, bounding broadcast root ranks.
+  if (g_state && g_state->initialized.load()) return 0;
+  auto* st = new GlobalState();
+  st->rank = rank;
+  st->size = size;
+  st->local_size = local_size;
+  st->virtual_size = virtual_size > 0 ? virtual_size : size * local_size;
+
+  const char* v = std::getenv("HOROVOD_TPU_FUSION_THRESHOLD");
+  if (!v) v = std::getenv("HOROVOD_FUSION_THRESHOLD");
+  if (v) st->fusion_threshold.store(std::atoll(v));
+  v = std::getenv("HOROVOD_TPU_CYCLE_TIME");
+  if (!v) v = std::getenv("HOROVOD_CYCLE_TIME");
+  if (v) st->cycle_time_us.store(static_cast<int64_t>(std::atof(v) * 1000));
+  v = std::getenv("HOROVOD_TPU_STALL_CHECK_DISABLE");
+  if (!v) v = std::getenv("HOROVOD_STALL_CHECK_DISABLE");
+  if (v && std::strcmp(v, "0") != 0) st->stall_warning_sec = 0;
+
+  v = std::getenv("HOROVOD_TPU_TIMELINE");
+  if (!v) v = std::getenv("HOROVOD_TIMELINE");
+  if (v && *v && rank == 0) {
+    const char* mc = std::getenv("HOROVOD_TPU_TIMELINE_MARK_CYCLES");
+    if (!mc) mc = std::getenv("HOROVOD_TIMELINE_MARK_CYCLES");
+    st->timeline.Initialize(v, mc && std::strcmp(mc, "0") != 0);
+  }
+
+  v = std::getenv("HOROVOD_TPU_AUTOTUNE");
+  if (!v) v = std::getenv("HOROVOD_AUTOTUNE");
+  if (v && std::strcmp(v, "0") != 0) {
+    const char* lg = std::getenv("HOROVOD_TPU_AUTOTUNE_LOG");
+    if (!lg) lg = std::getenv("HOROVOD_AUTOTUNE_LOG");
+    st->param_manager.Initialize(rank, lg ? lg : "");
+    st->param_manager.SetAutoTuning(true);
+  }
+
+  st->background = std::thread(BackgroundThreadLoop, std::ref(*st));
+  st->initialized.store(true);
+  g_state = st;
+  HVD_LOG(DEBUG) << "hvdtpu core initialized (rank " << rank << "/" << size
+                 << ")";
+  return 0;
+}
+
+int hvdtpu_initialized() {
+  return g_state && g_state->initialized.load() ? 1 : 0;
+}
+
+void hvdtpu_shutdown() {
+  // Coordinated shutdown (operations.cc:1942-1998): drain, stop thread,
+  // close the timeline.
+  if (!g_state) return;
+  GlobalState& st = *g_state;
+  st.shutdown_requested.store(true);
+  if (st.background.joinable()) st.background.join();
+  st.timeline.Shutdown();
+  st.initialized.store(false);
+  delete g_state;
+  g_state = nullptr;
+}
+
+void hvdtpu_set_execute_callback(void (*cb)(void*, int32_t, const int64_t*,
+                                            int32_t, const char*),
+                                 void* user) {
+  if (!g_state) return;
+  std::lock_guard<std::mutex> lk(g_state->mu);
+  g_state->execute_cb = cb;
+  g_state->execute_user = user;
+}
+
+// Returns handle > 0, or -1 for duplicate name (DUPLICATE_NAME_ERROR,
+// operations.cc:270-273), -2 if shut down (SHUT_DOWN_ERROR).
+int64_t hvdtpu_enqueue(int32_t op, const char* name, int32_t dtype,
+                       const int64_t* shape, int32_t ndims, int32_t root_rank,
+                       int32_t device, int64_t nbytes) {
+  if (!g_state || !g_state->initialized.load()) return -2;
+  GlobalState& st = *g_state;
+  if (st.shutdown_requested.load()) return -2;
+
+  PendingEntry pe;
+  pe.request.request_rank = st.rank;
+  pe.request.request_type = static_cast<Request::Type>(op);
+  pe.request.tensor_type = static_cast<DataType>(dtype);
+  pe.request.tensor_name = name;
+  pe.request.root_rank = root_rank;
+  pe.request.device = device;
+  std::vector<int64_t> dims(shape, shape + ndims);
+  pe.request.tensor_shape = TensorShape(std::move(dims));
+  pe.nbytes = nbytes;
+  pe.enqueued = Clock::now();
+
+  std::lock_guard<std::mutex> lk(st.mu);
+  if (st.tensor_table.count(pe.request.tensor_name)) return -1;
+  int64_t h = st.next_handle++;
+  pe.handle = h;
+  st.handles[h] = HandleState{pe.request.tensor_name, -1, ""};
+  st.tensor_table.emplace(pe.request.tensor_name, pe);
+  st.message_queue.push_back(std::move(pe));
+  if (st.timeline.Initialized()) {
+    st.timeline.NegotiateStart(name, op);
+    st.timeline.NegotiateRankReady(name, st.rank);
+  }
+  return h;
+}
+
+// Python reports group completion. status_type: StatusType values; reason
+// used when != OK.
+void hvdtpu_complete(const int64_t* handles, int32_t count,
+                     int32_t status_type, const char* reason) {
+  if (!g_state) return;
+  GlobalState& st = *g_state;
+  std::vector<std::string> names;
+  {
+    std::lock_guard<std::mutex> lk(st.mu);
+    for (int i = 0; i < count; ++i) {
+      auto it = st.handles.find(handles[i]);
+      if (it == st.handles.end()) continue;
+      it->second.status = status_type;
+      it->second.reason = reason ? reason : "";
+      names.push_back(it->second.name);
+      st.tensor_table.erase(it->second.name);
+    }
+  }
+  if (st.timeline.Initialized()) {
+    for (const auto& n : names) {
+      st.timeline.ActivityEnd(n);   // close QUEUE/XLA activity
+      st.timeline.End(n, "");
+    }
+  }
+}
+
+// Poll handle: -1 in flight, else StatusType value (PollHandle,
+// torch/handle_manager.cc:21-50).
+int32_t hvdtpu_poll(int64_t handle) {
+  if (!g_state) return static_cast<int32_t>(StatusType::ABORTED);
+  std::lock_guard<std::mutex> lk(g_state->mu);
+  auto it = g_state->handles.find(handle);
+  if (it == g_state->handles.end())
+    return static_cast<int32_t>(StatusType::INVALID_ARGUMENT);
+  return it->second.status;
+}
+
+void hvdtpu_release_handle(int64_t handle) {
+  if (!g_state) return;
+  std::lock_guard<std::mutex> lk(g_state->mu);
+  g_state->handles.erase(handle);
+}
+
+int hvdtpu_rank() { return g_state ? g_state->rank : -1; }
+int hvdtpu_size() { return g_state ? g_state->size : -1; }
+int hvdtpu_local_size() { return g_state ? g_state->local_size : -1; }
+
+void hvdtpu_set_fusion_threshold(int64_t bytes) {
+  if (g_state) g_state->fusion_threshold.store(bytes);
+}
+int64_t hvdtpu_get_fusion_threshold() {
+  return g_state ? g_state->fusion_threshold.load() : -1;
+}
+void hvdtpu_set_cycle_time_ms(double ms) {
+  if (g_state)
+    g_state->cycle_time_us.store(static_cast<int64_t>(ms * 1000));
+}
+double hvdtpu_get_cycle_time_ms() {
+  return g_state ? g_state->cycle_time_us.load() / 1000.0 : -1;
+}
+
+// Timeline bridge for Python-side activities (XLA launch/wait phases).
+void hvdtpu_timeline_activity_start(const char* tensor,
+                                    const char* activity) {
+  if (g_state) g_state->timeline.ActivityStart(tensor, activity);
+}
+void hvdtpu_timeline_activity_end(const char* tensor) {
+  if (g_state) g_state->timeline.ActivityEnd(tensor);
+}
+int hvdtpu_timeline_enabled() {
+  return g_state && g_state->timeline.Initialized() ? 1 : 0;
+}
+
+// Autotune inspection (test / observability surface).
+int hvdtpu_autotune_active() {
+  return g_state && g_state->param_manager.IsAutoTuning() &&
+                 !g_state->param_manager.IsDone()
+             ? 1 : 0;
+}
+
+// Host staging arena (FusionBufferManager bridge).
+uint8_t* hvdtpu_fusion_buffer(int device, int64_t threshold) {
+  return g_state ? g_state->fusion_buffers.GetBuffer(device, threshold)
+                 : nullptr;
+}
+
+// ---- wire protocol + negotiation test surface (used by pytest via ctypes
+// and by the multi-host controller) ----------------------------------------
+
+int64_t hvdtpu_wire_roundtrip_request_list(const uint8_t* in, int64_t in_len,
+                                           uint8_t* out, int64_t out_cap) {
+  RequestList rl;
+  if (!RequestList::ParseFrom(in, static_cast<size_t>(in_len), &rl)) return -1;
+  std::vector<uint8_t> buf;
+  rl.SerializeTo(&buf);
+  if (static_cast<int64_t>(buf.size()) > out_cap) return -1;
+  std::memcpy(out, buf.data(), buf.size());
+  return static_cast<int64_t>(buf.size());
+}
+
+// Build a serialized Request for tests / the controller client.
+int64_t hvdtpu_wire_make_request(int32_t rank, int32_t op, int32_t dtype,
+                                 const char* name, int32_t root_rank,
+                                 int32_t device, const int64_t* shape,
+                                 int32_t ndims, uint8_t* out,
+                                 int64_t out_cap) {
+  Request r;
+  r.request_rank = rank;
+  r.request_type = static_cast<Request::Type>(op);
+  r.tensor_type = static_cast<DataType>(dtype);
+  r.tensor_name = name;
+  r.root_rank = root_rank;
+  r.device = device;
+  r.tensor_shape = TensorShape(std::vector<int64_t>(shape, shape + ndims));
+  std::vector<uint8_t> buf;
+  r.SerializeTo(&buf);
+  if (static_cast<int64_t>(buf.size()) > out_cap) return -1;
+  std::memcpy(out, buf.data(), buf.size());
+  return static_cast<int64_t>(buf.size());
+}
+
+// Run coordinator validation over a batch of serialized Requests (size =
+// world size). Writes the Response error message (or "") to err; returns
+// the Response type.
+int32_t hvdtpu_negotiate(const uint8_t* data, int64_t len, int32_t nreq,
+                         int32_t world_size, char* err, int64_t err_cap,
+                         int64_t* tensor_sizes_out, int32_t sizes_cap) {
+  std::vector<Request> reqs;
+  size_t off = 0;
+  for (int i = 0; i < nreq; ++i) {
+    Request r;
+    size_t consumed;
+    if (!Request::ParseFrom(data + off, static_cast<size_t>(len) - off,
+                            &consumed, &r)) {
+      std::snprintf(err, err_cap, "parse error at request %d", i);
+      return static_cast<int32_t>(Response::ERROR);
+    }
+    off += consumed;
+    reqs.push_back(std::move(r));
+  }
+  Response resp = ConstructResponse(reqs, world_size);
+  std::snprintf(err, err_cap, "%s", resp.error_message.c_str());
+  int32_t n = std::min<int32_t>(sizes_cap,
+                                static_cast<int32_t>(resp.tensor_sizes.size()));
+  for (int32_t i = 0; i < n; ++i) tensor_sizes_out[i] = resp.tensor_sizes[i];
+  return static_cast<int32_t>(resp.response_type);
+}
+
+// half/bf16 conversion surface (N8 parity; exercised by tests).
+void hvdtpu_half_to_float(const uint16_t* in, float* out, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) out[i] = HalfBits2Float(in[i]);
+}
+void hvdtpu_float_to_half(const float* in, uint16_t* out, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) out[i] = Float2HalfBits(in[i]);
+}
+void hvdtpu_halfsum(const uint16_t* src, uint16_t* dst, int64_t n) {
+  HalfSum(src, dst, static_cast<size_t>(n));
+}
+void hvdtpu_bf16sum(const uint16_t* src, uint16_t* dst, int64_t n) {
+  BF16Sum(src, dst, static_cast<size_t>(n));
+}
+
+}  // extern "C"

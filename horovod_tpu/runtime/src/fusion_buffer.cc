@@ -1,0 +1,25 @@
+#include "fusion_buffer.h"
+
+namespace hvdtpu {
+
+uint8_t* FusionBufferManager::GetBuffer(int device, int64_t threshold_bytes) {
+  std::lock_guard<std::mutex> lk(mu_);
+  // Round up so every segment boundary can stay 64B-aligned
+  // (FUSION_BUFFER_ATOMIC_UNIT rounding, operations.cc:742-764).
+  int64_t want = (threshold_bytes + kFusionBufferAtomicUnit - 1) /
+                 kFusionBufferAtomicUnit * kFusionBufferAtomicUnit;
+  auto& buf = buffers_[device];
+  if (buf.size < want) {
+    buf.data = std::make_unique<uint8_t[]>(static_cast<size_t>(want));
+    buf.size = want;
+  }
+  return buf.data.get();
+}
+
+int64_t FusionBufferManager::buffer_size(int device) const {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = buffers_.find(device);
+  return it == buffers_.end() ? 0 : it->second.size;
+}
+
+}  // namespace hvdtpu

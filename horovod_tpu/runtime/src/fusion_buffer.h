@@ -1,0 +1,45 @@
+// Fusion buffer manager — TPU-native equivalent of
+// horovod/common/fusion_buffer_manager.{h,cc} (N4).
+//
+// The reference lazily allocates ONE persistent buffer of exactly the
+// fusion-threshold bytes per (device, framework) key and reallocates when
+// the autotuner changes the threshold (fusion_buffer_manager.cc:21-45). On
+// TPU the *device-side* fused buffer is the XLA concat inside the jitted
+// program; what remains native is the HOST staging arena used to assemble
+// eager numpy payloads contiguously before a single device_put (and to
+// stage fused results back). Alignment is kept at 64 bytes — the
+// FUSION_BUFFER_ATOMIC_UNIT (reference operations.h:52-54) — so fused
+// segment boundaries stay SIMD/DMA friendly.
+#ifndef HVD_TPU_FUSION_BUFFER_H
+#define HVD_TPU_FUSION_BUFFER_H
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <mutex>
+
+namespace hvdtpu {
+
+constexpr int64_t kFusionBufferAtomicUnit = 64;  // operations.h:52-54
+
+class FusionBufferManager {
+ public:
+  // Returns the persistent buffer for `device`, (re)allocating when the
+  // requested threshold grew (InitializeBuffer + GetBuffer,
+  // fusion_buffer_manager.cc:21-53). Thread-safe.
+  uint8_t* GetBuffer(int device, int64_t threshold_bytes);
+
+  int64_t buffer_size(int device) const;
+
+ private:
+  struct Buf {
+    std::unique_ptr<uint8_t[]> data;
+    int64_t size = 0;
+  };
+  mutable std::mutex mu_;
+  std::map<int, Buf> buffers_;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_FUSION_BUFFER_H

@@ -1,0 +1,155 @@
+#include "gaussian_process.h"
+
+#include <algorithm>
+#include <cmath>
+
+namespace hvdtpu {
+
+void GaussianProcess::AddSample(const std::vector<double>& x, double y) {
+  xs_.push_back(x);
+  ys_.push_back(y);
+  fitted_ = false;
+}
+
+double GaussianProcess::best_y() const {
+  double best = -1e300;
+  for (double y : ys_) best = std::max(best, y);
+  return best;
+}
+
+double GaussianProcess::Kernel(const std::vector<double>& a,
+                               const std::vector<double>& b) const {
+  double d2 = 0;
+  for (size_t i = 0; i < a.size(); ++i) {
+    double d = a[i] - b[i];
+    d2 += d * d;
+  }
+  return amp_ * std::exp(-d2 / (2.0 * length_ * length_));
+}
+
+bool GaussianProcess::Cholesky(const std::vector<double>& a, int n,
+                               std::vector<double>* lout) const {
+  // Dense lower-triangular Cholesky; n is small (≤ a few hundred samples).
+  std::vector<double>& l = *lout;
+  l.assign(n * n, 0.0);
+  for (int i = 0; i < n; ++i) {
+    for (int j = 0; j <= i; ++j) {
+      double s = a[i * n + j];
+      for (int k = 0; k < j; ++k) s -= l[i * n + k] * l[j * n + k];
+      if (i == j) {
+        if (s <= 0) return false;
+        l[i * n + i] = std::sqrt(s);
+      } else {
+        l[i * n + j] = s / l[j * n + j];
+      }
+    }
+  }
+  return true;
+}
+
+std::vector<double> GaussianProcess::CholSolve(const std::vector<double>& l,
+                                               int n,
+                                               std::vector<double> b) const {
+  for (int i = 0; i < n; ++i) {
+    double s = b[i];
+    for (int k = 0; k < i; ++k) s -= l[i * n + k] * b[k];
+    b[i] = s / l[i * n + i];
+  }
+  for (int i = n - 1; i >= 0; --i) {
+    double s = b[i];
+    for (int k = i + 1; k < n; ++k) s -= l[k * n + i] * b[k];
+    b[i] = s / l[i * n + i];
+  }
+  return b;
+}
+
+double GaussianProcess::LogMarginalLikelihood(double length,
+                                              double amp) const {
+  // -1/2 y^T K^-1 y - 1/2 log|K| - n/2 log 2π with centered y.
+  int n = static_cast<int>(ys_.size());
+  GaussianProcess tmp = *this;
+  tmp.length_ = length;
+  tmp.amp_ = amp;
+  std::vector<double> k(n * n);
+  for (int i = 0; i < n; ++i)
+    for (int j = 0; j < n; ++j)
+      k[i * n + j] = tmp.Kernel(xs_[i], xs_[j]) + (i == j ? noise_ : 0.0);
+  std::vector<double> l;
+  if (!tmp.Cholesky(k, n, &l)) return -1e300;
+  std::vector<double> yc(n);
+  for (int i = 0; i < n; ++i) yc[i] = ys_[i] - y_mean_;
+  std::vector<double> alpha = tmp.CholSolve(l, n, yc);
+  double quad = 0, logdet = 0;
+  for (int i = 0; i < n; ++i) {
+    quad += yc[i] * alpha[i];
+    logdet += std::log(l[i * n + i]);
+  }
+  return -0.5 * quad - logdet - 0.5 * n * std::log(2 * M_PI);
+}
+
+bool GaussianProcess::Fit() {
+  int n = static_cast<int>(ys_.size());
+  if (n == 0) return false;
+  y_mean_ = 0;
+  for (double y : ys_) y_mean_ += y;
+  y_mean_ /= n;
+
+  // Hyperparameter fit: grid over length scales / amplitudes (stands in for
+  // the reference's L-BFGS fit, gaussian_process.cc Fit()).
+  if (n >= 3) {
+    double best_ll = -1e301, best_len = length_, best_amp = amp_;
+    double var = 0;
+    for (double y : ys_) var += (y - y_mean_) * (y - y_mean_);
+    var = var / n + 1e-12;
+    for (double len : {0.05, 0.1, 0.2, 0.35, 0.5, 0.8, 1.2}) {
+      for (double amp : {0.5 * var, var, 2.0 * var}) {
+        double ll = LogMarginalLikelihood(len, amp);
+        if (ll > best_ll) {
+          best_ll = ll;
+          best_len = len;
+          best_amp = amp;
+        }
+      }
+    }
+    length_ = best_len;
+    amp_ = best_amp;
+  }
+
+  std::vector<double> k(n * n);
+  for (int i = 0; i < n; ++i)
+    for (int j = 0; j < n; ++j)
+      k[i * n + j] = Kernel(xs_[i], xs_[j]) + (i == j ? noise_ : 0.0);
+  if (!Cholesky(k, n, &chol_)) return false;
+  std::vector<double> yc(n);
+  for (int i = 0; i < n; ++i) yc[i] = ys_[i] - y_mean_;
+  alpha_ = CholSolve(chol_, n, yc);
+  fitted_ = true;
+  return true;
+}
+
+void GaussianProcess::Predict(const std::vector<double>& x, double* mean,
+                              double* variance) const {
+  int n = static_cast<int>(ys_.size());
+  if (!fitted_ || n == 0) {
+    *mean = y_mean_;
+    *variance = amp_;
+    return;
+  }
+  std::vector<double> kstar(n);
+  for (int i = 0; i < n; ++i) kstar[i] = Kernel(x, xs_[i]);
+  double m = y_mean_;
+  for (int i = 0; i < n; ++i) m += kstar[i] * alpha_[i];
+  // v = L^-1 k*; var = k(x,x) - v^T v
+  std::vector<double> v(kstar);
+  for (int i = 0; i < n; ++i) {
+    double s = v[i];
+    for (int k = 0; k < i; ++k) s -= chol_[i * n + k] * v[k];
+    v[i] = s / chol_[i * n + i];
+  }
+  double var = Kernel(x, x);
+  for (int i = 0; i < n; ++i) var -= v[i] * v[i];
+  *mean = m;
+  *variance = std::max(var, 1e-12);
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,61 @@
+// Gaussian-process regression — equivalent of
+// horovod/common/optim/gaussian_process.{h,cc} (N6).
+//
+// RBF kernel + Cholesky posterior, as the reference (gaussian_process.h:
+// 45-111). The reference fits kernel hyperparameters with L-BFGS over
+// Eigen; this rebuild has no Eigen/lbfgs dependency, so the kernel length
+// scale/amplitude are fit by maximizing the log marginal likelihood over a
+// small log-spaced grid — same objective, simpler optimizer, adequate for
+// the 2-D knob space the autotuner explores.
+#ifndef HVD_TPU_GAUSSIAN_PROCESS_H
+#define HVD_TPU_GAUSSIAN_PROCESS_H
+
+#include <cstddef>
+#include <vector>
+
+namespace hvdtpu {
+
+class GaussianProcess {
+ public:
+  GaussianProcess(double length_scale = 0.5, double noise = 1e-6)
+      : length_(length_scale), noise_(noise) {}
+
+  // Add observation x (d-dim, normalized to [0,1]) with value y.
+  void AddSample(const std::vector<double>& x, double y);
+
+  // Re-fit hyperparameters (grid-search marginal likelihood) and refresh the
+  // Cholesky factorization. Returns false if the kernel matrix is singular.
+  bool Fit();
+
+  // Posterior mean and variance at x.
+  void Predict(const std::vector<double>& x, double* mean,
+               double* variance) const;
+
+  size_t num_samples() const { return ys_.size(); }
+  double best_y() const;
+
+ private:
+  double Kernel(const std::vector<double>& a,
+                const std::vector<double>& b) const;
+  bool Cholesky(const std::vector<double>& a, int n,
+                std::vector<double>* l) const;
+  // Solve L y = b then L^T x = y.
+  std::vector<double> CholSolve(const std::vector<double>& l, int n,
+                                std::vector<double> b) const;
+  double LogMarginalLikelihood(double length, double amp) const;
+
+  double length_;
+  double amp_ = 1.0;
+  double noise_;
+  std::vector<std::vector<double>> xs_;
+  std::vector<double> ys_;
+  double y_mean_ = 0.0;
+  // Cached factorization.
+  std::vector<double> chol_;
+  std::vector<double> alpha_;   // K^-1 (y - mean)
+  bool fitted_ = false;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_GAUSSIAN_PROCESS_H

@@ -1,0 +1,115 @@
+#include "half.h"
+
+#include <cmath>
+#include <cstring>
+
+namespace hvdtpu {
+
+float HalfBits2Float(uint16_t h) {
+  // Bit-level conversion mirroring reference half.h:38-84.
+  uint32_t sign = (h >> 15) & 1;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t mant = h & 0x3ff;
+  uint32_t f;
+  if (exp == 0) {
+    if (mant == 0) {
+      f = sign << 31;  // +-0
+    } else {
+      // Subnormal: normalize.
+      int e = -1;
+      uint32_t m = mant;
+      do {
+        ++e;
+        m <<= 1;
+      } while ((m & 0x400) == 0);
+      f = (sign << 31) | ((127 - 15 - e) << 23) | ((m & 0x3ff) << 13);
+    }
+  } else if (exp == 0x1f) {
+    f = (sign << 31) | 0x7f800000 | (mant << 13);  // inf/nan
+  } else {
+    f = (sign << 31) | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float out;
+  std::memcpy(&out, &f, 4);
+  return out;
+}
+
+uint16_t Float2HalfBits(float v) {
+  // Mirrors reference half.h:86-130 (round-to-nearest-even).
+  uint32_t f;
+  std::memcpy(&f, &v, 4);
+  uint32_t sign = (f >> 31) & 1;
+  int32_t exp = static_cast<int32_t>((f >> 23) & 0xff) - 127 + 15;
+  uint32_t mant = f & 0x7fffff;
+  uint16_t h;
+  if (((f >> 23) & 0xff) == 0xff) {
+    h = static_cast<uint16_t>((sign << 15) | 0x7c00 |
+                              (mant ? 0x200 | (mant >> 13) : 0));
+  } else if (exp >= 0x1f) {
+    h = static_cast<uint16_t>((sign << 15) | 0x7c00);  // overflow -> inf
+  } else if (exp <= 0) {
+    if (exp < -10) {
+      h = static_cast<uint16_t>(sign << 15);  // underflow -> 0
+    } else {
+      // Subnormal half.
+      mant |= 0x800000;
+      int shift = 14 - exp;
+      uint32_t m = mant >> shift;
+      uint32_t rem = mant & ((1u << shift) - 1);
+      uint32_t half = 1u << (shift - 1);
+      if (rem > half || (rem == half && (m & 1))) ++m;
+      h = static_cast<uint16_t>((sign << 15) | m);
+    }
+  } else {
+    uint32_t m = mant >> 13;
+    uint32_t rem = mant & 0x1fff;
+    if (rem > 0x1000 || (rem == 0x1000 && (m & 1))) {
+      ++m;
+      if (m == 0x400) {
+        m = 0;
+        ++exp;
+        if (exp >= 0x1f) {
+          h = static_cast<uint16_t>((sign << 15) | 0x7c00);
+          return h;
+        }
+      }
+    }
+    h = static_cast<uint16_t>((sign << 15) | (exp << 10) | m);
+  }
+  return h;
+}
+
+float BF16Bits2Float(uint16_t b) {
+  uint32_t f = static_cast<uint32_t>(b) << 16;
+  float out;
+  std::memcpy(&out, &f, 4);
+  return out;
+}
+
+uint16_t Float2BF16Bits(float v) {
+  uint32_t f;
+  std::memcpy(&f, &v, 4);
+  // Round-to-nearest-even on the dropped 16 bits; NaN stays NaN.
+  if ((f & 0x7f800000) == 0x7f800000 && (f & 0x7fffff)) {
+    return static_cast<uint16_t>((f >> 16) | 0x0040);
+  }
+  uint32_t lsb = (f >> 16) & 1;
+  f += 0x7fff + lsb;
+  return static_cast<uint16_t>(f >> 16);
+}
+
+void HalfSum(const uint16_t* src, uint16_t* dst, size_t n) {
+  // Scalar fallback of the reference's AVX/F16C loop (half.cc:42-90); the
+  // compiler auto-vectorizes the conversions where F16C is available.
+  for (size_t i = 0; i < n; ++i) {
+    dst[i] = Float2HalfBits(HalfBits2Float(dst[i]) + HalfBits2Float(src[i]));
+  }
+}
+
+void BF16Sum(const uint16_t* src, uint16_t* dst, size_t n) {
+  for (size_t i = 0; i < n; ++i) {
+    dst[i] = Float2BF16Bits(BF16Bits2Float(dst[i]) + BF16Bits2Float(src[i]));
+  }
+}
+
+}  // namespace hvdtpu

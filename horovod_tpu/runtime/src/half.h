@@ -1,0 +1,35 @@
+// Software float16/bfloat16 conversion — equivalent of
+// horovod/common/half.{h,cc} (N8).
+//
+// The reference needs fp16 software emulation because MPI has no fp16
+// reduction (half.cc:42-90 registers a custom MPI_Op with F16C fast path).
+// On TPU the MXU handles bf16/fp16 natively inside XLA programs; the native
+// conversion here serves the host-side paths that remain — wire compression
+// of control/test payloads and host staging buffers — plus parity tests.
+#ifndef HVD_TPU_HALF_H
+#define HVD_TPU_HALF_H
+
+#include <cstdint>
+#include <cstddef>
+
+namespace hvdtpu {
+
+// Bit-exact fp16 <-> fp32 (reference half.h:38-130 HalfBits2Float /
+// Float2HalfBits).
+float HalfBits2Float(uint16_t h);
+uint16_t Float2HalfBits(float f);
+
+// bfloat16 <-> fp32 — truncation with round-to-nearest-even, the TPU-native
+// 16-bit format (no reference equivalent; bf16 is this platform's dtype).
+float BF16Bits2Float(uint16_t b);
+uint16_t Float2BF16Bits(float f);
+
+// Vectorizable array sum: dst[i] += src[i] over fp16 payloads — the
+// float16_sum MPI op body (half.cc:42-90), used by host-side fused
+// reductions in tests and the wire path.
+void HalfSum(const uint16_t* src, uint16_t* dst, size_t n);
+void BF16Sum(const uint16_t* src, uint16_t* dst, size_t n);
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_HALF_H

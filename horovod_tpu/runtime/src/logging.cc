@@ -1,0 +1,71 @@
+#include "logging.h"
+
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+
+namespace hvdtpu {
+
+LogLevel MinLogLevelFromEnv() {
+  // HOROVOD_LOG_LEVEL (reference logging.cc:76-84); HOROVOD_TPU_ overrides.
+  const char* v = std::getenv("HOROVOD_TPU_LOG_LEVEL");
+  if (!v) v = std::getenv("HOROVOD_LOG_LEVEL");
+  if (!v) return LogLevel::WARNING;
+  std::string s(v);
+  if (s == "trace") return LogLevel::TRACE;
+  if (s == "debug") return LogLevel::DEBUG;
+  if (s == "info") return LogLevel::INFO;
+  if (s == "warning") return LogLevel::WARNING;
+  if (s == "error") return LogLevel::ERROR;
+  if (s == "fatal") return LogLevel::FATAL;
+  return LogLevel::WARNING;
+}
+
+bool LogHideTimeFromEnv() {
+  const char* v = std::getenv("HOROVOD_TPU_LOG_HIDE_TIME");
+  if (!v) v = std::getenv("HOROVOD_LOG_HIDE_TIME");
+  return v != nullptr && std::strcmp(v, "1") == 0;
+}
+
+namespace {
+const char* LevelName(LogLevel l) {
+  switch (l) {
+    case LogLevel::TRACE: return "trace";
+    case LogLevel::DEBUG: return "debug";
+    case LogLevel::INFO: return "info";
+    case LogLevel::WARNING: return "warning";
+    case LogLevel::ERROR: return "error";
+    case LogLevel::FATAL: return "fatal";
+  }
+  return "?";
+}
+}  // namespace
+
+LogMessage::LogMessage(const char* file, int line, LogLevel level)
+    : file_(file), line_(line), level_(level) {}
+
+LogMessage::~LogMessage() {
+  static LogLevel min_level = MinLogLevelFromEnv();
+  static bool hide_time = LogHideTimeFromEnv();
+  if (level_ < min_level) return;
+  if (!hide_time) {
+    auto now = std::chrono::system_clock::now();
+    std::time_t t = std::chrono::system_clock::to_time_t(now);
+    auto us = std::chrono::duration_cast<std::chrono::microseconds>(
+                  now.time_since_epoch()).count() % 1000000;
+    char buf[32];
+    std::tm tm_buf;
+    localtime_r(&t, &tm_buf);
+    std::strftime(buf, sizeof(buf), "%Y-%m-%d %H:%M:%S", &tm_buf);
+    std::fprintf(stderr, "[%s.%06ld: %s %s:%d] %s\n", buf, (long)us,
+                 LevelName(level_), file_, line_, str().c_str());
+  } else {
+    std::fprintf(stderr, "[%s %s:%d] %s\n", LevelName(level_), file_, line_,
+                 str().c_str());
+  }
+  if (level_ == LogLevel::FATAL) std::abort();
+}
+
+}  // namespace hvdtpu

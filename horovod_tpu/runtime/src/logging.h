@@ -1,0 +1,47 @@
+// Logging — TPU-native equivalent of horovod/common/logging.{h,cc} (N9):
+// stream-style LOG(severity) macros, levels TRACE..FATAL, controlled by
+// HOROVOD_LOG_LEVEL / HOROVOD_LOG_HIDE_TIME (logging.cc:76-92).
+#ifndef HVD_TPU_LOGGING_H
+#define HVD_TPU_LOGGING_H
+
+#include <sstream>
+#include <string>
+
+namespace hvdtpu {
+
+enum class LogLevel : int { TRACE = 0, DEBUG = 1, INFO = 2, WARNING = 3,
+                            ERROR = 4, FATAL = 5 };
+
+LogLevel MinLogLevelFromEnv();
+bool LogHideTimeFromEnv();
+
+class LogMessage : public std::basic_ostringstream<char> {
+ public:
+  LogMessage(const char* file, int line, LogLevel level);
+  ~LogMessage();
+
+ private:
+  const char* file_;
+  int line_;
+  LogLevel level_;
+};
+
+#define HVD_LOG_TRACE \
+  ::hvdtpu::LogMessage(__FILE__, __LINE__, ::hvdtpu::LogLevel::TRACE)
+#define HVD_LOG_DEBUG \
+  ::hvdtpu::LogMessage(__FILE__, __LINE__, ::hvdtpu::LogLevel::DEBUG)
+#define HVD_LOG_INFO \
+  ::hvdtpu::LogMessage(__FILE__, __LINE__, ::hvdtpu::LogLevel::INFO)
+#define HVD_LOG_WARNING \
+  ::hvdtpu::LogMessage(__FILE__, __LINE__, ::hvdtpu::LogLevel::WARNING)
+#define HVD_LOG_ERROR \
+  ::hvdtpu::LogMessage(__FILE__, __LINE__, ::hvdtpu::LogLevel::ERROR)
+
+// LOG(severity) in the reference (logging.h:21-67); prefixed here to stay
+// symbol-clean in a shared object loaded next to other frameworks (the role
+// of horovod.lds/exp, reference N15).
+#define HVD_LOG(level) HVD_LOG_##level
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_LOGGING_H

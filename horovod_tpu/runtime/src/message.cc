@@ -1,0 +1,234 @@
+// Binary codec for the control-plane wire protocol. See message.h.
+#include "message.h"
+
+#include <cstring>
+
+namespace hvdtpu {
+
+namespace {
+
+// Little-endian primitive writers/readers. All lengths are uint32.
+void PutI32(std::vector<uint8_t>* out, int32_t v) {
+  uint32_t u = static_cast<uint32_t>(v);
+  out->push_back(u & 0xff);
+  out->push_back((u >> 8) & 0xff);
+  out->push_back((u >> 16) & 0xff);
+  out->push_back((u >> 24) & 0xff);
+}
+
+void PutI64(std::vector<uint8_t>* out, int64_t v) {
+  uint64_t u = static_cast<uint64_t>(v);
+  for (int i = 0; i < 8; ++i) out->push_back((u >> (8 * i)) & 0xff);
+}
+
+void PutStr(std::vector<uint8_t>* out, const std::string& s) {
+  PutI32(out, static_cast<int32_t>(s.size()));
+  out->insert(out->end(), s.begin(), s.end());
+}
+
+struct Reader {
+  const uint8_t* p;
+  size_t len;
+  size_t off = 0;
+
+  bool I32(int32_t* v) {
+    if (off + 4 > len) return false;
+    uint32_t u = 0;
+    for (int i = 0; i < 4; ++i) u |= static_cast<uint32_t>(p[off + i]) << (8 * i);
+    *v = static_cast<int32_t>(u);
+    off += 4;
+    return true;
+  }
+  bool I64(int64_t* v) {
+    if (off + 8 > len) return false;
+    uint64_t u = 0;
+    for (int i = 0; i < 8; ++i) u |= static_cast<uint64_t>(p[off + i]) << (8 * i);
+    *v = static_cast<int64_t>(u);
+    off += 8;
+    return true;
+  }
+  bool Str(std::string* s) {
+    int32_t n;
+    if (!I32(&n) || n < 0 || off + static_cast<size_t>(n) > len) return false;
+    s->assign(reinterpret_cast<const char*>(p + off), n);
+    off += n;
+    return true;
+  }
+};
+
+}  // namespace
+
+const char* DataTypeName(DataType t) {
+  switch (t) {
+    case DataType::HVD_UINT8: return "uint8";
+    case DataType::HVD_INT8: return "int8";
+    case DataType::HVD_UINT16: return "uint16";
+    case DataType::HVD_INT16: return "int16";
+    case DataType::HVD_INT32: return "int32";
+    case DataType::HVD_INT64: return "int64";
+    case DataType::HVD_FLOAT16: return "float16";
+    case DataType::HVD_FLOAT32: return "float32";
+    case DataType::HVD_FLOAT64: return "float64";
+    case DataType::HVD_BOOL: return "bool";
+    case DataType::HVD_BFLOAT16: return "bfloat16";
+  }
+  return "unknown";
+}
+
+int64_t DataTypeSize(DataType t) {
+  switch (t) {
+    case DataType::HVD_UINT8:
+    case DataType::HVD_INT8:
+    case DataType::HVD_BOOL:
+      return 1;
+    case DataType::HVD_UINT16:
+    case DataType::HVD_INT16:
+    case DataType::HVD_FLOAT16:
+    case DataType::HVD_BFLOAT16:
+      return 2;
+    case DataType::HVD_INT32:
+    case DataType::HVD_FLOAT32:
+      return 4;
+    case DataType::HVD_INT64:
+    case DataType::HVD_FLOAT64:
+      return 8;
+  }
+  return 0;
+}
+
+std::string TensorShape::DebugString() const {
+  std::string s = "[";
+  for (size_t i = 0; i < dims_.size(); ++i) {
+    if (i) s += ", ";
+    s += std::to_string(dims_[i]);
+  }
+  s += "]";
+  return s;
+}
+
+const char* RequestTypeName(Request::Type t) {
+  switch (t) {
+    case Request::ALLREDUCE: return "allreduce";
+    case Request::ALLGATHER: return "allgather";
+    case Request::BROADCAST: return "broadcast";
+  }
+  return "unknown";
+}
+
+void Request::SerializeTo(std::vector<uint8_t>* out) const {
+  PutI32(out, request_rank);
+  PutI32(out, static_cast<int32_t>(request_type));
+  PutI32(out, static_cast<int32_t>(tensor_type));
+  PutStr(out, tensor_name);
+  PutI32(out, root_rank);
+  PutI32(out, device);
+  PutI32(out, tensor_shape.ndims());
+  for (auto d : tensor_shape.dims()) PutI64(out, d);
+}
+
+bool Request::ParseFrom(const uint8_t* data, size_t len, size_t* consumed,
+                        Request* out) {
+  Reader r{data, len};
+  int32_t type, dtype, ndims;
+  if (!r.I32(&out->request_rank)) return false;
+  if (!r.I32(&type)) return false;
+  if (!r.I32(&dtype)) return false;
+  if (!r.Str(&out->tensor_name)) return false;
+  if (!r.I32(&out->root_rank)) return false;
+  if (!r.I32(&out->device)) return false;
+  if (!r.I32(&ndims) || ndims < 0 || ndims > 255) return false;
+  out->request_type = static_cast<Type>(type);
+  out->tensor_type = static_cast<DataType>(dtype);
+  std::vector<int64_t> dims(ndims);
+  for (int i = 0; i < ndims; ++i)
+    if (!r.I64(&dims[i])) return false;
+  out->tensor_shape = TensorShape(std::move(dims));
+  *consumed = r.off;
+  return true;
+}
+
+void RequestList::SerializeTo(std::vector<uint8_t>* out) const {
+  PutI32(out, shutdown ? 1 : 0);
+  PutI32(out, static_cast<int32_t>(requests.size()));
+  for (const auto& req : requests) req.SerializeTo(out);
+}
+
+bool RequestList::ParseFrom(const uint8_t* data, size_t len,
+                            RequestList* out) {
+  Reader r{data, len};
+  int32_t sd, n;
+  if (!r.I32(&sd) || !r.I32(&n) || n < 0) return false;
+  out->shutdown = sd != 0;
+  out->requests.clear();
+  size_t off = r.off;
+  for (int i = 0; i < n; ++i) {
+    Request req;
+    size_t consumed;
+    if (!Request::ParseFrom(data + off, len - off, &consumed, &req))
+      return false;
+    off += consumed;
+    out->requests.push_back(std::move(req));
+  }
+  return true;
+}
+
+void Response::SerializeTo(std::vector<uint8_t>* out) const {
+  PutI32(out, static_cast<int32_t>(response_type));
+  PutI32(out, static_cast<int32_t>(tensor_names.size()));
+  for (const auto& n : tensor_names) PutStr(out, n);
+  PutStr(out, error_message);
+  PutI32(out, static_cast<int32_t>(devices.size()));
+  for (auto d : devices) PutI32(out, d);
+  PutI32(out, static_cast<int32_t>(tensor_sizes.size()));
+  for (auto s : tensor_sizes) PutI64(out, s);
+}
+
+bool Response::ParseFrom(const uint8_t* data, size_t len, size_t* consumed,
+                         Response* out) {
+  Reader r{data, len};
+  int32_t type, n;
+  if (!r.I32(&type)) return false;
+  out->response_type = static_cast<Type>(type);
+  if (!r.I32(&n) || n < 0) return false;
+  out->tensor_names.resize(n);
+  for (int i = 0; i < n; ++i)
+    if (!r.Str(&out->tensor_names[i])) return false;
+  if (!r.Str(&out->error_message)) return false;
+  if (!r.I32(&n) || n < 0) return false;
+  out->devices.resize(n);
+  for (int i = 0; i < n; ++i)
+    if (!r.I32(&out->devices[i])) return false;
+  if (!r.I32(&n) || n < 0) return false;
+  out->tensor_sizes.resize(n);
+  for (int i = 0; i < n; ++i)
+    if (!r.I64(&out->tensor_sizes[i])) return false;
+  *consumed = r.off;
+  return true;
+}
+
+void ResponseList::SerializeTo(std::vector<uint8_t>* out) const {
+  PutI32(out, shutdown ? 1 : 0);
+  PutI32(out, static_cast<int32_t>(responses.size()));
+  for (const auto& resp : responses) resp.SerializeTo(out);
+}
+
+bool ResponseList::ParseFrom(const uint8_t* data, size_t len,
+                             ResponseList* out) {
+  Reader r{data, len};
+  int32_t sd, n;
+  if (!r.I32(&sd) || !r.I32(&n) || n < 0) return false;
+  out->shutdown = sd != 0;
+  out->responses.clear();
+  size_t off = r.off;
+  for (int i = 0; i < n; ++i) {
+    Response resp;
+    size_t consumed;
+    if (!Response::ParseFrom(data + off, len - off, &consumed, &resp))
+      return false;
+    off += consumed;
+    out->responses.push_back(std::move(resp));
+  }
+  return true;
+}
+
+}  // namespace hvdtpu

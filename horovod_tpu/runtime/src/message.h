@@ -1,0 +1,85 @@
+// Wire protocol (control plane) — TPU-native equivalent of
+// horovod/common/mpi_message.{h,cc} + wire/mpi_message.fbs (N2).
+//
+// The reference serializes negotiation messages with FlatBuffers. We use a
+// dependency-free little-endian binary format (length-prefixed strings,
+// fixed-width ints): the control plane rides a TCP rendezvous between host
+// processes instead of MPI_Gatherv/Bcast, and messages are small (names +
+// shapes), so a compact hand-rolled codec is simpler and faster than
+// vendoring a serialization library.
+#ifndef HVD_TPU_MESSAGE_H
+#define HVD_TPU_MESSAGE_H
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+// Mirrors MPIRequest (reference mpi_message.h:44-86): one rank announcing a
+// tensor is ready for a collective.
+struct Request {
+  enum Type : int32_t { ALLREDUCE = 0, ALLGATHER = 1, BROADCAST = 2 };
+
+  int32_t request_rank = 0;
+  Type request_type = ALLREDUCE;
+  DataType tensor_type = DataType::HVD_FLOAT32;
+  std::string tensor_name;
+  int32_t root_rank = -1;
+  int32_t device = CPU_DEVICE_ID;
+  TensorShape tensor_shape;
+
+  void SerializeTo(std::vector<uint8_t>* out) const;
+  static bool ParseFrom(const uint8_t* data, size_t len, size_t* consumed,
+                        Request* out);
+};
+
+const char* RequestTypeName(Request::Type t);
+
+// Mirrors MPIRequestList{requests, shutdown} (mpi_message.h:88-105).
+struct RequestList {
+  std::vector<Request> requests;
+  bool shutdown = false;
+
+  void SerializeTo(std::vector<uint8_t>* out) const;
+  static bool ParseFrom(const uint8_t* data, size_t len, RequestList* out);
+};
+
+// Mirrors MPIResponse (mpi_message.h:112-155): the coordinator's verdict for
+// one fused group — op to run, fused tensor names, error text, devices, and
+// per-rank first-dim sizes for allgather.
+struct Response {
+  enum Type : int32_t {
+    ALLREDUCE = 0,
+    ALLGATHER = 1,
+    BROADCAST = 2,
+    ERROR = 3,
+  };
+
+  Type response_type = ALLREDUCE;
+  std::vector<std::string> tensor_names;
+  std::string error_message;
+  std::vector<int32_t> devices;
+  // Allgather: first-dimension size contributed by each rank
+  // (mpi_message.h:147-152 tensor_sizes).
+  std::vector<int64_t> tensor_sizes;
+
+  void SerializeTo(std::vector<uint8_t>* out) const;
+  static bool ParseFrom(const uint8_t* data, size_t len, size_t* consumed,
+                        Response* out);
+};
+
+// Mirrors MPIResponseList (mpi_message.h:157-174).
+struct ResponseList {
+  std::vector<Response> responses;
+  bool shutdown = false;
+
+  void SerializeTo(std::vector<uint8_t>* out) const;
+  static bool ParseFrom(const uint8_t* data, size_t len, ResponseList* out);
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_MESSAGE_H

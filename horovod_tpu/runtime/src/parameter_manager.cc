@@ -1,0 +1,127 @@
+#include "parameter_manager.h"
+
+#include <algorithm>
+#include <cmath>
+
+#include "logging.h"
+
+namespace hvdtpu {
+
+namespace {
+// Knob bounds — fusion threshold 0..64 MB, cycle time 1..100 ms
+// (reference parameter_manager.cc:41-54).
+std::vector<std::pair<double, double>> KnobBounds() {
+  return {{0.0, 64.0}, {1.0, 100.0}};
+}
+}  // namespace
+
+ParameterManager::ParameterManager()
+    : bo_flat_(KnobBounds(), 0.01, 41), bo_hier_(KnobBounds(), 0.01, 43) {}
+
+void ParameterManager::Initialize(int rank, const std::string& log_path) {
+  rank_ = rank;
+  if (rank == 0 && !log_path.empty()) {
+    log_ = std::fopen(log_path.c_str(), "w");
+    if (log_) std::fputs("fusion_mb,cycle_ms,hierarchical,score\n", log_);
+  }
+}
+
+int64_t ParameterManager::TensorFusionThresholdBytes() const {
+  return static_cast<int64_t>(fusion_mb_ * 1024.0 * 1024.0);
+}
+
+double ParameterManager::CycleTimeMs() const { return cycle_ms_; }
+
+bool ParameterManager::HierarchicalAllreduce() const { return hierarchical_; }
+
+bool ParameterManager::Update(int64_t bytes, double seconds) {
+  if (!active_ || done_) return false;
+  acc_bytes_ += bytes;
+  acc_seconds_ += seconds;
+  if (++acc_cycles_ < kCyclesPerSample) return false;
+
+  // Score = bytes per microsecond (parameter_manager.cc:144-170).
+  double score =
+      acc_seconds_ > 0 ? (acc_bytes_ / (acc_seconds_ * 1e6)) : 0.0;
+  acc_bytes_ = 0;
+  acc_seconds_ = 0;
+  acc_cycles_ = 0;
+
+  if (warmups_left_ > 0) {
+    --warmups_left_;
+    return false;
+  }
+  samples_.push_back(score);
+  if (static_cast<int>(samples_.size()) < kSamplesPerStep) return false;
+
+  std::vector<double> s = samples_;
+  samples_.clear();
+  std::nth_element(s.begin(), s.begin() + s.size() / 2, s.end());
+  double median = s[s.size() / 2];
+  LogSample(median);
+  Tune(median);
+  return true;
+}
+
+void ParameterManager::Tune(double median_score) {
+  // Record the observation for the active category.
+  std::vector<double> point = {fusion_mb_, cycle_ms_};
+  (hierarchical_ ? bo_hier_ : bo_flat_).AddSample(point, median_score);
+  if (median_score > best_score_) {
+    best_score_ = median_score;
+    best_fusion_mb_ = fusion_mb_;
+    best_cycle_ms_ = cycle_ms_;
+    best_hierarchical_ = hierarchical_;
+  }
+
+  if (++steps_ >= kMaxSteps) {
+    SetDone();
+    return;
+  }
+
+  // Alternate the categorical flag (CategoricalParameter sweep) and ask the
+  // corresponding BO for its next point.
+  category_ = (category_ + 1) % 4;           // explore hierarchical 1 in 4
+  bool next_hier = category_ == 3;
+  auto next = (next_hier ? bo_hier_ : bo_flat_).NextSample();
+  ApplyPoint(next, next_hier);
+  HVD_LOG(DEBUG) << "autotune step " << steps_ << ": fusion_mb=" << fusion_mb_
+                 << " cycle_ms=" << cycle_ms_ << " hier=" << hierarchical_
+                 << " (median score " << median_score << ")";
+}
+
+void ParameterManager::ApplyPoint(const std::vector<double>& p,
+                                  bool hierarchical) {
+  fusion_mb_ = std::min(64.0, std::max(0.0, p[0]));
+  cycle_ms_ = std::min(100.0, std::max(1.0, p[1]));
+  hierarchical_ = hierarchical;
+}
+
+void ParameterManager::SetDone() {
+  // Freeze to best (parameter_manager.cc:173-209).
+  fusion_mb_ = best_fusion_mb_;
+  cycle_ms_ = best_cycle_ms_;
+  hierarchical_ = best_hierarchical_;
+  done_ = true;
+  if (rank_ == 0) {
+    HVD_LOG(INFO) << "autotune converged: fusion_mb=" << fusion_mb_
+                  << " cycle_ms=" << cycle_ms_
+                  << " hierarchical=" << hierarchical_
+                  << " score=" << best_score_;
+  }
+  if (log_) {
+    std::fflush(log_);
+    std::fclose(log_);
+    log_ = nullptr;
+  }
+}
+
+void ParameterManager::LogSample(double score) {
+  if (log_) {
+    std::fprintf(log_, "%.3f,%.3f,%d,%.6f\n", fusion_mb_, cycle_ms_,
+                 hierarchical_ ? 1 : 0, score);
+    std::fflush(log_);
+  }
+}
+
+}  // namespace hvdtpu

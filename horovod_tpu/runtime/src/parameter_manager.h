@@ -1,0 +1,88 @@
+// Parameter manager (autotuning) — equivalent of
+// horovod/common/parameter_manager.{h,cc} (N5).
+//
+// Tunes the fusion-buffer threshold (MB) and cycle time (ms) jointly with
+// Bayesian optimization, and the hierarchical-allreduce flag categorically,
+// to maximize throughput score = bytes / microsecond — the reference's
+// knobs and score exactly (parameter_manager.cc:28-54, 144-170). Scoring
+// protocol kept: samples are accumulated over a fixed number of cycles,
+// several warmup samples are discarded, and the median of recent samples
+// drives each tuning step (parameter_manager.h:211-213).
+#ifndef HVD_TPU_PARAMETER_MANAGER_H
+#define HVD_TPU_PARAMETER_MANAGER_H
+
+#include <cstdint>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+#include "bayesian_optimization.h"
+
+namespace hvdtpu {
+
+class ParameterManager {
+ public:
+  ParameterManager();
+
+  void Initialize(int rank, const std::string& log_path);
+  void SetAutoTuning(bool active) { active_ = active; }
+  bool IsAutoTuning() const { return active_; }
+
+  // Feed one completed-cycle observation (total payload bytes moved and
+  // wall seconds). Returns true when parameters changed (reference
+  // ParameterManager::Update, parameter_manager.cc:144-170).
+  bool Update(int64_t bytes, double seconds);
+
+  int64_t TensorFusionThresholdBytes() const;
+  double CycleTimeMs() const;
+  bool HierarchicalAllreduce() const;
+
+  // Freeze to best-seen values (reference convergence path,
+  // parameter_manager.cc:173-209).
+  void SetDone();
+  bool IsDone() const { return done_; }
+
+ private:
+  void Tune(double score);
+  void ApplyPoint(const std::vector<double>& p, bool hierarchical);
+  void LogSample(double score);
+
+  bool active_ = false;
+  bool done_ = false;
+  int rank_ = 0;
+
+  // Current / best values.
+  double fusion_mb_ = 64.0;   // default operations.cc:1838
+  double cycle_ms_ = 5.0;     // default operations.cc:1846
+  bool hierarchical_ = false;
+  double best_score_ = -1.0;
+  double best_fusion_mb_ = 64.0;
+  double best_cycle_ms_ = 5.0;
+  bool best_hierarchical_ = false;
+
+  // Scoring accumulation (parameter_manager.cc:28-29: 10 cycles/sample,
+  // median of 5 samples, 3 warmup discards).
+  static constexpr int kCyclesPerSample = 10;
+  static constexpr int kSamplesPerStep = 5;
+  static constexpr int kWarmupSamples = 3;
+  static constexpr int kMaxSteps = 30;
+
+  int64_t acc_bytes_ = 0;
+  double acc_seconds_ = 0.0;
+  int acc_cycles_ = 0;
+  std::vector<double> samples_;
+  int warmups_left_ = kWarmupSamples;
+  int steps_ = 0;
+
+  // One BO instance per categorical value of the hierarchical flag, the
+  // reference's CategoricalParameter × BayesianParameter structure.
+  BayesianOptimization bo_flat_;
+  BayesianOptimization bo_hier_;
+  int category_ = 0;  // alternate exploration between categories
+
+  std::FILE* log_ = nullptr;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_PARAMETER_MANAGER_H

@@ -1,0 +1,249 @@
+// See timeline.h. Chrome-trace JSON format (catapult), one record per line.
+#include "timeline.h"
+
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <memory>
+#include <mutex>
+
+#include "logging.h"
+
+namespace hvdtpu {
+
+namespace {
+
+int64_t NowUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// Producer-side mutex: the reference guards Timeline with a recursive mutex
+// (timeline.h:112-113) because enqueue threads and the background thread
+// both emit; the ring itself stays single-consumer.
+std::mutex& ProducerMutex() {
+  static std::mutex m;
+  return m;
+}
+
+void CopyStr(char* dst, size_t cap, const std::string& s) {
+  size_t n = s.size() < cap - 1 ? s.size() : cap - 1;
+  std::memcpy(dst, s.data(), n);
+  dst[n] = '\0';
+}
+
+}  // namespace
+
+SpscRing::SpscRing(size_t capacity_pow2)
+    : buf_(capacity_pow2), mask_(capacity_pow2 - 1) {}
+
+SpscRing::~SpscRing() = default;
+
+bool SpscRing::Push(const TimelineRecord& r) {
+  size_t tail = tail_.load(std::memory_order_relaxed);
+  size_t head = head_.load(std::memory_order_acquire);
+  if (tail - head >= buf_.size()) {
+    dropped_.fetch_add(1, std::memory_order_relaxed);
+    return false;  // full: drop instead of blocking the hot path
+  }
+  buf_[tail & mask_] = r;
+  tail_.store(tail + 1, std::memory_order_release);
+  return true;
+}
+
+bool SpscRing::Pop(TimelineRecord* r) {
+  size_t head = head_.load(std::memory_order_relaxed);
+  size_t tail = tail_.load(std::memory_order_acquire);
+  if (head == tail) return false;
+  *r = buf_[head & mask_];
+  head_.store(head + 1, std::memory_order_release);
+  return true;
+}
+
+void Timeline::Initialize(const std::string& path, bool mark_cycles) {
+  std::lock_guard<std::mutex> lk(ProducerMutex());
+  if (initialized_ || path.empty()) return;
+  // Open up front so an unwritable path disables the timeline instead of
+  // filling a ring nobody drains.
+  file_ = std::fopen(path.c_str(), "w");
+  if (!file_) {
+    HVD_LOG(ERROR) << "Failed to open timeline file " << path
+                   << "; timeline disabled";
+    return;
+  }
+  path_ = path;
+  mark_cycles_ = mark_cycles;
+  ring_ = std::make_unique<SpscRing>(1 << 20);  // 2^20, timeline.h:66-68
+  start_us_ = NowUs();
+  stop_.store(false);
+  writer_ = std::thread([this] { WriterLoop(); });
+  initialized_ = true;
+}
+
+void Timeline::Shutdown() {
+  {
+    std::lock_guard<std::mutex> lk(ProducerMutex());
+    if (!initialized_) return;
+    initialized_ = false;
+  }
+  stop_.store(true);
+  if (writer_.joinable()) writer_.join();
+}
+
+int64_t Timeline::TensorPid(const std::string& tensor_name) {
+  // Interned pid per tensor; emit Chrome process_name metadata on first
+  // sight (reference timeline.cc:70-90).
+  auto it = tensor_pids_.find(tensor_name);
+  if (it != tensor_pids_.end()) return it->second;
+  int64_t pid = static_cast<int64_t>(tensor_pids_.size()) + 1;
+  tensor_pids_.emplace(tensor_name, pid);
+  TimelineRecord r{};
+  r.type = TimelineRecordType::META;
+  r.pid = pid;
+  r.ts_us = NowUs() - start_us_;
+  CopyStr(r.name, sizeof(r.name), tensor_name);
+  ring_->Push(r);
+  return pid;
+}
+
+void Timeline::Emit(TimelineRecordType type, int64_t pid, const char* name,
+                    const char* args) {
+  TimelineRecord r{};
+  r.type = type;
+  r.pid = pid;
+  r.ts_us = NowUs() - start_us_;
+  if (name) CopyStr(r.name, sizeof(r.name), name);
+  if (args) CopyStr(r.args, sizeof(r.args), args);
+  ring_->Push(r);
+}
+
+void Timeline::NegotiateStart(const std::string& tensor_name,
+                              int32_t request_type) {
+  std::lock_guard<std::mutex> lk(ProducerMutex());
+  if (!initialized_) return;
+  static const char* kOps[] = {"NEGOTIATE_ALLREDUCE", "NEGOTIATE_ALLGATHER",
+                               "NEGOTIATE_BROADCAST"};
+  const char* op = (request_type >= 0 && request_type < 3)
+                       ? kOps[request_type] : "NEGOTIATE";
+  Emit(TimelineRecordType::EVENT_BEGIN, TensorPid(tensor_name), op, nullptr);
+}
+
+void Timeline::NegotiateRankReady(const std::string& tensor_name, int rank) {
+  std::lock_guard<std::mutex> lk(ProducerMutex());
+  if (!initialized_) return;
+  char name[32];
+  std::snprintf(name, sizeof(name), "%d", rank);
+  Emit(TimelineRecordType::EVENT_INSTANT, TensorPid(tensor_name), name,
+       nullptr);
+}
+
+void Timeline::NegotiateEnd(const std::string& tensor_name) {
+  std::lock_guard<std::mutex> lk(ProducerMutex());
+  if (!initialized_) return;
+  Emit(TimelineRecordType::EVENT_END, TensorPid(tensor_name), nullptr,
+       nullptr);
+}
+
+void Timeline::Start(const std::string& tensor_name,
+                     const std::string& op_name) {
+  std::lock_guard<std::mutex> lk(ProducerMutex());
+  if (!initialized_) return;
+  Emit(TimelineRecordType::EVENT_BEGIN, TensorPid(tensor_name),
+       op_name.c_str(), nullptr);
+}
+
+void Timeline::ActivityStart(const std::string& tensor_name,
+                             const std::string& activity) {
+  std::lock_guard<std::mutex> lk(ProducerMutex());
+  if (!initialized_) return;
+  Emit(TimelineRecordType::EVENT_BEGIN, TensorPid(tensor_name),
+       activity.c_str(), nullptr);
+}
+
+void Timeline::ActivityEnd(const std::string& tensor_name) {
+  std::lock_guard<std::mutex> lk(ProducerMutex());
+  if (!initialized_) return;
+  Emit(TimelineRecordType::EVENT_END, TensorPid(tensor_name), nullptr,
+       nullptr);
+}
+
+void Timeline::End(const std::string& tensor_name,
+                   const std::string& output_shape) {
+  std::lock_guard<std::mutex> lk(ProducerMutex());
+  if (!initialized_) return;
+  // Close the activity (if any) and the op event; log shape as args
+  // (reference timeline.cc End section).
+  Emit(TimelineRecordType::EVENT_END, TensorPid(tensor_name), nullptr,
+       output_shape.empty() ? nullptr : output_shape.c_str());
+}
+
+void Timeline::MarkCycleStart() {
+  std::lock_guard<std::mutex> lk(ProducerMutex());
+  if (!initialized_ || !mark_cycles_) return;
+  Emit(TimelineRecordType::EVENT_INSTANT, 0, "CYCLE_START", nullptr);
+}
+
+void Timeline::WriterLoop() {
+  std::FILE* f = file_;
+  std::fputs("[\n", f);
+  TimelineRecord r;
+  bool first = true;
+  auto write_one = [&](const TimelineRecord& rec) {
+    if (!first) std::fputs(",\n", f);
+    first = false;
+    switch (rec.type) {
+      case TimelineRecordType::META:
+        std::fprintf(f,
+                     "{\"name\": \"process_name\", \"ph\": \"M\", \"pid\": "
+                     "%lld, \"args\": {\"name\": \"%s\"}}",
+                     (long long)rec.pid, rec.name);
+        break;
+      case TimelineRecordType::EVENT_BEGIN:
+        std::fprintf(f,
+                     "{\"name\": \"%s\", \"ph\": \"B\", \"pid\": %lld, "
+                     "\"tid\": 0, \"ts\": %lld}",
+                     rec.name, (long long)rec.pid, (long long)rec.ts_us);
+        break;
+      case TimelineRecordType::EVENT_END:
+        if (rec.args[0]) {
+          std::fprintf(f,
+                       "{\"ph\": \"E\", \"pid\": %lld, \"tid\": 0, \"ts\": "
+                       "%lld, \"args\": {\"shape\": \"%s\"}}",
+                       (long long)rec.pid, (long long)rec.ts_us, rec.args);
+        } else {
+          std::fprintf(f,
+                       "{\"ph\": \"E\", \"pid\": %lld, \"tid\": 0, \"ts\": "
+                       "%lld}",
+                       (long long)rec.pid, (long long)rec.ts_us);
+        }
+        break;
+      case TimelineRecordType::EVENT_INSTANT:
+        std::fprintf(f,
+                     "{\"name\": \"%s\", \"ph\": \"i\", \"pid\": %lld, "
+                     "\"tid\": 0, \"ts\": %lld, \"s\": \"g\"}",
+                     rec.name, (long long)rec.pid, (long long)rec.ts_us);
+        break;
+    }
+  };
+  while (!stop_.load(std::memory_order_acquire)) {
+    bool any = false;
+    while (ring_->Pop(&r)) {
+      write_one(r);
+      any = true;
+    }
+    if (any) {
+      std::fflush(f);
+    } else {
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+  }
+  while (ring_->Pop(&r)) write_one(r);
+  // Leave the JSON array unterminated-but-valid-enough for catapult, the
+  // same trailing behavior as the reference writer (chrome://tracing
+  // accepts a missing closing bracket).
+  std::fputs("\n", f);
+  std::fclose(f);
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,106 @@
+// Timeline — TPU-native equivalent of horovod/common/timeline.{h,cc} (N7).
+//
+// Chrome-trace (catapult) JSON profiler written on the coordinator process
+// only, enabled by HOROVOD_TIMELINE=<file> (operations.cc:1824-1829). Design
+// kept from the reference: events are pushed into a lock-free single-
+// producer/single-consumer ring buffer (the reference uses
+// boost::lockfree::spsc_queue of capacity 2^20, timeline.h:66-68) drained by
+// a dedicated writer thread, so the hot cycle never blocks on file IO. Each
+// tensor is modeled as a Chrome "process" with an interned pid
+// (timeline.cc:70-90). Phases: NEGOTIATE_<OP> with per-rank ready ticks,
+// then the op with nested activities (WAIT_FOR_DATA, MEMCPY_IN_FUSION_
+// BUFFER, XLA_ALLREDUCE, ... — reference operations.h:29-50).
+#ifndef HVD_TPU_TIMELINE_H
+#define HVD_TPU_TIMELINE_H
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <memory>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace hvdtpu {
+
+enum class TimelineRecordType : int8_t {
+  EVENT_BEGIN = 'B',
+  EVENT_END = 'E',
+  EVENT_INSTANT = 'i',
+  META = 'M',
+};
+
+struct TimelineRecord {
+  TimelineRecordType type;
+  int64_t pid;
+  int64_t ts_us;
+  // Fixed-size payloads keep the ring buffer POD (no allocation on the
+  // producer side once interned).
+  char name[64];
+  char args[64];
+};
+
+// Lock-free SPSC ring buffer (capacity must be a power of two) — stands in
+// for boost::lockfree::spsc_queue (reference timeline.h:66-68).
+class SpscRing {
+ public:
+  explicit SpscRing(size_t capacity_pow2);
+  ~SpscRing();
+  bool Push(const TimelineRecord& r);   // producer
+  bool Pop(TimelineRecord* r);          // consumer
+  size_t dropped() const { return dropped_.load(std::memory_order_relaxed); }
+
+ private:
+  std::vector<TimelineRecord> buf_;
+  size_t mask_;
+  std::atomic<size_t> head_{0};  // consumer position
+  std::atomic<size_t> tail_{0};  // producer position
+  std::atomic<size_t> dropped_{0};
+};
+
+class Timeline {
+ public:
+  Timeline() = default;
+  ~Timeline() { Shutdown(); }
+
+  void Initialize(const std::string& path, bool mark_cycles);
+  bool Initialized() const { return initialized_; }
+  void Shutdown();
+
+  // Negotiation phase (reference timeline.h:42-50, operations.cc:298-311).
+  void NegotiateStart(const std::string& tensor_name, int32_t request_type);
+  void NegotiateRankReady(const std::string& tensor_name, int rank);
+  void NegotiateEnd(const std::string& tensor_name);
+
+  // Execution phase (timeline.h:52-60).
+  void Start(const std::string& tensor_name, const std::string& op_name);
+  void ActivityStart(const std::string& tensor_name,
+                     const std::string& activity);
+  void ActivityEnd(const std::string& tensor_name);
+  void End(const std::string& tensor_name, const std::string& output_shape);
+
+  // HOROVOD_TIMELINE_MARK_CYCLES (operations.cc:1831-1835).
+  void MarkCycleStart();
+
+ private:
+  int64_t TensorPid(const std::string& tensor_name);
+  void Emit(TimelineRecordType type, int64_t pid, const char* name,
+            const char* args);
+  void WriterLoop();
+
+  bool initialized_ = false;
+  bool mark_cycles_ = false;
+  std::string path_;
+  std::FILE* file_ = nullptr;  // opened in Initialize, closed by writer
+  std::unique_ptr<SpscRing> ring_;
+  std::thread writer_;
+  std::atomic<bool> stop_{false};
+  std::unordered_map<std::string, int64_t> tensor_pids_;
+  std::vector<std::string> pending_meta_;
+  int64_t start_us_ = 0;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVD_TPU_TIMELINE_H

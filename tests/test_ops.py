@@ -114,13 +114,13 @@ class TestAllreduce:
         from horovod_tpu.ops import collective
         eng = collective.engine()
         gate = threading.Event()
-        orig = eng._dispatch
+        orig = eng._execute_group
 
-        def slow_dispatch(batch):
+        def slow_execute(ex, group):
             gate.wait(10)
-            orig(batch)
+            return orig(ex, group)
 
-        monkeypatch.setattr(eng, "_dispatch", slow_dispatch)
+        monkeypatch.setattr(eng, "_execute_group", slow_execute)
         h1 = hvd.allreduce_async(jnp.ones((4,)), name="dup.name")
         try:
             with pytest.raises(ValueError, match="same name"):

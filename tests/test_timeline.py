@@ -1,0 +1,55 @@
+"""Timeline test — structural mirror of the reference's
+test/test_timeline.py:41-57: run collectives with HOROVOD_TIMELINE set,
+then grep the Chrome-trace JSON for the negotiation and execution phases."""
+
+import json
+import os
+import subprocess
+import sys
+
+SCRIPT = r"""
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import horovod_tpu as hvd
+from horovod_tpu.ops import collective
+
+hvd.init()
+hvd.allreduce(jnp.ones((16, 16)), name="timeline.test.allreduce")
+hvd.allgather(jnp.ones((4, 4)), name="timeline.test.allgather")
+hvd.broadcast(jnp.ones((4,)), 0, name="timeline.test.broadcast")
+collective.engine().shutdown()   # flush + close the timeline writer
+"""
+
+
+def test_timeline_records_phases(tmp_path):
+    tl = tmp_path / "timeline.json"
+    env = dict(os.environ)
+    env["HOROVOD_TIMELINE"] = str(tl)
+    env["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
+    proc = subprocess.run([sys.executable, "-c", SCRIPT], env=env,
+                          capture_output=True, text=True, timeout=300,
+                          cwd=os.path.dirname(os.path.dirname(__file__)))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    text = tl.read_text()
+    # Negotiation + op phases (reference test_timeline.py greps
+    # NEGOTIATE_ALLREDUCE / ALLREDUCE / CYCLE_START).
+    assert "NEGOTIATE_ALLREDUCE" in text
+    assert '"ALLREDUCE"' in text
+    assert "NEGOTIATE_ALLGATHER" in text
+    assert "NEGOTIATE_BROADCAST" in text
+    assert "CYCLE_START" in text
+    assert "XLA_ALLREDUCE" in text
+    # Tensor names became Chrome "processes" (timeline.cc:70-90 parity).
+    assert "timeline.test.allreduce" in text
+
+    # Every line between the brackets must be valid JSON records.
+    body = text.strip()
+    assert body.startswith("[")
+    records = [ln.rstrip(",") for ln in body.splitlines()[1:] if ln.strip()
+               and ln.strip() not in ("[", "]")]
+    for ln in records[:50]:
+        json.loads(ln)
