@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Synthetic ResNet-50 benchmark — the TPU-native equivalent of
+examples/tensorflow_synthetic_benchmark.py (the reference's in-tree
+benchmark driver, :88-107): ResNet-50 on synthetic ImageNet-shaped data,
+warmup batches then timed iterations, reporting img/sec.
+
+Method parity: 10 warmup batches; 10 iterations x 10 batches each; the
+reported number is the mean. Trains through the framework path: mesh over
+all available devices, batch sharded over 'dp', DistributedOptimizer.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "img/sec/chip", "vs_baseline": N}
+Baseline: the reference's sample run reports "total images/sec: 1656.82"
+on 16 Pascal GPUs (docs/benchmarks.md:22-38) = 103.55 img/sec/GPU.
+"""
+
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import ResNet50
+
+BASELINE_IMG_SEC_PER_CHIP = 1656.82 / 16  # docs/benchmarks.md:22-38
+
+BATCH_PER_CHIP = 64        # reference default --batch-size 64
+WARMUP_ITERS = 3
+NUM_ITERS = 10
+NUM_BATCHES_PER_ITER = 10
+
+
+def main():
+    hvd.init()
+    n = hvd.size()
+    mesh = hvd.mesh()
+    batch = BATCH_PER_CHIP * n
+
+    model = ResNet50(num_classes=1000)
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.normal(rng, (batch, 224, 224, 3), jnp.float32)
+    labels = jax.random.randint(rng, (batch,), 0, 1000)
+
+    variables = model.init(rng, images[:2], train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    # Framework path: broadcast initial state from rank 0, then wrap the
+    # optimizer (grads are averaged over the mesh inside the jitted step).
+    params = hvd.broadcast_parameters(params, root_rank=0)
+    opt = optax.sgd(0.01 * n, momentum=0.9)
+    opt_state = opt.init(params)
+
+    if n > 1:
+        images = jax.device_put(images, NamedSharding(mesh, P("dp")))
+        labels = jax.device_put(labels, NamedSharding(mesh, P("dp")))
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state, images, labels):
+        def loss_fn(p):
+            logits, new_state = model.apply(
+                {"params": p, "batch_stats": batch_stats}, images,
+                train=True, mutable=["batch_stats"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+            return loss, new_state["batch_stats"]
+
+        (loss, new_bs), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, new_opt = opt.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_bs, new_opt, loss
+
+    def run_batches(k):
+        nonlocal params, batch_stats, opt_state
+        for _ in range(k):
+            params, batch_stats, opt_state, loss = train_step(
+                params, batch_stats, opt_state, images, labels)
+        jax.block_until_ready(loss)
+        return loss
+
+    # Warmup (compile + stabilize), reference :88-92.
+    run_batches(WARMUP_ITERS)
+
+    # Timed iterations (reference :94-101).
+    img_secs = []
+    for _ in range(NUM_ITERS):
+        t0 = time.perf_counter()
+        run_batches(NUM_BATCHES_PER_ITER)
+        dt = time.perf_counter() - t0
+        img_secs.append(batch * NUM_BATCHES_PER_ITER / dt)
+
+    per_chip = float(np.mean(img_secs)) / n
+    print(json.dumps({
+        "metric": "resnet50_synthetic_img_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "img/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMG_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

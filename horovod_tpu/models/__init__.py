@@ -1,0 +1,10 @@
+"""Model zoo for benchmarks and examples.
+
+The reference ships no models (it benchmarks Keras-applications ResNet-50,
+examples/tensorflow_synthetic_benchmark.py:24-42); these are the TPU-native
+equivalents plus the flagship Transformer used for the parallelism layers.
+"""
+
+from .resnet import ResNet, ResNet50, ResNet101, ResNet152
+
+__all__ = ["ResNet", "ResNet50", "ResNet101", "ResNet152"]

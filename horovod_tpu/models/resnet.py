@@ -1,0 +1,91 @@
+"""ResNet v1.5 family in Flax — the benchmark workload.
+
+The reference has no model code of its own; its synthetic benchmark pulls
+ResNet-50 from Keras applications (examples/tensorflow_synthetic_benchmark.
+py:24-42) and the docs' scaling numbers are ResNet-101/Inception V3/VGG-16
+(docs/benchmarks.md:5-6). This is the TPU-native equivalent model zoo for
+those benchmarks.
+
+TPU-first choices: bf16 activations (MXU-native) with fp32 parameters and
+fp32 batch-norm statistics; NHWC layout (XLA's preferred conv layout on
+TPU); no data-dependent control flow, so the whole step jits into one
+program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    """ResNet v1.5 bottleneck (stride in the 3x3, torchvision-style)."""
+
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+    act: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1), use_bias=False, name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), self.strides, use_bias=False,
+                      name="conv2")(y)
+        y = self.norm(name="bn2")(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1), use_bias=False,
+                      name="conv3")(y)
+        y = self.norm(scale_init=nn.initializers.zeros, name="bn3")(y)
+
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides,
+                                 use_bias=False, name="downsample_conv")(
+                residual)
+            residual = self.norm(name="downsample_bn")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    """ResNet v1.5 with bf16 compute / fp32 params."""
+
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32,
+                       axis_name=None)
+
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                 use_bias=False, name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = BottleneckBlock(self.num_filters * 2 ** i,
+                                    strides=strides, conv=conv, norm=norm,
+                                    name=f"stage{i + 1}_block{j + 1}")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+ResNet50 = partial(ResNet, stage_sizes=[3, 4, 6, 3])
+ResNet101 = partial(ResNet, stage_sizes=[3, 4, 23, 3])
+ResNet152 = partial(ResNet, stage_sizes=[3, 8, 36, 3])

@@ -1,0 +1,222 @@
+"""Flagship Transformer LM — the model that exercises every parallelism
+axis the framework offers (dp / tp / sp / ep; pp via parallel.pipeline).
+
+The reference carries no model code (SURVEY.md §2: "no model code"); this
+is the TPU-native flagship used by __graft_entry__ and the long-context
+benchmarks. Design:
+
+  - Decoder-only pre-norm Transformer, GPT-style.
+  - bf16 activations, fp32 params/layernorms, MXU-shaped matmuls.
+  - Written shard_map-style: the *functional* apply takes the mesh axis
+    names active for tensor ('tp') and sequence ('sp') parallelism; the
+    attention runs ring attention when 'sp' is active.
+  - Optional MoE MLP every other block over 'ep'.
+  - ``jax.checkpoint`` (remat) around each block: HBM-for-FLOPs trade.
+
+Parameters are created with plain ``init`` and sharded by
+:func:`param_specs`, so jit-level code can use ordinary NamedSharding
+constraint-based partitioning.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.ring_attention import ring_attention, full_attention
+from ..parallel.expert import moe_apply, moe_init
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 2048
+    max_seq: int = 2048
+    dtype: Any = jnp.bfloat16
+    # parallelism axis names; None disables that axis
+    tp_axis: Optional[str] = None
+    sp_axis: Optional[str] = None
+    ep_axis: Optional[str] = None
+    # MoE: when set, every other block's MLP is a top-1 MoE
+    num_experts: int = 0
+    capacity_factor: float = 2.0
+    remat: bool = True
+
+
+def _axis_size(axis: Optional[str]) -> int:
+    return lax.axis_size(axis) if axis else 1
+
+
+def init_params(cfg: TransformerConfig, rng) -> Dict:
+    """Initialize GLOBAL parameters (unsharded; shard via param_specs)."""
+    keys = jax.random.split(rng, cfg.n_layers + 2)
+    d, h, f = cfg.d_model, cfg.n_heads, cfg.d_ff
+    scale = d ** -0.5
+
+    def dense(key, shape, s):
+        return jax.random.normal(key, shape, jnp.float32) * s
+
+    layers = []
+    for i in range(cfg.n_layers):
+        k = jax.random.split(keys[i], 8)
+        layer = {
+            "ln1": jnp.ones((d,), jnp.float32),
+            "ln2": jnp.ones((d,), jnp.float32),
+            "wq": dense(k[0], (d, d), scale),
+            "wk": dense(k[1], (d, d), scale),
+            "wv": dense(k[2], (d, d), scale),
+            "wo": dense(k[3], (d, d), scale),
+        }
+        if cfg.num_experts and i % 2 == 1:
+            layer["moe"] = moe_init(
+                k[4], num_experts=cfg.num_experts,
+                experts_per_shard=cfg.num_experts,  # global at init
+                features=d, hidden=f)
+        else:
+            layer["wi"] = dense(k[5], (d, f), scale)
+            layer["wo_mlp"] = dense(k[6], (f, d), f ** -0.5)
+        layers.append(layer)
+
+    return {
+        "embed": dense(keys[-2], (cfg.vocab, d), 1.0),
+        "pos": dense(keys[-1], (cfg.max_seq, d), 0.02),
+        "ln_f": jnp.ones((d,), jnp.float32),
+        "layers": layers,
+    }
+
+
+def param_specs(cfg: TransformerConfig) -> Dict:
+    """PartitionSpecs for jit-level sharding (scaling-book style):
+    tensor-parallel weights split on the head/ff dimension over 'tp',
+    experts over 'ep', everything else replicated (dp shards data, not
+    params)."""
+    tp = cfg.tp_axis
+    ep = cfg.ep_axis
+    layer_specs = []
+    for i in range(cfg.n_layers):
+        spec = {
+            "ln1": P(), "ln2": P(),
+            # Column-parallel QKV (split output dim), row-parallel out-proj
+            # (split input dim) — Megatron pairing, one psum per block.
+            "wq": P(None, tp), "wk": P(None, tp), "wv": P(None, tp),
+            "wo": P(tp, None),
+        }
+        if cfg.num_experts and i % 2 == 1:
+            spec["moe"] = {"router": P(), "wi": P(ep, None, None),
+                           "wo": P(ep, None, None)}
+        else:
+            spec["wi"] = P(None, tp)
+            spec["wo_mlp"] = P(tp, None)
+        layer_specs.append(spec)
+    return {"embed": P(), "pos": P(), "ln_f": P(), "layers": layer_specs}
+
+
+def _layernorm(x, g):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + 1e-5) * g).astype(x.dtype)
+
+
+def _block(params, x, cfg: TransformerConfig, layer_idx: int):
+    """One decoder block, shard_map-level (per-shard views).
+
+    x: [B, S_local, D]. Attention: heads are split over 'tp' (the wq/wk/wv
+    shards produce local heads), sequence over 'sp' (ring attention).
+    """
+    d = cfg.d_model
+    tp_n = _axis_size(cfg.tp_axis)
+    if cfg.n_heads % tp_n:
+        raise ValueError(
+            f"n_heads ({cfg.n_heads}) must be divisible by the tensor-"
+            f"parallel axis size ({tp_n})")
+    if d % cfg.n_heads:
+        raise ValueError(
+            f"d_model ({d}) must be divisible by n_heads ({cfg.n_heads})")
+    h_local = cfg.n_heads // tp_n
+    hd = d // cfg.n_heads
+    dt = cfg.dtype
+
+    y = _layernorm(x, params["ln1"])
+    b, s, _ = y.shape
+    q = (y @ params["wq"].astype(dt)).reshape(b, s, h_local, hd)
+    k = (y @ params["wk"].astype(dt)).reshape(b, s, h_local, hd)
+    v = (y @ params["wv"].astype(dt)).reshape(b, s, h_local, hd)
+
+    if cfg.sp_axis:
+        attn = ring_attention(q, k, v, axis_name=cfg.sp_axis, causal=True)
+    else:
+        attn = full_attention(q, k, v, causal=True)
+    attn = attn.reshape(b, s, h_local * hd)
+    o = attn @ params["wo"].astype(dt)
+    if cfg.tp_axis:
+        o = lax.psum(o, cfg.tp_axis)   # row-parallel out-proj
+    x = x + o
+
+    y = _layernorm(x, params["ln2"])
+    if cfg.num_experts and layer_idx % 2 == 1:
+        tokens = y.reshape(b * s, d)
+        # Under tp, split tokens across the tp axis so expert work is done
+        # once per tp group (not duplicated per rank) and every parameter's
+        # gradient stays a PARTIAL sum over tp — keeping the train-step's
+        # uniform reduction rule (psum over model axes) correct.
+        if cfg.tp_axis and tp_n > 1:
+            t_local = tokens.shape[0] // tp_n
+            i = lax.axis_index(cfg.tp_axis)
+            tokens = lax.dynamic_slice_in_dim(tokens, i * t_local, t_local)
+        out = moe_apply(params["moe"], tokens,
+                        num_experts=cfg.num_experts,
+                        capacity_factor=cfg.capacity_factor,
+                        axis_name=cfg.ep_axis, act=jax.nn.gelu, dtype=dt)
+        if cfg.tp_axis and tp_n > 1:
+            out = lax.all_gather(out, cfg.tp_axis, axis=0, tiled=True)
+        m = out.reshape(b, s, d)
+    else:
+        hmid = jax.nn.gelu(y @ params["wi"].astype(dt))
+        m = hmid @ params["wo_mlp"].astype(dt)
+        if cfg.tp_axis:
+            m = lax.psum(m, cfg.tp_axis)
+    return x + m
+
+
+def apply(params, tokens, cfg: TransformerConfig):
+    """Forward pass (shard_map-level). tokens: [B, S_local] int32.
+    Returns logits [B, S_local, vocab] (fp32)."""
+    dt = cfg.dtype
+    sp_n = _axis_size(cfg.sp_axis)
+    s_local = tokens.shape[1]
+    if cfg.sp_axis:
+        offset = lax.axis_index(cfg.sp_axis) * s_local
+    else:
+        offset = 0
+    pos = params["pos"][offset + jnp.arange(s_local)]
+
+    x = params["embed"].astype(dt)[tokens] + pos.astype(dt)
+
+    block = _block
+    if cfg.remat:
+        block = jax.checkpoint(_block, static_argnums=(2, 3))
+    for i, layer in enumerate(params["layers"]):
+        x = block(layer, x, cfg, i)
+
+    x = _layernorm(x, params["ln_f"])
+    logits = x.astype(jnp.float32) @ params["embed"].T
+    return logits
+
+
+def loss_fn(params, tokens, targets, cfg: TransformerConfig):
+    """Next-token cross-entropy, mean over local tokens; psum-mean over
+    'dp'/'sp' happens via the caller's pmean."""
+    logits = apply(params, tokens, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -ll.mean()

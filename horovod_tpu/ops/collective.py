@@ -182,24 +182,30 @@ class CollectiveEngine:
         operations.cc:2384-2402). Falls back to the Python control plane
         when the toolchain is unavailable or it is disabled via
         HOROVOD_TPU_DISABLE_NATIVE=1."""
-        if self._native_tried:
-            return self._native_core
-        self._native_tried = True
-        if os.environ.get("HOROVOD_TPU_DISABLE_NATIVE") == "1":
-            return None
-        try:
-            from ..runtime import native as _native_mod
-            core = _native_mod.load()
-            if core is None:
-                return None
-            topo = _topo._get()
-            core.init(topo.process_index, topo.process_count,
-                      topo.local_size, topo.size)
-            core.set_execute_callback(self._on_native_execute)
-            self._native_core = core
-        except Exception as e:  # pragma: no cover - degraded path
-            _log.warning("native control plane init failed: %s", e)
-            self._native_core = None
+        with self._lock:
+            if self._native_tried:
+                return self._native_core
+            # Resolve under the lock: a concurrent first-enqueue must not
+            # observe _native_tried=True with the core still loading (it
+            # would silently split the control plane between the native and
+            # Python paths).
+            try:
+                if os.environ.get("HOROVOD_TPU_DISABLE_NATIVE") == "1":
+                    return None
+                from ..runtime import native as _native_mod
+                core = _native_mod.load()
+                if core is None:
+                    return None
+                topo = _topo._get()
+                core.init(topo.process_index, topo.process_count,
+                          topo.local_size, topo.size)
+                core.set_execute_callback(self._on_native_execute)
+                self._native_core = core
+            except Exception as e:  # pragma: no cover - degraded path
+                _log.warning("native control plane init failed: %s", e)
+                self._native_core = None
+            finally:
+                self._native_tried = True
         return self._native_core
 
     def shutdown(self):

@@ -1,0 +1,29 @@
+"""Parallelism strategies over the device mesh.
+
+The reference implements exactly one strategy — synchronous data parallelism
+via allreduce (SURVEY.md §2.1: "TP / PP / SP / EP / CP / ring-attention:
+ABSENT") — so everything here beyond :mod:`data_parallel` is an extension
+built on the same mesh-axis collective layer, designed TPU-first:
+
+- :mod:`mesh`       — multi-axis mesh construction ('dp','tp','pp','sp','ep')
+- :mod:`collectives` — named-axis collective wrappers for in-jit use
+- :mod:`data_parallel` — batch sharding + gradient psum (the reference's
+  core capability, recast as shardings)
+- :mod:`tensor_parallel` — column/row-parallel Dense + attention heads
+- :mod:`ring_attention` — sequence/context parallelism for long sequences
+  (ppermute ring with online-softmax accumulation)
+- :mod:`pipeline`   — GPipe-style microbatch pipeline over 'pp'
+- :mod:`expert`     — mixture-of-experts dispatch over 'ep' (all_to_all)
+"""
+
+from .mesh import MeshSpec, create_mesh
+from .collectives import (all_gather, all_to_all, axis_index, axis_size,
+                          ppermute, psum, psum_scatter, ring_shift)
+from .data_parallel import shard_batch, allreduce_gradients_in_jit
+
+__all__ = [
+    "MeshSpec", "create_mesh",
+    "psum", "all_gather", "ppermute", "all_to_all", "psum_scatter",
+    "axis_index", "axis_size", "ring_shift",
+    "shard_batch", "allreduce_gradients_in_jit",
+]

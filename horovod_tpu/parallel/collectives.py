@@ -1,0 +1,71 @@
+"""In-jit collectives over named mesh axes.
+
+The TPU-native replacement for the reference's L0 transport (MPI/NCCL calls,
+operations.cc:1117-1612): inside a jitted SPMD program, XLA schedules these
+over ICI/DCN — fusion, overlap, and stream management all belong to the
+compiler (SURVEY.md §5.8). These wrappers exist so higher layers (tensor/
+sequence/pipeline/expert parallel) read as communication patterns, and so
+the eager layer and in-jit layer share vocabulary.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax import lax
+
+
+def axis_size(axis: str) -> int:
+    return lax.axis_size(axis)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def psum(x, axis: str):
+    """MPI_Allreduce / ncclAllReduce equivalent (operations.cc:1437-1446)."""
+    return lax.psum(x, axis)
+
+
+def pmean(x, axis: str):
+    return lax.pmean(x, axis)
+
+
+def psum_scatter(x, axis: str, *, scatter_dimension: int = 0,
+                 tiled: bool = True):
+    """ReduceScatter (the intra-node half of hierarchical allreduce,
+    operations.cc:1284-1436)."""
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_dimension,
+                            tiled=tiled)
+
+
+def all_gather(x, axis: str, *, gather_dimension: int = 0,
+               tiled: bool = True):
+    """MPI_Allgatherv equivalent (operations.cc:843-1113)."""
+    return lax.all_gather(x, axis, axis=gather_dimension, tiled=tiled)
+
+
+def ppermute(x, axis: str, perm: Sequence[Tuple[int, int]]):
+    """Point-to-point permutation over the axis ring (no reference
+    equivalent — MPI send/recv patterns are absent there; this is the
+    primitive behind ring attention and pipeline shifts)."""
+    return lax.ppermute(x, axis, perm)
+
+
+def ring_shift(x, axis: str, *, offset: int = 1):
+    """Shift each shard's value to the next rank around the ring
+    (rank i -> rank (i+offset) % n). The building block of ring attention
+    and the pipeline activation hand-off."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def all_to_all(x, axis: str, *, split_axis: int, concat_axis: int,
+               tiled: bool = True):
+    """All-to-all (the expert-parallel dispatch primitive; also the
+    DeepSpeed-Ulysses sequence<->head exchange)."""
+    return lax.all_to_all(x, axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=tiled)

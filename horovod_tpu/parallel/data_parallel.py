@@ -1,0 +1,37 @@
+"""Data parallelism — the reference's one-and-only strategy
+(SURVEY.md §2.1), recast as shardings.
+
+In the reference, data parallelism is explicit allreduce calls on gradients
+(DistributedOptimizer, torch/__init__.py:42-151). On TPU the same program
+is expressed by sharding the batch over 'dp' and letting the loss-mean
+insert the psum, or — when writing shard_map-style SPMD by hand — calling
+:func:`allreduce_gradients_in_jit`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def shard_batch(batch, mesh: Mesh, axis: str = "dp"):
+    """Place a host batch with its leading dim sharded over ``axis`` —
+    the DistributedSampler pattern (examples/pytorch_mnist.py:43-64)
+    without the sampler: every chip sees its own slice of one global
+    array."""
+    spec = P(axis)
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, spec)), batch)
+
+
+def allreduce_gradients_in_jit(grads, axis: str = "dp",
+                               average: bool = True):
+    """psum/pmean a gradient pytree over the mesh axis — the in-jit
+    equivalent of the reference's per-gradient allreduce hooks
+    (torch/__init__.py:106-130). XLA's collective combiner performs the
+    tensor-fusion role here (SURVEY.md §5.8)."""
+    op = lax.pmean if average else lax.psum
+    return jax.tree_util.tree_map(lambda g: op(g, axis), grads)

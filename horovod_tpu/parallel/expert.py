@@ -1,0 +1,119 @@
+"""Expert parallelism — mixture-of-experts dispatch over the 'ep' axis.
+
+No reference equivalent (SURVEY.md §2.1: EP absent). TPU-first design
+following the Switch/GShard pattern with static shapes throughout:
+
+  1. A router scores tokens against experts (one small matmul).
+  2. Tokens are dispatched to their top-1 expert with a fixed per-expert
+     capacity C (static shape — XLA requirement; overflow tokens drop, the
+     standard TPU MoE trade-off).
+  3. ``all_to_all`` over 'ep' exchanges the per-expert buckets so each rank
+     holds the tokens routed to ITS experts.
+  4. The local expert MLP runs as one batched matmul (MXU-friendly).
+  5. A second ``all_to_all`` returns outputs; combine weights scatter them
+     back into sequence order.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def top1_dispatch(router_logits, capacity: int):
+    """Top-1 routing with fixed capacity.
+
+    Args:
+      router_logits: [tokens, num_experts]
+      capacity: max tokens kept per expert (static).
+    Returns:
+      dispatch: [tokens, num_experts, capacity] one-hot dispatch mask
+      combine:  [tokens, num_experts, capacity] combine weights (gate prob)
+    """
+    n_tokens, n_experts = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                     # [tokens]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+
+    onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.int32)
+    # Position of each token within its expert's bucket (running count).
+    position = jnp.cumsum(onehot, axis=0) * onehot - 1      # [tokens, E]
+    keep = position < capacity
+    pos_onehot = jax.nn.one_hot(
+        jnp.where(keep, position, -1), capacity, dtype=jnp.float32)
+    dispatch = onehot[..., None] * pos_onehot               # [T, E, C]
+    combine = dispatch * gate[:, None, None]
+    return dispatch.astype(jnp.float32), combine
+
+
+def moe_apply(params, x, *, num_experts: int, capacity_factor: float,
+              axis_name: str, act: Callable, dtype=jnp.bfloat16):
+    """Functional top-1 MoE (used by the flagship model and tests).
+
+    params: dict with
+      router: [F, E_global]
+      wi:     [E_local, F, H]
+      wo:     [E_local, H, F]
+    x: [tokens_local, F] inside shard_map over ``axis_name``.
+    """
+    n_shards = lax.axis_size(axis_name)
+    e_local = num_experts // n_shards
+    t, f = x.shape
+    capacity = max(1, int(capacity_factor * t / num_experts))
+
+    logits = jnp.dot(x.astype(jnp.float32), params["router"])
+    dispatch, combine = top1_dispatch(logits, capacity)   # [T, E, C]
+
+    # Per-global-expert buckets of this rank's tokens: [E, C, F].
+    buckets = jnp.einsum("tec,tf->ecf", dispatch, x.astype(jnp.float32))
+
+    # Exchange so rank r receives bucket groups for ITS experts from every
+    # rank: reshape [E, C, F] -> [n_shards, e_local*C, F]; all_to_all
+    # scatters dim 0 and concatenates arrivals on dim 1.
+    buckets = buckets.reshape(n_shards, e_local * capacity, f)
+    buckets = lax.all_to_all(buckets, axis_name, split_axis=0,
+                             concat_axis=1, tiled=True)
+    # -> [n_shards * e_local * C? ] with tiled=True: [n_shards,
+    #    n_shards * e_local * capacity / n_shards ...]; net effect:
+    # [n_shards, e_local * capacity, f] where dim 0 now indexes SOURCE rank.
+    buckets = buckets.reshape(n_shards, e_local, capacity, f)
+    buckets = buckets.transpose(1, 0, 2, 3).reshape(
+        e_local, n_shards * capacity, f)                  # [E_l, N*C, F]
+
+    # Local expert MLPs, batched on the expert dim (one big MXU matmul).
+    h = jnp.einsum("ecf,efh->ech", buckets.astype(dtype),
+                   params["wi"].astype(dtype))
+    h = act(h)
+    y = jnp.einsum("ech,ehf->ecf", h, params["wo"].astype(dtype))
+    y = y.astype(jnp.float32)
+
+    # Return trip: invert the exchange.
+    y = y.reshape(e_local, n_shards, capacity, f).transpose(1, 0, 2, 3)
+    y = y.reshape(n_shards, e_local * capacity, f)
+    y = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=1,
+                       tiled=True)
+    y = y.reshape(num_experts, capacity, f)               # [E, C, F]
+
+    # Combine back to token order.
+    out = jnp.einsum("tec,ecf->tf", combine, y)
+    return out.astype(x.dtype)
+
+
+def moe_init(rng, *, num_experts: int, experts_per_shard: int, features: int,
+             hidden: int):
+    """Initialize per-shard MoE params (router replicated, experts local)."""
+    k1, k2, k3 = jax.random.split(rng, 3)
+    scale_in = (1.0 / features) ** 0.5
+    scale_hid = (1.0 / hidden) ** 0.5
+    return {
+        "router": jax.random.normal(k1, (features, num_experts),
+                                    jnp.float32) * scale_in,
+        "wi": jax.random.normal(k2, (experts_per_shard, features, hidden),
+                                jnp.float32) * scale_in,
+        "wo": jax.random.normal(k3, (experts_per_shard, hidden, features),
+                                jnp.float32) * scale_hid,
+    }

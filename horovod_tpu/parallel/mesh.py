@@ -1,0 +1,77 @@
+"""Multi-axis mesh construction.
+
+The reference's topology is world/local/cross MPI communicators
+(operations.cc:1760-1797). The TPU-native generalization is an N-D named
+mesh; each parallelism strategy binds to an axis name:
+
+  'dp' data, 'fsdp' sharded-data, 'tp' tensor, 'pp' pipeline,
+  'sp' sequence/context, 'ep' expert.
+
+``create_mesh`` builds the mesh with axis sizes that must multiply to the
+device count; leading axes span hosts (DCN) and trailing axes stay inside a
+host (ICI), following the scaling-book recipe of keeping high-traffic axes
+(tp/sp) on ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Axis-name → size spec. size -1 means "absorb remaining devices"."""
+
+    axes: Tuple[Tuple[str, int], ...]
+
+    @classmethod
+    def of(cls, **sizes: int) -> "MeshSpec":
+        return cls(tuple(sizes.items()))
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        fixed = math.prod(s for _, s in self.axes if s > 0)
+        wild = [a for a, s in self.axes if s <= 0]
+        if len(wild) > 1:
+            raise ValueError("at most one axis may have size -1")
+        out = dict(self.axes)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes "
+                    f"{fixed}")
+            out[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh axes {dict(self.axes)} multiply to {fixed}, but "
+                f"{n_devices} devices are available")
+        return out
+
+
+def create_mesh(spec: Optional[MeshSpec] = None,
+                devices: Optional[Sequence] = None,
+                **axis_sizes: int) -> Mesh:
+    """Create a named mesh over ``devices`` (default: all).
+
+    ``create_mesh(dp=-1)`` — flat data parallel.
+    ``create_mesh(dp=2, tp=2, sp=2)`` — 3-axis hybrid on 8 chips.
+    """
+    if spec is None:
+        spec = MeshSpec.of(**(axis_sizes or {"dp": -1}))
+    devs = list(devices) if devices is not None else list(jax.devices())
+    sizes = spec.resolve(len(devs))
+    names = tuple(sizes.keys())
+    shape = tuple(sizes.values())
+    try:
+        arr = mesh_utils.create_device_mesh(shape, devices=devs)
+    except Exception:
+        # CPU-emulation / exotic topologies: plain reshape keeps axis order.
+        arr = np.asarray(devs, dtype=object).reshape(shape)
+    return Mesh(arr, names)

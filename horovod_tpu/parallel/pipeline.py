@@ -1,0 +1,73 @@
+"""Pipeline parallelism — GPipe-style microbatching over the 'pp' axis.
+
+No reference equivalent (SURVEY.md §2.1: PP absent). TPU-first design: the
+whole pipeline is ONE jitted SPMD program. Each 'pp' rank holds the
+parameters of its stage; activations move between neighboring ranks with
+``ppermute`` (collective-permute rides ICI); the microbatch schedule is a
+``lax.scan`` with a static trip count of (num_microbatches + num_stages - 1)
+ticks — the classic skewed schedule where tick t has stage s working on
+microbatch t - s (bubbles at the ends).
+
+This is the "collective permute pipeline" pattern (cf. praxis/t5x-style
+pipelining): no host control flow, no per-stage programs, and XLA overlaps
+the permute with the stage compute.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(stage_fn: Callable, params, x_microbatches, *,
+                   axis_name: str = "pp"):
+    """Run a pipelined forward pass inside shard_map.
+
+    Args:
+      stage_fn: ``stage_fn(params, x) -> y`` with ``y.shape == x.shape`` —
+        one stage's computation (e.g. a group of transformer blocks); every
+        'pp' rank runs it with its own stage's params.
+      params: this rank's stage parameters (pytree).
+      x_microbatches: [num_micro, micro_batch, ...] input, meaningful on
+        stage 0 (other ranks' copies are ignored).
+
+    Returns: [num_micro, micro_batch, ...] outputs of the LAST stage,
+      replicated to all 'pp' ranks (one masked psum at the end).
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    m = x_microbatches.shape[0]
+    ticks = m + n - 1
+
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+    state0 = jnp.zeros_like(x_microbatches[0])
+    outs0 = jnp.zeros_like(x_microbatches)
+
+    def tick(carry, t):
+        state, outs = carry
+        # Stage 0 feeds microbatch t while they last; later stages consume
+        # the activations handed over on the previous tick.
+        mb_idx = jnp.clip(t, 0, m - 1)
+        fed = jnp.where(t < m, x_microbatches[mb_idx],
+                        jnp.zeros_like(state0))
+        inp = jnp.where(idx == 0, fed, state)
+        y = stage_fn(params, inp)
+        # The last stage finishes microbatch t-(n-1) at tick t.
+        out_idx = t - (n - 1)
+        record = jnp.logical_and(out_idx >= 0, idx == n - 1)
+        safe_idx = jnp.clip(out_idx, 0, m - 1)
+        outs = jnp.where(
+            record,
+            outs.at[safe_idx].set(y.astype(outs.dtype)),
+            outs)
+        state = lax.ppermute(y, axis_name, fwd_perm)
+        return (state, outs), None
+
+    (_, outs), _ = lax.scan(tick, (state0, outs0), jnp.arange(ticks))
+    # Replicate the last stage's outputs to every 'pp' rank.
+    outs = lax.psum(jnp.where(idx == n - 1, outs, jnp.zeros_like(outs)),
+                    axis_name)
+    return outs

@@ -1,0 +1,131 @@
+"""Ring attention — sequence/context parallelism for long sequences.
+
+No reference equivalent (SURVEY.md §5.7: "Absent — ... For the TPU rebuild
+this is green-field"); built on the same mesh-axis collective layer as
+everything else, per the survey's guidance that sequence-dimension sharding
+rides the comm layer.
+
+Algorithm (Liu et al., "Ring Attention with Blockwise Transformers", and
+the blockwise-parallel formulation): the sequence is sharded over the 'sp'
+axis; each device holds one query block Q_i and one key/value block
+(K_i, V_i). K/V blocks rotate around the ring via ``ppermute`` while each
+device accumulates its attention output *online* with the numerically
+stable streaming softmax (running max m, normalizer l, weighted numerator):
+
+    for step in 0..n-1:
+        scores   = Q_i @ K_j^T          # j = (i - step) mod n
+        m_new    = max(m, rowmax(scores))
+        corr     = exp(m - m_new)
+        p        = exp(scores - m_new)
+        num      = num * corr + p @ V_j
+        l        = l * corr + rowsum(p)
+        (K, V)  <- ring_shift(K, V)
+
+    out = num / l
+
+Communication (one K/V block per step, overlappable with the matmul) rides
+the ICI ring — bandwidth-optimal for sequence lengths that do not fit one
+chip. Causal masking uses global position offsets per block.
+
+The loop is a ``lax.fori_loop`` (compiler-friendly static trip count); each
+step is two MXU matmuls over full blocks — no dynamic shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _ring_step(carry, _, axis_name: str, causal: bool, scale: float,
+               q_index, n_shards: int, block_q: int, block_k: int):
+    (q, k, v, m, l, num, step) = carry
+    # Block j currently resident = (q_index - step) mod n.
+    j = (q_index - step) % n_shards
+
+    # scores: [B, H, block_q, block_k] in fp32 for a stable softmax.
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        q_pos = q_index * block_q + jnp.arange(block_q)[:, None]
+        k_pos = j * block_k + jnp.arange(block_k)[None, :]
+        mask = q_pos >= k_pos  # attend to self and the past
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    # Blocks fully masked out produce -inf rowmax; keep the old statistics.
+    m_new = jnp.where(jnp.isfinite(m_new), m_new, m)
+    # corr would be exp(-inf - -inf) = nan for rows with no mass yet; they
+    # carry zero numerator/normalizer, so force corr to 0 there.
+    corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new), 0.0)
+    p = jnp.exp(scores - m_new[..., None])
+    p = jnp.where(jnp.isfinite(scores), p, 0.0)
+
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
+    num = num * corr.transpose(0, 2, 1)[..., None] + pv
+    l = l * corr + p.sum(axis=-1)
+
+    # Rotate K/V to the next rank (ring_shift): each device passes its
+    # resident block along, receiving the previous rank's.
+    n = n_shards
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    k = lax.ppermute(k, axis_name, perm)
+    v = lax.ppermute(v, axis_name, perm)
+    return (q, k, v, m_new, l, num, step + 1), None
+
+
+def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
+                   scale: Optional[float] = None):
+    """Blockwise ring attention over the ``axis_name`` mesh axis.
+
+    Args (per-shard views inside shard_map):
+      q, k, v: [batch, seq_shard, heads, head_dim]
+    Returns: [batch, seq_shard, heads, head_dim] attention output for this
+    device's query block, exact (up to fp) vs full attention.
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    if scale is None:
+        scale = d ** -0.5
+
+    m0 = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    num0 = jnp.zeros((b, sq, h, d), jnp.float32)
+
+    step_fn = functools.partial(
+        _ring_step, axis_name=axis_name, causal=causal, scale=scale,
+        q_index=idx, n_shards=n, block_q=sq, block_k=sk)
+
+    (q, k, v, m, l, num, _), _ = lax.scan(
+        step_fn, (q, k, v, m0, l0, num0, jnp.zeros((), jnp.int32)),
+        None, length=n)
+
+    l = jnp.maximum(l, 1e-20)  # fully-masked rows (shouldn't occur causally)
+    out = num / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def full_attention(q, k, v, *, causal: bool = True,
+                   scale: Optional[float] = None):
+    """Single-device reference attention (same layout) for tests."""
+    b, sq, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        sk = k.shape[1]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)

@@ -1,0 +1,102 @@
+"""Tensor parallelism — Megatron-style column/row-parallel layers.
+
+No reference equivalent (SURVEY.md §2.1: TP absent); built on the mesh
+collective layer. The classic pairing keeps activations local between the
+two halves of an MLP / attention block:
+
+  ColumnParallelDense: Y_k = X @ W_k       (weights split on OUTPUT dim;
+                                            no comm going in)
+  RowParallelDense:    Y   = psum_k(X_k @ W_k)  (weights split on INPUT
+                                            dim; ONE psum coming out)
+
+so an MLP (column → gelu → row) or attention (column QKV → heads local →
+row out-proj) costs exactly one psum per block, riding ICI.
+
+These are shard_map-level modules: they expect to run *inside* a
+``shard_map`` where ``axis_name`` is bound, with per-shard parameter
+slices. Parameter sharding specs for jit-level use are provided by
+``param_specs`` in models/transformer.py.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class ColumnParallelDense(nn.Module):
+    """Dense with output features split over ``axis_name``.
+
+    ``features`` is the GLOBAL output dim; this shard holds
+    features / axis_size columns.
+    """
+
+    features: int
+    axis_name: str = "tp"
+    use_bias: bool = True
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        n = lax.axis_size(self.axis_name)
+        if self.features % n:
+            raise ValueError(
+                f"features {self.features} not divisible by "
+                f"{self.axis_name} size {n}")
+        local = self.features // n
+        w = self.param("kernel", nn.initializers.lecun_normal(),
+                       (x.shape[-1], local), jnp.float32)
+        y = jnp.dot(x.astype(self.dtype), w.astype(self.dtype))
+        if self.use_bias:
+            b = self.param("bias", nn.initializers.zeros, (local,),
+                           jnp.float32)
+            y = y + b.astype(self.dtype)
+        return y
+
+
+class RowParallelDense(nn.Module):
+    """Dense with input features split over ``axis_name``; output psum'd.
+
+    ``features`` is the GLOBAL output dim; the input x is the local shard
+    of the hidden (produced by a ColumnParallelDense).
+    """
+
+    features: int
+    axis_name: str = "tp"
+    use_bias: bool = True
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param("kernel", nn.initializers.lecun_normal(),
+                       (x.shape[-1], self.features), jnp.float32)
+        y = jnp.dot(x.astype(self.dtype), w.astype(self.dtype))
+        # The single communication point of the block.
+        y = lax.psum(y, self.axis_name)
+        if self.use_bias:
+            b = self.param("bias", nn.initializers.zeros, (self.features,),
+                           jnp.float32)
+            y = y + b.astype(self.dtype)
+        return y
+
+
+class ParallelMLP(nn.Module):
+    """column → activation → row: one psum per MLP (Megatron fig. 3)."""
+
+    hidden: int           # global intermediate dim
+    features: int         # model dim
+    axis_name: str = "tp"
+    act: Callable = nn.gelu
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        h = ColumnParallelDense(self.hidden, self.axis_name,
+                                dtype=self.dtype, name="wi")(x)
+        h = self.act(h)
+        return RowParallelDense(self.features, self.axis_name,
+                                dtype=self.dtype, name="wo")(h)

@@ -240,12 +240,13 @@ std::vector<Response> FuseResponses(
           dtype_of(cand.tensor_names[0]) == head_dtype &&
           cand.devices == head.devices &&
           total + bytes_of(cand.tensor_names[0]) <= threshold_bytes;
-      // Allgather fusion additionally requires matching trailing dims; the
-      // executor re-checks, so here we fuse allgathers only when both have
-      // per-rank sizes recorded (same-shape classes are the common case in
-      // the reference too, operations.cc:2183-2215).
+      // Allgather responses carry one first-dim size per rank; candidates
+      // stay joinable when they carry a full rank-count vector (the
+      // devices vector keeps the rank count as head.tensor_sizes grows by
+      // world_size per joined tensor). Trailing-dim compatibility is
+      // re-checked by the executor at run time.
       if (joinable && cand.response_type == Response::ALLGATHER) {
-        joinable = cand.tensor_sizes.size() == head.tensor_sizes.size();
+        joinable = cand.tensor_sizes.size() == head.devices.size();
       }
       if (joinable) {
         total += bytes_of(cand.tensor_names[0]);

@@ -55,6 +55,7 @@ struct PendingEntry {
   Request request;
   int64_t nbytes;
   Clock::time_point enqueued;
+  bool executing = false;  // negotiated & handed to the execute callback
 };
 
 struct HandleState {
@@ -159,6 +160,13 @@ bool RunLoopOnce(GlobalState& st) {
       EmitTimelineStartGroup(st, resp);
       std::vector<int64_t> hs;
       int64_t bytes = 0;
+      {
+        std::lock_guard<std::mutex> lk(st.mu);
+        for (const auto& name : resp.tensor_names) {
+          auto it = st.tensor_table.find(name);
+          if (it != st.tensor_table.end()) it->second.executing = true;
+        }
+      }
       for (const auto& name : resp.tensor_names) {
         for (int64_t h : handle_of[name]) hs.push_back(h);
         bytes += sizes.count(name) ? sizes[name] : 0;
@@ -194,6 +202,10 @@ bool RunLoopOnce(GlobalState& st) {
       {
         std::lock_guard<std::mutex> lk(st.mu);
         for (const auto& kv : st.tensor_table) {
+          // Only un-negotiated tensors count — the reference scans its
+          // MessageTable, not ops already executing
+          // (CheckForStalledTensors, operations.cc:1625-1672).
+          if (kv.second.executing) continue;
           double age = std::chrono::duration<double>(now - kv.second.enqueued)
                            .count();
           if (age > st.stall_warning_sec) stalled.push_back(kv.first);
@@ -211,7 +223,20 @@ bool RunLoopOnce(GlobalState& st) {
     }
   }
 
-  // Autotuner: feed cycle observation (parameter_manager.cc:144-170).
+  if (st.shutdown_requested.load()) {
+    std::lock_guard<std::mutex> lk(st.mu);
+    if (st.message_queue.empty()) return false;
+  }
+
+  // Sleep out the remainder of the cycle (operations.cc:2032-2040).
+  auto elapsed = Clock::now() - cycle_start;
+  auto cycle = std::chrono::microseconds(st.cycle_time_us.load());
+  if (elapsed < cycle) std::this_thread::sleep_for(cycle - elapsed);
+
+  // Autotuner: feed the FULL cycle wall time including the pacing sleep —
+  // the reference scores bytes over the whole interval between samples
+  // (parameter_manager.cc:144-170), which is what makes the cycle-time
+  // knob observable to the optimizer.
   double secs =
       std::chrono::duration<double>(Clock::now() - cycle_start).count();
   if (st.param_manager.IsAutoTuning()) {
@@ -223,16 +248,6 @@ bool RunLoopOnce(GlobalState& st) {
   } else {
     st.cycle_bytes.store(0);
   }
-
-  if (st.shutdown_requested.load()) {
-    std::lock_guard<std::mutex> lk(st.mu);
-    if (st.message_queue.empty()) return false;
-  }
-
-  // Sleep out the remainder of the cycle (operations.cc:2032-2040).
-  auto elapsed = Clock::now() - cycle_start;
-  auto cycle = std::chrono::microseconds(st.cycle_time_us.load());
-  if (elapsed < cycle) std::this_thread::sleep_for(cycle - elapsed);
   return true;
 }
 
@@ -265,6 +280,24 @@ int hvdtpu_init(int rank, int size, int local_size, int virtual_size) {
   // host-process granular (the negotiation unit); `virtual_size` is the
   // total device count, bounding broadcast root ranks.
   if (g_state && g_state->initialized.load()) return 0;
+  if (g_state) {
+    // Re-init after shutdown (test hook): reset the retained state.
+    std::lock_guard<std::mutex> lk(g_state->mu);
+    g_state->message_queue.clear();
+    g_state->tensor_table.clear();
+    g_state->handles.clear();
+    g_state->shutdown_requested.store(false);
+    g_state->background_done = false;
+    g_state->rank = rank;
+    g_state->size = size;
+    g_state->local_size = local_size;
+    g_state->virtual_size = virtual_size > 0 ? virtual_size
+                                             : size * local_size;
+    g_state->background = std::thread(BackgroundThreadLoop,
+                                      std::ref(*g_state));
+    g_state->initialized.store(true);
+    return 0;
+  }
   auto* st = new GlobalState();
   st->rank = rank;
   st->size = size;
@@ -295,6 +328,9 @@ int hvdtpu_init(int rank, int size, int local_size, int virtual_size) {
     const char* lg = std::getenv("HOROVOD_TPU_AUTOTUNE_LOG");
     if (!lg) lg = std::getenv("HOROVOD_AUTOTUNE_LOG");
     st->param_manager.Initialize(rank, lg ? lg : "");
+    st->param_manager.SetCurrent(
+        st->fusion_threshold.load() / (1024.0 * 1024.0),
+        st->cycle_time_us.load() / 1000.0);
     st->param_manager.SetAutoTuning(true);
   }
 
@@ -312,15 +348,16 @@ int hvdtpu_initialized() {
 
 void hvdtpu_shutdown() {
   // Coordinated shutdown (operations.cc:1942-1998): drain, stop thread,
-  // close the timeline.
+  // close the timeline. The GlobalState is intentionally NEVER freed —
+  // other threads may be concurrently inside C-API calls that already
+  // passed the g_state null-check (the reference keeps its global state
+  // for the process lifetime for the same reason).
   if (!g_state) return;
   GlobalState& st = *g_state;
   st.shutdown_requested.store(true);
   if (st.background.joinable()) st.background.join();
   st.timeline.Shutdown();
   st.initialized.store(false);
-  delete g_state;
-  g_state = nullptr;
 }
 
 void hvdtpu_set_execute_callback(void (*cb)(void*, int32_t, const int64_t*,

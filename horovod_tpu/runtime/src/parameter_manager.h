@@ -25,6 +25,14 @@ class ParameterManager {
   ParameterManager();
 
   void Initialize(int rank, const std::string& log_path);
+  // Seed the tuner with the knobs the runtime is ACTUALLY running, so the
+  // first observation is attributed to the right point.
+  void SetCurrent(double fusion_mb, double cycle_ms) {
+    fusion_mb_ = std::min(64.0, std::max(0.0, fusion_mb));
+    cycle_ms_ = std::min(100.0, std::max(1.0, cycle_ms));
+    best_fusion_mb_ = fusion_mb_;
+    best_cycle_ms_ = cycle_ms_;
+  }
   void SetAutoTuning(bool active) { active_ = active; }
   bool IsAutoTuning() const { return active_; }
 

@@ -1,0 +1,222 @@
+"""Parallelism-layer tests: ring attention exactness, pipeline schedule,
+MoE dispatch, and full train-step parity of sharded vs single-device runs.
+
+These strategies are extensions beyond the reference (SURVEY.md §2.1 lists
+TP/PP/SP/EP as absent there); the test strategy mirrors the reference's op
+tests — numeric equality against an unsharded oracle."""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.parallel import create_mesh
+from horovod_tpu.parallel.ring_attention import (full_attention,
+                                                 ring_attention)
+from horovod_tpu.parallel.pipeline import pipeline_apply
+from horovod_tpu.parallel.expert import moe_apply
+from horovod_tpu.parallel.train import build_train_step
+from horovod_tpu.models import transformer as tfm
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_full_attention(self, causal):
+        mesh = create_mesh(sp=8)
+        B, S, H, D = 2, 64, 4, 16
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+        k = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+        v = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+        ref = full_attention(q, k, v, causal=causal)
+        f = jax.jit(jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, axis_name="sp",
+                                           causal=causal),
+            mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+            out_specs=P(None, "sp"), check_vma=False))
+        out = f(q, k, v)
+        assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
+
+    def test_grad_flows_through_ring(self):
+        """Backward through ppermute routes cross-shard cotangents."""
+        mesh = create_mesh(sp=4, dp=2)
+        B, S, H, D = 2, 32, 2, 8
+        rng = np.random.RandomState(1)
+        q = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+        k = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+        v = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+
+        def loss_ring(q, k, v):
+            def shard(q, k, v):
+                out = ring_attention(q, k, v, axis_name="sp", causal=True)
+                l = (out.astype(jnp.float32) ** 2).sum()
+                return lax.psum(l, ("sp", "dp"))
+            return jax.shard_map(
+                shard, mesh=mesh,
+                in_specs=(P("dp", "sp"),) * 3, out_specs=P(),
+                check_vma=False)(q, k, v)
+
+        def loss_full(q, k, v):
+            out = full_attention(q, k, v, causal=True)
+            return (out.astype(jnp.float32) ** 2).sum()
+
+        g1 = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            assert float(jnp.max(jnp.abs(np.asarray(a) - np.asarray(b)))) \
+                < 1e-3
+
+
+class TestPipeline:
+    def test_four_stage_product(self):
+        mesh = create_mesh(pp=4, dp=2)
+        scales = jnp.arange(1.0, 5.0)[:, None]
+        xs = jnp.ones((3, 2, 8))
+
+        def stage_fn(p, x):
+            return x * p["scale"]
+
+        def run(scale_local, x):
+            return pipeline_apply(stage_fn, {"scale": scale_local[0]}, x,
+                                  axis_name="pp")
+
+        f = jax.jit(jax.shard_map(
+            run, mesh=mesh, in_specs=(P("pp"), P(None, "dp")),
+            out_specs=P(None, "dp"), check_vma=False))
+        out = f(scales, xs)
+        assert np.allclose(np.asarray(out), 24.0)  # 1*2*3*4
+
+    def test_microbatch_identity_order(self):
+        """Outputs keep microbatch order through the skewed schedule."""
+        mesh = create_mesh(pp=4, dp=2)
+        xs = jnp.arange(4 * 2 * 4, dtype=jnp.float32).reshape(4, 2, 4)
+
+        def stage_fn(p, x):
+            return x + p["b"]
+
+        ones = jnp.ones((4, 1))
+
+        def run(b_local, x):
+            return pipeline_apply(stage_fn, {"b": b_local[0]}, x,
+                                  axis_name="pp")
+
+        f = jax.jit(jax.shard_map(
+            run, mesh=mesh, in_specs=(P("pp"), P(None, "dp")),
+            out_specs=P(None, "dp"), check_vma=False))
+        out = f(ones, xs)
+        assert np.allclose(np.asarray(out), np.asarray(xs) + 4.0)
+
+
+class TestMoE:
+    def test_matches_dense_with_ample_capacity(self):
+        mesh = create_mesh(ep=8)
+        F, H, E = 16, 32, 8
+        rng = jax.random.PRNGKey(0)
+        x = jax.random.normal(jax.random.PRNGKey(1), (64, F), jnp.float32)
+        pw = {
+            "router": jax.random.normal(rng, (F, E)) * 0.25,
+            "wi": jax.random.normal(rng, (E, F, H)) * 0.1,
+            "wo": jax.random.normal(rng, (E, H, F)) * 0.1,
+        }
+
+        def run_moe(p, xl):
+            return moe_apply(p, xl, num_experts=E, capacity_factor=8.0,
+                             axis_name="ep", act=jax.nn.gelu,
+                             dtype=jnp.float32)
+
+        f = jax.jit(jax.shard_map(
+            run_moe, mesh=mesh,
+            in_specs=({"router": P(), "wi": P("ep"), "wo": P("ep")},
+                      P("ep")),
+            out_specs=P("ep"), check_vma=False))
+        out = f(pw, x)
+
+        logits = x @ pw["router"]
+        e = jnp.argmax(logits, -1)
+        gate = jax.nn.softmax(logits, -1)
+        g = jnp.take_along_axis(gate, e[:, None], 1)[:, 0]
+        wi = np.asarray(pw["wi"])
+        wo = np.asarray(pw["wo"])
+        ref = jnp.stack([
+            (jax.nn.gelu(x[i] @ wi[int(e[i])]) @ wo[int(e[i])]) * g[i]
+            for i in range(64)])
+        assert float(jnp.max(jnp.abs(out - ref))) < 1e-5
+
+
+class TestTrainStepParity:
+    """The flagship guarantee: a sharded multi-axis training step equals
+    the single-device step bit-for-bit (up to fp reassociation)."""
+
+    def _run(self, cfg, mesh, params, tok, tgt, opt):
+        make, shard_p, shard_b = build_train_step(cfg, mesh, opt)
+        state = opt.init(params)
+        step, _ = make(params, state)
+        p, _, loss = step(shard_p(params), state, shard_b(tok),
+                          shard_b(tgt))
+        leaves = [np.asarray(x, np.float32)
+                  for x in jax.tree_util.tree_leaves(p)]
+        return leaves, float(loss)
+
+    def test_dense_dp_tp_sp(self):
+        rng = jax.random.PRNGKey(0)
+        tok = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 64)
+        tgt = jnp.roll(tok, -1, axis=1)
+        opt = optax.sgd(0.1)
+        cfg = tfm.TransformerConfig(
+            vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq=32, dtype=jnp.float32, tp_axis="tp", sp_axis="sp",
+            remat=True)
+        params = tfm.init_params(cfg, rng)
+        l1, loss1 = self._run(cfg, create_mesh(dp=2, tp=2, sp=2), params,
+                              tok, tgt, opt)
+        l2, loss2 = self._run(
+            cfg, create_mesh(devices=jax.devices()[:1], dp=1, tp=1, sp=1),
+            params, tok, tgt, opt)
+        assert abs(loss1 - loss2) < 1e-5
+        err = max(np.max(np.abs(a - b)) for a, b in zip(l1, l2))
+        assert err < 1e-4, f"param divergence {err}"
+
+    def test_moe_dp_ep(self):
+        rng = jax.random.PRNGKey(0)
+        tok = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 64)
+        tgt = jnp.roll(tok, -1, axis=1)
+        opt = optax.adam(1e-2)
+        cfg = tfm.TransformerConfig(
+            vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq=32, dtype=jnp.float32, ep_axis="ep", num_experts=4,
+            capacity_factor=8.0, remat=False)
+        params = tfm.init_params(cfg, rng)
+        l1, loss1 = self._run(cfg, create_mesh(dp=2, ep=4), params, tok,
+                              tgt, opt)
+        l2, loss2 = self._run(
+            cfg, create_mesh(devices=jax.devices()[:1], dp=1, ep=1),
+            params, tok, tgt, opt)
+        assert abs(loss1 - loss2) < 1e-4
+        err = max(np.max(np.abs(a - b)) for a, b in zip(l1, l2))
+        assert err < 1e-3, f"param divergence {err}"
+
+    def test_loss_decreases_over_steps(self):
+        rng = jax.random.PRNGKey(0)
+        tok = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 64)
+        tgt = jnp.roll(tok, -1, axis=1)
+        opt = optax.adam(1e-2)
+        cfg = tfm.TransformerConfig(
+            vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq=32, dtype=jnp.float32, tp_axis="tp", sp_axis="sp")
+        params = tfm.init_params(cfg, rng)
+        mesh = create_mesh(dp=2, tp=2, sp=2)
+        make, shard_p, shard_b = build_train_step(cfg, mesh, opt)
+        state = opt.init(params)
+        step, _ = make(params, state)
+        p, s = shard_p(params), state
+        tk, tg = shard_b(tok), shard_b(tgt)
+        losses = []
+        for _ in range(5):
+            p, s, loss = step(p, s, tk, tg)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
