@@ -15,6 +15,7 @@ on 16 Pascal GPUs (docs/benchmarks.md:22-38) = 103.55 img/sec/GPU.
 """
 
 import json
+import os
 import time
 
 import numpy as np
@@ -30,10 +31,11 @@ from horovod_tpu.models import ResNet50
 
 BASELINE_IMG_SEC_PER_CHIP = 1656.82 / 16  # docs/benchmarks.md:22-38
 
-BATCH_PER_CHIP = 64        # reference default --batch-size 64
-WARMUP_ITERS = 3
-NUM_ITERS = 10
-NUM_BATCHES_PER_ITER = 10
+BATCH_PER_CHIP = int(os.environ.get("HVD_BENCH_BATCH", 64))  # ref --batch-size
+IMAGE_SIZE = int(os.environ.get("HVD_BENCH_IMAGE", 224))
+WARMUP_ITERS = int(os.environ.get("HVD_BENCH_WARMUP", 3))
+NUM_ITERS = int(os.environ.get("HVD_BENCH_ITERS", 10))
+NUM_BATCHES_PER_ITER = int(os.environ.get("HVD_BENCH_BATCHES", 10))
 
 
 def main():
@@ -44,7 +46,8 @@ def main():
 
     model = ResNet50(num_classes=1000)
     rng = jax.random.PRNGKey(0)
-    images = jax.random.normal(rng, (batch, 224, 224, 3), jnp.float32)
+    images = jax.random.normal(rng, (batch, IMAGE_SIZE, IMAGE_SIZE, 3),
+                               jnp.float32)
     labels = jax.random.randint(rng, (batch,), 0, 1000)
 
     variables = model.init(rng, images[:2], train=True)
@@ -53,7 +56,8 @@ def main():
     # Framework path: broadcast initial state from rank 0, then wrap the
     # optimizer (grads are averaged over the mesh inside the jitted step).
     params = hvd.broadcast_parameters(params, root_rank=0)
-    opt = optax.sgd(0.01 * n, momentum=0.9)
+    opt = hvd.DistributedGradientTransformation(
+        optax.sgd(0.01 * n, momentum=0.9))
     opt_state = opt.init(params)
 
     if n > 1:
@@ -81,8 +85,13 @@ def main():
         for _ in range(k):
             params, batch_stats, opt_state, loss = train_step(
                 params, batch_stats, opt_state, images, labels)
-        jax.block_until_ready(loss)
-        return loss
+        # Block on the full updated state: the last step's parameter update
+        # is not a data dependency of its own loss, so blocking on loss
+        # alone under-counts one update's worth of work per call. The
+        # float() forces a device-to-host read, which no runtime can
+        # report "ready" early.
+        jax.block_until_ready((params, opt_state))
+        return float(loss)
 
     # Warmup (compile + stabilize), reference :88-92.
     run_batches(WARMUP_ITERS)

@@ -51,6 +51,12 @@ class TransformerConfig:
     capacity_factor: float = 2.0
     remat: bool = True
 
+    def __post_init__(self):
+        if self.num_experts and not self.ep_axis:
+            raise ValueError(
+                "num_experts > 0 requires ep_axis (the expert-parallel mesh "
+                "axis the MoE all_to_all routes over)")
+
 
 def _axis_size(axis: Optional[str]) -> int:
     return lax.axis_size(axis) if axis else 1

@@ -580,7 +580,17 @@ def _prep(tensor):
             f"on the LEADING dimension only (per-rank values); got sharding "
             f"{sh}. For other layouts use the in-jit collectives "
             "(horovod_tpu.allreduce_gradients inside shard_map) instead.")
+    src_dtype = getattr(tensor, "dtype", None)
     arr = jnp.asarray(tensor)
+    if (src_dtype is not None and np.dtype(src_dtype).itemsize == 8
+            and arr.dtype.itemsize < 8):
+        # jnp.asarray silently narrowed a 64-bit input (jax_enable_x64 is
+        # off) — refuse rather than corrupt values; the reference reduces
+        # int64/float64 natively over MPI (mpi_message.h:26-37).
+        raise ValueError(
+            f"collective on {src_dtype} requires 64-bit JAX mode; enable "
+            "it with jax.config.update('jax_enable_x64', True) before "
+            "hvd.init(), or cast to a 32-bit dtype first")
     return arr, False
 
 

@@ -240,14 +240,10 @@ std::vector<Response> FuseResponses(
           dtype_of(cand.tensor_names[0]) == head_dtype &&
           cand.devices == head.devices &&
           total + bytes_of(cand.tensor_names[0]) <= threshold_bytes;
-      // Allgather responses carry one first-dim size per rank; candidates
-      // stay joinable when they carry a full rank-count vector (the
-      // devices vector keeps the rank count as head.tensor_sizes grows by
-      // world_size per joined tensor). Trailing-dim compatibility is
-      // re-checked by the executor at run time.
-      if (joinable && cand.response_type == Response::ALLGATHER) {
-        joinable = cand.tensor_sizes.size() == head.devices.size();
-      }
+      // Fused allgathers keep one first-dim-size vector per joined tensor
+      // (head.tensor_sizes grows by world_size per join); the executor
+      // gathers each tensor of the group separately, so no per-rank size
+      // compatibility is needed at plan time.
       if (joinable) {
         total += bytes_of(cand.tensor_names[0]);
         for (auto& n : cand.tensor_names)

@@ -275,27 +275,84 @@ using namespace hvdtpu;
 
 extern "C" {
 
+namespace {
+
+// Serializes init/shutdown transitions; never taken by the background
+// thread, so joining under it cannot deadlock.
+std::mutex g_init_mu;
+
+const char* EnvOr(const char* primary, const char* fallback) {
+  const char* v = std::getenv(primary);
+  return v ? v : std::getenv(fallback);
+}
+
+// Knob parsing (operations.cc:1824-1909) — shared by fresh init and
+// re-init after shutdown so env-derived config (timeline, autotune,
+// fusion/cycle knobs, stall check) is honored on every bring-up. Every
+// knob is reset to its default first so a re-init with a *changed*
+// environment behaves exactly like a fresh init (no feature stays on
+// because a previous session enabled it).
+void ConfigureFromEnv(GlobalState& st) {
+  st.fusion_threshold.store(64LL * 1024 * 1024);  // operations.cc:1838
+  st.cycle_time_us.store(1000);  // TPU default 1 ms, see utils/env.py
+  st.param_manager.SetAutoTuning(false);
+  const char* v = EnvOr("HOROVOD_TPU_FUSION_THRESHOLD",
+                        "HOROVOD_FUSION_THRESHOLD");
+  if (v) st.fusion_threshold.store(std::atoll(v));
+  v = EnvOr("HOROVOD_TPU_CYCLE_TIME", "HOROVOD_CYCLE_TIME");
+  if (v) st.cycle_time_us.store(static_cast<int64_t>(std::atof(v) * 1000));
+  v = EnvOr("HOROVOD_TPU_STALL_CHECK_DISABLE",
+            "HOROVOD_STALL_CHECK_DISABLE");
+  st.stall_warning_sec = (v && std::strcmp(v, "0") != 0) ? 0 : 60;
+
+  v = EnvOr("HOROVOD_TPU_TIMELINE", "HOROVOD_TIMELINE");
+  if (v && *v && st.rank == 0) {
+    const char* mc = EnvOr("HOROVOD_TPU_TIMELINE_MARK_CYCLES",
+                           "HOROVOD_TIMELINE_MARK_CYCLES");
+    st.timeline.Initialize(v, mc && std::strcmp(mc, "0") != 0);
+  }
+
+  v = EnvOr("HOROVOD_TPU_AUTOTUNE", "HOROVOD_AUTOTUNE");
+  if (v && std::strcmp(v, "0") != 0) {
+    const char* lg = EnvOr("HOROVOD_TPU_AUTOTUNE_LOG",
+                           "HOROVOD_AUTOTUNE_LOG");
+    st.param_manager.Initialize(st.rank, lg ? lg : "");
+    st.param_manager.SetCurrent(
+        st.fusion_threshold.load() / (1024.0 * 1024.0),
+        st.cycle_time_us.load() / 1000.0);
+    st.param_manager.SetAutoTuning(true);
+  }
+}
+
+}  // namespace
+
 int hvdtpu_init(int rank, int size, int local_size, int virtual_size) {
   // InitializeHorovodOnce (operations.cc:2384-2402). `rank`/`size` are
   // host-process granular (the negotiation unit); `virtual_size` is the
   // total device count, bounding broadcast root ranks.
+  std::lock_guard<std::mutex> init_lk(g_init_mu);
   if (g_state && g_state->initialized.load()) return 0;
   if (g_state) {
-    // Re-init after shutdown (test hook): reset the retained state.
-    std::lock_guard<std::mutex> lk(g_state->mu);
-    g_state->message_queue.clear();
-    g_state->tensor_table.clear();
-    g_state->handles.clear();
-    g_state->shutdown_requested.store(false);
-    g_state->background_done = false;
-    g_state->rank = rank;
-    g_state->size = size;
-    g_state->local_size = local_size;
-    g_state->virtual_size = virtual_size > 0 ? virtual_size
-                                             : size * local_size;
-    g_state->background = std::thread(BackgroundThreadLoop,
-                                      std::ref(*g_state));
-    g_state->initialized.store(true);
+    // Re-init after shutdown (test hook): reset the retained state and
+    // reconfigure from the environment exactly like a fresh init.
+    GlobalState& st = *g_state;
+    if (st.background.joinable()) st.background.join();
+    {
+      std::lock_guard<std::mutex> lk(st.mu);
+      st.message_queue.clear();
+      st.tensor_table.clear();
+      st.handles.clear();
+      st.shutdown_requested.store(false);
+      st.background_done = false;
+      st.rank = rank;
+      st.size = size;
+      st.local_size = local_size;
+      st.virtual_size = virtual_size > 0 ? virtual_size
+                                         : size * local_size;
+    }
+    ConfigureFromEnv(st);
+    st.background = std::thread(BackgroundThreadLoop, std::ref(st));
+    st.initialized.store(true);
     return 0;
   }
   auto* st = new GlobalState();
@@ -303,37 +360,7 @@ int hvdtpu_init(int rank, int size, int local_size, int virtual_size) {
   st->size = size;
   st->local_size = local_size;
   st->virtual_size = virtual_size > 0 ? virtual_size : size * local_size;
-
-  const char* v = std::getenv("HOROVOD_TPU_FUSION_THRESHOLD");
-  if (!v) v = std::getenv("HOROVOD_FUSION_THRESHOLD");
-  if (v) st->fusion_threshold.store(std::atoll(v));
-  v = std::getenv("HOROVOD_TPU_CYCLE_TIME");
-  if (!v) v = std::getenv("HOROVOD_CYCLE_TIME");
-  if (v) st->cycle_time_us.store(static_cast<int64_t>(std::atof(v) * 1000));
-  v = std::getenv("HOROVOD_TPU_STALL_CHECK_DISABLE");
-  if (!v) v = std::getenv("HOROVOD_STALL_CHECK_DISABLE");
-  if (v && std::strcmp(v, "0") != 0) st->stall_warning_sec = 0;
-
-  v = std::getenv("HOROVOD_TPU_TIMELINE");
-  if (!v) v = std::getenv("HOROVOD_TIMELINE");
-  if (v && *v && rank == 0) {
-    const char* mc = std::getenv("HOROVOD_TPU_TIMELINE_MARK_CYCLES");
-    if (!mc) mc = std::getenv("HOROVOD_TIMELINE_MARK_CYCLES");
-    st->timeline.Initialize(v, mc && std::strcmp(mc, "0") != 0);
-  }
-
-  v = std::getenv("HOROVOD_TPU_AUTOTUNE");
-  if (!v) v = std::getenv("HOROVOD_AUTOTUNE");
-  if (v && std::strcmp(v, "0") != 0) {
-    const char* lg = std::getenv("HOROVOD_TPU_AUTOTUNE_LOG");
-    if (!lg) lg = std::getenv("HOROVOD_AUTOTUNE_LOG");
-    st->param_manager.Initialize(rank, lg ? lg : "");
-    st->param_manager.SetCurrent(
-        st->fusion_threshold.load() / (1024.0 * 1024.0),
-        st->cycle_time_us.load() / 1000.0);
-    st->param_manager.SetAutoTuning(true);
-  }
-
+  ConfigureFromEnv(*st);
   st->background = std::thread(BackgroundThreadLoop, std::ref(*st));
   st->initialized.store(true);
   g_state = st;
@@ -352,6 +379,7 @@ void hvdtpu_shutdown() {
   // other threads may be concurrently inside C-API calls that already
   // passed the g_state null-check (the reference keeps its global state
   // for the process lifetime for the same reason).
+  std::lock_guard<std::mutex> init_lk(g_init_mu);
   if (!g_state) return;
   GlobalState& st = *g_state;
   st.shutdown_requested.store(true);

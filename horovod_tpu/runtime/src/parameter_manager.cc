@@ -20,6 +20,21 @@ ParameterManager::ParameterManager()
 
 void ParameterManager::Initialize(int rank, const std::string& log_path) {
   rank_ = rank;
+  // Re-initialization (init after shutdown) restarts tuning from scratch:
+  // drop converged/accumulated state and any previous log handle.
+  done_ = false;
+  active_ = false;
+  warmups_left_ = kWarmupSamples;
+  acc_bytes_ = 0;
+  acc_seconds_ = 0.0;
+  acc_cycles_ = 0;
+  samples_.clear();
+  steps_ = 0;
+  best_score_ = -1.0;
+  if (log_) {
+    std::fclose(log_);
+    log_ = nullptr;
+  }
   if (rank == 0 && !log_path.empty()) {
     log_ = std::fopen(log_path.c_str(), "w");
     if (log_) std::fputs("fusion_mb,cycle_ms,hierarchical,score\n", log_);

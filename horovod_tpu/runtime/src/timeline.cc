@@ -76,6 +76,9 @@ void Timeline::Initialize(const std::string& path, bool mark_cycles) {
   mark_cycles_ = mark_cycles;
   ring_ = std::make_unique<SpscRing>(1 << 20);  // 2^20, timeline.h:66-68
   start_us_ = NowUs();
+  // A fresh trace file needs fresh pid interning: cached pids would skip
+  // the process_name META records in the new file.
+  tensor_pids_.clear();
   stop_.store(false);
   writer_ = std::thread([this] { WriterLoop(); });
   initialized_ = true;

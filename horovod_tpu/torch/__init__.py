@@ -1,0 +1,262 @@
+"""horovod_tpu.torch — the PyTorch framework shim.
+
+Parity target: horovod/torch/__init__.py (348 LoC) + mpi_ops.py (438 LoC):
+``DistributedOptimizer`` firing an async allreduce per parameter as its
+gradient is accumulated, ``synchronize()`` flushing handles before
+``step()``, ``backward_passes_per_step`` gradient accumulation,
+``broadcast_parameters`` and ``broadcast_optimizer_state``. Torch stays the
+autograd/optimizer engine; the collectives run on the TPU-native XLA data
+plane (see mpi_ops.py in this package).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Tuple
+
+import torch
+
+from ..topology import (init, shutdown, is_initialized, rank, local_rank,
+                        size, local_size, mpi_threads_supported)
+from .compression import Compression
+from .mpi_ops import (allreduce, allreduce_, allreduce_async,
+                      allreduce_async_, allgather, allgather_async,
+                      broadcast, broadcast_, broadcast_async,
+                      broadcast_async_, poll, synchronize)
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "local_rank", "size",
+    "local_size", "mpi_threads_supported", "Compression",
+    "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
+    "allgather", "allgather_async", "broadcast", "broadcast_",
+    "broadcast_async", "broadcast_async_", "poll", "synchronize",
+    "DistributedOptimizer", "broadcast_parameters",
+    "broadcast_optimizer_state",
+]
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    """Mixin installed on a dynamic subclass of the wrapped optimizer
+    (horovod/torch/__init__.py:42-151).
+
+    Each parameter gets a post-grad-accumulation hook that launches an
+    async in-place allreduce as soon as its gradient is ready (the
+    reference registers hooks on the grad accumulator nodes,
+    torch/__init__.py:95-130); ``step()`` synchronizes all outstanding
+    handles first (torch/__init__.py:149-151).
+    """
+
+    def __init__(self, params, named_parameters, compression,
+                 backward_passes_per_step=1):
+        super(self.__class__, self).__init__(params)
+        self._compression = compression
+        self.backward_passes_per_step = backward_passes_per_step
+
+        if named_parameters is not None:
+            named_parameters = list(named_parameters)
+        else:
+            named_parameters = [
+                (f"allreduce.noname.{i}.{j}", v)
+                for i, group in enumerate(self.param_groups)
+                for j, v in enumerate(group["params"])]
+        all_params = {id(v) for group in self.param_groups
+                      for v in group["params"]}
+        named_ids = {id(v) for _, v in named_parameters}
+        if len(named_ids) != len(named_parameters):
+            raise ValueError(
+                "named_parameters contains duplicate parameters")
+        if not named_ids.issubset(all_params):
+            raise ValueError(
+                "named_parameters was not a subset of optimizer.param_groups"
+                " parameters (torch/__init__.py:56-66)")
+        self._parameter_names = {id(v): k for k, v in named_parameters}
+        self._handles = {}
+        self._wire_ctx = {}
+        self._allreduce_delay = {id(v): backward_passes_per_step
+                                 for group in self.param_groups
+                                 for v in group["params"]}
+        self._register_hooks()
+
+    def _register_hooks(self):
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p.requires_grad:
+                    p.register_post_accumulate_grad_hook(self._make_hook())
+
+    def _make_hook(self):
+        def hook(p):
+            if id(p) in self._handles:
+                raise AssertionError(
+                    "Gradient for this parameter was already allreduced "
+                    "this step. If you call backward() more than once per "
+                    "step, pass backward_passes_per_step="
+                    "<number of backward passes> to DistributedOptimizer "
+                    "(torch/__init__.py:114-124).")
+            self._allreduce_delay[id(p)] -= 1
+            if self._allreduce_delay[id(p)] == 0:
+                self._handles[id(p)] = self._allreduce_grad_async(p)
+        return hook
+
+    def _allreduce_grad_async(self, p):
+        name = self._parameter_names.get(id(p), f"allreduce.{id(p)}")
+        wire, ctx = self._compression.compress(p.grad)
+        self._wire_ctx[id(p)] = ctx
+        if wire is p.grad:
+            return allreduce_async_(p.grad, average=True, name=name)
+        return allreduce_async(wire, average=True, name=name)
+
+    def synchronize(self):
+        """Flush: enqueue any parameter whose hook never fired, then block
+        on every handle and install the (decompressed) averaged gradients
+        (torch/__init__.py:132-147)."""
+        missing = [p for group in self.param_groups
+                   for p in group["params"]
+                   if p.requires_grad and p.grad is not None
+                   and id(p) not in self._handles
+                   and self._allreduce_delay[id(p)] ==
+                   self.backward_passes_per_step]
+        for p in missing:
+            self._handles[id(p)] = self._allreduce_grad_async(p)
+        params_by_id = {id(p): p for group in self.param_groups
+                        for p in group["params"]}
+        for pid, handle in self._handles.items():
+            out = synchronize(handle)
+            p = params_by_id[pid]
+            ctx = self._wire_ctx.pop(pid, None)
+            if out is not p.grad:
+                p.grad.copy_(self._compression.decompress(out, ctx)
+                             .reshape(p.grad.shape))
+            self._allreduce_delay[pid] = self.backward_passes_per_step
+        self._handles.clear()
+
+    def step(self, closure=None):
+        self.synchronize()
+        return super(self.__class__, self).step(closure)
+
+    def zero_grad(self, *args, **kwargs):
+        if self._handles:
+            raise AssertionError(
+                "optimizer.zero_grad() was called after loss.backward() "
+                "but before optimizer.step() or optimizer.synchronize(); "
+                "this would discard in-flight allreduced gradients.")
+        return super(self.__class__, self).zero_grad(*args, **kwargs)
+
+
+def DistributedOptimizer(optimizer: torch.optim.Optimizer,
+                         named_parameters: Optional[
+                             Iterable[Tuple[str, torch.Tensor]]] = None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1):
+    """Wrap a torch optimizer so ``step()`` applies allreduce-averaged
+    gradients — the reference builds a dynamic subclass of the wrapped
+    optimizer's class so isinstance() and LR schedulers keep working
+    (torch/__init__.py:154-197)."""
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedOptimizer.__dict__))
+    return cls(optimizer.param_groups, named_parameters, compression,
+               backward_passes_per_step)
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """Broadcast model parameters from ``root_rank`` in place — accepts a
+    ``state_dict()`` or ``model.named_parameters()``
+    (torch/__init__.py:200-229)."""
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = list(params)
+    handles = []
+    for name, p in items:
+        if p is None or not isinstance(p, torch.Tensor):
+            continue
+        handles.append(broadcast_async_(p, root_rank, name=f"bcast.{name}"))
+    for h in handles:
+        synchronize(h)
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
+                              root_rank: int = 0) -> None:
+    """Broadcast an optimizer's state from ``root_rank`` in place.
+
+    Mirrors torch/__init__.py:232-348: scalar state entries (e.g. Adam's
+    ``step`` counts, param-group hyperparameters) are tensorized,
+    broadcast, and cast back to their original Python types; tensor state
+    (exp_avg, momentum buffers, ...) is broadcast in place. If the
+    optimizer has no state yet, it is materialized with zero gradients so
+    every rank agrees on the state layout (torch/__init__.py:249-262).
+    """
+    if isinstance(optimizer, torch.optim.LBFGS):
+        raise ValueError(
+            "cannot broadcast torch.optim.LBFGS state "
+            "(torch/__init__.py:241-244)")
+    state_dict = optimizer.state_dict()
+    if not state_dict["state"]:
+        created = []
+        for group in optimizer.param_groups:
+            for p in group["params"]:
+                if p.requires_grad and p.grad is None:
+                    p.grad = torch.zeros_like(p)
+                    created.append(p)
+        optimizer.step()
+        for p in created:
+            p.grad = None
+        state_dict = optimizer.state_dict()
+
+    callbacks = []
+    handles = []
+    scalars = {}
+
+    def _tensorize(key, value):
+        t = torch.tensor([float(value)], dtype=torch.float64)
+        scalars[key] = (t, type(value))
+        handles.append(broadcast_async_(t, root_rank, name=f"opt.{key}"))
+
+    for gi, group in enumerate(state_dict["param_groups"]):
+        for key, value in group.items():
+            if key == "params":
+                continue
+            if isinstance(value, (int, float, bool)) and not isinstance(
+                    value, bool):
+                skey = f"group.{gi}.{key}"
+                _tensorize(skey, value)
+
+                def make_cb(gi=gi, key=key, skey=skey):
+                    def cb():
+                        t, typ = scalars[skey]
+                        optimizer.param_groups[gi][key] = typ(t.item())
+                    return cb
+                callbacks.append(make_cb())
+    for pid, pstate in state_dict["state"].items():
+        for key, value in pstate.items():
+            if isinstance(value, torch.Tensor):
+                if value.ndim == 0:
+                    # 0-dim tensors (modern torch 'step') broadcast via a
+                    # 1-element view-alike then copy back.
+                    flat = value.reshape(1).clone()
+                    handles.append(broadcast_async_(
+                        flat, root_rank, name=f"opt.state.{pid}.{key}"))
+
+                    def make_cb0(value=value, flat=flat):
+                        def cb():
+                            value.copy_(flat[0])
+                        return cb
+                    callbacks.append(make_cb0())
+                else:
+                    handles.append(broadcast_async_(
+                        value, root_rank, name=f"opt.state.{pid}.{key}"))
+            elif isinstance(value, (int, float)) and not isinstance(
+                    value, bool):
+                skey = f"state.{pid}.{key}"
+                _tensorize(skey, value)
+
+                def make_cb2(pid=pid, key=key, skey=skey):
+                    def cb():
+                        t, typ = scalars[skey]
+                        sd = optimizer.state_dict()
+                        sd["state"][pid][key] = typ(t.item())
+                        optimizer.load_state_dict(sd)
+                    return cb
+                callbacks.append(make_cb2())
+    for h in handles:
+        synchronize(h)
+    for cb in callbacks:
+        cb()

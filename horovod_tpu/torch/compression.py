@@ -1,0 +1,69 @@
+"""Gradient compression on torch tensors — parity with
+horovod/torch/compression.py (identical to tensorflow/compression.py in the
+reference). ``Compression.none`` passes through; ``Compression.fp16`` casts
+floating tensors to half for the wire and back after; ``Compression.bf16``
+is the TPU-native extension (bfloat16 survives the JAX hop losslessly and is
+the platform's 16-bit type).
+"""
+
+from __future__ import annotations
+
+import torch
+
+
+class Compressor:
+    """Interface (compression.py:23-31)."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """No-op (compression.py:33-43)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype: torch.dtype = torch.float16
+
+    @classmethod
+    def compress(cls, tensor):
+        ctx = tensor.dtype
+        if tensor.dtype.is_floating_point:
+            tensor = tensor.to(cls.wire_dtype)
+        return tensor, ctx
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        if ctx is not None and ctx.is_floating_point and tensor.dtype != ctx:
+            tensor = tensor.to(ctx)
+        return tensor
+
+
+class FP16Compressor(_CastCompressor):
+    """fp16 wire format (compression.py:46-61)."""
+    wire_dtype = torch.float16
+
+
+class BF16Compressor(_CastCompressor):
+    """bfloat16 wire format — TPU-native extension."""
+    wire_dtype = torch.bfloat16
+
+
+class Compression:
+    """Option enum (compression.py:64-75)."""
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

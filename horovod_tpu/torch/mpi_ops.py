@@ -1,0 +1,277 @@
+"""Torch tensor collectives over the TPU-native engine.
+
+This is the shim the reference implements as a C++ extension
+(horovod/torch/mpi_ops_v2.cc + horovod/torch/mpi_ops.py): sync / async /
+in-place variants of allreduce / allgather / broadcast on ``torch.Tensor``s,
+integer-handle ``poll``/``synchronize`` semantics, and autograd Functions
+whose backward passes are themselves collectives (torch/mpi_ops.py:110-121,
+236-254, 318-332).
+
+Where the reference moves THTensor memory into the MPI/NCCL fusion buffer,
+this shim moves torch (CPU) tensors across the numpy boundary into the JAX
+collective engine (the XLA data plane) and back. bfloat16 — which numpy
+lacks — crosses as a uint16 bit-pattern reinterpreted via ml_dtypes.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+import torch
+
+from .. import ops as _ops
+from ..ops import HorovodInternalError
+from .. import topology as _topo
+
+try:
+    import ml_dtypes as _mld
+except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+    _mld = None
+
+
+# ---------------------------------------------------------------------------
+# torch <-> jax conversion
+# ---------------------------------------------------------------------------
+
+_64BIT = (torch.int64, torch.float64)
+
+
+def _x64_enabled() -> bool:
+    import jax
+    return bool(jax.config.jax_enable_x64)
+
+
+def _check_64bit_reduce(t: torch.Tensor) -> None:
+    """Without jax_enable_x64 the JAX engine narrows 64-bit values to
+    32-bit, silently corrupting an arithmetic reduction — refuse rather
+    than corrupt (the reference reduces int64/float64 natively via MPI)."""
+    if t.dtype in _64BIT and not _x64_enabled():
+        raise ValueError(
+            f"allreduce of {t.dtype} requires 64-bit JAX mode; enable it "
+            "with jax.config.update('jax_enable_x64', True) before "
+            "hvd.init(), or reduce in 32-bit")
+
+
+def _to_numpy(t: torch.Tensor) -> np.ndarray:
+    t = t.detach().cpu().contiguous()
+    if t.dtype == torch.bfloat16:
+        bits = t.view(torch.uint16).numpy()
+        return bits.view(_mld.bfloat16)
+    return t.numpy()
+
+
+def _bits32(t: torch.Tensor) -> np.ndarray:
+    """Reinterpret a 64-bit tensor as int32 pairs — exact transport for
+    data-movement collectives (broadcast/allgather) under 32-bit JAX."""
+    return t.detach().cpu().contiguous().view(torch.int32).numpy()
+
+
+def _to_torch(a, dtype: torch.dtype, from_bits: bool = False) -> torch.Tensor:
+    arr = np.asarray(a)
+    if from_bits:
+        bits = torch.from_numpy(np.ascontiguousarray(arr).copy())
+        return bits.view(dtype)
+    if dtype == torch.bfloat16:
+        bits = np.ascontiguousarray(arr.view(np.uint16))
+        return torch.from_numpy(bits.copy()).view(torch.bfloat16)
+    return torch.from_numpy(np.array(arr)).to(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Handle manager — integer handles like the reference's HandleManager
+# (horovod/torch/handle_manager.cc:21-50)
+# ---------------------------------------------------------------------------
+
+class _TorchHandle:
+    __slots__ = ("inner", "dtype", "shape", "output", "target", "from_bits")
+
+    def __init__(self, inner, dtype, shape, target=None, from_bits=False):
+        self.inner = inner          # engine Handle
+        self.dtype = dtype          # torch dtype of the result
+        self.shape = shape
+        self.output = None          # materialized torch result
+        self.target = target        # in-place target tensor, if any
+        self.from_bits = from_bits  # 64-bit value sent as int32 bit pairs
+
+
+_lock = threading.Lock()
+_next_handle = [0]
+_handles: Dict[int, _TorchHandle] = {}
+
+
+def _register(h: _TorchHandle) -> int:
+    with _lock:
+        _next_handle[0] += 1
+        hid = _next_handle[0]
+        _handles[hid] = h
+    return hid
+
+
+def poll(handle: int) -> bool:
+    """True iff the collective behind ``handle`` completed
+    (mpi_ops_v2.cc:226, torch/mpi_ops.py:406-417)."""
+    with _lock:
+        th = _handles.get(handle)
+    if th is None:
+        raise ValueError(f"Unknown handle {handle}")
+    return _ops.poll(th.inner)
+
+
+def synchronize(handle: int) -> torch.Tensor:
+    """Block until done; return the output tensor. In-place variants copy
+    the result into the submitted tensor (WaitAndClear,
+    mpi_ops_v2.cc:228-234 + torch/mpi_ops.py:419-438)."""
+    with _lock:
+        th = _handles.pop(handle, None)
+    if th is None:
+        raise ValueError(f"Unknown handle {handle}")
+    out = th.inner.wait()
+    result = _to_torch(out, th.dtype, from_bits=th.from_bits)
+    if th.target is not None:
+        with torch.no_grad():
+            th.target.copy_(result.reshape(th.target.shape))
+        return th.target
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Async ops
+# ---------------------------------------------------------------------------
+
+def allreduce_async(tensor: torch.Tensor, average: bool = True,
+                    name: Optional[str] = None) -> int:
+    """Returns a handle; result via synchronize() (torch/mpi_ops.py:128-152)."""
+    _check_64bit_reduce(tensor)
+    arr = _to_numpy(tensor)
+    inner = _ops.allreduce_async(arr, average=average, name=name)
+    return _register(_TorchHandle(inner, tensor.dtype, tensor.shape))
+
+
+def allreduce_async_(tensor: torch.Tensor, average: bool = True,
+                     name: Optional[str] = None) -> int:
+    """In-place: the result lands in ``tensor`` (torch/mpi_ops.py:182-207)."""
+    _check_64bit_reduce(tensor)
+    arr = _to_numpy(tensor)
+    inner = _ops.allreduce_async(arr, average=average, name=name)
+    return _register(
+        _TorchHandle(inner, tensor.dtype, tensor.shape, target=tensor))
+
+
+def _movement_payload(tensor: torch.Tensor):
+    """(numpy array, from_bits) for data-movement collectives: 64-bit
+    dtypes travel as exact int32 bit pairs when JAX is in 32-bit mode."""
+    if tensor.dtype in _64BIT and not _x64_enabled():
+        return _bits32(tensor), True
+    return _to_numpy(tensor), False
+
+
+def allgather_async(tensor: torch.Tensor, name: Optional[str] = None) -> int:
+    """Gather along dim 0 from every rank (torch/mpi_ops.py:256-280)."""
+    arr, from_bits = _movement_payload(tensor)
+    inner = _ops.allgather_async(arr, name=name)
+    return _register(
+        _TorchHandle(inner, tensor.dtype, None, from_bits=from_bits))
+
+
+def broadcast_async(tensor: torch.Tensor, root_rank: int,
+                    name: Optional[str] = None) -> int:
+    arr, from_bits = _movement_payload(tensor)
+    inner = _ops.broadcast_async(arr, root_rank, name=name)
+    return _register(_TorchHandle(inner, tensor.dtype, tensor.shape,
+                                  from_bits=from_bits))
+
+
+def broadcast_async_(tensor: torch.Tensor, root_rank: int,
+                     name: Optional[str] = None) -> int:
+    """In-place broadcast (torch/mpi_ops.py:360-392)."""
+    arr, from_bits = _movement_payload(tensor)
+    inner = _ops.broadcast_async(arr, root_rank, name=name)
+    return _register(
+        _TorchHandle(inner, tensor.dtype, tensor.shape, target=tensor,
+                     from_bits=from_bits))
+
+
+# ---------------------------------------------------------------------------
+# Autograd functions — backward passes are collectives, exactly as the
+# reference registers them (torch/mpi_ops.py:110-121, 236-254, 318-332)
+# ---------------------------------------------------------------------------
+
+class _HorovodAllreduce(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, average, name):
+        ctx.average = average
+        return synchronize(allreduce_async(tensor, average, name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        # d(allreduce(x))/dx distributes the same allreduce over the grads.
+        return (synchronize(allreduce_async(grad_output, ctx.average)),
+                None, None)
+
+
+class _HorovodAllgather(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, name):
+        ctx.dim0 = tensor.shape[0]
+        return synchronize(allgather_async(tensor, name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        # Sum-allreduce the full gathered grad, then take this rank's
+        # segment (torch/mpi_ops.py:236-254).
+        summed = synchronize(allreduce_async(grad_output, average=False))
+        r = _topo.rank()
+        return summed[r * ctx.dim0:(r + 1) * ctx.dim0], None
+
+
+class _HorovodBroadcast(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name):
+        ctx.root_rank = root_rank
+        return synchronize(broadcast_async(tensor, root_rank, name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        grad = synchronize(allreduce_async(grad_output, average=False))
+        if _topo.rank() != ctx.root_rank:
+            grad = torch.zeros_like(grad)
+        return grad, None, None
+
+
+# ---------------------------------------------------------------------------
+# Sync ops
+# ---------------------------------------------------------------------------
+
+def allreduce(tensor: torch.Tensor, average: bool = True,
+              name: Optional[str] = None, compression=None) -> torch.Tensor:
+    """Differentiable synchronous allreduce (torch/mpi_ops.py:110-126)."""
+    from .compression import Compression
+    compression = compression or Compression.none
+    wire, cctx = compression.compress(tensor)
+    out = _HorovodAllreduce.apply(wire, average, name)
+    return compression.decompress(out, cctx)
+
+
+def allreduce_(tensor: torch.Tensor, average: bool = True,
+               name: Optional[str] = None) -> torch.Tensor:
+    """In-place synchronous allreduce (torch/mpi_ops.py:209-233)."""
+    return synchronize(allreduce_async_(tensor, average, name))
+
+
+def allgather(tensor: torch.Tensor,
+              name: Optional[str] = None) -> torch.Tensor:
+    """Differentiable allgather along dim 0 (torch/mpi_ops.py:282-316)."""
+    return _HorovodAllgather.apply(tensor, name)
+
+
+def broadcast(tensor: torch.Tensor, root_rank: int,
+              name: Optional[str] = None) -> torch.Tensor:
+    """Differentiable broadcast (torch/mpi_ops.py:318-358)."""
+    return _HorovodBroadcast.apply(tensor, root_rank, name)
+
+
+def broadcast_(tensor: torch.Tensor, root_rank: int,
+               name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(broadcast_async_(tensor, root_rank, name))

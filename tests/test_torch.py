@@ -1,0 +1,290 @@
+"""PyTorch shim tests — structural mirror of the reference's test_torch.py
+(1211 LoC, 33 tests): dtype x dimension sweeps for the three collectives,
+async handle poll/synchronize, in-place variants, autograd through
+collectives, DistributedOptimizer end-to-end, broadcast_parameters /
+broadcast_optimizer_state, compression, error cases.
+
+Virtual-rank semantics (see tests/test_ops.py): every device is a rank and
+eager inputs are replicated, so allreduce(x) == size * x etc.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import horovod_tpu as hvd
+import horovod_tpu.torch as hvd_torch
+
+SWEEP_DTYPES = [torch.uint8, torch.int8, torch.int32,
+                torch.float16, torch.float32, torch.bfloat16]
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    hvd.init()
+    yield
+
+
+def _rand(shape, dtype):
+    if dtype in (torch.uint8, torch.int8, torch.int32, torch.int64):
+        return torch.randint(0, 10, shape, dtype=dtype)
+    return torch.rand(*shape).to(dtype)
+
+
+class TestTorchAllreduce:
+    @pytest.mark.parametrize("dtype", SWEEP_DTYPES)
+    @pytest.mark.parametrize("dim", [1, 2, 3])
+    def test_allreduce_sum(self, dtype, dim):
+        t = _rand([17] * dim, dtype)
+        out = hvd_torch.allreduce(t, average=False)
+        expected = t * hvd.size()
+        assert out.dtype == dtype
+        tol = 1e-2 if dtype in (torch.float16, torch.bfloat16) else 1e-5
+        assert torch.allclose(out.float(), expected.float(),
+                              rtol=tol, atol=tol)
+
+    @pytest.mark.parametrize("dtype", [torch.int64, torch.float64])
+    def test_allreduce_64bit_rejected_without_x64(self, dtype):
+        # Without jax_enable_x64, a 64-bit reduction would silently narrow
+        # to 32 bits — the shim must refuse rather than corrupt.
+        t = torch.tensor([2 ** 40, 5], dtype=dtype)
+        with pytest.raises(ValueError, match="64-bit"):
+            hvd_torch.allreduce(t, average=False)
+
+    @pytest.mark.parametrize("dtype", [torch.int64, torch.float64])
+    def test_broadcast_allgather_64bit_exact(self, dtype):
+        # Data-movement collectives transport 64-bit values as int32 bit
+        # pairs — exact even in 32-bit JAX mode.
+        t = torch.tensor([[2 ** 40 + 3, -7], [1, 2 ** 52 + 1]], dtype=dtype)
+        out = hvd_torch.broadcast(t.clone(), root_rank=0)
+        assert torch.equal(out, t)
+        g = hvd_torch.allgather(t.clone())
+        assert g.shape[0] == 2 * hvd.size()
+        assert torch.equal(g[:2], t)
+
+    def test_allreduce_average(self):
+        t = torch.rand(5, 5)
+        out = hvd_torch.allreduce(t, average=True)
+        assert torch.allclose(out, t, rtol=1e-5, atol=1e-6)
+
+    def test_allreduce_inplace(self):
+        t = torch.ones(4, 4)
+        ret = hvd_torch.allreduce_(t, average=False)
+        assert ret is t
+        assert torch.allclose(t, torch.full((4, 4), float(hvd.size())))
+
+    def test_allreduce_async_poll_synchronize(self):
+        t = torch.ones(8)
+        handle = hvd_torch.allreduce_async(t, average=False)
+        out = hvd_torch.synchronize(handle)
+        assert torch.allclose(out, torch.full((8,), float(hvd.size())))
+        # handle is cleared after synchronize (HandleManager semantics)
+        with pytest.raises(ValueError):
+            hvd_torch.synchronize(handle)
+
+    def test_allreduce_async_poll_completes(self):
+        t = torch.ones(8)
+        handle = hvd_torch.allreduce_async(t, average=False)
+        deadline = 100
+        while not hvd_torch.poll(handle) and deadline:
+            deadline -= 1
+        hvd_torch.synchronize(handle)
+
+    def test_allreduce_multiple_fused(self):
+        tensors = [torch.rand(10) for _ in range(8)]
+        handles = [hvd_torch.allreduce_async(t, average=False,
+                                             name=f"fuse.{i}")
+                   for i, t in enumerate(tensors)]
+        for t, h in zip(tensors, handles):
+            out = hvd_torch.synchronize(h)
+            assert torch.allclose(out, t * hvd.size(), rtol=1e-5, atol=1e-6)
+
+    def test_allreduce_grad(self):
+        t = torch.rand(5, requires_grad=True)
+        out = hvd_torch.allreduce(t, average=False)
+        out.sum().backward()
+        # backward of sum-allreduce is sum-allreduce of the ones grad
+        assert torch.allclose(t.grad,
+                              torch.full((5,), float(hvd.size())))
+
+    def test_allreduce_compression_fp16(self):
+        t = torch.rand(16)
+        out = hvd_torch.allreduce(t, average=True,
+                                  compression=hvd_torch.Compression.fp16)
+        assert out.dtype == torch.float32
+        assert torch.allclose(out, t, rtol=1e-2, atol=1e-2)
+
+
+class TestTorchAllgather:
+    @pytest.mark.parametrize("dtype", [torch.int32, torch.float32,
+                                       torch.bfloat16])
+    @pytest.mark.parametrize("dim", [1, 2, 3])
+    def test_allgather(self, dtype, dim):
+        t = _rand([17] * dim, dtype)
+        out = hvd_torch.allgather(t)
+        assert out.shape[0] == 17 * hvd.size()
+        for r in range(hvd.size()):
+            seg = out[r * 17:(r + 1) * 17]
+            assert torch.equal(seg, t)
+
+    def test_allgather_grad(self):
+        t = torch.rand(3, 2, requires_grad=True)
+        out = hvd_torch.allgather(t)
+        out.sum().backward()
+        assert t.grad.shape == t.shape
+        # each of the size() copies contributes 1 through the sum, and the
+        # backward sums across ranks
+        assert torch.allclose(t.grad,
+                              torch.full((3, 2), float(hvd.size())))
+
+
+class TestTorchBroadcast:
+    @pytest.mark.parametrize("dtype", [torch.int32, torch.float32])
+    def test_broadcast(self, dtype):
+        t = _rand([17, 17], dtype)
+        out = hvd_torch.broadcast(t, root_rank=0)
+        assert torch.equal(out, t)
+
+    def test_broadcast_inplace(self):
+        t = torch.rand(4)
+        ret = hvd_torch.broadcast_(t, root_rank=0)
+        assert ret is t
+
+    def test_broadcast_invalid_root(self):
+        with pytest.raises(ValueError):
+            hvd_torch.broadcast(torch.ones(2), root_rank=hvd.size() + 7)
+
+    def test_broadcast_grad_root(self):
+        t = torch.rand(4, requires_grad=True)
+        out = hvd_torch.broadcast(t, root_rank=0)
+        out.sum().backward()
+        if hvd_torch.rank() == 0:
+            assert torch.allclose(t.grad,
+                                  torch.full((4,), float(hvd.size())))
+
+
+class TestDistributedOptimizer:
+    def _model(self):
+        torch.manual_seed(0)
+        return torch.nn.Sequential(
+            torch.nn.Linear(8, 16), torch.nn.ReLU(), torch.nn.Linear(16, 2))
+
+    def test_end_to_end_step(self):
+        model = self._model()
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        opt = hvd_torch.DistributedOptimizer(
+            opt, named_parameters=model.named_parameters())
+        x = torch.rand(4, 8)
+        y = torch.randint(0, 2, (4,))
+        before = [p.detach().clone() for p in model.parameters()]
+        loss = torch.nn.functional.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        after = list(model.parameters())
+        assert any(not torch.equal(b, a.detach())
+                   for b, a in zip(before, after))
+
+    def test_gradients_are_averaged(self):
+        model = self._model()
+        base = torch.optim.SGD(model.parameters(), lr=0.0)
+        opt = hvd_torch.DistributedOptimizer(
+            base, named_parameters=model.named_parameters())
+        x = torch.rand(4, 8)
+        loss = model(x).sum()
+        loss.backward()
+        expected = {n: p.grad.detach().clone()
+                    for n, p in model.named_parameters()}
+        opt.synchronize()
+        # average over identical virtual ranks == local grad
+        for n, p in model.named_parameters():
+            assert torch.allclose(p.grad, expected[n], rtol=1e-4, atol=1e-5)
+
+    def test_backward_passes_per_step(self):
+        model = self._model()
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(),
+            backward_passes_per_step=2)
+        x = torch.rand(4, 8)
+        model(x).sum().backward()
+        model(x).sum().backward()
+        opt.step()
+
+    def test_double_backward_raises_without_accumulation(self):
+        model = self._model()
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters())
+        x = torch.rand(4, 8)
+        model(x).sum().backward()
+        with pytest.raises((AssertionError, RuntimeError)):
+            model(x).sum().backward()
+        # drain in-flight handles so their names free up for later tests
+        opt.synchronize()
+
+    def test_named_parameters_validation(self):
+        model = self._model()
+        other = torch.nn.Linear(2, 2)
+        with pytest.raises(ValueError):
+            hvd_torch.DistributedOptimizer(
+                torch.optim.SGD(model.parameters(), lr=0.1),
+                named_parameters=other.named_parameters())
+
+    def test_isinstance_preserved(self):
+        model = self._model()
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters())
+        assert isinstance(opt, torch.optim.SGD)
+
+    def test_compression_fp16_optimizer(self):
+        model = self._model()
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(),
+            compression=hvd_torch.Compression.fp16)
+        model(torch.rand(4, 8)).sum().backward()
+        opt.step()
+        for p in model.parameters():
+            assert p.grad.dtype == torch.float32
+
+
+class TestBroadcastState:
+    def test_broadcast_parameters_state_dict(self):
+        model = torch.nn.Linear(4, 4)
+        hvd_torch.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    def test_broadcast_parameters_named(self):
+        model = torch.nn.Linear(4, 4)
+        before = {n: p.detach().clone()
+                  for n, p in model.named_parameters()}
+        hvd_torch.broadcast_parameters(model.named_parameters(), root_rank=0)
+        for n, p in model.named_parameters():
+            assert torch.allclose(p.detach(), before[n])
+
+    def test_broadcast_optimizer_state(self):
+        model = torch.nn.Linear(4, 4)
+        opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+        model(torch.rand(2, 4)).sum().backward()
+        opt.step()
+        lr_before = opt.param_groups[0]["lr"]
+        hvd_torch.broadcast_optimizer_state(opt, root_rank=0)
+        assert opt.param_groups[0]["lr"] == pytest.approx(lr_before)
+        for st in opt.state.values():
+            assert "exp_avg" in st
+
+    def test_broadcast_optimizer_state_materializes_empty(self):
+        model = torch.nn.Linear(4, 4)
+        opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+        params_before = [p.detach().clone() for p in model.parameters()]
+        hvd_torch.broadcast_optimizer_state(opt, root_rank=0)
+        # zero-grad materialization must not move the parameters
+        for b, p in zip(params_before, model.parameters()):
+            assert torch.allclose(b, p.detach())
+        assert len(opt.state) > 0
+
+    def test_broadcast_optimizer_state_lbfgs_rejected(self):
+        model = torch.nn.Linear(4, 4)
+        opt = torch.optim.LBFGS(model.parameters())
+        with pytest.raises(ValueError):
+            hvd_torch.broadcast_optimizer_state(opt, root_rank=0)
