@@ -582,8 +582,8 @@ def _prep(tensor):
             "(horovod_tpu.allreduce_gradients inside shard_map) instead.")
     src_dtype = getattr(tensor, "dtype", None)
     arr = jnp.asarray(tensor)
-    if (src_dtype is not None and np.dtype(src_dtype).itemsize == 8
-            and arr.dtype.itemsize < 8):
+    if (src_dtype is not None
+            and np.dtype(src_dtype).itemsize > arr.dtype.itemsize):
         # jnp.asarray silently narrowed a 64-bit input (jax_enable_x64 is
         # off) — refuse rather than corrupt values; the reference reduces
         # int64/float64 natively over MPI (mpi_message.h:26-37).

@@ -11,6 +11,8 @@ plane (see mpi_ops.py in this package).
 
 from __future__ import annotations
 
+import warnings
+from contextlib import contextmanager
 from typing import Iterable, Optional, Tuple
 
 import torch
@@ -50,6 +52,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         super(self.__class__, self).__init__(params)
         self._compression = compression
         self.backward_passes_per_step = backward_passes_per_step
+        self._synchronized = False
+        self._should_synchronize = True
 
         if named_parameters is not None:
             named_parameters = list(named_parameters)
@@ -108,12 +112,14 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         """Flush: enqueue any parameter whose hook never fired, then block
         on every handle and install the (decompressed) averaged gradients
         (torch/__init__.py:132-147)."""
+        # Every parameter not already in flight gets flushed here — even one
+        # mid-accumulation (delay > 0), matching the reference, so that an
+        # early step() never applies un-allreduced local gradients
+        # (torch/__init__.py:132-140).
         missing = [p for group in self.param_groups
                    for p in group["params"]
                    if p.requires_grad and p.grad is not None
-                   and id(p) not in self._handles
-                   and self._allreduce_delay[id(p)] ==
-                   self.backward_passes_per_step]
+                   and id(p) not in self._handles]
         for p in missing:
             self._handles[id(p)] = self._allreduce_grad_async(p)
         params_by_id = {id(p): p for group in self.param_groups
@@ -127,9 +133,35 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                              .reshape(p.grad.shape))
             self._allreduce_delay[pid] = self.backward_passes_per_step
         self._handles.clear()
+        self._synchronized = True
+
+    @contextmanager
+    def skip_synchronize(self):
+        """Use after an explicit ``synchronize()`` (e.g. for gradient
+        clipping) so ``step()`` does not allreduce a second time
+        (torch/__init__.py:149-160)::
+
+            optimizer.synchronize()
+            torch.nn.utils.clip_grad_norm_(model.parameters(), 1.0)
+            with optimizer.skip_synchronize():
+                optimizer.step()
+        """
+        self._should_synchronize = False
+        try:
+            yield
+        finally:
+            self._should_synchronize = True
 
     def step(self, closure=None):
-        self.synchronize()
+        if self._should_synchronize:
+            if self._synchronized:
+                warnings.warn(
+                    "optimizer.step() called without skip_synchronize() "
+                    "after optimizer.synchronize(); this re-allreduces "
+                    "every gradient. Wrap step() in "
+                    "optimizer.skip_synchronize() context.")
+            self.synchronize()
+        self._synchronized = False
         return super(self.__class__, self).step(closure)
 
     def zero_grad(self, *args, **kwargs):
@@ -204,6 +236,7 @@ def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
     callbacks = []
     handles = []
     scalars = {}
+    scalar_state_keys = []
 
     def _tensorize(key, value):
         t = torch.tensor([float(value)], dtype=torch.float64)
@@ -214,7 +247,7 @@ def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
         for key, value in group.items():
             if key == "params":
                 continue
-            if isinstance(value, (int, float, bool)) and not isinstance(
+            if isinstance(value, (int, float)) and not isinstance(
                     value, bool):
                 skey = f"group.{gi}.{key}"
                 _tensorize(skey, value)
@@ -247,16 +280,17 @@ def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
                     value, bool):
                 skey = f"state.{pid}.{key}"
                 _tensorize(skey, value)
-
-                def make_cb2(pid=pid, key=key, skey=skey):
-                    def cb():
-                        t, typ = scalars[skey]
-                        sd = optimizer.state_dict()
-                        sd["state"][pid][key] = typ(t.item())
-                        optimizer.load_state_dict(sd)
-                    return cb
-                callbacks.append(make_cb2())
+                scalar_state_keys.append((pid, key, skey))
     for h in handles:
         synchronize(h)
     for cb in callbacks:
         cb()
+    if scalar_state_keys:
+        # One state_dict round trip for ALL scalar state entries (not one
+        # per entry): load_state_dict re-casts every tensor, so per-entry
+        # reloads would be O(P^2) in tensor traffic.
+        sd = optimizer.state_dict()
+        for pid, key, skey in scalar_state_keys:
+            t, typ = scalars[skey]
+            sd["state"][pid][key] = typ(t.item())
+        optimizer.load_state_dict(sd)

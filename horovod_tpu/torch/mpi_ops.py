@@ -43,17 +43,6 @@ def _x64_enabled() -> bool:
     return bool(jax.config.jax_enable_x64)
 
 
-def _check_64bit_reduce(t: torch.Tensor) -> None:
-    """Without jax_enable_x64 the JAX engine narrows 64-bit values to
-    32-bit, silently corrupting an arithmetic reduction — refuse rather
-    than corrupt (the reference reduces int64/float64 natively via MPI)."""
-    if t.dtype in _64BIT and not _x64_enabled():
-        raise ValueError(
-            f"allreduce of {t.dtype} requires 64-bit JAX mode; enable it "
-            "with jax.config.update('jax_enable_x64', True) before "
-            "hvd.init(), or reduce in 32-bit")
-
-
 def _to_numpy(t: torch.Tensor) -> np.ndarray:
     t = t.detach().cpu().contiguous()
     if t.dtype == torch.bfloat16:
@@ -65,7 +54,12 @@ def _to_numpy(t: torch.Tensor) -> np.ndarray:
 def _bits32(t: torch.Tensor) -> np.ndarray:
     """Reinterpret a 64-bit tensor as int32 pairs — exact transport for
     data-movement collectives (broadcast/allgather) under 32-bit JAX."""
-    return t.detach().cpu().contiguous().view(torch.int32).numpy()
+    t = t.detach().cpu().contiguous()
+    if t.dim() == 0:
+        # torch refuses to view a 0-dim tensor as a narrower dtype; the
+        # original shape is restored from the handle at synchronize time.
+        t = t.reshape(1)
+    return t.view(torch.int32).numpy()
 
 
 def _to_torch(a, dtype: torch.dtype, from_bits: bool = False) -> torch.Tensor:
@@ -133,6 +127,8 @@ def synchronize(handle: int) -> torch.Tensor:
         with torch.no_grad():
             th.target.copy_(result.reshape(th.target.shape))
         return th.target
+    if th.shape is not None:
+        result = result.reshape(th.shape)
     return result
 
 
@@ -142,8 +138,10 @@ def synchronize(handle: int) -> torch.Tensor:
 
 def allreduce_async(tensor: torch.Tensor, average: bool = True,
                     name: Optional[str] = None) -> int:
-    """Returns a handle; result via synchronize() (torch/mpi_ops.py:128-152)."""
-    _check_64bit_reduce(tensor)
+    """Returns a handle; result via synchronize() (torch/mpi_ops.py:128-152).
+
+    64-bit reductions without jax_enable_x64 are rejected by the engine's
+    narrowing guard (ops/collective.py::_prep) at enqueue time."""
     arr = _to_numpy(tensor)
     inner = _ops.allreduce_async(arr, average=average, name=name)
     return _register(_TorchHandle(inner, tensor.dtype, tensor.shape))
@@ -152,7 +150,6 @@ def allreduce_async(tensor: torch.Tensor, average: bool = True,
 def allreduce_async_(tensor: torch.Tensor, average: bool = True,
                      name: Optional[str] = None) -> int:
     """In-place: the result lands in ``tensor`` (torch/mpi_ops.py:182-207)."""
-    _check_64bit_reduce(tensor)
     arr = _to_numpy(tensor)
     inner = _ops.allreduce_async(arr, average=average, name=name)
     return _register(

@@ -67,6 +67,17 @@ class TestAllreduce:
         out = hvd.allreduce(x, average=True)
         assert np.allclose(np.asarray(out), np.asarray(x), atol=1e-5)
 
+    @pytest.mark.parametrize("dtype", [np.int64, np.float64, np.complex128])
+    def test_allreduce_numpy_64bit_rejected_without_x64(self, dtype):
+        # Any numpy input that jnp.asarray would narrow (including
+        # complex128 → complex64) must be refused, not silently corrupted.
+        import jax
+        if jax.config.jax_enable_x64:
+            pytest.skip("x64 enabled; narrowing cannot occur")
+        x = np.ones((4,), dtype=dtype)
+        with pytest.raises(ValueError, match="64-bit"):
+            hvd.allreduce(x, average=False)
+
     def test_allreduce_sharded_per_rank(self):
         """Per-rank distinct values via a 'dp'-sharded leading axis."""
         size = hvd.size()

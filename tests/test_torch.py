@@ -210,6 +210,44 @@ class TestDistributedOptimizer:
         model(x).sum().backward()
         opt.step()
 
+    def test_skip_synchronize_gradient_clipping(self):
+        # The gradient-clipping recipe: explicit synchronize(), clip, then
+        # step() inside skip_synchronize() — no second allreduce, no warning.
+        import warnings as _warnings
+        model = self._model()
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters())
+        model(torch.rand(4, 8)).sum().backward()
+        opt.synchronize()
+        torch.nn.utils.clip_grad_norm_(model.parameters(), 1.0)
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("error")
+            with opt.skip_synchronize():
+                opt.step()
+        # step() right after synchronize() without the guard re-allreduces
+        # and must warn about it
+        model(torch.rand(4, 8)).sum().backward()
+        opt.synchronize()
+        with pytest.warns(UserWarning, match="skip_synchronize"):
+            opt.step()
+
+    def test_partial_accumulation_step_still_allreduces(self):
+        # Early step() mid-accumulation (dataset not divisible by
+        # backward_passes_per_step): every pending gradient must still be
+        # flushed through allreduce, and delays reset, or replicas diverge.
+        model = self._model()
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(),
+            backward_passes_per_step=2)
+        model(torch.rand(4, 8)).sum().backward()  # one pass only
+        opt.step()
+        for group in opt.param_groups:
+            for p in group["params"]:
+                assert opt._allreduce_delay[id(p)] == 2
+        assert not opt._handles
+
     def test_double_backward_raises_without_accumulation(self):
         model = self._model()
         opt = hvd_torch.DistributedOptimizer(
@@ -261,6 +299,22 @@ class TestBroadcastState:
         hvd_torch.broadcast_parameters(model.named_parameters(), root_rank=0)
         for n, p in model.named_parameters():
             assert torch.allclose(p.detach(), before[n])
+
+    def test_broadcast_parameters_batchnorm_state_dict(self):
+        # BatchNorm carries a 0-dim int64 buffer (num_batches_tracked) that
+        # must survive the int32 bit-pair transport under 32-bit JAX.
+        model = torch.nn.Sequential(torch.nn.Linear(4, 4),
+                                    torch.nn.BatchNorm1d(4))
+        model(torch.rand(8, 4))  # tick num_batches_tracked to 1
+        tracked = model[1].num_batches_tracked.clone()
+        hvd_torch.broadcast_parameters(model.state_dict(), root_rank=0)
+        assert torch.equal(model[1].num_batches_tracked, tracked)
+
+    def test_broadcast_0dim_int64_roundtrip(self):
+        t = torch.tensor(2 ** 40 + 7, dtype=torch.int64)
+        out = hvd_torch.broadcast(t.clone(), root_rank=0)
+        assert out.shape == t.shape
+        assert torch.equal(out, t)
 
     def test_broadcast_optimizer_state(self):
         model = torch.nn.Linear(4, 4)
