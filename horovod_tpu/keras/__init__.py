@@ -1,0 +1,285 @@
+"""horovod_tpu.keras — the Keras framework shim.
+
+Parity target: horovod/keras/__init__.py (148) + horovod/tensorflow/keras/
+__init__.py (155) + the shared impl horovod/_keras/__init__.py (109): a
+``DistributedOptimizer`` built as a dynamic subclass of the wrapped
+optimizer's class (so saved models restore without the framework,
+_keras/__init__.py:63-70), eager ``allreduce/allgather/broadcast`` on
+host values, ``broadcast_variables`` and ``load_model`` that re-wraps
+every stock optimizer class (_keras/__init__.py:93-109).
+
+The reference targets Keras 2 over TF sessions and hooks
+``get_gradients`` (graph mode). Keras 3 is multi-backend and routes every
+gradient application through ``Optimizer.apply`` — that is the hook here.
+The collectives run on the TPU-native XLA engine; gradients cross from
+whatever backend Keras is using:
+
+- ``torch`` backend: tensors move through the torch shim's transport.
+- ``tensorflow`` backend: eager tensors via numpy; inside a traced
+  ``tf.function`` the allreduce is bridged with ``tf.py_function`` (the
+  host-callback analogue of the reference's AsyncOpKernel,
+  tensorflow/mpi_ops.cc:281-303).
+- ``jax`` backend: concrete arrays go straight to the engine. Inside a
+  jitted step (``model.fit``), collectives must be part of the SPMD
+  program — use ``lax.psum`` over a mesh axis ('dp' is tried
+  automatically under ``shard_map``) or Keras's own
+  ``keras.distribution`` sharding; an un-shardable tracer raises with
+  that guidance rather than silently skipping the reduction.
+- ``numpy`` backend: direct.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import keras
+
+from .. import ops as _ops
+from .. import topology as _topo
+from ..compression import Compression
+from ..topology import (init, shutdown, is_initialized, rank, local_rank,
+                        size, local_size, mpi_threads_supported)
+from . import callbacks
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "local_rank", "size",
+    "local_size", "mpi_threads_supported", "Compression",
+    "DistributedOptimizer", "broadcast_global_variables",
+    "broadcast_variables", "allreduce", "allgather", "broadcast",
+    "load_model", "callbacks",
+]
+
+
+# ---------------------------------------------------------------------------
+# Backend bridging
+# ---------------------------------------------------------------------------
+
+def _backend() -> str:
+    return keras.backend.backend()
+
+
+def _is_jax_tracer(x) -> bool:
+    import jax
+    return isinstance(x, jax.core.Tracer)
+
+
+def _jax_inline_allreduce(g):
+    """Inside a jitted Keras-JAX train step the reduction must be part of
+    the SPMD program. Under shard_map with a 'dp' axis, psum does it;
+    otherwise there is no data-parallel axis to reduce over and we fail
+    loudly instead of silently skipping the averaging."""
+    import jax
+    from jax import lax
+    try:
+        return lax.psum(g, "dp") / lax.psum(
+            jax.numpy.ones((), g.dtype), "dp")
+    except NameError as e:
+        raise RuntimeError(
+            "horovod_tpu.keras.DistributedOptimizer was traced into a "
+            "jitted train step with no 'dp' mesh axis in scope. With the "
+            "Keras JAX backend, either run the optimizer inside "
+            "shard_map over a mesh with a 'dp' axis, or use SPMD data "
+            "parallelism (keras.distribution.DataParallel / "
+            "horovod_tpu.parallel) where XLA inserts the gradient "
+            "reduction itself.") from e
+
+
+def _tf_graph_allreduce(g, name: Optional[str], average: bool, wire_dtype):
+    """Bridge a symbolic tf.function tensor to the eager engine through
+    tf.py_function — the host-callback analogue of the reference's TF
+    AsyncOpKernel enqueue (tensorflow/mpi_ops.cc:281-303)."""
+    import tensorflow as tf
+
+    def _host(x):
+        arr = x.numpy()
+        if wire_dtype is not None and np.issubdtype(arr.dtype, np.floating):
+            out = _ops.allreduce(arr.astype(wire_dtype), average=average,
+                                 name=name)
+        else:
+            out = _ops.allreduce(arr, average=average, name=name)
+        return np.asarray(out, dtype=arr.dtype)
+
+    out = tf.py_function(_host, [g], Tout=g.dtype)
+    out.set_shape(g.shape)
+    return out
+
+
+def _allreduce_grad(g, name: Optional[str], compression) -> object:
+    """Average one backend gradient tensor across ranks, preserving its
+    backend type."""
+    wire = getattr(compression, "wire_dtype", None)
+    wire_np = np.dtype("float16") if wire is not None and "float16" in str(
+        wire) else (np.dtype("bfloat16") if wire is not None else None)
+    kb = _backend()
+    if kb == "torch":
+        from . import _torch_bridge
+        return _torch_bridge.allreduce_average(g, name, compression)
+    if kb == "tensorflow":
+        import tensorflow as tf
+        if not tf.executing_eagerly():
+            return _tf_graph_allreduce(g, name, True, wire_np)
+        arr = g.numpy()
+        out = _engine_allreduce(arr, name, compression)
+        return tf.constant(out, dtype=g.dtype)
+    if kb == "jax":
+        if _is_jax_tracer(g):
+            return _jax_inline_allreduce(g)
+        return _engine_allreduce(np.asarray(g), name, compression,
+                                 like=g)
+    # numpy / anything array-like
+    arr = keras.ops.convert_to_numpy(g)
+    return keras.ops.convert_to_tensor(
+        _engine_allreduce(arr, name, compression))
+
+
+def _engine_allreduce(arr: np.ndarray, name: Optional[str], compression,
+                      like=None):
+    wire, ctx = compression.compress(arr) if compression is not None else (
+        arr, None)
+    out = _ops.allreduce(wire, average=True, name=name)
+    if compression is not None:
+        out = compression.decompress(out, ctx)
+    if like is not None:
+        return out  # jax array already
+    return np.asarray(out, dtype=arr.dtype)
+
+
+# ---------------------------------------------------------------------------
+# DistributedOptimizer
+# ---------------------------------------------------------------------------
+
+class _DistributedOptimizer:
+    """Mixin copied onto a dynamic subclass of the wrapped optimizer's
+    class (_keras/__init__.py:63-70) so ``isinstance`` checks, LR
+    schedules and model saving keep working."""
+
+    _hvd_wrapped = True
+    # Class-level defaults: instances deserialized by load_model() never
+    # pass through DistributedOptimizer(), which sets instance attrs.
+    _hvd_name = None
+    _hvd_compression = Compression.none
+
+    def apply(self, grads, trainable_variables=None):
+        if not _topo.is_initialized():
+            init()
+        if _topo.size() > 1:
+            prefix = self._hvd_name or f"Distributed{type(self).__name__}"
+            grads = [
+                g if g is None else _allreduce_grad(
+                    g, f"{prefix}.grad.{i}", self._hvd_compression)
+                for i, g in enumerate(grads)]
+        return super(self.__class__, self).apply(grads, trainable_variables)
+
+
+def _make_wrapped_class(cls):
+    ns = {k: v for k, v in _DistributedOptimizer.__dict__.items()
+          if k not in ("__dict__", "__weakref__")}
+    return type(cls.__name__, (cls,), ns)
+
+
+def DistributedOptimizer(optimizer, name: Optional[str] = None,
+                         compression=Compression.none):
+    """Wrap a ``keras.optimizers.Optimizer`` so every gradient is
+    allreduce-averaged across ranks before the update rule runs
+    (_keras/__init__.py:20-70). The returned object is an instance of a
+    dynamic subclass with the SAME class name, so a model saved with it
+    loads without horovod_tpu installed."""
+    cls = _make_wrapped_class(optimizer.__class__)
+    new = cls.from_config(optimizer.get_config())
+    new._hvd_name = name or f"Distributed{optimizer.__class__.__name__}"
+    new._hvd_compression = compression
+    return new
+
+
+# ---------------------------------------------------------------------------
+# Eager host-value collectives (_keras/__init__.py:78-90)
+# ---------------------------------------------------------------------------
+
+def _host_array(value) -> np.ndarray:
+    """Python scalars/lists default to 32-bit, as ``tf.constant`` does in
+    the reference's host-value helpers (_keras/__init__.py:78-90);
+    explicit numpy 64-bit arrays still hit the engine's narrowing guard."""
+    if isinstance(value, np.ndarray):
+        return value
+    arr = np.asarray(value)
+    if arr.dtype == np.float64:
+        return arr.astype(np.float32)
+    if arr.dtype == np.int64:
+        return arr.astype(np.int32)
+    return arr
+
+
+def allreduce(value, name: Optional[str] = None, average: bool = True):
+    """Allreduce a host value (scalar / array); returns numpy."""
+    out = _ops.allreduce(_host_array(value), average=average, name=name)
+    return np.asarray(out)
+
+
+def allgather(value, name: Optional[str] = None):
+    out = _ops.allgather(np.atleast_1d(_host_array(value)), name=name)
+    return np.asarray(out)
+
+
+def broadcast(value, root_rank: int = 0, name: Optional[str] = None):
+    out = _ops.broadcast(_host_array(value), root_rank, name=name)
+    return np.asarray(out)
+
+
+# ---------------------------------------------------------------------------
+# Variable broadcast + model loading
+# ---------------------------------------------------------------------------
+
+def broadcast_variables(variables, root_rank: int = 0) -> None:
+    """Broadcast ``keras.Variable``s from ``root_rank`` in place — the
+    rank-0 state sync used at (re)start (tensorflow/__init__.py:95-114)."""
+    from ..utils.wire import movement_payload, movement_restore
+    handles = []
+    for i, v in enumerate(variables):
+        arr = np.ascontiguousarray(keras.ops.convert_to_numpy(v))
+        wire, from_bits = movement_payload(arr)
+        h = _ops.broadcast_async(
+            wire, root_rank, name=f"keras.bcast.{i}.{getattr(v, 'path', i)}")
+        handles.append((v, arr.dtype, arr.shape, from_bits, h))
+    for v, dtype, shape, from_bits, h in handles:
+        v.assign(movement_restore(h.wait(), dtype, shape, from_bits))
+
+
+def broadcast_global_variables(root_rank: int = 0, model=None) -> None:
+    """Broadcast all of a model's variables (weights + optimizer slots).
+    Keras 3 has no global-variables collection; pass the model (the
+    callback does this automatically)."""
+    if model is None:
+        raise ValueError(
+            "Keras 3 has no global variable collection; pass model= or "
+            "use callbacks.BroadcastGlobalVariablesCallback")
+    broadcast_variables(model.variables, root_rank)
+    if getattr(model, "optimizer", None) is not None:
+        broadcast_variables(model.optimizer.variables, root_rank)
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compile=True):
+    """Load a model, re-wrapping every stock optimizer class in
+    ``DistributedOptimizer`` so restored training resumes distributed
+    (_keras/__init__.py:93-109)."""
+    import inspect
+
+    horovod_objects = {}
+    for attr in dir(keras.optimizers):
+        obj = getattr(keras.optimizers, attr)
+        if (inspect.isclass(obj)
+                and issubclass(obj, keras.optimizers.Optimizer)
+                and obj is not keras.optimizers.Optimizer):
+            wrapped = _make_wrapped_class(obj)
+            horovod_objects[obj.__name__] = wrapped
+            horovod_objects[obj.__name__.lower()] = wrapped
+    if custom_optimizers is not None:
+        horovod_objects.update(
+            {cls.__name__: _make_wrapped_class(cls)
+             for cls in custom_optimizers})
+    if custom_objects is not None:
+        horovod_objects.update(custom_objects)
+    return keras.models.load_model(filepath, custom_objects=horovod_objects,
+                                   compile=compile)

@@ -1,0 +1,22 @@
+"""Torch-backend gradient bridge for the Keras shim.
+
+Isolated in its own module so ``horovod_tpu.keras`` does not import torch
+unless Keras is actually running on the torch backend.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..compression import Compression as _JaxCompression
+
+
+def allreduce_average(g, name: Optional[str], compression):
+    from .. import torch as _hvd_torch
+    comp = (_hvd_torch.Compression.fp16
+            if compression is _JaxCompression.fp16
+            else _hvd_torch.Compression.none)
+    wire, ctx = comp.compress(g)
+    out = _hvd_torch.mpi_ops.synchronize(
+        _hvd_torch.mpi_ops.allreduce_async(wire, average=True, name=name))
+    return comp.decompress(out, ctx)

@@ -1,0 +1,199 @@
+"""Keras callbacks — parity with horovod/_keras/callbacks.py (168 LoC) and
+its two façades (horovod/keras/callbacks.py, horovod/tensorflow/keras/
+callbacks.py), rebuilt for Keras 3's multi-backend callback API.
+
+- ``BroadcastGlobalVariablesCallback`` — rank-0 state sync at train start
+  (_keras/callbacks.py:20-30).
+- ``MetricAverageCallback`` — epoch-end metric allreduce
+  (_keras/callbacks.py:33-67).
+- ``LearningRateScheduleCallback`` — epoch/batch LR schedule with momentum
+  correction (_keras/callbacks.py:70-147).
+- ``LearningRateWarmupCallback`` — gradual 1/N → 1 warmup over the first
+  epochs (_keras/callbacks.py:149-168).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import numpy as np
+
+import keras
+
+from .. import ops as _ops
+from .. import topology as _topo
+
+
+def _get_lr(optimizer) -> float:
+    return float(keras.ops.convert_to_numpy(optimizer.learning_rate))
+
+
+def _set_lr(optimizer, value: float) -> None:
+    optimizer.learning_rate = value
+
+
+class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
+    """Broadcast all model variables from ``root_rank`` when training
+    begins, and optimizer slot variables as soon as they exist (after the
+    first batch builds them) — ensures consistent initialization of all
+    workers when training starts or resumes from a checkpoint."""
+
+    def __init__(self, root_rank: int = 0):
+        super().__init__()
+        self.root_rank = root_rank
+        self._model_done = False
+        self._opt_done = False
+
+    def on_train_begin(self, logs=None):
+        from . import broadcast_variables
+        if not self._model_done:
+            broadcast_variables(self.model.variables, self.root_rank)
+            self._model_done = True
+
+    def on_train_batch_end(self, batch, logs=None):
+        # Optimizer slots (momentum, Adam moments, iteration counter) are
+        # built lazily by the first apply; sync them once available so a
+        # restored rank-0 optimizer state propagates.
+        from . import broadcast_variables
+        if not self._opt_done and getattr(
+                self.model, "optimizer", None) is not None:
+            vs = self.model.optimizer.variables
+            if vs:
+                broadcast_variables(vs, self.root_rank)
+                self._opt_done = True
+
+
+class MetricAverageCallback(keras.callbacks.Callback):
+    """Average epoch metrics across ranks before other callbacks (e.g.
+    checkpointing or LR plateau schedules) consume them. Order matters:
+    place this before them in the callback list, as the reference docs
+    instruct (_keras/callbacks.py:33-67)."""
+
+    def _average_metrics_in_place(self, logs):
+        logs = logs or {}
+        reduced = {}
+        for metric, value in sorted(logs.items()):
+            if isinstance(value, (int, float, np.floating, np.integer)):
+                out = _ops.allreduce(
+                    np.asarray(float(value), dtype=np.float32),
+                    average=True, name=f"metric.{metric}")
+                reduced[metric] = float(np.asarray(out))
+        logs.update(reduced)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._average_metrics_in_place(logs)
+
+
+class LearningRateScheduleCallback(keras.callbacks.Callback):
+    """Multiply the initial LR by ``multiplier`` (a constant or a
+    function of epoch) between ``start_epoch`` and ``end_epoch``.
+
+    ``staircase=True`` adjusts once per epoch; ``staircase=False``
+    interpolates per batch using ``steps_per_epoch`` (auto-detected from
+    ``self.params['steps']`` when possible). When the wrapped optimizer
+    has momentum and ``momentum_correction`` is on, momentum is scaled by
+    ``new_lr/old_lr`` for the batches where LR changed and restored after
+    (the momentum-correction trick from the large-batch SGD literature,
+    _keras/callbacks.py:103-117).
+
+    Note: with a compiled/jitted train step, only ``learning_rate``
+    (a Keras variable) is guaranteed to take effect mid-training;
+    momentum on some optimizers is a Python constant captured at trace
+    time, in which case momentum correction only applies on eagerly
+    executing backends.
+    """
+
+    def __init__(self, multiplier: Union[float, Callable[[float], float]],
+                 start_epoch: int = 0, end_epoch: Optional[int] = None,
+                 staircase: bool = True, momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None):
+        super().__init__()
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.initial_lr = None
+        self.restore_momentum = None
+        self.steps_per_epoch = steps_per_epoch
+        self.current_epoch = None
+        if not callable(multiplier):
+            self.staircase = True
+            self.multiplier = lambda epoch: multiplier
+        else:
+            self.multiplier = multiplier
+
+    def _autodetect_steps_per_epoch(self) -> int:
+        if self.params and self.params.get("steps"):
+            return self.params["steps"]
+        raise ValueError(
+            f"Could not autodetect steps per epoch; pass steps_per_epoch "
+            f"to {self.__class__.__name__}()")
+
+    def _adjust_learning_rate(self, epoch: float) -> None:
+        old_lr = _get_lr(self.model.optimizer)
+        new_lr = self.initial_lr * self.multiplier(epoch)
+        _set_lr(self.model.optimizer, new_lr)
+        if (self.momentum_correction
+                and hasattr(self.model.optimizer, "momentum")
+                and old_lr > 0):
+            self.restore_momentum = self.model.optimizer.momentum
+            self.model.optimizer.momentum = (
+                self.restore_momentum * new_lr / old_lr)
+
+    def _restore_momentum_if_needed(self) -> None:
+        if self.restore_momentum:
+            self.model.optimizer.momentum = self.restore_momentum
+            self.restore_momentum = None
+
+    def on_train_begin(self, logs=None):
+        self.initial_lr = _get_lr(self.model.optimizer)
+        if not self.staircase and not self.steps_per_epoch:
+            self.steps_per_epoch = self._autodetect_steps_per_epoch()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+
+    def on_train_batch_begin(self, batch, logs=None):
+        if (self.current_epoch is None
+                or self.current_epoch < self.start_epoch
+                or (self.end_epoch is not None
+                    and self.current_epoch >= self.end_epoch)):
+            return
+        if self.staircase and batch == 0:
+            self._adjust_learning_rate(self.current_epoch)
+        elif not self.staircase:
+            epoch = self.current_epoch + float(batch) / self.steps_per_epoch
+            self._adjust_learning_rate(epoch)
+
+    def on_train_batch_end(self, batch, logs=None):
+        self._restore_momentum_if_needed()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            logs["lr"] = _get_lr(self.model.optimizer)
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradually scale the LR from ``initial_lr/size`` up to ``initial_lr``
+    over the first ``warmup_epochs`` — 'Accurate, Large Minibatch SGD'
+    warmup (_keras/callbacks.py:149-168)."""
+
+    def __init__(self, warmup_epochs: int = 5,
+                 momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None, verbose: int = 0):
+        def multiplier(epoch):
+            epoch += 1.0 / self.steps_per_epoch
+            size = _topo.size()
+            return 1.0 / size * (epoch * (size - 1) / warmup_epochs + 1)
+
+        super().__init__(multiplier, start_epoch=0, end_epoch=warmup_epochs,
+                         staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch)
+        self.verbose = verbose
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if epoch == self.end_epoch - 1 and self.verbose > 0:
+            print(f"\nEpoch {epoch + 1}: finished gradual learning rate "
+                  f"warmup to {_get_lr(self.model.optimizer):g}.")

@@ -1,0 +1,290 @@
+"""horovod_tpu.tensorflow — the TensorFlow framework shim.
+
+Parity target: horovod/tensorflow/__init__.py (326) + mpi_ops.py (183) +
+the C++ binding horovod/tensorflow/mpi_ops.cc (466): differentiable
+``allreduce`` / ``allgather`` / ``broadcast`` on ``tf.Tensor``s with the
+reference's registered gradients (tensorflow/mpi_ops.py:94-183),
+``DistributedOptimizer`` overriding gradient computation
+(tensorflow/__init__.py:151-249), ``DistributedGradientTape``
+(tensorflow/__init__.py:252-326), ``broadcast_variables`` and a
+``BroadcastGlobalVariablesCallback``-style hook.
+
+Where the reference registers a TF ``AsyncOpKernel`` that enqueues into
+the MPI coordinator (mpi_ops.cc:281-303), this shim bridges with
+``tf.py_function`` into the TPU-native XLA engine: eager tensors cross
+via numpy; inside a traced ``tf.function`` the py_function node plays the
+AsyncOpKernel's role (a host callback that blocks on the engine handle).
+TF stays the autograd engine; the collectives run on the XLA data plane.
+
+Gradient registrations (all three, mirroring tensorflow/mpi_ops.py):
+- grad(allreduce(x))  = allreduce(grad)            (94-105)
+- grad(allgather(x))  = this rank's slice of the unsummed
+                        allreduce of the gathered grad (127-148)
+- grad(broadcast(x))  = allreduce(grad), zeroed on non-root (168-183)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import tensorflow as tf
+
+from .. import ops as _ops
+from .. import topology as _topo
+from ..compression import Compression
+from ..topology import (init, shutdown, is_initialized, rank, local_rank,
+                        size, local_size, mpi_threads_supported)
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "local_rank", "size",
+    "local_size", "mpi_threads_supported", "Compression",
+    "allreduce", "allgather", "broadcast", "broadcast_variables",
+    "broadcast_global_variables", "DistributedOptimizer",
+    "DistributedGradientTape", "BroadcastGlobalVariablesCallback",
+]
+
+
+# ---------------------------------------------------------------------------
+# Host bridge — the AsyncOpKernel analogue
+# ---------------------------------------------------------------------------
+
+def _np(x: tf.Tensor) -> np.ndarray:
+    arr = x.numpy()
+    if arr.dtype == np.float64 or arr.dtype == np.int64:
+        # tf defaults many python constants to 64-bit; the engine's wire is
+        # 32-bit unless jax_enable_x64 — the result is cast back by Tout.
+        import jax
+        if not jax.config.jax_enable_x64:
+            arr = arr.astype(
+                np.float32 if arr.dtype == np.float64 else np.int32)
+    return arr
+
+
+def _hvd_allreduce_host(x: tf.Tensor, average: bool, name: str) -> np.ndarray:
+    out = _ops.allreduce(_np(x), average=average, name=name or None)
+    return np.asarray(out)
+
+
+def _py_collective(host_fn, inputs: tf.Tensor, out_dtype, out_shape):
+    out = tf.py_function(host_fn, [inputs], Tout=out_dtype)
+    if out_shape is not None:
+        out.set_shape(out_shape)
+    return out
+
+
+_name_counter = [0]
+
+
+def _auto_name(prefix: str, name: Optional[str]) -> str:
+    if name:
+        return name
+    _name_counter[0] += 1
+    return f"tf.{prefix}.{_name_counter[0]}"
+
+
+# ---------------------------------------------------------------------------
+# Differentiable collectives
+# ---------------------------------------------------------------------------
+
+def allreduce(tensor, average: bool = True, name: Optional[str] = None,
+              compression=Compression.none):
+    """Differentiable allreduce. ``tf.IndexedSlices`` inputs are handled
+    as allgather(values)+allgather(indices) — the sparse data-parallel
+    path (tensorflow/__init__.py:72-83)."""
+    if isinstance(tensor, tf.IndexedSlices):
+        values = allgather(tensor.values, name=_auto_name("ar.sv", name))
+        indices = allgather(tensor.indices, name=_auto_name("ar.si", name))
+        if average:
+            values = values / float(_topo.size())
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=tensor.dense_shape)
+
+    nm = _auto_name("allreduce", name)
+
+    @tf.custom_gradient
+    def _op(x):
+        wire = x
+        ctx = None
+        if compression is not Compression.none:
+            warr = tf.cast(x, tf.float16) if x.dtype.is_floating else x
+            wire, ctx = warr, x.dtype
+
+        def host(v):
+            return _hvd_allreduce_host(v, average, nm)
+
+        out = _py_collective(host, wire, wire.dtype, wire.shape)
+        if ctx is not None:
+            out = tf.cast(out, ctx)
+
+        def grad(dy):
+            return allreduce(dy, average=average,
+                             name=_auto_name("allreduce", None),
+                             compression=compression)
+
+        return out, grad
+
+    return _op(tf.convert_to_tensor(tensor))
+
+
+def allgather(tensor, name: Optional[str] = None):
+    """Differentiable allgather along dim 0 (tensorflow/mpi_ops.py:107-148).
+    Backward: sum-allreduce the gathered gradient, slice this rank's
+    segment."""
+    nm = _auto_name("allgather", name)
+
+    @tf.custom_gradient
+    def _op(x):
+        dim0 = x.shape[0]
+
+        def host(v):
+            return np.asarray(_ops.allgather(_np(v), name=nm))
+
+        out_shape = tf.TensorShape(
+            [None if dim0 is None else dim0 * _topo.size()]
+            + list(x.shape[1:]))
+        out = _py_collective(host, x, x.dtype, out_shape)
+
+        def grad(dy):
+            summed = allreduce(dy, average=False,
+                               name=_auto_name("allgather.grad", None))
+            r = _topo.rank()
+            n = tf.shape(summed)[0] // _topo.size()
+            return summed[r * n:(r + 1) * n]
+
+        return out, grad
+
+    return _op(tf.convert_to_tensor(tensor))
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
+    """Differentiable broadcast (tensorflow/mpi_ops.py:150-183).
+    Backward: allreduce the gradient; non-root ranks contribute zeros."""
+    nm = _auto_name("broadcast", name)
+
+    @tf.custom_gradient
+    def _op(x):
+        def host(v):
+            return np.asarray(_ops.broadcast(_np(v), root_rank, name=nm))
+
+        out = _py_collective(host, x, x.dtype, x.shape)
+
+        def grad(dy):
+            g = allreduce(dy, average=False,
+                          name=_auto_name("broadcast.grad", None))
+            if _topo.rank() != root_rank:
+                g = tf.zeros_like(g)
+            return g
+
+        return out, grad
+
+    return _op(tf.convert_to_tensor(tensor))
+
+
+# ---------------------------------------------------------------------------
+# Variable sync
+# ---------------------------------------------------------------------------
+
+def broadcast_variables(variables, root_rank: int = 0) -> None:
+    """Assign every variable the root rank's value
+    (tensorflow/__init__.py:95-114)."""
+    from ..utils.wire import movement_payload, movement_restore
+    handles = []
+    for i, v in enumerate(variables):
+        arr = np.ascontiguousarray(v.numpy())
+        wire, from_bits = movement_payload(arr)
+        handles.append((v, arr.dtype, arr.shape, from_bits,
+                        _ops.broadcast_async(
+                            wire, root_rank, name=f"tf.bcast.{i}.{v.name}")))
+    for v, dtype, shape, from_bits, h in handles:
+        v.assign(movement_restore(h.wait(), dtype, shape, from_bits))
+
+
+def broadcast_global_variables(root_rank: int = 0, variables=None) -> None:
+    """TF2 has no global-variables collection; pass the variables (e.g.
+    ``model.variables``) explicitly."""
+    if variables is None:
+        raise ValueError(
+            "TF2 has no global variable collection; pass variables= "
+            "(e.g. model.variables + optimizer.variables)")
+    broadcast_variables(variables, root_rank)
+
+
+class BroadcastGlobalVariablesCallback:
+    """Callable hook: invoke once after the first step (when optimizer
+    slots exist) to sync all state from ``root_rank`` — the TF2 analogue
+    of the reference's SessionRunHook (tensorflow/__init__.py:117-148)."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+        self._done = False
+
+    def __call__(self, model=None, optimizer=None) -> None:
+        if self._done:
+            return
+        vs = []
+        if model is not None:
+            vs += list(model.variables)
+        if optimizer is not None:
+            vs += list(optimizer.variables)
+        broadcast_variables(vs, self.root_rank)
+        self._done = True
+
+
+# ---------------------------------------------------------------------------
+# DistributedOptimizer / DistributedGradientTape
+# ---------------------------------------------------------------------------
+
+def DistributedOptimizer(optimizer, name: Optional[str] = None,
+                         compression=Compression.none,
+                         sparse_as_dense: bool = False):
+    """Wrap a ``tf.keras.optimizers``-style optimizer: gradients passed to
+    ``apply_gradients`` are allreduce-averaged first
+    (tensorflow/__init__.py:151-249)."""
+    prefix = name or f"Distributed{optimizer.__class__.__name__}"
+
+    class _Wrapped(optimizer.__class__):
+        _hvd_wrapped = True
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            gv = list(grads_and_vars)
+            reduced = []
+            for i, (g, v) in enumerate(gv):
+                if g is None:
+                    reduced.append((g, v))
+                    continue
+                if sparse_as_dense and isinstance(g, tf.IndexedSlices):
+                    g = tf.convert_to_tensor(g)
+                reduced.append((allreduce(
+                    g, average=True, name=f"{prefix}.grad.{i}",
+                    compression=compression), v))
+            return super().apply_gradients(reduced, *args, **kwargs)
+
+    new = _Wrapped.from_config(optimizer.get_config())
+    return new
+
+
+class DistributedGradientTape(tf.GradientTape):
+    """``tf.GradientTape`` whose ``gradient()`` returns allreduce-averaged
+    gradients (tensorflow/__init__.py:252-326)."""
+
+    def __init__(self, *args, compression=Compression.none,
+                 sparse_as_dense: bool = False, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._hvd_compression = compression
+        self._hvd_sparse_as_dense = sparse_as_dense
+
+    def gradient(self, target, sources, *args, **kwargs):
+        grads = super().gradient(target, sources, *args, **kwargs)
+        flat = tf.nest.flatten(grads)
+        out = []
+        for i, g in enumerate(flat):
+            if g is None:
+                out.append(None)
+                continue
+            if self._hvd_sparse_as_dense and isinstance(g, tf.IndexedSlices):
+                g = tf.convert_to_tensor(g)
+            out.append(allreduce(g, average=True,
+                                 name=_auto_name("tape.grad", None),
+                                 compression=self._hvd_compression))
+        return tf.nest.pack_sequence_as(grads, out)

@@ -1,0 +1,38 @@
+"""Exact 64-bit transport for data-movement collectives on numpy payloads.
+
+Without ``jax_enable_x64`` the engine narrows 64-bit values to 32-bit.
+For *movement* collectives (broadcast/allgather) no arithmetic happens,
+so a 64-bit array can travel as int32 bit pairs and be reinterpreted on
+the way out — the same trick the torch shim uses for tensors
+(horovod_tpu/torch/mpi_ops.py). Reductions cannot use this (bits are not
+additive); those still require x64 mode.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_64BIT = (np.dtype(np.int64), np.dtype(np.float64))
+
+
+def _x64_enabled() -> bool:
+    import jax
+    return bool(jax.config.jax_enable_x64)
+
+
+def movement_payload(arr: np.ndarray):
+    """Returns ``(wire_array, from_bits)``; 64-bit dtypes become int32 bit
+    pairs when JAX is in 32-bit mode."""
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype in _64BIT and not _x64_enabled():
+        flat = arr.reshape(1) if arr.ndim == 0 else arr
+        return flat.view(np.int32), True
+    return arr, False
+
+
+def movement_restore(out, orig_dtype, orig_shape, from_bits: bool):
+    """Invert :func:`movement_payload` on the collective's result."""
+    arr = np.ascontiguousarray(np.asarray(out))
+    if from_bits:
+        arr = arr.view(orig_dtype)
+    return arr.reshape(orig_shape)

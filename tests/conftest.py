@@ -25,6 +25,11 @@ import pytest  # noqa: E402
 import horovod_tpu as hvd  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running subprocess integration test")
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _init_horovod():
     hvd.init()

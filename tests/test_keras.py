@@ -1,0 +1,177 @@
+"""Keras shim tests — structural mirror of the reference's test_keras.py
+(246 LoC, 5 tests) + test_tensorflow_keras.py, targeting Keras 3 on the
+torch backend (eager, so the collective path is exercised directly; the
+tf.function and jitted-jax paths have their own tests below/elsewhere).
+"""
+
+import os
+
+os.environ.setdefault("KERAS_BACKEND", "torch")
+
+import numpy as np
+import pytest
+
+keras = pytest.importorskip("keras")
+
+import horovod_tpu as hvd
+import horovod_tpu.keras as hvd_keras
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    hvd.init()
+    yield
+
+
+def _model():
+    keras.utils.set_random_seed(0)
+    return keras.Sequential([
+        keras.layers.Input((8,)),
+        keras.layers.Dense(16, activation="relu"),
+        keras.layers.Dense(2),
+    ])
+
+
+class TestDistributedOptimizer:
+    def test_wraps_and_preserves_class_name(self):
+        opt = hvd_keras.DistributedOptimizer(keras.optimizers.SGD(0.01))
+        assert opt.__class__.__name__ == "SGD"
+        assert isinstance(opt, keras.optimizers.SGD)
+        assert opt._hvd_wrapped
+
+    def test_fit_end_to_end(self):
+        model = _model()
+        opt = hvd_keras.DistributedOptimizer(
+            keras.optimizers.SGD(learning_rate=0.1))
+        model.compile(optimizer=opt, loss="mse", jit_compile=False)
+        x = np.random.rand(16, 8).astype("float32")
+        y = np.random.rand(16, 2).astype("float32")
+        before = [np.array(w) for w in model.get_weights()]
+        model.fit(x, y, batch_size=8, epochs=1, verbose=0)
+        after = model.get_weights()
+        assert any(not np.allclose(b, a) for b, a in zip(before, after))
+
+    def test_gradients_are_averaged(self):
+        # With identical virtual ranks, the averaged gradient equals the
+        # local gradient — so a wrapped and an unwrapped optimizer must
+        # take identical steps.
+        x = np.random.rand(16, 8).astype("float32")
+        y = np.random.rand(16, 2).astype("float32")
+
+        def run(wrap):
+            model = _model()
+            opt = keras.optimizers.SGD(learning_rate=0.1)
+            if wrap:
+                opt = hvd_keras.DistributedOptimizer(opt)
+            model.compile(optimizer=opt, loss="mse", jit_compile=False)
+            model.fit(x, y, batch_size=16, epochs=1, shuffle=False,
+                      verbose=0)
+            return model.get_weights()
+
+        for w_ref, w_hvd in zip(run(False), run(True)):
+            assert np.allclose(w_ref, w_hvd, rtol=1e-4, atol=1e-5)
+
+    def test_compression_fp16(self):
+        model = _model()
+        opt = hvd_keras.DistributedOptimizer(
+            keras.optimizers.SGD(0.1), compression=hvd_keras.Compression.fp16)
+        model.compile(optimizer=opt, loss="mse", jit_compile=False)
+        x = np.random.rand(8, 8).astype("float32")
+        y = np.random.rand(8, 2).astype("float32")
+        model.fit(x, y, batch_size=8, epochs=1, verbose=0)
+
+
+class TestHostCollectives:
+    def test_allreduce_scalar(self):
+        out = hvd_keras.allreduce(3.0, average=False, name="k.ar")
+        assert out == pytest.approx(3.0 * hvd.size())
+
+    def test_allgather(self):
+        out = hvd_keras.allgather(np.array([1.0, 2.0], np.float32),
+                                  name="k.ag")
+        assert out.shape == (2 * hvd.size(),)
+
+    def test_broadcast(self):
+        out = hvd_keras.broadcast(np.arange(4.0, dtype=np.float32),
+                                  root_rank=0, name="k.bc")
+        assert np.allclose(out, np.arange(4.0))
+
+    def test_allreduce_python_list(self):
+        out = hvd_keras.allreduce([1.0, 2.0], average=True, name="k.arl")
+        assert np.allclose(out, [1.0, 2.0])
+
+
+class TestBroadcastVariables:
+    def test_broadcast_variables_roundtrip(self):
+        model = _model()
+        before = [np.array(w) for w in model.get_weights()]
+        hvd_keras.broadcast_variables(model.variables, root_rank=0)
+        for b, a in zip(before, model.get_weights()):
+            assert np.allclose(b, a)
+
+
+class TestCallbacks:
+    def test_broadcast_callback_fit(self):
+        model = _model()
+        model.compile(optimizer=hvd_keras.DistributedOptimizer(
+            keras.optimizers.SGD(0.1)), loss="mse", jit_compile=False)
+        cb = hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0)
+        x = np.random.rand(8, 8).astype("float32")
+        y = np.random.rand(8, 2).astype("float32")
+        model.fit(x, y, batch_size=4, epochs=1, callbacks=[cb], verbose=0)
+        assert cb._model_done and cb._opt_done
+
+    def test_metric_average_callback(self):
+        cb = hvd_keras.callbacks.MetricAverageCallback()
+        logs = {"loss": 2.5, "acc": 0.5}
+        cb._average_metrics_in_place(logs)
+        # identical virtual ranks → average == local value
+        assert logs["loss"] == pytest.approx(2.5, rel=1e-5)
+        assert logs["acc"] == pytest.approx(0.5, rel=1e-5)
+
+    def test_lr_schedule_staircase(self):
+        model = _model()
+        model.compile(optimizer=keras.optimizers.SGD(
+            learning_rate=0.1, momentum=0.9), loss="mse", jit_compile=False)
+        cb = hvd_keras.callbacks.LearningRateScheduleCallback(
+            multiplier=lambda epoch: 10 ** -epoch, start_epoch=0)
+        x = np.random.rand(8, 8).astype("float32")
+        y = np.random.rand(8, 2).astype("float32")
+        hist = model.fit(x, y, batch_size=8, epochs=3, callbacks=[cb],
+                         verbose=0)
+        lrs = hist.history["lr"]
+        assert lrs[0] == pytest.approx(0.1, rel=1e-5)
+        assert lrs[1] == pytest.approx(0.01, rel=1e-5)
+        assert lrs[2] == pytest.approx(0.001, rel=1e-5)
+        # momentum restored after correction batches
+        assert float(model.optimizer.momentum) == pytest.approx(0.9)
+
+    def test_lr_warmup_reaches_initial(self):
+        model = _model()
+        model.compile(optimizer=keras.optimizers.SGD(learning_rate=0.8),
+                      loss="mse", jit_compile=False)
+        cb = hvd_keras.callbacks.LearningRateWarmupCallback(
+            warmup_epochs=2, verbose=0)
+        x = np.random.rand(16, 8).astype("float32")
+        y = np.random.rand(16, 2).astype("float32")
+        hist = model.fit(x, y, batch_size=4, epochs=3, callbacks=[cb],
+                         verbose=0)
+        # warmup starts near initial_lr/size and ends at initial_lr
+        assert hist.history["lr"][0] < 0.8
+        assert hist.history["lr"][-1] == pytest.approx(0.8, rel=1e-3)
+
+
+class TestLoadModel:
+    def test_load_model_rewraps_optimizer(self, tmp_path):
+        model = _model()
+        model.compile(optimizer=hvd_keras.DistributedOptimizer(
+            keras.optimizers.SGD(0.05)), loss="mse", jit_compile=False)
+        x = np.random.rand(8, 8).astype("float32")
+        y = np.random.rand(8, 2).astype("float32")
+        model.fit(x, y, batch_size=8, epochs=1, verbose=0)
+        path = str(tmp_path / "model.keras")
+        model.save(path)
+        loaded = hvd_keras.load_model(path)
+        assert getattr(loaded.optimizer, "_hvd_wrapped", False) or \
+            loaded.optimizer.__class__.__name__ == "SGD"
+        loaded.fit(x, y, batch_size=8, epochs=1, verbose=0)

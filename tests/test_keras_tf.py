@@ -1,0 +1,80 @@
+"""Keras-on-TensorFlow-backend integration, in a subprocess.
+
+The in-process Keras backend is pinned to torch by tests/test_keras.py
+(one backend per process in Keras 3), so the tensorflow-backend path —
+Keras ``model.fit`` tracing the shim's allreduce through ``tf.function``
+via the py_function bridge — runs in a fresh interpreter here. This is
+the analogue of the reference's separate test_tensorflow_keras.py.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+SCRIPT = textwrap.dedent("""
+    import os
+    os.environ["KERAS_BACKEND"] = "tensorflow"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    import keras
+    import horovod_tpu as hvd
+    import horovod_tpu.keras as hvd_keras
+    import horovod_tpu.tensorflow as hvd_tf
+
+    hvd.init()
+    assert hvd.size() == 8, hvd.size()
+
+    keras.utils.set_random_seed(0)
+    model = keras.Sequential([
+        keras.layers.Input((8,)),
+        keras.layers.Dense(16, activation="relu"),
+        keras.layers.Dense(2),
+    ])
+    opt = hvd_keras.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=0.1))
+    model.compile(optimizer=opt, loss="mse")   # default: tf.function traced
+    x = np.random.rand(16, 8).astype("float32")
+    y = np.random.rand(16, 2).astype("float32")
+    before = [np.array(w) for w in model.get_weights()]
+    model.fit(x, y, batch_size=8, epochs=1, verbose=0,
+              callbacks=[
+                  hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0),
+                  hvd_keras.callbacks.MetricAverageCallback(),
+              ])
+    after = model.get_weights()
+    assert any(not np.allclose(b, a) for b, a in zip(before, after))
+
+    # tf-shim DistributedOptimizer on a keras optimizer
+    opt2 = hvd_tf.DistributedOptimizer(keras.optimizers.SGD(0.05))
+    assert opt2._hvd_wrapped
+    import tensorflow as tf
+    v = tf.Variable([1.0, 2.0])
+    with tf.GradientTape() as tape:
+        loss = tf.reduce_sum(v * v)
+    g = tape.gradient(loss, [v])
+    opt2.apply_gradients(zip(g, [v]))
+    assert not np.allclose(v.numpy(), [1.0, 2.0])
+    print("KERAS_TF_OK")
+""")
+
+
+@pytest.mark.slow
+def test_keras_tensorflow_backend_fit():
+    env = dict(os.environ)
+    env.pop("PYTEST_CURRENT_TEST", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", SCRIPT], capture_output=True, text=True,
+        timeout=540, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert "KERAS_TF_OK" in proc.stdout, (
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-4000:]}")
