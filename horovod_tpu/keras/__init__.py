@@ -237,7 +237,7 @@ def broadcast_variables(variables, root_rank: int = 0) -> None:
     from ..utils.wire import movement_payload, movement_restore
     handles = []
     for i, v in enumerate(variables):
-        arr = np.ascontiguousarray(keras.ops.convert_to_numpy(v))
+        arr = np.asarray(keras.ops.convert_to_numpy(v))  # not ascontiguousarray: it promotes 0-dim to (1,)
         wire, from_bits = movement_payload(arr)
         h = _ops.broadcast_async(
             wire, root_rank, name=f"keras.bcast.{i}.{getattr(v, 'path', i)}")

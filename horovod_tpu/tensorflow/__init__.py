@@ -191,7 +191,7 @@ def broadcast_variables(variables, root_rank: int = 0) -> None:
     from ..utils.wire import movement_payload, movement_restore
     handles = []
     for i, v in enumerate(variables):
-        arr = np.ascontiguousarray(v.numpy())
+        arr = np.asarray(v.numpy())  # not ascontiguousarray: it promotes 0-dim to (1,)
         wire, from_bits = movement_payload(arr)
         handles.append((v, arr.dtype, arr.shape, from_bits,
                         _ops.broadcast_async(
