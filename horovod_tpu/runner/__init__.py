@@ -1,0 +1,21 @@
+"""Cluster launcher — the TPU-native equivalent of the reference's L4 layer
+(horovod/spark/ + bare mpirun, docs/running.md).
+
+Pieces:
+  - :mod:`.network`   HMAC-authenticated pickle RPC (spark/util/network.py)
+  - :mod:`.secret`    shared-secret handling (spark/util/secret.py)
+  - :mod:`.host_hash` host grouping (spark/util/host_hash.py)
+  - :mod:`.safe_exec` process management + watchdogs (safe_shell_exec.py,
+                      mpirun_exec_fn.py)
+  - :mod:`.launcher`  rank spawning, local + ssh (mpirun / mpirun_rsh.py)
+  - :mod:`.driver_service` rendezvous + result collection
+                      (driver/driver_service.py)
+  - :mod:`.api`       ``run(fn)`` (horovod.spark.run, spark/__init__.py)
+  - CLI: ``python -m horovod_tpu.runner -np 4 python train.py``
+"""
+
+from .api import run
+from .launcher import launch, parse_hosts
+from .network import find_free_port
+
+__all__ = ["run", "launch", "parse_hosts", "find_free_port"]

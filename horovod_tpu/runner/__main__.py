@@ -1,0 +1,50 @@
+"""CLI launcher: ``python -m horovod_tpu.runner -np 4 python train.py``.
+
+The reference has no dedicated CLI (bare ``mpirun`` per docs/running.md:
+1-45); this plays mpirun's role for the TPU-native stack. Slots follow
+mpirun's ``-H host:slots`` syntax; output is tag-prefixed per rank.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.runner",
+        description="Launch a distributed horovod_tpu job "
+                    "(the mpirun of the TPU-native stack).")
+    parser.add_argument("-np", "--num-proc", type=int, required=True,
+                        help="total number of worker processes")
+    parser.add_argument("-H", "--hosts", default=None,
+                        help="host slots, mpirun syntax: host1:2,host2:2 "
+                             "(default: localhost)")
+    parser.add_argument("--timeout", type=float, default=None,
+                        help="overall job timeout in seconds")
+    parser.add_argument("--no-tag-output", action="store_true",
+                        help="do not prefix worker output with [rank]")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="worker command, e.g. python train.py")
+    args = parser.parse_args(argv)
+
+    if not args.command:
+        parser.error("missing worker command")
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+
+    from .launcher import launch
+
+    job = launch(command, np=args.num_proc, hosts=args.hosts,
+                 tag_output=not args.no_tag_output)
+    try:
+        return job.wait(timeout=args.timeout)
+    except KeyboardInterrupt:
+        job.terminate()
+        return 130
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,198 @@
+"""HMAC-authenticated pickle RPC over TCP — the launcher's control wire.
+
+Parity: horovod/spark/util/network.py (reference :44-142). The reference
+wraps socket streams in an HMAC check before cloudpickle-deserializing
+requests; a ``BasicService`` dispatches request objects to handlers and a
+``BasicClient`` sends them with retries. This is the same design with an
+explicit length-prefixed frame:
+
+    [4-byte big-endian payload length][32-byte HMAC-SHA256(key, payload)][payload]
+
+The digest is verified *before* unpickling — unauthenticated bytes are never
+deserialized (the reference's ``check_digest`` wrapper, network.py:44-79).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+_LEN = struct.Struct(">I")
+_DIGEST_BYTES = hashlib.sha256().digest_size
+_MAX_FRAME = 1 << 30
+
+
+class AuthenticationError(RuntimeError):
+    """A frame failed HMAC verification (wrong or missing secret key)."""
+
+
+class Wire:
+    """Frame codec over a connected socket."""
+
+    def __init__(self, key: bytes):
+        self._key = key
+
+    def write(self, sock: socket.socket, obj: Any) -> None:
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        digest = hmac.new(self._key, payload, hashlib.sha256).digest()
+        sock.sendall(_LEN.pack(len(payload)) + digest + payload)
+
+    def read(self, sock: socket.socket) -> Any:
+        header = self._read_exact(sock, _LEN.size + _DIGEST_BYTES)
+        (n,) = _LEN.unpack(header[:_LEN.size])
+        if n > _MAX_FRAME:
+            raise AuthenticationError(f"oversized frame ({n} bytes)")
+        digest = header[_LEN.size:]
+        payload = self._read_exact(sock, n)
+        expected = hmac.new(self._key, payload, hashlib.sha256).digest()
+        if not hmac.compare_digest(digest, expected):
+            raise AuthenticationError(
+                "HMAC verification failed; refusing to deserialize")
+        return pickle.loads(payload)
+
+    @staticmethod
+    def _read_exact(sock: socket.socket, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("connection closed mid-frame")
+            buf.extend(chunk)
+        return bytes(buf)
+
+
+class PingRequest:
+    pass
+
+
+class PingResponse:
+    pass
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        service: "BasicService" = self.server.service  # type: ignore
+        wire = service._wire
+        sock = self.request
+        sock.settimeout(service.conn_timeout)
+        try:
+            while True:
+                try:
+                    req = wire.read(sock)
+                except (ConnectionError, socket.timeout, OSError):
+                    return
+                except AuthenticationError:
+                    return  # drop unauthenticated peers silently
+                resp = service._dispatch(req, self.client_address)
+                wire.write(sock, resp)
+        except (ConnectionError, BrokenPipeError, OSError):
+            return
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class BasicService:
+    """Threaded TCP service dispatching authenticated request objects.
+
+    Subclasses override :meth:`_handle`. Mirrors the reference's
+    ``network.BasicService`` (spark/util/network.py:81-142).
+    """
+
+    conn_timeout = 3600.0
+
+    def __init__(self, name: str, key: bytes, host: str = "0.0.0.0"):
+        self.name = name
+        self._wire = Wire(key)
+        self._server = _Server((host, 0), _Handler)
+        self._server.service = self  # type: ignore[attr-defined]
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name=f"{name}-rpc",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def addresses(self) -> List[Tuple[str, int]]:
+        """All (ip, port) pairs this service answers on — the reference
+        collects every NIC's address so the driver can find a mutually
+        routable interface (network.py:93-107)."""
+        addrs = [("127.0.0.1", self._port)]
+        try:
+            hostname = socket.gethostname()
+            for info in socket.getaddrinfo(hostname, None,
+                                           socket.AF_INET):
+                ip = info[4][0]
+                if (ip, self._port) not in addrs:
+                    addrs.append((ip, self._port))
+        except OSError:
+            pass
+        return addrs
+
+    def _dispatch(self, req: Any, client_address) -> Any:
+        if isinstance(req, PingRequest):
+            return PingResponse()
+        return self._handle(req, client_address)
+
+    def _handle(self, req: Any, client_address) -> Any:
+        raise NotImplementedError(f"{self.name}: unknown request {req!r}")
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class BasicClient:
+    """Connect-per-call RPC client with retries (network.py:~150+)."""
+
+    def __init__(self, addresses, key: bytes, attempts: int = 3,
+                 timeout: float = 60.0):
+        if isinstance(addresses, tuple) and len(addresses) == 2 \
+                and isinstance(addresses[0], str):
+            addresses = [addresses]
+        self._addresses: List[Tuple[str, int]] = list(addresses)
+        self._wire = Wire(key)
+        self._attempts = attempts
+        self._timeout = timeout
+
+    def request(self, req: Any) -> Any:
+        last: Optional[Exception] = None
+        for _ in range(self._attempts):
+            for host, port in self._addresses:
+                try:
+                    with socket.create_connection(
+                            (host, port), timeout=self._timeout) as sock:
+                        self._wire.write(sock, req)
+                        sock.settimeout(self._timeout)
+                        return self._wire.read(sock)
+                except (OSError, ConnectionError) as e:
+                    last = e
+            time.sleep(0.2)
+        raise ConnectionError(
+            f"could not reach service at {self._addresses}: {last}")
+
+    def ping(self) -> bool:
+        try:
+            return isinstance(self.request(PingRequest()), PingResponse)
+        except ConnectionError:
+            return False
+
+
+def find_free_port(host: str = "") -> int:
+    """Ask the OS for an ephemeral port (used for the JAX coordinator)."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        return s.getsockname()[1]

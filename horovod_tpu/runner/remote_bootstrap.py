@@ -1,0 +1,33 @@
+"""Remote worker bootstrap (``python -m horovod_tpu.runner.remote_bootstrap``).
+
+The rsh/orted hop of the stack (reference: horovod/spark/driver/
+mpirun_rsh.py:24-37 bridging orted launches through remote agents). The
+launcher ssh-es to the host and pipes ONE JSON line on stdin:
+
+    {"env": {...}, "cmd": ["python", "train.py", ...]}
+
+Env (including the HMAC secret) and command travel over ssh's encrypted
+stdin rather than the remote argv, so values with spaces survive and
+secrets never show up in ``ps`` output. The child is exec'd directly —
+no shell interprets any of it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    line = sys.stdin.readline()
+    spec = json.loads(line)
+    env = dict(os.environ)
+    env.update(spec["env"])
+    cmd = spec["cmd"]
+    os.execvpe(cmd[0], cmd, env)
+    return 127  # unreachable
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,224 @@
+"""Launcher/runner tests — RPC wire auth, host parsing, end-to-end
+function-mode launches (the reference tests the Spark runner end-to-end on
+a local cluster the same way, test/test_spark.py:52-70)."""
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from horovod_tpu.runner import network, parse_hosts
+from horovod_tpu.runner.host_hash import host_hash
+from horovod_tpu.runner.launcher import expand_slots
+from horovod_tpu.runner.network import (AuthenticationError, BasicClient,
+                                        BasicService, Wire)
+from horovod_tpu.runner.secret import (decode_key, encode_key,
+                                       make_secret_key)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Wire / auth
+# ---------------------------------------------------------------------------
+
+class TestWire:
+    def _pair(self):
+        a, b = socket.socketpair()
+        return a, b
+
+    def test_roundtrip(self):
+        key = make_secret_key()
+        wire = Wire(key)
+        a, b = self._pair()
+        obj = {"hello": [1, 2, 3], "x": "y"}
+        wire.write(a, obj)
+        assert wire.read(b) == obj
+        a.close(); b.close()
+
+    def test_tampered_payload_rejected(self):
+        key = make_secret_key()
+        wire = Wire(key)
+        a, b = self._pair()
+        wire.write(a, ["payload"])
+        raw = bytearray(b.recv(65536))
+        raw[-1] ^= 0xFF  # flip a bit in the pickle
+        c, d = self._pair()
+        c.sendall(bytes(raw))
+        with pytest.raises(AuthenticationError):
+            wire.read(d)
+        for s in (a, b, c, d):
+            s.close()
+
+    def test_wrong_key_rejected(self):
+        a, b = self._pair()
+        Wire(make_secret_key()).write(a, "secret message")
+        with pytest.raises(AuthenticationError):
+            Wire(make_secret_key()).read(b)
+        a.close(); b.close()
+
+    def test_key_codec(self):
+        key = make_secret_key()
+        assert decode_key(encode_key(key)) == key
+
+
+# ---------------------------------------------------------------------------
+# Service / client
+# ---------------------------------------------------------------------------
+
+class _EchoRequest:
+    def __init__(self, value):
+        self.value = value
+
+
+class _EchoService(BasicService):
+    def _handle(self, req, client_address):
+        return ("echo", req.value)
+
+
+class TestService:
+    def test_request_response(self):
+        key = make_secret_key()
+        svc = _EchoService("echo", key)
+        try:
+            client = BasicClient([("127.0.0.1", svc.port)], key)
+            assert client.ping()
+            assert client.request(_EchoRequest(42)) == ("echo", 42)
+        finally:
+            svc.shutdown()
+
+    def test_wrong_key_client_rejected(self):
+        key = make_secret_key()
+        svc = _EchoService("echo", key)
+        try:
+            bad = BasicClient([("127.0.0.1", svc.port)],
+                              make_secret_key(), attempts=1, timeout=2.0)
+            with pytest.raises(ConnectionError):
+                bad.request(_EchoRequest(1))
+        finally:
+            svc.shutdown()
+
+    def test_concurrent_clients(self):
+        key = make_secret_key()
+        svc = _EchoService("echo", key)
+        results = []
+        try:
+            def call(i):
+                c = BasicClient([("127.0.0.1", svc.port)], key)
+                results.append(c.request(_EchoRequest(i)))
+            threads = [threading.Thread(target=call, args=(i,))
+                       for i in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert sorted(v for _, v in results) == list(range(8))
+        finally:
+            svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Host parsing / hashing
+# ---------------------------------------------------------------------------
+
+class TestHosts:
+    def test_parse_hosts(self):
+        assert parse_hosts("a:2,b:3") == [("a", 2), ("b", 3)]
+        assert parse_hosts("localhost") == [("localhost", 1)]
+        assert parse_hosts("a:1, b:2") == [("a", 1), ("b", 2)]
+
+    def test_expand_slots_contiguous(self):
+        ranks = expand_slots([("a", 2), ("b", 2)], 4)
+        assert ranks == ["a", "a", "b", "b"]
+
+    def test_expand_slots_insufficient(self):
+        with pytest.raises(ValueError):
+            expand_slots([("a", 1)], 2)
+
+    def test_host_hash_stable(self):
+        assert host_hash() == host_hash()
+        assert host_hash("x") != host_hash("y")
+
+
+# ---------------------------------------------------------------------------
+# End-to-end function mode (horovod.spark.run parity)
+# ---------------------------------------------------------------------------
+
+_NO_JAX_ENV = {
+    # keep workers light: they only read env vars
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+}
+
+
+@pytest.mark.slow
+class TestRun:
+    def test_run_collects_results_in_rank_order(self):
+        from horovod_tpu.runner import run
+
+        # Defined locally so cloudpickle ships it by value (as with a user
+        # script's __main__ functions).
+        def fn():
+            import os
+            return (int(os.environ["HOROVOD_TPU_PROCESS_ID"]),
+                    int(os.environ["HOROVOD_TPU_NUM_PROCESSES"]))
+
+        results = run(fn, np=2, extra_env=dict(_NO_JAX_ENV),
+                      start_timeout=300, run_timeout=300)
+        assert results == [(0, 2), (1, 2)]
+
+    def test_run_propagates_worker_error(self):
+        from horovod_tpu.runner import run
+
+        def fn():
+            import os
+            if os.environ["HOROVOD_TPU_PROCESS_ID"] == "1":
+                raise RuntimeError("boom on rank 1")
+            return "ok"
+
+        with pytest.raises(RuntimeError, match="rank 1"):
+            run(fn, np=2, extra_env=dict(_NO_JAX_ENV),
+                start_timeout=300, run_timeout=300)
+
+    def test_run_initializes_jax_world(self):
+        from horovod_tpu.runner import run
+
+        def fn():
+            import horovod_tpu as hvd
+            hvd.init()
+            return (hvd.rank(), hvd.size(), hvd.process_count())
+
+        results = run(fn, np=2, extra_env=dict(_NO_JAX_ENV),
+                      start_timeout=600, run_timeout=600)
+        # 1 CPU device per process ⇒ rank == process id, size == 2.
+        assert results == [(0, 2, 2), (1, 2, 2)]
+
+
+@pytest.mark.slow
+class TestCLI:
+    def test_cli_tags_output_per_rank(self):
+        env = dict(os.environ)
+        env.update(_NO_JAX_ENV)
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+             sys.executable, "-c",
+             "import os; print('rank', os.environ['HOROVOD_TPU_PROCESS_ID'])"],
+            capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+        assert proc.returncode == 0, proc.stderr
+        assert "[0]<stdout>:rank 0" in proc.stdout
+        assert "[1]<stdout>:rank 1" in proc.stdout
+
+    def test_cli_failfast_nonzero_exit(self):
+        env = dict(os.environ)
+        env.update(_NO_JAX_ENV)
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+             sys.executable, "-c",
+             "import os, sys, time\n"
+             "sys.exit(3) if os.environ['HOROVOD_TPU_PROCESS_ID'] == '1' "
+             "else time.sleep(60)"],
+            capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+        assert proc.returncode == 3
