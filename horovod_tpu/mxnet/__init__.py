@@ -1,0 +1,126 @@
+"""horovod_tpu.mxnet — the MXNet framework shim.
+
+Parity target: horovod/mxnet/__init__.py (105 LoC): a
+``DistributedOptimizer`` that allreduces gradients inside ``update()`` /
+``update_multi_precision()`` before delegating to the wrapped optimizer
+(:36-59), and ``broadcast_parameters`` for dicts and gluon
+``ParameterDict``s with the deferred-initialization skip (:71-104).
+
+Works against real ``mxnet`` when importable; otherwise against the
+NDArray protocol in :mod:`horovod_tpu.mxnet.ndarray` (this image ships
+without MXNet). The wrapped optimizer only needs the
+``mx.optimizer.Optimizer`` method surface the reference touches.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from .mpi_ops import (init, shutdown, is_initialized, rank, local_rank,
+                      size, local_size, mpi_threads_supported,
+                      allreduce, allreduce_, allreduce_multi_, allgather,
+                      broadcast, broadcast_)
+from . import ndarray as nd
+from .ndarray import NDArray, DeferredInitializationError
+
+try:  # pragma: no cover - mxnet is not in the image
+    import mxnet as _mx
+    _OptimizerBase = _mx.optimizer.Optimizer
+except ImportError:
+    _mx = None
+    _OptimizerBase = object
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "local_rank", "size",
+    "local_size", "mpi_threads_supported",
+    "allreduce", "allreduce_", "allreduce_multi_", "allgather",
+    "broadcast", "broadcast_",
+    "DistributedOptimizer", "broadcast_parameters", "nd", "NDArray",
+]
+
+
+class DistributedOptimizer(_OptimizerBase):
+    """Wraps an MXNet-style optimizer: every ``update`` first averages the
+    gradient(s) over all processes (horovod/mxnet/__init__.py:36-59).
+
+    The index-list form enqueues all allreduces before blocking so the
+    engine can fuse them into a single XLA program.
+    """
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def create_state(self, index, weight):
+        return self._optimizer.create_state(index, weight)
+
+    def create_state_multi_precision(self, index, weight):
+        return self._optimizer.create_state_multi_precision(index, weight)
+
+    def _do_allreduce(self, index, grad):
+        if isinstance(index, (tuple, list)):
+            import horovod_tpu.ops as _ops
+            handles = [
+                _ops.allreduce_async(g.asnumpy(), average=True, name=str(i))
+                for i, g in zip(index, grad)]
+            for g, h in zip(grad, handles):
+                g[:] = _np.asarray(h.wait()).reshape(g.shape)
+        else:
+            allreduce_(grad, average=True, name=str(index))
+
+    def update(self, index, weight, grad, state):
+        self._do_allreduce(index, grad)
+        self._optimizer.update(index, weight, grad, state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self._do_allreduce(index, grad)
+        self._optimizer.update_multi_precision(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def set_lr_mult(self, args_lr_mult):
+        self._optimizer.set_lr_mult(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self._optimizer.set_wd_mult(args_wd_mult)
+
+
+def _is_parameter_dict(params) -> bool:
+    """True for gluon ``ParameterDict``-likes: items() yields Parameters
+    exposing ``.data()`` (horovod/mxnet/__init__.py:87-93)."""
+    if _mx is not None and isinstance(
+            params, _mx.gluon.parameter.ParameterDict):  # pragma: no cover
+        return True
+    try:
+        items = list(params.items())
+    except AttributeError:
+        return False
+    return bool(items) and all(hasattr(p, "data") and callable(p.data)
+                               for _, p in items)
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """Broadcast parameters from ``root_rank`` in place. Accepts a dict of
+    NDArrays (``Module.get_params()``) or a ParameterDict
+    (``Block.collect_params()``); deferred-init parameters are skipped
+    (horovod/mxnet/__init__.py:71-104)."""
+    if isinstance(params, dict):
+        tensors = [p for _, p in sorted(params.items())]
+    elif _is_parameter_dict(params):
+        tensors = []
+        for _, p in sorted(params.items()):
+            try:
+                tensors.append(p.data())
+            except Exception as e:  # DeferredInitializationError duck-match
+                if type(e).__name__ != "DeferredInitializationError":
+                    raise
+    else:
+        raise ValueError("invalid params of type: %s" % type(params))
+
+    for i, tensor in enumerate(tensors):
+        broadcast_(tensor, root_rank, str(i))
+    for tensor in tensors:
+        tensor.wait_to_read()

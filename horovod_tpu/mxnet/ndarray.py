@@ -1,0 +1,127 @@
+"""Minimal NDArray compatibility layer for the MXNet shim.
+
+The reference binding operates on ``mx.nd.NDArray`` handles pushed through
+the MXNet engine (horovod/mxnet/mpi_ops.py:45-214, mxnet/mpi_ops.cc:204-236).
+This image ships without MXNet, so the shim is written against the small
+*NDArray protocol* actually used — ``asnumpy()``, ``shape``, ``dtype``,
+``context``, ``wait_to_read()`` and slice assignment — and this module
+provides a numpy-backed implementation of that protocol used when MXNet is
+not importable (and by the test suite). With MXNet installed the same shim
+code operates on real ``mx.nd.NDArray`` objects unchanged.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:  # pragma: no cover - mxnet is not in the image
+    import mxnet as _mx
+except ImportError:
+    _mx = None
+
+
+class DeferredInitializationError(RuntimeError):
+    """Raised by ``Parameter.data()`` before shape inference — mirrors
+    ``mx.gluon.parameter.DeferredInitializationError``."""
+
+
+class NDArray:
+    """Numpy-backed stand-in for ``mx.nd.NDArray`` (dense, CPU).
+
+    Implements exactly the surface the Horovod MXNet API touches; writes
+    through ``arr[:] = value`` mutate the underlying buffer, matching
+    MXNet's in-place collective semantics.
+    """
+
+    __slots__ = ("_data", "context")
+
+    def __init__(self, data, dtype=None, ctx=None):
+        self._data = np.array(data, dtype=dtype)
+        self.context = ctx if ctx is not None else "cpu(0)"
+
+    # -- protocol ----------------------------------------------------------
+    @property
+    def shape(self):
+        return self._data.shape
+
+    @property
+    def dtype(self):
+        return self._data.dtype.type
+
+    @property
+    def size(self):
+        return self._data.size
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    def asnumpy(self) -> np.ndarray:
+        return self._data.copy()
+
+    def wait_to_read(self):  # engine sync point; shim ops are synchronous
+        return None
+
+    def astype(self, dtype):
+        return NDArray(self._data.astype(dtype), ctx=self.context)
+
+    def copy(self):
+        return NDArray(self._data.copy(), ctx=self.context)
+
+    def reshape(self, *shape):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return NDArray(self._data.reshape(shape), ctx=self.context)
+
+    # -- mutation ----------------------------------------------------------
+    def __setitem__(self, key, value):
+        if isinstance(value, NDArray):
+            value = value._data
+        self._data[key] = value
+
+    def __getitem__(self, key):
+        out = self._data[key]
+        if np.isscalar(out) or out.ndim == 0:
+            return out
+        return NDArray(out, ctx=self.context)
+
+    # -- arithmetic (what examples/tests use) ------------------------------
+    def _coerce(self, other):
+        return other._data if isinstance(other, NDArray) else other
+
+    def __add__(self, other):
+        return NDArray(self._data + self._coerce(other), ctx=self.context)
+
+    def __sub__(self, other):
+        return NDArray(self._data - self._coerce(other), ctx=self.context)
+
+    def __mul__(self, other):
+        return NDArray(self._data * self._coerce(other), ctx=self.context)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return NDArray(self._data / self._coerce(other), ctx=self.context)
+
+    def __repr__(self):
+        return f"NDArray({self._data!r})"
+
+
+def zeros(shape, ctx=None, dtype=None):
+    """``mx.nd.zeros`` equivalent for output allocation
+    (horovod/mxnet/mpi_ops.py:69-70)."""
+    if _mx is not None:  # pragma: no cover
+        return _mx.nd.zeros(shape=shape, ctx=ctx, dtype=dtype or np.float32)
+    return NDArray(np.zeros(shape, dtype=dtype or np.float32), ctx=ctx)
+
+
+def array(data, ctx=None, dtype=None):
+    if _mx is not None:  # pragma: no cover
+        return _mx.nd.array(data, ctx=ctx, dtype=dtype)
+    return NDArray(np.array(data, dtype=dtype), ctx=ctx)
+
+
+def is_ndarray(x) -> bool:
+    if _mx is not None and isinstance(x, _mx.nd.NDArray):  # pragma: no cover
+        return True
+    return isinstance(x, NDArray)

@@ -1,0 +1,214 @@
+"""MXNet shim tests — structural mirror of the reference's test_mxnet.py
+(449 LoC, 12 tests): dtype x dimension sweeps for the three collectives,
+in-place variants, DistributedOptimizer update, broadcast_parameters for
+dict and ParameterDict (with deferred-init skip).
+
+Virtual-rank semantics (tests/test_ops.py): every device is a rank and
+eager inputs are replicated, so allreduce(x, average=False) == size * x.
+"""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+import horovod_tpu.mxnet as hvd_mx
+from horovod_tpu.mxnet import nd
+from horovod_tpu.mxnet.ndarray import DeferredInitializationError
+
+SWEEP_DTYPES = [np.uint8, np.int8, np.int32, np.float16, np.float32]
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    hvd.init()
+    yield
+
+
+def _rand(shape, dtype):
+    if np.issubdtype(dtype, np.integer):
+        return nd.array(np.random.randint(0, 10, shape), dtype=dtype)
+    return nd.array(np.random.rand(*shape), dtype=dtype)
+
+
+class TestMXAllreduce:
+    @pytest.mark.parametrize("dtype", SWEEP_DTYPES)
+    @pytest.mark.parametrize("dim", [1, 2, 3])
+    def test_allreduce_sum(self, dtype, dim):
+        t = _rand([17] * dim, dtype)
+        out = hvd_mx.allreduce(t, average=False)
+        expected = t.asnumpy().astype(np.float64) * hvd.size()
+        assert out.dtype == dtype
+        tol = 1e-2 if dtype == np.float16 else 1e-5
+        # Integer dtypes wrap identically on every rank; compare modulo.
+        got = out.asnumpy().astype(np.float64)
+        if np.issubdtype(dtype, np.integer):
+            expected = expected.astype(dtype).astype(np.float64)
+        assert np.allclose(got, expected, rtol=tol, atol=tol)
+
+    def test_allreduce_average(self):
+        t = nd.array(np.random.rand(5, 5), dtype=np.float32)
+        out = hvd_mx.allreduce(t, average=True)
+        assert np.allclose(out.asnumpy(), t.asnumpy(), rtol=1e-5, atol=1e-6)
+        # input unmodified
+        assert out is not t
+
+    def test_allreduce_inplace(self):
+        t = nd.array(np.ones((4, 4)), dtype=np.float32)
+        ret = hvd_mx.allreduce_(t, average=False)
+        assert ret is t
+        assert np.allclose(t.asnumpy(), hvd.size() * np.ones((4, 4)))
+
+    def test_allreduce_multi_fused(self):
+        tensors = [nd.array(np.full((8,), i + 1.0), dtype=np.float32)
+                   for i in range(5)]
+        hvd_mx.allreduce_multi_(tensors, average=False, name_prefix="mx.mk")
+        for i, t in enumerate(tensors):
+            assert np.allclose(t.asnumpy(), hvd.size() * (i + 1.0))
+
+
+class TestMXAllgather:
+    @pytest.mark.parametrize("dtype", SWEEP_DTYPES)
+    @pytest.mark.parametrize("dim", [1, 2, 3])
+    def test_allgather(self, dtype, dim):
+        t = _rand([17] * dim, dtype)
+        out = hvd_mx.allgather(t)
+        assert out.shape == (17 * hvd.size(),) + tuple([17] * (dim - 1))
+        got = out.asnumpy()
+        ref = t.asnumpy()
+        for r in range(hvd.size()):
+            assert np.array_equal(got[r * 17:(r + 1) * 17], ref)
+
+    def test_allgather_64bit_exact(self):
+        vals = np.array([[2 ** 40 + 3, -7], [1, 2 ** 52 + 1]], dtype=np.int64)
+        out = hvd_mx.allgather(nd.array(vals, dtype=np.int64))
+        assert out.dtype == np.int64
+        assert np.array_equal(out.asnumpy()[:2], vals)
+
+
+class TestMXBroadcast:
+    @pytest.mark.parametrize("dtype", SWEEP_DTYPES)
+    @pytest.mark.parametrize("dim", [1, 2, 3])
+    def test_broadcast(self, dtype, dim):
+        t = _rand([17] * dim, dtype)
+        out = hvd_mx.broadcast(t, root_rank=0)
+        assert out.dtype == dtype
+        assert np.array_equal(out.asnumpy(), t.asnumpy())
+        assert out is not t
+
+    def test_broadcast_inplace(self):
+        t = nd.array(np.arange(12.0).reshape(3, 4), dtype=np.float32)
+        ref = t.asnumpy()
+        ret = hvd_mx.broadcast_(t, root_rank=0)
+        assert ret is t
+        assert np.array_equal(t.asnumpy(), ref)
+
+    def test_broadcast_float64_exact(self):
+        vals = np.array([1e300, -2.5e-308, 3.14], dtype=np.float64)
+        t = nd.array(vals, dtype=np.float64)
+        out = hvd_mx.broadcast(t, root_rank=0)
+        assert out.dtype == np.float64
+        assert np.array_equal(out.asnumpy(), vals)
+
+
+class _SGD:
+    """MXNet-style optimizer stub: update(index, weight, grad, state)
+    applies weight -= lr * grad (mx.optimizer.Optimizer surface)."""
+
+    def __init__(self, learning_rate=0.1):
+        self.learning_rate = learning_rate
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        if isinstance(index, (tuple, list)):
+            for w, g in zip(weight, grad):
+                w[:] = w.asnumpy() - self.learning_rate * g.asnumpy()
+        else:
+            weight[:] = (weight.asnumpy()
+                         - self.learning_rate * grad.asnumpy())
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self.learning_rate = lr
+
+
+class TestMXDistributedOptimizer:
+    def test_update_averages_then_delegates(self):
+        opt = hvd_mx.DistributedOptimizer(_SGD(learning_rate=0.5))
+        w = nd.array(np.ones(4), dtype=np.float32)
+        g = nd.array(np.full(4, 2.0), dtype=np.float32)
+        opt.update(0, w, g, opt.create_state(0, w))
+        # averaged grad == local grad under replication; w -= 0.5*2
+        assert np.allclose(w.asnumpy(), np.zeros(4))
+        assert np.allclose(g.asnumpy(), np.full(4, 2.0))
+
+    def test_update_index_list(self):
+        opt = hvd_mx.DistributedOptimizer(_SGD(learning_rate=1.0))
+        ws = [nd.array(np.ones(3), dtype=np.float32) for _ in range(3)]
+        gs = [nd.array(np.full(3, float(i)), dtype=np.float32)
+              for i in range(3)]
+        opt.update([0, 1, 2], ws, gs, [None] * 3)
+        for i, w in enumerate(ws):
+            assert np.allclose(w.asnumpy(), 1.0 - float(i))
+
+    def test_getattr_delegates(self):
+        opt = hvd_mx.DistributedOptimizer(_SGD(learning_rate=0.25))
+        assert opt.learning_rate == 0.25
+        opt.set_learning_rate(0.125)
+        assert opt._optimizer.learning_rate == 0.125
+
+
+class _Param:
+    """gluon Parameter stub: data() returns the NDArray or raises
+    DeferredInitializationError before init."""
+
+    def __init__(self, arr=None):
+        self._arr = arr
+
+    def data(self):
+        if self._arr is None:
+            raise DeferredInitializationError("not initialized")
+        return self._arr
+
+
+class _ParamDict:
+    """gluon ParameterDict stub — NOT a dict subclass (gluon's isn't):
+    exposes items() yielding (name, Parameter)."""
+
+    def __init__(self, params):
+        self._params = params
+
+    def items(self):
+        return self._params.items()
+
+    def __getitem__(self, k):
+        return self._params[k]
+
+
+class TestMXBroadcastParameters:
+    def test_dict(self):
+        params = {"b": nd.array(np.full(4, 2.0), dtype=np.float32),
+                  "a": nd.array(np.arange(3.0), dtype=np.float32)}
+        before = {k: v.asnumpy() for k, v in params.items()}
+        hvd_mx.broadcast_parameters(params, root_rank=0)
+        for k in params:
+            assert np.array_equal(params[k].asnumpy(), before[k])
+
+    def test_parameter_dict_with_deferred_init(self):
+        pd = _ParamDict({
+            "w": _Param(nd.array(np.ones(5), dtype=np.float32)),
+            "deferred": _Param(None),
+            "b": _Param(nd.array(np.zeros(2), dtype=np.float32)),
+        })
+        hvd_mx.broadcast_parameters(pd, root_rank=0)  # must not raise
+        assert np.array_equal(pd["w"].data().asnumpy(), np.ones(5))
+
+    def test_invalid_type_raises(self):
+        with pytest.raises(ValueError, match="invalid params"):
+            hvd_mx.broadcast_parameters([1, 2, 3])
