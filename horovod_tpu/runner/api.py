@@ -9,6 +9,7 @@ them with the JAX distributed coordinator.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import sys
 from typing import Any, Callable, Dict, List, Optional
@@ -72,7 +73,12 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
                 "Timed out after {timeout} s waiting for results.")
             results = driver.wait_for_results(total,
                                               failfast=job.failfast_check)
-            job.wait(timeout=60)
+            # Results are already in hand: a worker lingering in teardown
+            # (profiler flush, TPU runtime exit) past the grace period is
+            # not a reason to discard a successful job — wait() already
+            # terminates stragglers before raising.
+            with contextlib.suppress(TimeoutError):
+                job.wait(timeout=60)
             return results
         finally:
             job.terminate()

@@ -24,7 +24,7 @@ import sys
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from .network import find_free_port
+from .network import find_free_port, routable_addresses
 from .safe_exec import ManagedProcess
 
 _LOCAL_NAMES = {"localhost", "127.0.0.1", "::1"}
@@ -68,17 +68,35 @@ def is_local_host(host: str) -> bool:
         return False
 
 
-def _ssh_spawn_spec(host: str, env: Dict[str, str], args: List[str]
+def routable_local_address() -> str:
+    """Best-effort address OTHER hosts can reach this machine on (the
+    reference eliminates non-routable NAT/loopback interfaces the same
+    way, spark/__init__.py:134-159). Delegates to the shared probe in
+    :mod:`.network`; first candidate wins."""
+    candidates = routable_addresses()
+    if candidates:
+        return candidates[0]
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+def _ssh_spawn_spec(host: str, env: Dict[str, str], args: List[str],
+                    extra_keys: Sequence[str] = ()
                     ) -> Tuple[List[str], bytes]:
     """Remote spawn via ssh — the rsh-agent role (mpirun_rsh.py:24-37).
 
     Returns (ssh argv, stdin payload). Env and command are shipped as one
     JSON line over ssh's stdin to :mod:`.remote_bootstrap`: no shell
-    quoting pitfalls, and the HMAC secret stays off the remote argv. Only
-    HOROVOD_TPU_*/JAX/XLA/TPU env is forwarded across the hop."""
+    quoting pitfalls, and the HMAC secret stays off the remote argv.
+    HOROVOD_TPU_*/JAX/XLA/TPU env plus every caller-supplied ``extra_env``
+    key is forwarded across the hop, so the ``run(fn, extra_env=...)``
+    contract holds on remote workers too."""
     import json
     fwd = {k: v for k, v in env.items()
-           if k.startswith(("HOROVOD_TPU_", "JAX_", "XLA_", "TPU_"))}
+           if k.startswith(("HOROVOD_TPU_", "JAX_", "XLA_", "TPU_"))
+           or k in extra_keys}
     payload = json.dumps({"env": fwd, "cmd": args}).encode() + b"\n"
     argv = ["ssh", "-o", "StrictHostKeyChecking=no", host,
             "python3", "-m", "horovod_tpu.runner.remote_bootstrap"]
@@ -137,27 +155,48 @@ class LaunchedJob:
 def launch(command: List[str], np: int, hosts: Optional[str] = None,
            extra_env: Optional[Dict[str, str]] = None,
            stdout=None, stderr=None, tag_output: bool = True,
-           control_port: Optional[int] = None,
            coordinator_port: Optional[int] = None) -> LaunchedJob:
     """Spawn ``np`` copies of ``command`` with the distributed env wired up.
 
     Env contract consumed by :func:`horovod_tpu.init`
     (horovod_tpu/topology.py:136-176):
-      HOROVOD_TPU_COORDINATOR     host:port of the JAX coordinator (rank 0)
-      HOROVOD_TPU_NUM_PROCESSES   world size
-      HOROVOD_TPU_PROCESS_ID      this worker's process id
-      HOROVOD_TPU_CONTROL         host:port of the TCP collective
-                                  coordinator (multi-process eager ops)
+      HOROVOD_TPU_COORDINATOR       host:port of the JAX coordinator (rank 0)
+      HOROVOD_TPU_NUM_PROCESSES     world size
+      HOROVOD_TPU_PROCESS_ID        this worker's process id
+    Informational, for user scripts (the OMPI_COMM_WORLD_LOCAL_RANK
+    equivalent, test/common.py:25-57):
+      HOROVOD_TPU_LOCAL_PROCESS_ID  rank within its host
     """
     host_slots = parse_hosts(hosts) if hosts else [("localhost", np)]
     rank_hosts = expand_slots(host_slots, np)
+    any_remote = any(not is_local_host(h) for h in rank_hosts)
 
+    # The coordinator (JAX distributed service) binds on rank 0's host.
+    # All-local jobs use loopback; once any worker is remote, loopback is
+    # unreachable from it, so advertise a routable address of rank 0's
+    # machine instead (the launcher's own when rank 0 is local).
     first_host = rank_hosts[0]
-    coord_host = "127.0.0.1" if is_local_host(first_host) else first_host
-    coord_port = (coordinator_port if coordinator_port is not None
-                  else find_free_port())
-    ctrl_port = control_port if control_port is not None else find_free_port()
+    if not any_remote:
+        coord_host = "127.0.0.1"
+    elif is_local_host(first_host):
+        coord_host = routable_local_address()
+    else:
+        coord_host = first_host
+    if coordinator_port is not None:
+        coord_port = coordinator_port
+    elif is_local_host(first_host):
+        # Probing only tells us the port is free HERE — valid exactly when
+        # the coordinator binds here.
+        coord_port = find_free_port()
+    else:
+        # Rank 0 binds on a remote machine we cannot probe; an entropy-
+        # backed pick from the high range keeps collisions between
+        # concurrent launches rare (not impossible — pass
+        # coordinator_port to pin it).
+        import random
+        coord_port = random.SystemRandom().randrange(20000, 60000)
 
+    extra_keys = tuple(extra_env.keys()) if extra_env else ()
     workers: List[ManagedProcess] = []
     local_counts: Dict[str, int] = {}
     for rank, host in enumerate(rank_hosts):
@@ -167,7 +206,6 @@ def launch(command: List[str], np: int, hosts: Optional[str] = None,
         env["HOROVOD_TPU_COORDINATOR"] = f"{coord_host}:{coord_port}"
         env["HOROVOD_TPU_NUM_PROCESSES"] = str(np)
         env["HOROVOD_TPU_PROCESS_ID"] = str(rank)
-        env["HOROVOD_TPU_CONTROL"] = f"{coord_host}:{ctrl_port}"
         local_rank = local_counts.get(host, 0)
         local_counts[host] = local_rank + 1
         env["HOROVOD_TPU_LOCAL_PROCESS_ID"] = str(local_rank)
@@ -177,7 +215,8 @@ def launch(command: List[str], np: int, hosts: Optional[str] = None,
             workers.append(ManagedProcess(list(command), env, prefix=prefix,
                                           stdout=stdout, stderr=stderr))
         else:
-            args, stdin_data = _ssh_spawn_spec(host, env, list(command))
+            args, stdin_data = _ssh_spawn_spec(host, env, list(command),
+                                               extra_keys)
             workers.append(ManagedProcess(args, env, prefix=prefix,
                                           stdout=stdout, stderr=stderr,
                                           stdin_data=stdin_data))

@@ -101,6 +101,40 @@ class _Server(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
 
+def routable_addresses(
+        probes: Tuple[str, ...] = ("8.8.8.8", "10.255.255.255"),
+) -> List[str]:
+    """Candidate non-loopback addresses other hosts may reach us on.
+
+    Combines hostname resolution with the UDP-connect trick (no packet is
+    sent; the kernel's route selection picks the outbound interface per
+    probe target). Multiple probe targets matter: on a host with a VPN or
+    overlay route covering 10.0.0.0/8, the 10.x probe resolves to the
+    tunnel IP while 8.8.8.8 resolves to the LAN IP — every candidate is
+    returned so peers can pick the one they can actually dial (the
+    reference probes all NICs for the same reason, network.py:93-107)."""
+    out: List[str] = []
+
+    def _add(ip: str) -> None:
+        if ip and not ip.startswith("127.") and ip not in out:
+            out.append(ip)
+
+    try:
+        for info in socket.getaddrinfo(socket.gethostname(), None,
+                                       socket.AF_INET):
+            _add(info[4][0])
+    except OSError:
+        pass
+    for probe in probes:
+        try:
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                s.connect((probe, 1))
+                _add(s.getsockname()[0])
+        except OSError:
+            continue
+    return out
+
+
 class BasicService:
     """Threaded TCP service dispatching authenticated request objects.
 
@@ -128,17 +162,16 @@ class BasicService:
     def addresses(self) -> List[Tuple[str, int]]:
         """All (ip, port) pairs this service answers on — the reference
         collects every NIC's address so the driver can find a mutually
-        routable interface (network.py:93-107)."""
+        routable interface (network.py:93-107).
+
+        ``getaddrinfo(gethostname())`` alone is not enough: Debian-style
+        /etc/hosts maps the hostname to 127.0.1.1, leaving only loopback
+        candidates. The UDP-connect trick recovers the outbound interface's
+        address without sending a packet (kernel route selection only)."""
         addrs = [("127.0.0.1", self._port)]
-        try:
-            hostname = socket.gethostname()
-            for info in socket.getaddrinfo(hostname, None,
-                                           socket.AF_INET):
-                ip = info[4][0]
-                if (ip, self._port) not in addrs:
-                    addrs.append((ip, self._port))
-        except OSError:
-            pass
+        for ip in routable_addresses():
+            if (ip, self._port) not in addrs:
+                addrs.append((ip, self._port))
         return addrs
 
     def _dispatch(self, req: Any, client_address) -> Any:
