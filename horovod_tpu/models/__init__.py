@@ -6,5 +6,9 @@ equivalents plus the flagship Transformer used for the parallelism layers.
 """
 
 from .resnet import ResNet, ResNet50, ResNet101, ResNet152
+from .vgg import VGG, VGG16, VGG19
+from .inception import InceptionV3
+from .mnist import MnistConvNet
 
-__all__ = ["ResNet", "ResNet50", "ResNet101", "ResNet152"]
+__all__ = ["ResNet", "ResNet50", "ResNet101", "ResNet152",
+           "VGG", "VGG16", "VGG19", "InceptionV3", "MnistConvNet"]

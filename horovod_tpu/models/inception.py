@@ -1,0 +1,173 @@
+"""Inception V3 in Flax — benchmark workload.
+
+Inception V3 is the reference's best-scaling benchmark (90% at 512 GPUs,
+docs/benchmarks.md:5-6): compute-heavy with relatively few parameters, so
+allreduce traffic is small relative to FLOPs. The TPU equivalent keeps the
+same property — a good MXU-utilization benchmark.
+
+Faithful to Szegedy et al. 2015 (the torchvision/slim graph): stem,
+3x InceptionA, InceptionB, 4x InceptionC, InceptionD, 2x InceptionE,
+global pool + fc. Aux classifier omitted (benchmarks run without it).
+
+TPU-first choices: bf16 activations / fp32 params + fp32 BN, NHWC.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    filters: int
+    kernel: tuple
+    strides: tuple = (1, 1)
+    padding: Any = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.filters, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=jnp.float32)(x)
+        return nn.relu(x)
+
+
+def _avgpool_same(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(64, (1, 1))(x, train)
+        b2 = cbn(48, (1, 1))(x, train)
+        b2 = cbn(64, (5, 5))(b2, train)
+        b3 = cbn(64, (1, 1))(x, train)
+        b3 = cbn(96, (3, 3))(b3, train)
+        b3 = cbn(96, (3, 3))(b3, train)
+        b4 = cbn(self.pool_features, (1, 1))(_avgpool_same(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """Grid reduction 35x35 -> 17x17."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(384, (3, 3), (2, 2), "VALID")(x, train)
+        b2 = cbn(64, (1, 1))(x, train)
+        b2 = cbn(96, (3, 3))(b2, train)
+        b2 = cbn(96, (3, 3), (2, 2), "VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """Factorized 7x7 branches."""
+
+    channels_7x7: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        c7 = self.channels_7x7
+        b1 = cbn(192, (1, 1))(x, train)
+        b2 = cbn(c7, (1, 1))(x, train)
+        b2 = cbn(c7, (1, 7))(b2, train)
+        b2 = cbn(192, (7, 1))(b2, train)
+        b3 = cbn(c7, (1, 1))(x, train)
+        b3 = cbn(c7, (7, 1))(b3, train)
+        b3 = cbn(c7, (1, 7))(b3, train)
+        b3 = cbn(c7, (7, 1))(b3, train)
+        b3 = cbn(192, (1, 7))(b3, train)
+        b4 = cbn(192, (1, 1))(_avgpool_same(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """Grid reduction 17x17 -> 8x8."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(192, (1, 1))(x, train)
+        b1 = cbn(320, (3, 3), (2, 2), "VALID")(b1, train)
+        b2 = cbn(192, (1, 1))(x, train)
+        b2 = cbn(192, (1, 7))(b2, train)
+        b2 = cbn(192, (7, 1))(b2, train)
+        b2 = cbn(192, (3, 3), (2, 2), "VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """Expanded-filter-bank output blocks."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(320, (1, 1))(x, train)
+        b2 = cbn(384, (1, 1))(x, train)
+        b2 = jnp.concatenate([cbn(384, (1, 3))(b2, train),
+                              cbn(384, (3, 1))(b2, train)], axis=-1)
+        b3 = cbn(448, (1, 1))(x, train)
+        b3 = cbn(384, (3, 3))(b3, train)
+        b3 = jnp.concatenate([cbn(384, (1, 3))(b3, train),
+                              cbn(384, (3, 1))(b3, train)], axis=-1)
+        b4 = cbn(192, (1, 1))(_avgpool_same(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    """Inception V3 for 299x299 inputs (works on any >= 75x75)."""
+
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        # Stem
+        x = cbn(32, (3, 3), (2, 2), "VALID")(x, train)
+        x = cbn(32, (3, 3), padding="VALID")(x, train)
+        x = cbn(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = cbn(80, (1, 1), padding="VALID")(x, train)
+        x = cbn(192, (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        # Inception stacks
+        x = InceptionA(32, dtype=self.dtype)(x, train)
+        x = InceptionA(64, dtype=self.dtype)(x, train)
+        x = InceptionA(64, dtype=self.dtype)(x, train)
+        x = InceptionB(dtype=self.dtype)(x, train)
+        x = InceptionC(128, dtype=self.dtype)(x, train)
+        x = InceptionC(160, dtype=self.dtype)(x, train)
+        x = InceptionC(160, dtype=self.dtype)(x, train)
+        x = InceptionC(192, dtype=self.dtype)(x, train)
+        x = InceptionD(dtype=self.dtype)(x, train)
+        x = InceptionE(dtype=self.dtype)(x, train)
+        x = InceptionE(dtype=self.dtype)(x, train)
+        # Head
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)

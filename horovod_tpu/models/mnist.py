@@ -1,0 +1,35 @@
+"""MNIST ConvNet — the examples' workhorse model.
+
+The reference's MNIST examples all use the same small conv net (two convs,
+two fc — examples/tensorflow_mnist.py:conv_model, examples/pytorch_mnist.py
+Net, examples/mxnet_mnist.py conv_nets). This is its Flax equivalent, used
+by every example in ``examples/``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistConvNet(nn.Module):
+    """conv32(5x5) -> pool -> conv64(5x5) -> pool -> fc1024 -> fc10."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # x: [B, 28, 28, 1]
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(32, (5, 5), name="conv1")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (5, 5), name="conv2")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(1024, name="fc1")(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc2")(x)
+        return x.astype(jnp.float32)

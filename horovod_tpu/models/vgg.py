@@ -1,0 +1,59 @@
+"""VGG family in Flax — benchmark workload.
+
+VGG-16 is the reference's hardest scaling benchmark (68% at 512 GPUs,
+docs/benchmarks.md:5-6): ~138M parameters, most of them in the fc layers,
+which makes gradient allreduce bandwidth the bottleneck. On TPU the same
+model stresses HBM and ICI the same way, so it stays in the zoo as the
+communication-bound stress test.
+
+TPU-first choices: bf16 activations / fp32 params, NHWC, static shapes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# Block specs: number of conv layers x output channels per stage.
+_VGG16 = ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512))
+_VGG19 = ((2, 64), (2, 128), (4, 256), (4, 512), (4, 512))
+
+
+class VGG(nn.Module):
+    """Configurable VGG (Simonyan & Zisserman 2014) with batch norm off by
+    default, matching the classic benchmark configuration."""
+
+    cfg: Sequence = _VGG16
+    num_classes: int = 1000
+    use_bn: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, kernel_size=(3, 3), padding=[(1, 1), (1, 1)],
+                       dtype=self.dtype)
+        x = x.astype(self.dtype)
+        for i, (n_layers, ch) in enumerate(self.cfg):
+            for j in range(n_layers):
+                x = conv(ch, name=f"conv{i + 1}_{j + 1}")(x)
+                if self.use_bn:
+                    x = nn.BatchNorm(use_running_average=not train,
+                                     momentum=0.9, epsilon=1e-5,
+                                     dtype=jnp.float32,
+                                     name=f"bn{i + 1}_{j + 1}")(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc1")(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc2")(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+VGG16 = partial(VGG, cfg=_VGG16)
+VGG19 = partial(VGG, cfg=_VGG19)

@@ -1,0 +1,88 @@
+"""Skip-gram word2vec with NCE loss — pure JAX.
+
+The reference ships a distributed word2vec example
+(examples/tensorflow_word2vec.py, 249 LoC: skip-gram batches, NCE loss,
+embedding lookups trained data-parallel). This is the TPU-native model
+behind ``examples/jax_word2vec.py``: functional params, a jittable NCE
+loss with in-program negative sampling, and similarity scoring.
+
+TPU-first: the NCE loss is one batched gather + two matmul-shaped
+contractions — no per-example Python, everything vectorized for the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Word2VecParams(NamedTuple):
+    embeddings: jax.Array   # [vocab, dim] input embeddings
+    nce_weights: jax.Array  # [vocab, dim] output (context) embeddings
+    nce_biases: jax.Array   # [vocab]
+
+
+def init_params(vocab_size: int, embedding_dim: int,
+                rng: jax.Array) -> Word2VecParams:
+    """Uniform(-1,1) embeddings, truncated-normal NCE weights, zero biases
+    (the reference's initialization, tensorflow_word2vec.py:154-166)."""
+    k1, k2 = jax.random.split(rng)
+    emb = jax.random.uniform(k1, (vocab_size, embedding_dim),
+                             minval=-1.0, maxval=1.0)
+    scale = 1.0 / jnp.sqrt(embedding_dim)
+    nce_w = jax.random.truncated_normal(
+        k2, -2.0, 2.0, (vocab_size, embedding_dim)) * scale
+    return Word2VecParams(emb, nce_w, jnp.zeros((vocab_size,)))
+
+
+def nce_loss(params: Word2VecParams, centers: jax.Array,
+             contexts: jax.Array, rng: jax.Array,
+             num_negatives: int = 64, vocab_size: int | None = None
+             ) -> jax.Array:
+    """Noise-contrastive estimation loss for a skip-gram batch.
+
+    centers/contexts: [B] int32 token ids. Negatives are drawn uniformly
+    in-program (log-uniform in the reference; uniform keeps the sampler a
+    single stateless jax.random call — the distinction does not change the
+    benchmark's compute shape).
+    """
+    vocab = vocab_size or params.embeddings.shape[0]
+    emb = params.embeddings[centers]                       # [B, D]
+    true_w = params.nce_weights[contexts]                  # [B, D]
+    true_b = params.nce_biases[contexts]                   # [B]
+    true_logits = jnp.sum(emb * true_w, axis=-1) + true_b  # [B]
+
+    neg_ids = jax.random.randint(rng, (num_negatives,), 0, vocab)
+    neg_w = params.nce_weights[neg_ids]                    # [N, D]
+    neg_b = params.nce_biases[neg_ids]                     # [N]
+    neg_logits = emb @ neg_w.T + neg_b[None, :]            # [B, N]
+
+    # Binary logistic: true pairs -> 1, sampled pairs -> 0.
+    pos = jnp.logaddexp(0.0, -true_logits)                 # -log sigmoid
+    neg = jnp.logaddexp(0.0, neg_logits).sum(axis=-1)
+    return jnp.mean(pos + neg)
+
+
+def skipgram_batch(data: jnp.ndarray, step: int, batch_size: int,
+                   skip_window: int = 1) -> tuple:
+    """Deterministic skip-gram pairs from a token stream: each center is
+    paired with one neighbor, alternating left/right. Static shapes, so
+    the training step stays jittable over ``step``."""
+    n = data.shape[0]
+    idx = (step * batch_size + jnp.arange(batch_size)) % (
+        n - 2 * skip_window) + skip_window
+    offset = jnp.where(jnp.arange(batch_size) % 2 == 0,
+                       -skip_window, skip_window)
+    return data[idx], data[idx + offset]
+
+
+def nearest(params: Word2VecParams, word_ids: jax.Array, k: int = 8
+            ) -> jax.Array:
+    """Top-k nearest token ids by cosine similarity (the reference's
+    eval loop, tensorflow_word2vec.py:188-206)."""
+    norm = params.embeddings / jnp.linalg.norm(
+        params.embeddings, axis=-1, keepdims=True)
+    sims = norm[word_ids] @ norm.T                         # [Q, vocab]
+    return jax.lax.top_k(sims, k + 1)[1][:, 1:]            # drop self
