@@ -63,6 +63,43 @@ def _accum_dtype(dtype) -> Optional[np.dtype]:
     return None
 
 
+def _fused_reduce(vals, reduce_fn, prescale: float, postscale: float):
+    """The fusion-buffer body shared by the single- and multi-process
+    allreduce programs: group per-shard values by dtype, flatten + concat
+    (the "fusion buffer", operations.cc:1221-1243), reduce each buffer
+    with ``reduce_fn``, split back out. One collective per dtype mirrors
+    one collective per fused response (operations.cc:2149-2265)."""
+    by_dtype = {}
+    for i, v in enumerate(vals):
+        by_dtype.setdefault(v.dtype, []).append((i, v))
+    results = [None] * len(vals)
+    for dt, items in by_dtype.items():
+        acc = _accum_dtype(dt)
+        flat = [jnp.ravel(v).astype(acc or dt) for _, v in items]
+        if prescale != 1.0:
+            flat = [f * prescale for f in flat]
+        buf = jnp.concatenate(flat) if len(flat) > 1 else flat[0]
+        red = reduce_fn(buf)
+        if postscale != 1.0:
+            red = red * postscale
+        off = 0
+        for (i, v), f in zip(items, flat):
+            n = f.size
+            piece = jax.lax.dynamic_slice(red, (off,), (n,))
+            results[i] = piece.reshape(v.shape).astype(dt)
+            off += n
+    return tuple(results)
+
+
+def _trim_concat(gathered, per_rank_dims):
+    """Trim a padded [n, max_dim, ...] gather back to ragged segments and
+    concatenate — the MPI_Allgatherv displacement math
+    (operations.cc:862-897)."""
+    segs = [jax.lax.slice_in_dim(gathered[i], 0, int(d), axis=0)
+            for i, d in enumerate(per_rank_dims)]
+    return jnp.concatenate(segs, axis=0)
+
+
 class CollectiveExecutor:
     """Builds and caches jitted collective programs for one mesh."""
 
@@ -148,29 +185,8 @@ class CollectiveExecutor:
         def build():
             def fused(*xs):
                 def shard_fn(*ys):
-                    # Group by dtype into fusion segments; one collective per
-                    # dtype mirrors one collective per fused response
-                    # (operations.cc:2149-2265 fusion, 1491-1586 execution).
-                    by_dtype = {}
-                    for i, y in enumerate(ys):
-                        by_dtype.setdefault(y.dtype, []).append((i, y))
-                    results = [None] * len(ys)
-                    for dt, items in by_dtype.items():
-                        acc = _accum_dtype(dt)
-                        flat = [jnp.ravel(y).astype(acc or dt) for _, y in items]
-                        if prescale != 1.0:
-                            flat = [f * prescale for f in flat]
-                        buf = jnp.concatenate(flat) if len(flat) > 1 else flat[0]
-                        red = reduce_buf(buf)
-                        if postscale != 1.0:
-                            red = red * postscale
-                        off = 0
-                        for (i, y), f in zip(items, flat):
-                            n = f.size
-                            piece = jax.lax.dynamic_slice(red, (off,), (n,))
-                            results[i] = piece.reshape(ys[i].shape).astype(dt)
-                            off += n
-                    return tuple(results)
+                    return _fused_reduce(ys, reduce_buf, prescale,
+                                         postscale)
 
                 return jax.shard_map(
                     shard_fn, mesh=mesh,
@@ -358,9 +374,169 @@ class CollectiveExecutor:
         prog = self._program(key, build)
         gathered = prog(jax.device_put(
             padded, NamedSharding(mesh, P("dp"))))
-        segs = [jax.lax.slice_in_dim(gathered[i], 0, first_dims[i], axis=0)
-                for i in range(n)]
-        return jnp.concatenate(segs, axis=0)
+        return _trim_concat(gathered, first_dims)
+
+
+    # ------------------------------------------- multi-process (multi-host)
+    #
+    # In multi-process mode the mesh spans devices this process cannot
+    # address, and each process holds *different* eager values, so the
+    # replicated-input programs above would lie to XLA about consistency.
+    # Instead every tensor becomes a global [size, ...] array whose leading
+    # axis is sharded over 'dp' — each device holds its process's value —
+    # built from process-local data only. The group sequence executed here
+    # is agreed through the TCP coordinator (ops/control_plane.py), so all
+    # processes enter the same program in the same order (the SPMD
+    # requirement the reference meets with its MPI_Bcast'd response list,
+    # operations.cc:2282-2287).
+
+    def _mp_stacked(self, x) -> jax.Array:
+        """Global [size, ...] dp-sharded array; every local device holds
+        this process's value."""
+        local_devices = [d for d in self.mesh.devices.flat
+                         if d.process_index == jax.process_index()]
+        arr = np.asarray(x)
+        local = np.broadcast_to(arr, (len(local_devices),) + arr.shape)
+        return jax.make_array_from_process_local_data(
+            NamedSharding(self.mesh, P("dp")), local)
+
+    def allreduce_fused_mp(self, tensors: Sequence[jax.Array],
+                           prescale: float = 1.0,
+                           postscale: float = 1.0) -> List[jax.Array]:
+        """Fused sum-allreduce across processes: every virtual rank
+        (device) contributes its process's copy."""
+        mesh = self.mesh
+        shapes = tuple(tuple(t.shape) for t in tensors)
+        dtypes = tuple(str(t.dtype) for t in tensors)
+        key = ("armp", shapes, dtypes, float(prescale), float(postscale),
+               id(mesh))
+
+        def build():
+            def fused(*xs):
+                def shard_fn(*ys):
+                    # y[0]: this device's block of the [size, ...] axis.
+                    return _fused_reduce(
+                        [y[0] for y in ys],
+                        lambda buf: jax.lax.psum(buf, "dp"),
+                        prescale, postscale)
+
+                return jax.shard_map(
+                    shard_fn, mesh=mesh,
+                    in_specs=tuple(P("dp") for _ in xs),
+                    out_specs=tuple(P() for _ in xs),
+                    check_vma=False)(*xs)
+
+            return jax.jit(fused)
+
+        prog = self._program(key, build)
+        outs = prog(*[self._mp_stacked(t) for t in tensors])
+        return list(outs)
+
+    def broadcast_fused_mp(self, tensors: Sequence[jax.Array],
+                           root_rank: int) -> List[jax.Array]:
+        """Cross-process broadcast from virtual rank ``root_rank``."""
+        mesh = self.mesh
+        shapes = tuple(tuple(t.shape) for t in tensors)
+        dtypes = tuple(str(t.dtype) for t in tensors)
+        key = ("bcmp", shapes, dtypes, int(root_rank), id(mesh))
+
+        def build():
+            def fused(*xs):
+                def shard_fn(*ys):
+                    idx = jax.lax.axis_index("dp")
+                    outs = []
+                    for y in ys:
+                        v = y[0]
+                        acc = _accum_dtype(v.dtype)
+                        z = v.astype(acc) if acc is not None else v
+                        masked = jnp.where(idx == root_rank, z,
+                                           jnp.zeros_like(z))
+                        outs.append(
+                            jax.lax.psum(masked, "dp").astype(v.dtype))
+                    return tuple(outs)
+                return jax.shard_map(
+                    shard_fn, mesh=mesh,
+                    in_specs=tuple(P("dp") for _ in xs),
+                    out_specs=tuple(P() for _ in xs),
+                    check_vma=False)(*xs)
+            return jax.jit(fused)
+
+        prog = self._program(key, build)
+        return list(prog(*[self._mp_stacked(t) for t in tensors]))
+
+    def allgather_fused_mp(self, tensors: Sequence[jax.Array]
+                           ) -> List[jax.Array]:
+        """Cross-process allgather, equal first dims: one segment per
+        virtual rank, concatenated along dim 0."""
+        mesh = self.mesh
+        shapes = tuple(tuple(t.shape) for t in tensors)
+        dtypes = tuple(str(t.dtype) for t in tensors)
+        key = ("agmp", shapes, dtypes, id(mesh))
+
+        def build():
+            def fused(*xs):
+                def shard_fn(*ys):
+                    return tuple(
+                        jax.lax.all_gather(y[0], "dp", axis=0, tiled=True)
+                        for y in ys)
+                return jax.shard_map(
+                    shard_fn, mesh=mesh,
+                    in_specs=tuple(P("dp") for _ in xs),
+                    out_specs=tuple(P() for _ in xs),
+                    check_vma=False)(*xs)
+            return jax.jit(fused)
+
+        prog = self._program(key, build)
+        return list(prog(*[self._mp_stacked(t) for t in tensors]))
+
+    def allgather_sharded_mp(self, x: jax.Array) -> jax.Array:
+        """Allgather of a global array already sharded P('dp') on the
+        leading axis: each virtual rank contributes its row block; the
+        result is the same rows, replicated. (The single-process path
+        routes this through allgather_ragged; a multi-host sharded array
+        cannot be pulled to one host, so it is re-gathered in place.)"""
+        mesh = self.mesh
+        key = ("agsmp", tuple(x.shape), str(x.dtype), id(mesh))
+
+        def build():
+            def fn(z):
+                def shard_fn(y):
+                    return jax.lax.all_gather(y, "dp", axis=0, tiled=True)
+                return jax.shard_map(
+                    shard_fn, mesh=mesh, in_specs=P("dp"),
+                    out_specs=P(), check_vma=False)(z)
+            return jax.jit(fn)
+
+        return self._program(key, build)(x)
+
+    def allgather_ragged_mp(self, tensor: jax.Array,
+                            per_device_dims: Sequence[int]) -> jax.Array:
+        """Cross-process MPI_Allgatherv: first dims differ per process.
+        ``per_device_dims`` (one per virtual rank, from the coordinator's
+        announced shapes) drives pad-to-max + gather + trim."""
+        mesh = self.mesh
+        n = self.world_size
+        m = max(int(d) for d in per_device_dims)
+        arr = np.asarray(tensor)
+        rest = arr.shape[1:]
+        key = ("agrmp", (m,) + tuple(rest), str(tensor.dtype),
+               tuple(int(d) for d in per_device_dims), id(mesh))
+
+        def build():
+            def fn(stacked):
+                def shard_fn(z):
+                    return jax.lax.all_gather(z[0], "dp", axis=0,
+                                              tiled=False)
+                return jax.shard_map(
+                    shard_fn, mesh=mesh, in_specs=P("dp"),
+                    out_specs=P(), check_vma=False)(stacked)
+            return jax.jit(fn)
+
+        padded = np.zeros((m,) + rest, dtype=arr.dtype)
+        padded[: arr.shape[0]] = arr
+        prog = self._program(key, build)
+        gathered = prog(self._mp_stacked(padded))
+        return _trim_concat(gathered, per_device_dims)
 
 
 _default_executor: Optional[CollectiveExecutor] = None

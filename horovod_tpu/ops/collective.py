@@ -158,6 +158,14 @@ class CollectiveEngine:
         self._native_core = None
         self._native_tried = False
         self._native_pending: Dict[int, _Request] = {}
+        # Multi-process control plane (ops/control_plane.py): when more
+        # than one host process participates, fusion groups must be agreed
+        # across processes (SPMD programs over the global mesh), so the
+        # rank-0 TCP coordinator replaces local planning.
+        self._mp = None               # tri-state: None=unknown
+        self._mp_client = None
+        self._mp_service = None
+        self._announced: set = set()
 
     # ------------------------------------------------------------- lifecycle
 
@@ -182,6 +190,11 @@ class CollectiveEngine:
         operations.cc:2384-2402). Falls back to the Python control plane
         when the toolchain is unavailable or it is disabled via
         HOROVOD_TPU_DISABLE_NATIVE=1."""
+        if self._is_multiprocess():
+            # Cross-process negotiation runs through the TCP coordinator;
+            # the native core's planner is process-local and would diverge
+            # the SPMD program order (see control_plane.py docstring).
+            return None
         with self._lock:
             if self._native_tried:
                 return self._native_core
@@ -208,9 +221,50 @@ class CollectiveEngine:
                 self._native_tried = True
         return self._native_core
 
+    def _is_multiprocess(self) -> bool:
+        if self._mp is None:
+            try:
+                self._mp = _topo._get().process_count > 1
+            except Exception:
+                return False
+        return self._mp
+
+    def _ensure_mp(self):
+        """Bring up the cross-process control plane once: process 0 hosts
+        the coordinator (the rank-0 role, operations.cc:2061-2067), every
+        process connects a client."""
+        from . import control_plane as _cp
+        with self._lock:
+            if self._mp_client is not None:
+                return self._mp_client
+            topo = _topo._get()
+            if topo.process_index == 0:
+                self._mp_service = _cp.start_coordinator(
+                    topo.process_count, self.fusion_threshold)
+                self._mp_client = _cp.CoordinatorClient(
+                    [("127.0.0.1", self._mp_service.port)],
+                    self._mp_service.key, topo.process_index)
+                return self._mp_client
+            else:
+                ep = _cp.control_endpoint()
+                if ep is None:
+                    raise HorovodInternalError(
+                        "Multi-process eager collectives need the "
+                        "coordinator address in HOROVOD_TPU_CONTROL "
+                        "(exported by the horovod_tpu runner); launch "
+                        "workers with `python -m horovod_tpu.runner` or "
+                        "export it manually.")
+                addr = [ep]
+            self._mp_client = _cp.CoordinatorClient(
+                addr, _cp.control_key(), topo.process_index)
+            return self._mp_client
+
     def shutdown(self):
         """Drain and stop; outstanding handles get SHUT_DOWN_ERROR
         (operations.cc:1942-1998)."""
+        if self._mp_client is not None:
+            self._mp_client.announce_shutdown()
+            self._mp_client = None
         core = self._native_core
         if core is not None:
             # Native path: the C++ shutdown drains its queue (the execute
@@ -237,6 +291,9 @@ class CollectiveEngine:
         if t is not None and t.is_alive() and t is not threading.current_thread():
             t.join(timeout=5.0)
         self._thread = None
+        if self._mp_service is not None:
+            self._mp_service.shutdown()
+            self._mp_service = None
 
     # --------------------------------------------------------------- enqueue
 
@@ -350,7 +407,9 @@ class CollectiveEngine:
 
     def _loop(self):
         """``RunLoopOnce`` (operations.cc:2030-2380): sleep to cycle time,
-        drain queue, plan fusion, execute."""
+        drain queue, plan fusion, execute. In multi-process mode the plan
+        comes from the rank-0 coordinator instead of local fusion."""
+        mp = self._is_multiprocess()
         while not self._shutdown:
             self._wake.wait(timeout=self.cycle_time_s)
             self._wake.clear()
@@ -359,12 +418,129 @@ class CollectiveEngine:
             with self._lock:
                 batch = self._queue
                 self._queue = []
-            if batch:
+            if mp:
+                try:
+                    self._mp_cycle(batch)
+                except BaseException as e:   # pragma: no cover - safety net
+                    _log.error("multi-process cycle failed: %s", e)
+                    self._fail_all(_as_error(e))
+            elif batch:
                 try:
                     self._dispatch(batch)
                 except BaseException as e:   # pragma: no cover - safety net
                     _log.error("background dispatch failed: %s", e)
             self._maybe_check_stalls()
+
+    def _fail_all(self, err: BaseException):
+        with self._lock:
+            pending = list(self._in_flight.values())
+            self._in_flight.clear()
+        for r in pending:
+            r.handle._fulfill(error=err)
+
+    # ------------------------------------------- multi-process cycle
+
+    def _mp_cycle(self, batch: List[_Request]):
+        """The worker half of RunLoopOnce (operations.cc:2323-2377):
+        announce newly-ready requests (the Gatherv), fetch the agreed
+        ordered group list (the Bcast), execute each group."""
+        client = self._ensure_mp()
+        if batch:
+            client.announce([{
+                "name": r.name, "op": r.op,
+                "dtype": str((r.tensor if r.tensor is not None
+                              else r.per_rank[0]).dtype),
+                "shape": tuple((r.tensor if r.tensor is not None
+                                else r.per_rank[0]).shape),
+                "root_rank": r.root_rank, "nbytes": r.nbytes,
+            } for r in batch])
+        with self._lock:
+            waiting = bool(self._in_flight)
+        if not waiting:
+            return
+        resp = client.fetch(wait_s=max(self.cycle_time_s, 0.05))
+        if resp.shutdown and not resp.groups:
+            self._fail_all(HorovodInternalError(
+                SHUT_DOWN_ERROR.format(op="run")))
+            return
+        for group in resp.groups:
+            self._execute_mp_group(group)
+
+    def _execute_mp_group(self, group: dict):
+        """Execute one coordinator-agreed group. All names were announced
+        by this process (a group forms only when every process announced),
+        so the requests are in our in-flight table."""
+        with self._lock:
+            reqs = [self._in_flight.pop(n) for n in group["names"]
+                    if n in self._in_flight]
+        if not reqs:
+            return
+        if group["error"]:
+            for r in reqs:
+                r.handle._fulfill(error=HorovodInternalError(group["error"]))
+            return
+        ex = self.executor
+        # Execution-semantic attributes the coordinator doesn't track
+        # subdivide the group — deterministically, since SPMD call sites
+        # pass identical attributes on every process.
+        subgroups: Dict[tuple, List[_Request]] = {}
+        for r in reqs:
+            k = (r.sharded, r.average, r.prescale, r.postscale)
+            subgroups.setdefault(k, []).append(r)
+        topo = _topo._get()
+        for sub in subgroups.values():
+            try:
+                results = self._execute_group_mp(ex, sub, group, topo)
+            except BaseException as e:
+                err = _as_error(e)
+                for r in sub:
+                    r.handle._fulfill(error=err)
+                continue
+            for r, out in zip(sub, results):
+                r.handle._fulfill(result=out)
+
+    def _execute_group_mp(self, ex: CollectiveExecutor,
+                          group: List[_Request], meta: dict, topo) -> List:
+        op = group[0].op
+        if op == ALLREDUCE:
+            if group[0].sharded:
+                return [ex.allreduce_sharded(
+                    r.tensor, average=r.average, prescale=r.prescale,
+                    postscale=r.postscale) for r in group]
+            post = group[0].postscale
+            if group[0].average:
+                post = post / ex.world_size
+            return ex.allreduce_fused_mp(
+                [r.tensor for r in group], prescale=group[0].prescale,
+                postscale=post)
+        if op == BROADCAST:
+            if group[0].sharded:
+                return [ex.broadcast_sharded(r.tensor, r.root_rank)
+                        for r in group]
+            return ex.broadcast_fused_mp([r.tensor for r in group],
+                                         meta["root_rank"])
+        if op == ALLGATHER:
+            outs: List = []
+            for r in group:
+                if r.sharded:
+                    # Already a global dp-sharded array: re-gather in
+                    # place (cannot be pulled host-side across processes).
+                    outs.append(ex.allgather_sharded_mp(r.tensor))
+                    continue
+                proc_dims = meta["sizes"].get(r.name)
+                if proc_dims is None:
+                    proc_dims = [int(r.tensor.shape[0])] * topo.process_count
+                # One segment per virtual rank: expand the per-process
+                # first dims by each process's device count (homogeneous
+                # topology, checked at init like operations.cc:1772-1790).
+                dev_dims = [d for d in proc_dims
+                            for _ in range(topo.local_size)]
+                if len(set(dev_dims)) == 1:
+                    outs.append(ex.allgather_fused_mp([r.tensor])[0])
+                else:
+                    outs.append(ex.allgather_ragged_mp(r.tensor, dev_dims))
+            return outs
+        raise ValueError(f"unknown op {op}")
 
     def _maybe_check_stalls(self):
         """Stall detector (CheckForStalledTensors, operations.cc:1625-1672):
@@ -653,6 +829,12 @@ def allgather_async(tensor, name: Optional[str] = None) -> Handle:
     _topo._get()
     eng = engine()
     if isinstance(tensor, (list, tuple)):
+        if eng._is_multiprocess():
+            raise ValueError(
+                "per-virtual-rank tensor lists are a single-process "
+                "convenience; in multi-process mode pass this process's "
+                "tensor (first dims may differ across processes — the "
+                "MPI_Allgatherv case, operations.cc:843-1113)")
         per_rank = [jnp.asarray(t) for t in tensor]
         nm = name or eng._next_name("allgather")
         h = eng.make_handle(nm)

@@ -1,0 +1,339 @@
+"""Cross-process control plane — the rank-0 coordinator protocol over TCP.
+
+This is the TPU-native equivalent of the reference's coordinator half of
+``RunLoopOnce`` (horovod/common/operations.cc:2030-2380): there, every
+cycle each rank MPI_Gathers its serialized request list to rank 0, rank 0
+counts announcements per tensor (``IncrementTensorCount``,
+operations.cc:287-313), validates cross-rank consistency
+(``ConstructMPIResponse``, operations.cc:321-523), fuses ready tensors
+into response groups with look-ahead (operations.cc:2149-2265), and
+MPI_Bcasts the ordered response list so *every rank executes the same
+fused collectives in the same order*.
+
+Here the transport is the launcher's HMAC-authenticated TCP RPC
+(runner/network.py) instead of MPI, and the executed collective is a
+jitted XLA program over the global device mesh — which is exactly why the
+agreement matters: a multi-host XLA program is SPMD, so every process
+must enter the *same* program in the *same* order or the job deadlocks.
+The coordinator's ordered group sequence provides that guarantee; cycle
+timing differences between processes can no longer diverge the fusion
+plan.
+
+Endpoint discovery: the launcher exports ``HOROVOD_TPU_CONTROL``
+(host:port, bound by process 0) and ``HOROVOD_TPU_SECRET_KEY``; workers
+poll with ``FetchGroups`` (the Bcast analogue) after announcing requests
+(the Gather analogue).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..runner.network import BasicClient, BasicService
+from ..runner.secret import SECRET_ENV, decode_key, make_secret_key
+from ..utils.logging import get_logger
+
+_log = get_logger("control_plane")
+
+CONTROL_ENV = "HOROVOD_TPU_CONTROL"
+
+# Wire op enums shared with the engine (executor.ALLREDUCE etc.).
+_OP_NAMES = {0: "allreduce", 1: "allgather", 2: "broadcast"}
+
+
+# --------------------------------------------------------------------------
+# Wire messages
+# --------------------------------------------------------------------------
+
+class AnnounceRequest:
+    """One process's newly-ready request metadata for this cycle — the
+    serialized MPIRequestList of the reference (mpi_message.h:88-105)."""
+
+    def __init__(self, rank: int, requests: List[dict], shutdown: bool = False):
+        self.rank = rank
+        self.requests = requests  # {name, op, dtype, shape, root_rank, nbytes}
+        self.shutdown = shutdown
+
+
+class AnnounceResponse:
+    def __init__(self, ok: bool = True):
+        self.ok = ok
+
+
+class FetchRequest:
+    """Long-poll for response groups after ``after_seq`` — the response
+    list Bcast of the reference (operations.cc:2282-2287)."""
+
+    def __init__(self, rank: int, after_seq: int, wait_s: float = 0.0):
+        self.rank = rank
+        self.after_seq = after_seq
+        self.wait_s = wait_s
+
+
+class FetchResponse:
+    def __init__(self, groups: List[dict], shutdown: bool):
+        self.groups = groups      # [{seq, op, names, error, root_rank,
+        #                            sizes: {name: [dim0 per process]}}]
+        self.shutdown = shutdown
+
+
+class _Entry:
+    __slots__ = ("op_by_rank", "dtype_by_rank", "shape_by_rank",
+                 "root_by_rank", "nbytes", "ranks", "order")
+
+    def __init__(self, order: int):
+        self.op_by_rank: Dict[int, int] = {}
+        self.dtype_by_rank: Dict[int, str] = {}
+        self.shape_by_rank: Dict[int, Tuple[int, ...]] = {}
+        self.root_by_rank: Dict[int, int] = {}
+        self.nbytes = 0
+        self.ranks = set()
+        self.order = order
+
+    @property
+    def op(self) -> int:
+        return next(iter(self.op_by_rank.values()))
+
+    @property
+    def dtype(self) -> str:
+        return next(iter(self.dtype_by_rank.values()))
+
+
+class CoordinatorService(BasicService):
+    """Rank-0 coordinator: counts announcements, validates, plans fusion,
+    serves the ordered group sequence."""
+
+    def __init__(self, nproc: int, key: bytes,
+                 fusion_threshold: int = 64 * 1024 * 1024,
+                 port: int = 0):
+        super().__init__("horovod-tpu-coordinator", key, port=port)
+        self.key = key
+        self._nproc = nproc
+        self.fusion_threshold = fusion_threshold
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._table: Dict[str, _Entry] = {}
+        self._ready: List[Tuple[str, _Entry]] = []
+        # Executed-group history is pruned up to the lowest sequence every
+        # process has acknowledged (via FetchRequest.after_seq) — a
+        # days-long job must not grow coordinator memory linearly.
+        self._groups: List[dict] = []
+        self._base_seq = 0
+        self._acked: Dict[int, int] = {}
+        self._order = 0
+        self._shutdown = False
+
+    # ------------------------------------------------------------- protocol
+
+    def _handle(self, req, client_address):
+        if isinstance(req, AnnounceRequest):
+            return self._announce(req)
+        if isinstance(req, FetchRequest):
+            return self._fetch(req)
+        return super()._handle(req, client_address)
+
+    def _announce(self, req: AnnounceRequest) -> AnnounceResponse:
+        with self._cv:
+            if req.shutdown:
+                # Any rank announcing shutdown stops the world — the
+                # reference ORs the shutdown flag into the response list
+                # (operations.cc:2125-2128).
+                self._shutdown = True
+                self._cv.notify_all()
+                return AnnounceResponse()
+            for r in req.requests:
+                e = self._table.get(r["name"])
+                if e is None:
+                    e = _Entry(self._order)
+                    self._order += 1
+                    self._table[r["name"]] = e
+                if req.rank in e.ranks:
+                    continue  # duplicate announce (client retry)
+                e.ranks.add(req.rank)
+                e.op_by_rank[req.rank] = int(r["op"])
+                e.dtype_by_rank[req.rank] = str(r["dtype"])
+                e.shape_by_rank[req.rank] = tuple(r["shape"])
+                e.root_by_rank[req.rank] = int(r.get("root_rank", -1))
+                e.nbytes = max(e.nbytes, int(r.get("nbytes", 0)))
+                # Mismatched op/dtype is detected in _validate once every
+                # rank has announced — SPMD code enqueues the same name on
+                # all ranks, so a colliding name still reaches quorum and
+                # becomes an error group (operations.cc:321-395) rather
+                # than a divergent program.
+                if len(e.ranks) == self._nproc:
+                    self._ready.append((r["name"], e))
+                    del self._table[r["name"]]
+            self._plan_locked()
+            if self._groups:
+                self._cv.notify_all()
+        return AnnounceResponse()
+
+    def _fetch(self, req: FetchRequest) -> FetchResponse:
+        deadline = time.monotonic() + max(0.0, req.wait_s)
+        with self._cv:
+            self._acked[req.rank] = max(self._acked.get(req.rank, 0),
+                                        req.after_seq)
+            if len(self._acked) == self._nproc:
+                floor = min(self._acked.values())
+                if floor > self._base_seq:
+                    del self._groups[: floor - self._base_seq]
+                    self._base_seq = floor
+            next_seq = len(self._groups) + self._base_seq
+            while (next_seq <= req.after_seq and not self._shutdown
+                   and time.monotonic() < deadline):
+                self._cv.wait(timeout=max(0.0,
+                                          deadline - time.monotonic()))
+                next_seq = len(self._groups) + self._base_seq
+            start = max(0, req.after_seq - self._base_seq)
+            return FetchResponse(self._groups[start:], self._shutdown)
+
+    # ------------------------------------------------------------- planning
+
+    def _validate(self, name: str, e: _Entry) -> str:
+        """ConstructMPIResponse's cross-rank checks (operations.cc:321-523)."""
+        if len(set(e.op_by_rank.values())) > 1:
+            ops = sorted({_OP_NAMES.get(o, str(o))
+                          for o in e.op_by_rank.values()})
+            return (f"Mismatched collective operations for tensor {name}: "
+                    f"ranks requested {ops} (operations.cc:354-360)")
+        if len(set(e.dtype_by_rank.values())) > 1:
+            return (f"Mismatched data types for tensor {name}: "
+                    f"{sorted(set(e.dtype_by_rank.values()))} "
+                    "(operations.cc:341-352)")
+        shapes = list(e.shape_by_rank.values())
+        op_name = _OP_NAMES.get(e.op, str(e.op))
+        if e.op in (0, 2):  # allreduce / broadcast: identical shapes
+            if any(s != shapes[0] for s in shapes):
+                return (f"Mismatched {op_name} tensor shapes: tensor {name} "
+                        f"has different shapes on different ranks: "
+                        f"{sorted(set(shapes))}")
+        if e.op == 1:  # allgather: dims beyond the first must agree
+            rests = {s[1:] for s in shapes}
+            if len(rests) > 1 or any(len(s) == 0 for s in shapes):
+                return (f"Mismatched allgather tensor shapes: tensor {name} "
+                        "must agree on every dimension except the first "
+                        f"across ranks; got {sorted(set(shapes))}")
+        if e.op == 2:  # broadcast: same root everywhere
+            roots = set(e.root_by_rank.values())
+            if len(roots) > 1:
+                return (f"Mismatched broadcast root ranks for tensor "
+                        f"{name}: {sorted(roots)}")
+        return ""
+
+    def _plan_locked(self):
+        """Greedy fusion with look-ahead over the ready list
+        (operations.cc:2149-2265): same (op, dtype, root) under the byte
+        threshold fuse into one group; error entries become singleton
+        error groups."""
+        remaining = self._ready
+        self._ready = []
+        while remaining:
+            name, e = remaining.pop(0)
+            err = self._validate(name, e)
+            if err:
+                self._groups.append({
+                    "seq": len(self._groups) + self._base_seq, "op": e.op,
+                    "names": [name], "error": err, "root_rank": -1,
+                    "sizes": {}})
+                continue
+            group_names = [name]
+            sizes = {}
+            if e.op == 1:
+                sizes[name] = [e.shape_by_rank[r][0]
+                               for r in range(self._nproc)]
+            total = e.nbytes
+            keep = []
+            for name2, e2 in remaining:
+                if (e2.op == e.op and e2.dtype == e.dtype
+                        and not self._validate(name2, e2)
+                        and e2.root_by_rank == e.root_by_rank
+                        and total + e2.nbytes <= self.fusion_threshold):
+                    group_names.append(name2)
+                    total += e2.nbytes
+                    if e2.op == 1:
+                        sizes[name2] = [e2.shape_by_rank[r][0]
+                                        for r in range(self._nproc)]
+                else:
+                    keep.append((name2, e2))
+            remaining = keep
+            self._groups.append({
+                "seq": len(self._groups) + self._base_seq, "op": e.op,
+                "names": group_names, "error": "",
+                "root_rank": next(iter(e.root_by_rank.values()), -1),
+                "sizes": sizes})
+
+
+class CoordinatorClient:
+    """Per-process client — the worker half of RunLoopOnce
+    (operations.cc:2323-2377)."""
+
+    def __init__(self, addresses: List[Tuple[str, int]], key: bytes,
+                 rank: int):
+        self._client = BasicClient(addresses, key)
+        self._rank = rank
+        self.last_seq = 0
+
+    def announce(self, requests: List[dict]) -> None:
+        self._client.request(AnnounceRequest(self._rank, requests))
+
+    def fetch(self, wait_s: float = 0.0) -> FetchResponse:
+        resp = self._client.request(
+            FetchRequest(self._rank, self.last_seq, wait_s))
+        if resp.groups:
+            self.last_seq = resp.groups[-1]["seq"] + 1
+        return resp
+
+    def announce_shutdown(self) -> None:
+        try:
+            self._client.request(
+                AnnounceRequest(self._rank, [], shutdown=True))
+        except Exception:
+            pass  # coordinator may already be gone at teardown
+
+
+# --------------------------------------------------------------------------
+# Process wiring
+# --------------------------------------------------------------------------
+
+def control_key() -> bytes:
+    """HMAC key for the control plane, from the launcher-provided env.
+
+    There is deliberately NO fallback derived from the control address:
+    the service unpickles authenticated frames, so a guessable key would
+    hand code execution to anyone who can reach the port. Processes that
+    did not receive a key must fail loudly (the reference likewise
+    requires ``_HOROVOD_SECRET_KEY`` for its RPC plane,
+    spark/util/secret.py:21-36)."""
+    v = os.environ.get(SECRET_ENV)
+    if v:
+        return decode_key(v)
+    raise RuntimeError(
+        f"{SECRET_ENV} is not set. Multi-process eager collectives "
+        "authenticate their control plane with a shared secret; launch "
+        "workers with `python -m horovod_tpu.runner` (which mints one), "
+        "or export the same random key on every process.")
+
+
+def control_endpoint() -> Optional[Tuple[str, int]]:
+    v = os.environ.get(CONTROL_ENV)
+    if not v:
+        return None
+    host, port = v.rsplit(":", 1)
+    return host, int(port)
+
+
+def start_coordinator(nproc: int, fusion_threshold: int
+                      ) -> CoordinatorService:
+    """Start the rank-0 coordinator, binding the launcher-published port
+    from HOROVOD_TPU_CONTROL. Without a published endpoint (single-host
+    tests talking to it in-process) an ephemeral port and a random key
+    are used — nothing off this host can authenticate."""
+    ep = control_endpoint()
+    key = control_key() if (ep or os.environ.get(SECRET_ENV)) \
+        else make_secret_key()
+    return CoordinatorService(nproc, key,
+                              fusion_threshold=fusion_threshold,
+                              port=ep[1] if ep else 0)

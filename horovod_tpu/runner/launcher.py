@@ -163,6 +163,11 @@ def launch(command: List[str], np: int, hosts: Optional[str] = None,
       HOROVOD_TPU_COORDINATOR       host:port of the JAX coordinator (rank 0)
       HOROVOD_TPU_NUM_PROCESSES     world size
       HOROVOD_TPU_PROCESS_ID        this worker's process id
+    Consumed by the eager collective engine (ops/control_plane.py) for
+    cross-process fusion negotiation:
+      HOROVOD_TPU_CONTROL           host:port of the rank-0 TCP coordinator
+      HOROVOD_TPU_SECRET_KEY        HMAC key for the control plane (created
+                                    here unless the caller already set one)
     Informational, for user scripts (the OMPI_COMM_WORLD_LOCAL_RANK
     equivalent, test/common.py:25-57):
       HOROVOD_TPU_LOCAL_PROCESS_ID  rank within its host
@@ -182,19 +187,41 @@ def launch(command: List[str], np: int, hosts: Optional[str] = None,
         coord_host = routable_local_address()
     else:
         coord_host = first_host
-    if coordinator_port is not None:
-        coord_port = coordinator_port
-    elif is_local_host(first_host):
+    if is_local_host(first_host):
         # Probing only tells us the port is free HERE — valid exactly when
         # the coordinator binds here.
-        coord_port = find_free_port()
+        coord_port = (coordinator_port if coordinator_port is not None
+                      else find_free_port())
+        ctrl_port = find_free_port()
+        while ctrl_port == coord_port:
+            ctrl_port = find_free_port()
     else:
         # Rank 0 binds on a remote machine we cannot probe; an entropy-
         # backed pick from the high range keeps collisions between
         # concurrent launches rare (not impossible — pass
         # coordinator_port to pin it).
         import random
-        coord_port = random.SystemRandom().randrange(20000, 60000)
+        rnd = random.SystemRandom()
+        coord_port = (coordinator_port if coordinator_port is not None
+                      else rnd.randrange(20000, 60000))
+        ctrl_port = rnd.randrange(20000, 60000)
+        while ctrl_port == coord_port:
+            ctrl_port = rnd.randrange(20000, 60000)
+
+    # Local workers must be able to import horovod_tpu (and task_exec)
+    # regardless of the caller's cwd — e.g. a script run from examples/
+    # with the package importable only via the caller's sys.path. Remote
+    # hosts need the package installed; PYTHONPATH is not shipped there.
+    import horovod_tpu
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(horovod_tpu.__file__)))
+
+    # The eager engine's control plane authenticates with a shared HMAC
+    # key (secret.py, reference spark/util/secret.py:21-36); mint one per
+    # launch unless the caller (e.g. api.run) already provided it.
+    from .secret import SECRET_ENV, encode_key, make_secret_key
+    secret = ((extra_env or {}).get(SECRET_ENV)
+              or os.environ.get(SECRET_ENV) or encode_key(make_secret_key()))
 
     extra_keys = tuple(extra_env.keys()) if extra_env else ()
     workers: List[ManagedProcess] = []
@@ -203,9 +230,15 @@ def launch(command: List[str], np: int, hosts: Optional[str] = None,
         env = dict(os.environ)
         if extra_env:
             env.update(extra_env)
+        prev_pp = env.get("PYTHONPATH", "")
+        if pkg_root not in prev_pp.split(os.pathsep):
+            env["PYTHONPATH"] = (f"{pkg_root}{os.pathsep}{prev_pp}"
+                                 if prev_pp else pkg_root)
         env["HOROVOD_TPU_COORDINATOR"] = f"{coord_host}:{coord_port}"
         env["HOROVOD_TPU_NUM_PROCESSES"] = str(np)
         env["HOROVOD_TPU_PROCESS_ID"] = str(rank)
+        env["HOROVOD_TPU_CONTROL"] = f"{coord_host}:{ctrl_port}"
+        env[SECRET_ENV] = secret
         local_rank = local_counts.get(host, 0)
         local_counts[host] = local_rank + 1
         env["HOROVOD_TPU_LOCAL_PROCESS_ID"] = str(local_rank)

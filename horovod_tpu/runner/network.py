@@ -144,10 +144,11 @@ class BasicService:
 
     conn_timeout = 3600.0
 
-    def __init__(self, name: str, key: bytes, host: str = "0.0.0.0"):
+    def __init__(self, name: str, key: bytes, host: str = "0.0.0.0",
+                 port: int = 0):
         self.name = name
         self._wire = Wire(key)
-        self._server = _Server((host, 0), _Handler)
+        self._server = _Server((host, port), _Handler)
         self._server.service = self  # type: ignore[attr-defined]
         self._port = self._server.server_address[1]
         self._thread = threading.Thread(
@@ -188,7 +189,14 @@ class BasicService:
 
 
 class BasicClient:
-    """Connect-per-call RPC client with retries (network.py:~150+)."""
+    """RPC client with retries (network.py:~150+).
+
+    The connection is persistent: the server handler loops over framed
+    requests on one socket, so keeping it open avoids per-call TCP
+    setup/teardown and handler-thread churn (the eager engine issues RPCs
+    every ~1 ms cycle). Reconnects transparently on failure. Thread-safe:
+    one in-flight request at a time per client.
+    """
 
     def __init__(self, addresses, key: bytes, attempts: int = 3,
                  timeout: float = 60.0):
@@ -199,20 +207,49 @@ class BasicClient:
         self._wire = Wire(key)
         self._attempts = attempts
         self._timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._mu = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        last: Optional[Exception] = None
+        for host, port in self._addresses:
+            try:
+                sock = socket.create_connection((host, port),
+                                                timeout=self._timeout)
+                sock.settimeout(self._timeout)
+                return sock
+            except (OSError, ConnectionError) as e:
+                last = e
+        raise ConnectionError(
+            f"could not reach service at {self._addresses}: {last}")
+
+    def close(self) -> None:
+        with self._mu:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
 
     def request(self, req: Any) -> Any:
         last: Optional[Exception] = None
-        for _ in range(self._attempts):
-            for host, port in self._addresses:
+        with self._mu:
+            for attempt in range(self._attempts):
                 try:
-                    with socket.create_connection(
-                            (host, port), timeout=self._timeout) as sock:
-                        self._wire.write(sock, req)
-                        sock.settimeout(self._timeout)
-                        return self._wire.read(sock)
+                    if self._sock is None:
+                        self._sock = self._connect()
+                    self._wire.write(self._sock, req)
+                    return self._wire.read(self._sock)
                 except (OSError, ConnectionError) as e:
                     last = e
-            time.sleep(0.2)
+                    if self._sock is not None:
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        self._sock = None
+                    if attempt + 1 < self._attempts:
+                        time.sleep(0.2)
         raise ConnectionError(
             f"could not reach service at {self._addresses}: {last}")
 

@@ -1,0 +1,141 @@
+"""Coordinator protocol unit tests — in-process, no subprocesses.
+
+Covers the rank-0 negotiation logic the reference implements in
+IncrementTensorCount / ConstructMPIResponse / the fusion loop
+(operations.cc:287-313, 321-523, 2149-2265): quorum counting, cross-rank
+validation errors, fusion grouping under the byte threshold, ordered
+sequence delivery, history pruning, and shutdown propagation.
+"""
+
+import threading
+
+import pytest
+
+from horovod_tpu.ops.control_plane import (AnnounceRequest, CoordinatorClient,
+                                           CoordinatorService, FetchRequest)
+from horovod_tpu.runner.secret import make_secret_key
+
+
+@pytest.fixture
+def svc():
+    s = CoordinatorService(nproc=2, key=make_secret_key(),
+                           fusion_threshold=1024)
+    yield s
+    s.shutdown()
+
+
+def _client(svc, rank):
+    return CoordinatorClient([("127.0.0.1", svc.port)], svc.key, rank)
+
+
+def _req(name, op=0, dtype="float32", shape=(4,), root=-1, nbytes=16):
+    return {"name": name, "op": op, "dtype": dtype, "shape": shape,
+            "root_rank": root, "nbytes": nbytes}
+
+
+class TestNegotiation:
+    def test_quorum_then_group(self, svc):
+        c0, c1 = _client(svc, 0), _client(svc, 1)
+        c0.announce([_req("t")])
+        # only one rank announced: no group yet
+        assert c0.fetch(wait_s=0.05).groups == []
+        c1.announce([_req("t")])
+        groups = c0.fetch(wait_s=2.0).groups
+        assert len(groups) == 1
+        assert groups[0]["names"] == ["t"] and groups[0]["error"] == ""
+        # the other rank sees the same sequence
+        g1 = c1.fetch(wait_s=2.0).groups
+        assert g1 == groups
+
+    def test_fusion_same_dtype_under_threshold(self, svc):
+        c0, c1 = _client(svc, 0), _client(svc, 1)
+        reqs = [_req("a", nbytes=400), _req("b", nbytes=400),
+                _req("c", nbytes=400)]
+        c0.announce(reqs)
+        c1.announce(reqs)
+        groups = c0.fetch(wait_s=2.0).groups
+        # 400+400 fits in 1024; c overflows into a second group
+        assert [g["names"] for g in groups] == [["a", "b"], ["c"]]
+
+    def test_lookahead_skips_mismatched_dtype(self, svc):
+        c0, c1 = _client(svc, 0), _client(svc, 1)
+        reqs = [_req("f1", dtype="float32"), _req("i1", dtype="int32"),
+                _req("f2", dtype="float32")]
+        c0.announce(reqs)
+        c1.announce(reqs)
+        groups = c0.fetch(wait_s=2.0).groups
+        assert [g["names"] for g in groups] == [["f1", "f2"], ["i1"]]
+
+    def test_shape_mismatch_error(self, svc):
+        c0, c1 = _client(svc, 0), _client(svc, 1)
+        c0.announce([_req("t", shape=(3,))])
+        c1.announce([_req("t", shape=(5,))])
+        groups = c0.fetch(wait_s=2.0).groups
+        assert len(groups) == 1
+        assert "Mismatched allreduce tensor shapes" in groups[0]["error"]
+
+    def test_op_mismatch_error(self, svc):
+        c0, c1 = _client(svc, 0), _client(svc, 1)
+        c0.announce([_req("t", op=0)])
+        c1.announce([_req("t", op=2, root=0)])
+        groups = c0.fetch(wait_s=2.0).groups
+        assert "Mismatched collective operations" in groups[0]["error"]
+
+    def test_broadcast_root_mismatch(self, svc):
+        c0, c1 = _client(svc, 0), _client(svc, 1)
+        c0.announce([_req("t", op=2, root=0)])
+        c1.announce([_req("t", op=2, root=1)])
+        groups = c0.fetch(wait_s=2.0).groups
+        assert "Mismatched broadcast root ranks" in groups[0]["error"]
+
+    def test_allgather_sizes_per_rank(self, svc):
+        c0, c1 = _client(svc, 0), _client(svc, 1)
+        c0.announce([_req("g", op=1, shape=(2, 4))])
+        c1.announce([_req("g", op=1, shape=(5, 4))])
+        groups = c0.fetch(wait_s=2.0).groups
+        assert groups[0]["error"] == ""
+        assert groups[0]["sizes"]["g"] == [2, 5]
+
+    def test_history_pruned_after_all_ack(self, svc):
+        c0, c1 = _client(svc, 0), _client(svc, 1)
+        for i in range(5):
+            c0.announce([_req(f"t{i}", dtype="int32" if i % 2 else "float32",
+                              nbytes=2000)])
+            c1.announce([_req(f"t{i}", dtype="int32" if i % 2 else "float32",
+                              nbytes=2000)])
+            assert c0.fetch(wait_s=2.0).groups
+            assert c1.fetch(wait_s=2.0).groups
+        # both clients acked everything -> history collapses
+        c0.fetch(wait_s=0.01)
+        c1.fetch(wait_s=0.01)
+        assert len(svc._groups) <= 1
+        assert svc._base_seq >= 4
+
+    def test_shutdown_propagates(self, svc):
+        c0, c1 = _client(svc, 0), _client(svc, 1)
+        c0.announce([], )  # no-op announce
+        c1.announce_shutdown()
+        resp = c0.fetch(wait_s=2.0)
+        assert resp.shutdown
+
+    def test_concurrent_announce_consistent_order(self, svc):
+        """Both ranks see identical group order even with racing
+        announcements from different threads."""
+        c0, c1 = _client(svc, 0), _client(svc, 1)
+        names = [f"x{i}" for i in range(20)]
+
+        def announce(client, order):
+            for n in order:
+                client.announce([_req(n, nbytes=600)])
+
+        t0 = threading.Thread(target=announce, args=(c0, names))
+        t1 = threading.Thread(target=announce, args=(c1, list(reversed(
+            names))))
+        t0.start(); t1.start(); t0.join(); t1.join()
+        g0, g1 = [], []
+        while sum(len(g) for g in g0) < len(names):
+            g0.extend(g["names"] for g in c0.fetch(wait_s=2.0).groups)
+        while sum(len(g) for g in g1) < len(names):
+            g1.extend(g["names"] for g in c1.fetch(wait_s=2.0).groups)
+        assert g0 == g1
+        assert sorted(n for g in g0 for n in g) == sorted(names)
