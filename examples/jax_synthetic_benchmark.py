@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Synthetic throughput benchmark, model-selectable — the TPU-native
+equivalent of examples/tensorflow_synthetic_benchmark.py (120 LoC:
+Keras-applications model on random data, 10 warmup batches, 10x10 timed
+batches, img/sec mean +- 1.96 sigma).
+
+    python examples/jax_synthetic_benchmark.py --model ResNet50
+    python examples/jax_synthetic_benchmark.py --model VGG16 --batch-size 32
+"""
+
+import argparse
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path[:0] = [_HERE, os.path.dirname(_HERE)]  # _data + repo root (uninstalled runs)
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu import models as zoo
+
+from _data import synthetic_imagenet  # noqa: E402
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="ResNet50",
+                   choices=["ResNet50", "ResNet101", "ResNet152",
+                            "VGG16", "VGG19", "InceptionV3"])
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-warmup-batches", type=int, default=10)
+    p.add_argument("--num-batches-per-iter", type=int, default=10)
+    p.add_argument("--num-iters", type=int, default=10)
+    p.add_argument("--image-size", type=int, default=None)
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    hvd.init()
+    n = hvd.size()
+    mesh = hvd.mesh()
+    image_size = args.image_size or (299 if args.model == "InceptionV3"
+                                     else 224)
+
+    model = getattr(zoo, args.model)(num_classes=1000)
+    batch = args.batch_size * n
+    images_np, labels_np = synthetic_imagenet(batch, image_size)
+    rng = jax.random.PRNGKey(0)
+    variables = model.init({"params": rng, "dropout": rng},
+                           jnp.asarray(images_np[:2]), train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+
+    params = hvd.broadcast_parameters(params, root_rank=0)
+    opt = hvd.DistributedGradientTransformation(
+        optax.sgd(0.01 * n, momentum=0.9))
+    opt_state = opt.init(params)
+
+    images = jnp.asarray(images_np)
+    labels = jnp.asarray(labels_np)
+    if n > 1:
+        images = jax.device_put(images, NamedSharding(mesh, P("dp")))
+        labels = jax.device_put(labels, NamedSharding(mesh, P("dp")))
+
+    has_bn = bool(batch_stats)
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state, x, y, r):
+        def loss_fn(p):
+            var = {"params": p}
+            if has_bn:
+                var["batch_stats"] = batch_stats
+                logits, new = model.apply(var, x, train=True,
+                                          rngs={"dropout": r},
+                                          mutable=["batch_stats"])
+                return (optax.softmax_cross_entropy_with_integer_labels(
+                    logits, y).mean(), new["batch_stats"])
+            logits = model.apply(var, x, train=True, rngs={"dropout": r})
+            return (optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean(), batch_stats)
+
+        (loss, new_bs), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, new_opt = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_bs, new_opt, loss
+
+    def run(k):
+        nonlocal params, batch_stats, opt_state
+        for i in range(k):
+            params, batch_stats, opt_state, loss = train_step(
+                params, batch_stats, opt_state, images, labels,
+                jax.random.fold_in(rng, i))
+        jax.block_until_ready((params, opt_state))
+
+    if hvd.rank() == 0:
+        print(f"Model: {args.model}, batch {args.batch_size}/chip x "
+              f"{n} chips")
+    run(args.num_warmup_batches)  # warmup (reference :88-92)
+
+    img_secs = []
+    for i in range(args.num_iters):
+        t0 = time.perf_counter()
+        run(args.num_batches_per_iter)
+        dt = time.perf_counter() - t0
+        rate = batch * args.num_batches_per_iter / dt
+        if hvd.rank() == 0:
+            print(f"Iter #{i}: {rate:.1f} img/sec total")
+        img_secs.append(rate)
+
+    if hvd.rank() == 0:
+        mean, conf = np.mean(img_secs), 1.96 * np.std(img_secs)
+        print(f"Img/sec total: {mean:.1f} +- {conf:.1f}  "
+              f"({mean / n:.1f}/chip on {n} chips)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Keras ResNet-50 ImageNet-style training — the TPU-native equivalent of
+examples/keras_imagenet_resnet50.py (179 LoC): warmup callback + staged
+LR schedule (30/60/80 epoch decay), metric averaging, rank-0 checkpoints.
+
+Uses synthetic ImageNet-shaped data (no egress); swap in a real input
+pipeline for production runs.
+"""
+
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path[:0] = [_HERE, os.path.dirname(_HERE)]  # _data + repo root (uninstalled runs)
+
+os.environ.setdefault("KERAS_BACKEND", "torch")
+
+import keras  # noqa: E402
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+import horovod_tpu.keras as hvd_keras  # noqa: E402
+import horovod_tpu.keras.callbacks as hvd_callbacks  # noqa: E402
+
+from _data import synthetic_imagenet  # noqa: E402
+
+EPOCHS = int(os.environ.get("EPOCHS", 2))
+BATCH = int(os.environ.get("BATCH", 8))
+IMAGE = int(os.environ.get("IMAGE", 64))  # 224 for the real benchmark
+CLASSES = 100
+
+
+def main():
+    hvd.init()
+
+    x, y = synthetic_imagenet(BATCH * 8, IMAGE, CLASSES,
+                              seed=hvd.rank())
+
+    model = keras.applications.ResNet50(weights=None, classes=CLASSES,
+                                        input_shape=(IMAGE, IMAGE, 3))
+
+    # Reference schedule: LR = 0.0125 * size, staged decay at 30/60/80.
+    base_lr = 0.0125 * hvd.size()
+    opt = hvd_keras.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=base_lr, momentum=0.9))
+    model.compile(optimizer=opt,
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], jit_compile=False)
+
+    callbacks = [
+        hvd_callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd_callbacks.MetricAverageCallback(),
+        hvd_callbacks.LearningRateWarmupCallback(
+            warmup_epochs=1, verbose=int(hvd.rank() == 0)),
+        # Staged decay: x1 until 30, x0.1 until 60, x0.01 until 80, x0.001.
+        hvd_callbacks.LearningRateScheduleCallback(
+            1.0, start_epoch=1, end_epoch=30),
+        hvd_callbacks.LearningRateScheduleCallback(
+            1e-1, start_epoch=30, end_epoch=60),
+        hvd_callbacks.LearningRateScheduleCallback(
+            1e-2, start_epoch=60, end_epoch=80),
+        hvd_callbacks.LearningRateScheduleCallback(1e-3, start_epoch=80),
+    ]
+    if hvd.rank() == 0:
+        os.makedirs("/tmp/hvd_tpu_keras_resnet", exist_ok=True)
+        callbacks.append(keras.callbacks.ModelCheckpoint(
+            "/tmp/hvd_tpu_keras_resnet/ckpt-{epoch}.weights.h5",
+            save_weights_only=True))
+
+    model.fit(x, y, batch_size=BATCH, epochs=EPOCHS, callbacks=callbacks,
+              verbose=1 if hvd.rank() == 0 else 0)
+
+
+if __name__ == "__main__":
+    main()

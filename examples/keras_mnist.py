@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Keras MNIST with the Horovod pattern — the TPU-native equivalent of
+examples/keras_mnist.py: DistributedOptimizer + broadcast callback +
+rank-0-only checkpointing, on Keras 3.
+
+    KERAS_BACKEND=torch python examples/keras_mnist.py
+"""
+
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path[:0] = [_HERE, os.path.dirname(_HERE)]  # _data + repo root (uninstalled runs)
+
+os.environ.setdefault("KERAS_BACKEND", "torch")
+
+import keras  # noqa: E402
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+import horovod_tpu.keras as hvd_keras  # noqa: E402
+import horovod_tpu.keras.callbacks as hvd_callbacks  # noqa: E402
+
+from _data import synthetic_mnist, shard_for_rank  # noqa: E402
+
+EPOCHS = int(os.environ.get("EPOCHS", 2))
+
+
+def main():
+    hvd.init()
+
+    images, labels = synthetic_mnist()
+    (x_train, y_train) = shard_for_rank((images, labels),
+                                        hvd.rank(), hvd.size())
+
+    model = keras.Sequential([
+        keras.layers.Input((28, 28, 1)),
+        keras.layers.Conv2D(32, (5, 5), activation="relu"),
+        keras.layers.MaxPooling2D((2, 2)),
+        keras.layers.Conv2D(64, (5, 5), activation="relu"),
+        keras.layers.MaxPooling2D((2, 2)),
+        keras.layers.Flatten(),
+        keras.layers.Dense(128, activation="relu"),
+        keras.layers.Dropout(0.5),
+        keras.layers.Dense(10, activation="softmax"),
+    ])
+
+    # LR scaled by world size; optimizer wrapped so grads are averaged.
+    opt = hvd_keras.DistributedOptimizer(
+        keras.optimizers.Adadelta(learning_rate=1.0 * hvd.size()))
+    model.compile(optimizer=opt,
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], jit_compile=False)
+
+    callbacks = [
+        # Sync initial weights from rank 0 (keras_mnist.py callback list).
+        hvd_callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd_callbacks.MetricAverageCallback(),
+    ]
+    # Checkpoint on rank 0 only.
+    if hvd.rank() == 0:
+        os.makedirs("/tmp/hvd_tpu_keras_mnist", exist_ok=True)
+        callbacks.append(keras.callbacks.ModelCheckpoint(
+            "/tmp/hvd_tpu_keras_mnist/checkpoint.weights.h5",
+            save_weights_only=True))
+
+    model.fit(x_train, y_train, batch_size=64, epochs=EPOCHS,
+              callbacks=callbacks, verbose=1 if hvd.rank() == 0 else 0)
+
+    score = model.evaluate(x_train[:512], y_train[:512], verbose=0)
+    if hvd.rank() == 0:
+        print(f"loss {score[0]:.4f}  accuracy {score[1]:.4f}")
+
+
+if __name__ == "__main__":
+    main()

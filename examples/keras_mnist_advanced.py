@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Advanced Keras MNIST — the TPU-native equivalent of
+examples/keras_mnist_advanced.py (127 LoC): LR warmup over the first
+epochs, metric averaging across ranks, and epoch-scaled training.
+
+Demonstrates the full callback suite:
+  - BroadcastGlobalVariablesCallback: weight sync at train start
+  - LearningRateWarmupCallback: gradual 1/N -> 1 ramp of the scaled LR
+  - MetricAverageCallback: epoch metrics averaged over ranks
+"""
+
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path[:0] = [_HERE, os.path.dirname(_HERE)]  # _data + repo root (uninstalled runs)
+
+os.environ.setdefault("KERAS_BACKEND", "torch")
+
+import keras  # noqa: E402
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+import horovod_tpu.keras as hvd_keras  # noqa: E402
+import horovod_tpu.keras.callbacks as hvd_callbacks  # noqa: E402
+
+from _data import synthetic_mnist, shard_for_rank  # noqa: E402
+
+EPOCHS = int(os.environ.get("EPOCHS", 4))
+WARMUP_EPOCHS = 2
+
+
+def main():
+    hvd.init()
+
+    images, labels = synthetic_mnist(n=8192)
+    x_train, y_train = shard_for_rank((images, labels),
+                                      hvd.rank(), hvd.size())
+
+    model = keras.Sequential([
+        keras.layers.Input((28, 28, 1)),
+        keras.layers.Conv2D(32, (3, 3), activation="relu"),
+        keras.layers.Conv2D(64, (3, 3), activation="relu"),
+        keras.layers.MaxPooling2D((2, 2)),
+        keras.layers.Dropout(0.25),
+        keras.layers.Flatten(),
+        keras.layers.Dense(128, activation="relu"),
+        keras.layers.Dropout(0.5),
+        keras.layers.Dense(10, activation="softmax"),
+    ])
+
+    opt = hvd_keras.DistributedOptimizer(
+        keras.optimizers.Adam(learning_rate=1e-3 * hvd.size()))
+    model.compile(optimizer=opt,
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], jit_compile=False)
+
+    callbacks = [
+        hvd_callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd_callbacks.MetricAverageCallback(),
+        # Scale-up warmup: LR ramps from lr/N to lr over WARMUP_EPOCHS
+        # (keras_mnist_advanced.py + _keras/callbacks.py:149-168).
+        hvd_callbacks.LearningRateWarmupCallback(
+            warmup_epochs=WARMUP_EPOCHS, verbose=hvd.rank() == 0),
+    ]
+
+    model.fit(x_train, y_train, batch_size=128, epochs=EPOCHS,
+              callbacks=callbacks, verbose=1 if hvd.rank() == 0 else 0)
+
+    score = model.evaluate(x_train[:512], y_train[:512], verbose=0)
+    if hvd.rank() == 0:
+        print(f"loss {score[0]:.4f}  accuracy {score[1]:.4f}")
+
+
+if __name__ == "__main__":
+    main()

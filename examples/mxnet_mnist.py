@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""MXNet-style MNIST through the mxnet shim — the TPU-native equivalent
+of examples/mxnet_mnist.py (142 LoC): DistributedOptimizer wrapping the
+base optimizer's update(), broadcast_parameters before training.
+
+Runs against real MXNet when installed; otherwise against the bundled
+NDArray protocol (a simple linear model trained with manual gradients, so
+the example stays runnable without the MXNet engine).
+"""
+
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path[:0] = [_HERE, os.path.dirname(_HERE)]  # _data + repo root (uninstalled runs)
+
+import numpy as np
+
+import horovod_tpu.mxnet as hvd
+from horovod_tpu.mxnet import nd
+
+from _data import synthetic_mnist, shard_for_rank  # noqa: E402
+
+BATCH = 64
+EPOCHS = int(os.environ.get("EPOCHS", 2))
+
+
+class SGD:
+    """mx.optimizer.SGD-shaped stub used when MXNet is absent."""
+
+    def __init__(self, learning_rate=0.05):
+        self.learning_rate = learning_rate
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        if isinstance(index, (tuple, list)):
+            for w, g in zip(weight, grad):
+                w[:] = w.asnumpy() - self.learning_rate * g.asnumpy()
+        else:
+            weight[:] = (weight.asnumpy()
+                         - self.learning_rate * grad.asnumpy())
+
+    def set_learning_rate(self, lr):
+        self.learning_rate = lr
+
+
+def softmax_xent_grads(W, b, x, y):
+    """Loss + gradients of a linear softmax classifier, by hand — the
+    NDArray-protocol path has no autograd engine."""
+    logits = x @ W.asnumpy() + b.asnumpy()
+    logits -= logits.max(axis=1, keepdims=True)
+    p = np.exp(logits)
+    p /= p.sum(axis=1, keepdims=True)
+    n = x.shape[0]
+    loss = -np.log(p[np.arange(n), y] + 1e-9).mean()
+    dlogits = p
+    dlogits[np.arange(n), y] -= 1.0
+    dlogits /= n
+    return loss, nd.array(x.T @ dlogits), nd.array(dlogits.sum(axis=0))
+
+
+def main():
+    hvd.init()
+
+    images, labels = synthetic_mnist()
+    images, labels = shard_for_rank((images, labels),
+                                    hvd.rank(), hvd.size())
+    x_all = images.reshape(images.shape[0], -1)
+
+    rng = np.random.RandomState(0)
+    params = {"weight": nd.array(rng.randn(784, 10) * 0.01,
+                                 dtype=np.float32),
+              "bias": nd.array(np.zeros(10), dtype=np.float32)}
+
+    # Sync initial params from rank 0 (reference :108-112).
+    hvd.broadcast_parameters(params, root_rank=0)
+
+    # Wrap the optimizer: update() allreduces grads first (reference :100).
+    opt = hvd.DistributedOptimizer(SGD(learning_rate=0.05 * hvd.size()))
+
+    n = x_all.shape[0]
+    step = 0
+    for epoch in range(EPOCHS):
+        for i in range(0, n - BATCH + 1, BATCH):
+            x, y = x_all[i:i + BATCH], labels[i:i + BATCH]
+            loss, gw, gb = softmax_xent_grads(params["weight"],
+                                              params["bias"], x, y)
+            opt.update([2 * step, 2 * step + 1],
+                       [params["weight"], params["bias"]], [gw, gb],
+                       [None, None])
+            step += 1
+        logits = x_all @ params["weight"].asnumpy() + params["bias"].asnumpy()
+        acc = float((logits.argmax(1) == labels).mean())
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss {loss:.4f} acc {acc:.4f}")
+
+
+if __name__ == "__main__":
+    main()

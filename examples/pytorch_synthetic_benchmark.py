@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""PyTorch synthetic throughput benchmark through the torch shim — the
+TPU-native equivalent of examples/pytorch_synthetic_benchmark.py (~100
+LoC): torchvision model on random data, warmup then timed iterations,
+img/sec mean +- 1.96 sigma.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path[:0] = [_HERE, os.path.dirname(_HERE)]  # repo root (uninstalled runs)
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+import torch.utils.data
+
+import horovod_tpu.torch as hvd
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet18")
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--num-warmup-batches", type=int, default=2)
+    p.add_argument("--num-batches-per-iter", type=int, default=3)
+    p.add_argument("--num-iters", type=int, default=3)
+    p.add_argument("--image-size", type=int, default=64)
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    hvd.init()
+
+    import torchvision.models as tvm
+    model = getattr(tvm, args.model)(num_classes=100)
+
+    opt = torch.optim.SGD(model.parameters(), lr=0.01 * hvd.size())
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+
+    data = torch.randn(args.batch_size, 3, args.image_size, args.image_size)
+    target = torch.randint(0, 100, (args.batch_size,))
+
+    def benchmark_step():
+        opt.zero_grad()
+        loss = F.cross_entropy(model(data), target)
+        loss.backward()
+        opt.step()
+
+    if hvd.rank() == 0:
+        print(f"Model: {args.model}, batch {args.batch_size}/proc x "
+              f"{hvd.size()} procs")
+    for _ in range(args.num_warmup_batches):
+        benchmark_step()
+
+    img_secs = []
+    for i in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            benchmark_step()
+        dt = time.perf_counter() - t0
+        rate = args.batch_size * args.num_batches_per_iter / dt
+        if hvd.rank() == 0:
+            print(f"Iter #{i}: {rate:.1f} img/sec per proc")
+        img_secs.append(rate)
+
+    if hvd.rank() == 0:
+        mean, conf = np.mean(img_secs), 1.96 * np.std(img_secs)
+        print(f"Img/sec per proc: {mean:.1f} +- {conf:.1f}")
+        print(f"Total img/sec on {hvd.size()} proc(s): "
+              f"{hvd.size() * mean:.1f} +- {hvd.size() * conf:.1f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""TensorFlow MNIST through the TF shim — the TPU-native equivalent of
+examples/tensorflow_mnist.py + tensorflow_mnist_estimator.py (graph-mode
+training with DistributedOptimizer, broadcast at start, rank-0-only
+checkpointing).
+
+TF computes the model; the collectives ride the XLA data plane through
+py_function hooks (graph-safe).
+"""
+
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path[:0] = [_HERE, os.path.dirname(_HERE)]  # _data + repo root (uninstalled runs)
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+from _data import synthetic_mnist, shard_for_rank  # noqa: E402
+
+BATCH = 64
+STEPS = int(os.environ.get("STEPS", 100))
+CKPT = os.environ.get("CKPT_DIR", "/tmp/hvd_tpu_tf_mnist")
+
+
+def conv_model(feature, target):
+    """The reference's conv_model (tensorflow_mnist.py:37-64)."""
+    feature = tf.reshape(feature, [-1, 28, 28, 1])
+    h = tf.keras.layers.Conv2D(32, 5, padding="same",
+                               activation="relu")(feature)
+    h = tf.keras.layers.MaxPooling2D(2)(h)
+    h = tf.keras.layers.Conv2D(64, 5, padding="same", activation="relu")(h)
+    h = tf.keras.layers.MaxPooling2D(2)(h)
+    h = tf.keras.layers.Flatten()(h)
+    h = tf.keras.layers.Dense(1024, activation="relu")(h)
+    logits = tf.keras.layers.Dense(10)(h)
+    loss = tf.reduce_mean(
+        tf.nn.sparse_softmax_cross_entropy_with_logits(
+            labels=target, logits=logits))
+    return logits, loss
+
+
+def main():
+    hvd.init()
+
+    images, labels = synthetic_mnist()
+    images, labels = shard_for_rank((images, labels),
+                                    hvd.rank(), hvd.size())
+    images = images.reshape(-1, 784)
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Reshape((28, 28, 1), input_shape=(784,)),
+        tf.keras.layers.Conv2D(32, 5, padding="same", activation="relu"),
+        tf.keras.layers.MaxPooling2D(2),
+        tf.keras.layers.Conv2D(64, 5, padding="same", activation="relu"),
+        tf.keras.layers.MaxPooling2D(2),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(1024, activation="relu"),
+        tf.keras.layers.Dense(10),
+    ])
+    model.build((None, 784))
+
+    # LR scaled by size; optimizer wrapped (reference :103-108).
+    opt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(0.01 * hvd.size()))
+
+    loss_obj = tf.keras.losses.SparseCategoricalCrossentropy(
+        from_logits=True)
+
+    @tf.function
+    def train_step(x, y):
+        with tf.GradientTape() as tape:
+            loss = loss_obj(y, model(x, training=True))
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        return loss
+
+    # Broadcast initial variables from rank 0 (the hook's job,
+    # tensorflow/__init__.py:117-148).
+    hvd.broadcast_variables(model.variables, root_rank=0)
+
+    n = images.shape[0]
+    for step in range(STEPS):
+        i = (step * BATCH) % (n - BATCH)
+        loss = train_step(tf.constant(images[i:i + BATCH]),
+                          tf.constant(labels[i:i + BATCH]))
+        if step % 20 == 0 and hvd.rank() == 0:
+            print(f"step {step:4d}  loss {float(loss):.4f}")
+
+    # Checkpoint on rank 0 only (reference: checkpoint_dir gated on rank).
+    if hvd.rank() == 0:
+        os.makedirs(CKPT, exist_ok=True)
+        model.save_weights(os.path.join(CKPT, "model.weights.h5"))
+        print(f"checkpoint written to {CKPT}")
+
+
+if __name__ == "__main__":
+    main()
