@@ -69,10 +69,11 @@ def main():
         return optax.apply_updates(params, updates), opt_state, loss
 
     n = images.shape[0]
+    batch = min(BATCH, n)
     for step in range(STEPS):
-        i = (step * BATCH) % (n - BATCH)
-        x = jnp.asarray(images[i:i + BATCH])
-        y = jnp.asarray(labels[i:i + BATCH])
+        i = (step * batch) % (n - batch + 1)
+        x = jnp.asarray(images[i:i + batch])
+        y = jnp.asarray(labels[i:i + batch])
         params, opt_state, loss = train_step(
             params, opt_state, x, y, jax.random.fold_in(rng, step))
         if step % 10 == 0 and hvd.rank() == 0:

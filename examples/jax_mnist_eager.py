@@ -49,10 +49,11 @@ def main():
             y).mean()))
 
     n = images.shape[0]
+    batch = min(BATCH, n)
     for step in range(STEPS):
-        i = (step * BATCH) % (n - BATCH)
-        x = jnp.asarray(images[i:i + BATCH])
-        y = jnp.asarray(labels[i:i + BATCH])
+        i = (step * batch) % (n - batch + 1)
+        x = jnp.asarray(images[i:i + batch])
+        y = jnp.asarray(labels[i:i + batch])
         loss, grads = grad_fn(params, x, y, jax.random.fold_in(rng, step))
 
         # Eager per-gradient async allreduce: enqueue all, then sync —

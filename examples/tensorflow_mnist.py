@@ -26,23 +26,6 @@ STEPS = int(os.environ.get("STEPS", 100))
 CKPT = os.environ.get("CKPT_DIR", "/tmp/hvd_tpu_tf_mnist")
 
 
-def conv_model(feature, target):
-    """The reference's conv_model (tensorflow_mnist.py:37-64)."""
-    feature = tf.reshape(feature, [-1, 28, 28, 1])
-    h = tf.keras.layers.Conv2D(32, 5, padding="same",
-                               activation="relu")(feature)
-    h = tf.keras.layers.MaxPooling2D(2)(h)
-    h = tf.keras.layers.Conv2D(64, 5, padding="same", activation="relu")(h)
-    h = tf.keras.layers.MaxPooling2D(2)(h)
-    h = tf.keras.layers.Flatten()(h)
-    h = tf.keras.layers.Dense(1024, activation="relu")(h)
-    logits = tf.keras.layers.Dense(10)(h)
-    loss = tf.reduce_mean(
-        tf.nn.sparse_softmax_cross_entropy_with_logits(
-            labels=target, logits=logits))
-    return logits, loss
-
-
 def main():
     hvd.init()
 
@@ -83,10 +66,11 @@ def main():
     hvd.broadcast_variables(model.variables, root_rank=0)
 
     n = images.shape[0]
+    batch = min(BATCH, n)
     for step in range(STEPS):
-        i = (step * BATCH) % (n - BATCH)
-        loss = train_step(tf.constant(images[i:i + BATCH]),
-                          tf.constant(labels[i:i + BATCH]))
+        i = (step * batch) % (n - batch + 1)
+        loss = train_step(tf.constant(images[i:i + batch]),
+                          tf.constant(labels[i:i + batch]))
         if step % 20 == 0 and hvd.rank() == 0:
             print(f"step {step:4d}  loss {float(loss):.4f}")
 

@@ -41,10 +41,11 @@ def main():
         from_logits=True)
 
     n = images.shape[0]
+    batch = min(BATCH, n)
     for step in range(STEPS):
-        i = (step * BATCH) % (n - BATCH)
-        x = tf.constant(images[i:i + BATCH])
-        y = tf.constant(labels[i:i + BATCH])
+        i = (step * batch) % (n - batch + 1)
+        x = tf.constant(images[i:i + batch])
+        y = tf.constant(labels[i:i + batch])
         # DistributedGradientTape allreduces in gradient() (reference
         # :78-90).
         with hvd.DistributedGradientTape() as tape:

@@ -46,6 +46,13 @@ class TransformerConfig:
     tp_axis: Optional[str] = None
     sp_axis: Optional[str] = None
     ep_axis: Optional[str] = None
+    # sequence-parallel attention: 'ring' (K/V ppermute ring, any head
+    # count) or 'ulysses' (two all_to_alls, heads % sp_size == 0)
+    sp_impl: str = "ring"
+    # single-shard attention via the Pallas flash kernel
+    # (ops/flash_attention.py) instead of XLA full attention; wins from
+    # ~4k sequence where the [S, S] score matrix stops fitting on chip
+    use_flash: bool = False
     # MoE: when set, every other block's MLP is a top-1 MoE
     num_experts: int = 0
     capacity_factor: float = 2.0
@@ -56,6 +63,10 @@ class TransformerConfig:
             raise ValueError(
                 "num_experts > 0 requires ep_axis (the expert-parallel mesh "
                 "axis the MoE all_to_all routes over)")
+        if self.sp_impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"sp_impl must be 'ring' or 'ulysses', got "
+                f"{self.sp_impl!r}")
 
 
 def _axis_size(axis: Optional[str]) -> int:
@@ -158,8 +169,20 @@ def _block(params, x, cfg: TransformerConfig, layer_idx: int):
     k = (y @ params["wk"].astype(dt)).reshape(b, s, h_local, hd)
     v = (y @ params["wv"].astype(dt)).reshape(b, s, h_local, hd)
 
-    if cfg.sp_axis:
+    import jax as _jax
+    flash_interp = _jax.default_backend() != "tpu"  # interpret off-TPU
+    if cfg.sp_axis and cfg.sp_impl == "ulysses":
+        from ..parallel.ulysses import ulysses_attention
+        attn = ulysses_attention(q, k, v, axis_name=cfg.sp_axis,
+                                 causal=True, use_flash=cfg.use_flash,
+                                 flash_interpret=flash_interp)
+    elif cfg.sp_axis:
+        # Ring attention is already blockwise-O(S/n); use_flash does not
+        # apply to its inner per-block matmuls.
         attn = ring_attention(q, k, v, axis_name=cfg.sp_axis, causal=True)
+    elif cfg.use_flash:
+        from ..ops.flash_attention import flash_attention
+        attn = flash_attention(q, k, v, True, None, 128, 128, flash_interp)
     else:
         attn = full_attention(q, k, v, causal=True)
     attn = attn.reshape(b, s, h_local * hd)

@@ -1,0 +1,358 @@
+"""Pallas TPU flash attention — the hot-op kernel for the flagship model.
+
+No reference equivalent (the reference ships no model/attention code at
+all — SURVEY.md §5.7); this is the TPU-native kernel for the attention
+the transformer (models/transformer.py) runs, written per the Pallas TPU
+playbook: blockwise online softmax so the [S, S] score matrix never
+materializes in HBM, fp32 accumulation on the MXU, static shapes, grid
+iterated sequentially so the running (m, l, acc) statistics live in VMEM
+scratch across k-blocks (FlashAttention-2 schedule).
+
+Forward saves the per-row logsumexp; backward recomputes block scores
+(the rematerialization trade: O(S) memory instead of O(S^2), extra FLOPs
+the MXU has to spare) in two passes — one accumulating dK/dV per
+key-block, one accumulating dQ per query-block.
+
+Layout matches the rest of the stack: [batch, seq, heads, head_dim],
+internally reshaped to [batch*heads, seq, head_dim]. ``interpret=True``
+runs the same kernels through the Pallas interpreter — used by the CPU
+test mesh; on TPU they compile to Mosaic.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() exact zero
+#                   without nan from (-inf) - (-inf) in masked-out rows
+
+
+def _row_ok(start_block: int, block: int, limit: int):
+    """[block, 1] validity mask for rows of a cdiv-padded block. Padded
+    rows read uninitialized (NaN in interpret mode) memory; every load is
+    masked with where() because 0 * NaN still poisons matmul accumulations."""
+    rows = start_block * block + jax.lax.broadcasted_iota(
+        jnp.int32, (block, 1), 0)
+    return rows < limit
+
+
+def _masked_scores(q, k, qi, kj, *, scale, causal, block_q, block_k,
+                   seq_q, seq_k):
+    """Scaled q k^T block scores with the bounds+causal mask applied.
+
+    Shared by the forward and both backward kernels so a mask change
+    (sliding window, segment ids, ...) cannot desynchronize them.
+    Returns (scores, valid)."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale       # [BQ, BK]
+    q_pos = (qi * block_q
+             + jax.lax.broadcasted_iota(jnp.int32,
+                                        (block_q, block_k), 0))
+    k_pos = (kj * block_k
+             + jax.lax.broadcasted_iota(jnp.int32,
+                                        (block_q, block_k), 1))
+    # Bounds mask handles block-padded tails (grid is cdiv-rounded);
+    # the causal mask stacks on top.
+    valid = (q_pos < seq_q) & (k_pos < seq_k)
+    if causal:
+        valid = valid & (q_pos >= k_pos)
+    return jnp.where(valid, s, _NEG_INF), valid
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc,
+                *, scale: float, causal: bool, block_q: int, block_k: int,
+                n_k: int, seq_q: int, seq_k: int):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    def _update():
+        q_ok = _row_ok(qi, block_q, seq_q)
+        k_ok = _row_ok(kj, block_k, seq_k)
+        q = jnp.where(q_ok, q_ref[0], 0)   # [BQ, D]
+        k = jnp.where(k_ok, k_ref[0], 0)   # [BK, D]
+        v = jnp.where(k_ok, v_ref[0], 0)
+        s, valid = _masked_scores(
+            q, k, qi, kj, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, seq_q=seq_q, seq_k=seq_k)
+
+        m_prev = m_sc[:, 0]                                # [BQ]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.where(valid, jnp.exp(s - m_new[:, None]), 0.0)  # [BQ, BK]
+        l_sc[:, 0] = l_sc[:, 0] * corr + p.sum(axis=-1)
+        acc_sc[:] = (acc_sc[:] * corr[:, None]
+                     + jax.lax.dot_general(
+                         p.astype(v.dtype), v,
+                         (((1,), (0,)), ((), ())),
+                         preferred_element_type=jnp.float32))
+        m_sc[:, 0] = m_new
+
+    if causal:
+        # Blocks fully above the diagonal contribute nothing.
+        @pl.when(kj * block_k <= (qi + 1) * block_q - 1)
+        def _():
+            _update()
+    else:
+        _update()
+
+    @pl.when(kj == n_k - 1)
+    def _finalize():
+        l = jnp.maximum(l_sc[:, 0], 1e-30)
+        o_ref[0] = (acc_sc[:] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = (m_sc[:, 0] + jnp.log(l))[:, None]
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_sc, dv_sc,
+                *, scale: float, causal: bool, block_q: int, block_k: int,
+                n_q: int, seq_q: int, seq_k: int):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    def _update():
+        q_ok = _row_ok(qi, block_q, seq_q)
+        k_ok = _row_ok(kj, block_k, seq_k)
+        q = jnp.where(q_ok, q_ref[0], 0)   # [BQ, D]
+        k = jnp.where(k_ok, k_ref[0], 0)   # [BK, D]
+        v = jnp.where(k_ok, v_ref[0].astype(jnp.float32), 0)
+        do = jnp.where(q_ok, do_ref[0].astype(jnp.float32), 0)
+        lse = jnp.where(q_ok, lse_ref[0], 0)
+        delta = jnp.where(q_ok, delta_ref[0], 0)
+        s, valid = _masked_scores(
+            q, k, qi, kj, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, seq_q=seq_q, seq_k=seq_k)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)       # [BQ, BK]
+        # dV += P^T dO
+        dv_sc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dS = P * (dO V^T - delta)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [BQ, BK]
+        ds = jnp.where(valid, p * (dp - delta), 0.0)
+        # dK += dS^T Q * scale
+        dk_sc[:] += jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        @pl.when((qi + 1) * block_q - 1 >= kj * block_k)
+        def _():
+            _update()
+    else:
+        _update()
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_sc, *, scale: float, causal: bool, block_q: int,
+               block_k: int, n_k: int, seq_q: int, seq_k: int):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_sc[:] = jnp.zeros_like(dq_sc)
+
+    def _update():
+        q_ok = _row_ok(qi, block_q, seq_q)
+        k_ok = _row_ok(kj, block_k, seq_k)
+        q = jnp.where(q_ok, q_ref[0], 0)
+        k = jnp.where(k_ok, k_ref[0], 0)
+        v = jnp.where(k_ok, v_ref[0].astype(jnp.float32), 0)
+        do = jnp.where(q_ok, do_ref[0].astype(jnp.float32), 0)
+        lse = jnp.where(q_ok, lse_ref[0], 0)
+        delta = jnp.where(q_ok, delta_ref[0], 0)
+        s, valid = _masked_scores(
+            q, k, qi, kj, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, seq_q=seq_q, seq_k=seq_k)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = jnp.where(valid, p * (dp - delta), 0.0)
+        dq_sc[:] += jax.lax.dot_general(
+            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        @pl.when(kj * block_k <= (qi + 1) * block_q - 1)
+        def _():
+            _update()
+    else:
+        _update()
+
+    @pl.when(kj == n_k - 1)
+    def _finalize():
+        dq_ref[0] = dq_sc[:].astype(dq_ref.dtype)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    bh, s, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, s)
+    block_k = min(block_k, sk)
+    n_q = pl.cdiv(s, block_q)
+    n_k = pl.cdiv(sk, block_k)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, n_k=n_k, seq_q=s, seq_k=sk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running max m
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running norm l
+            pltpu.VMEM((block_q, d), jnp.float32),   # output accumulator
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal: bool = True,
+                    scale: Optional[float] = None, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """Flash attention over [batch, seq, heads, head_dim] inputs.
+
+    Exact (up to fp) vs full attention; O(seq) memory. ``interpret``
+    routes through the Pallas interpreter (CPU tests); on TPU leave
+    False for the compiled Mosaic kernel.
+    """
+    out, _ = _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k,
+                             interpret)
+    return out
+
+
+def _prep(q, scale):
+    b, s, h, d = q.shape
+    return (scale if scale is not None else d ** -0.5)
+
+
+def _to_bh(x):
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _from_bh(x, b, h):
+    bh, s, d = x.shape
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, s, h, d = q.shape
+    sc = _prep(q, scale)
+    qb, kb, vb = _to_bh(q), _to_bh(k), _to_bh(v)
+    out, lse = _flash_fwd(qb, kb, vb, sc, causal, block_q, block_k,
+                          interpret)
+    out4 = _from_bh(out, b, h)
+    return out4, (q, k, v, out4, lse)
+
+
+def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    b, s, h, d = q.shape
+    sc = _prep(q, scale)
+    qb, kb, vb = _to_bh(q), _to_bh(k), _to_bh(v)
+    ob, gb = _to_bh(out), _to_bh(g)
+    bh = qb.shape[0]
+    sk = kb.shape[1]
+    bq = min(block_q, s)
+    bk = min(block_k, sk)
+    n_q = pl.cdiv(s, bq)
+    n_k = pl.cdiv(sk, bk)
+
+    # delta = rowsum(dO * O) — the softmax-jacobian diagonal term.
+    delta = jnp.sum(gb.astype(jnp.float32) * ob.astype(jnp.float32),
+                    axis=-1, keepdims=True)                   # [bh, s, 1]
+
+    dkv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=sc, causal=causal,
+                          block_q=bq, block_k=bk, n_q=n_q,
+                          seq_q=s, seq_k=sk),
+        grid=(bh, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qb, kb, vb, gb, lse, delta)
+    dk, dv = dkv
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=sc, causal=causal,
+                          block_q=bq, block_k=bk, n_k=n_k,
+                          seq_q=s, seq_k=sk),
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qb, kb, vb, gb, lse, delta)
+
+    return (_from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h))
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

@@ -12,6 +12,8 @@ built on the same mesh-axis collective layer, designed TPU-first:
 - :mod:`tensor_parallel` — column/row-parallel Dense + attention heads
 - :mod:`ring_attention` — sequence/context parallelism for long sequences
   (ppermute ring with online-softmax accumulation)
+- :mod:`ulysses`    — all-to-all sequence parallelism (DeepSpeed-Ulysses:
+  reshard seq->heads, local attention, reshard back)
 - :mod:`pipeline`   — GPipe-style microbatch pipeline over 'pp'
 - :mod:`expert`     — mixture-of-experts dispatch over 'ep' (all_to_all)
 """

@@ -1,0 +1,89 @@
+"""Ulysses attention — all-to-all sequence parallelism.
+
+No reference equivalent (SURVEY.md §5.7: sequence parallelism is
+green-field for the rebuild); this is the DeepSpeed-Ulysses formulation
+(Jacobs et al. 2023), the all-to-all complement to
+:mod:`.ring_attention`:
+
+  - Inputs arrive sequence-sharded over the 'sp' axis: each device holds
+    [B, S/n, H, D] for ALL heads.
+  - An all-to-all reshards to head-sharded [B, S, H/n, D]: each device now
+    holds the FULL sequence for a subset of heads, so plain (flash)
+    attention runs locally with no communication inside the softmax.
+  - A second all-to-all reshards the output back to sequence-sharded.
+
+Communication: 2 all-to-alls of the activations per attention call —
+O(B·S·H·D/n) per device, constant in sequence length per hop, riding the
+ICI all-to-all bandwidth. Ring attention instead sends K/V blocks n times;
+Ulysses wins when head count >= n and the all-to-all fabric is strong
+(TPU ICI is), ring wins for head counts smaller than the shard count.
+
+Constraint: n_heads must be divisible by the 'sp' axis size.
+
+All ops are static-shape einsum/reshape/all_to_all — one fused XLA
+program, MXU-friendly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from .ring_attention import full_attention
+
+
+def _seq_to_heads(x, axis_name: str):
+    """[B, S/n, H, D] sequence-sharded -> [B, S, H/n, D] head-sharded.
+
+    lax.all_to_all splits axis ``split_axis`` across the mesh axis and
+    concatenates received blocks along ``concat_axis``.
+    """
+    # split heads (axis 2) across devices, gather sequence (axis 1)
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def _heads_to_seq(x, axis_name: str):
+    """[B, S, H/n, D] head-sharded -> [B, S/n, H, D] sequence-sharded."""
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention(q, k, v, *, axis_name: str = "sp",
+                      causal: bool = True,
+                      scale: Optional[float] = None,
+                      use_flash: bool = False,
+                      flash_interpret: bool = False):
+    """Attention over a sequence sharded on ``axis_name`` via two
+    all-to-alls (DeepSpeed-Ulysses).
+
+    Args (per-shard views inside shard_map):
+      q, k, v: [batch, seq_shard, heads, head_dim], heads % axis_size == 0
+      use_flash: run the local (full-sequence) attention through the
+        Pallas flash kernel — O(S) memory instead of the [S, S] score
+        matrix; essential at long global sequence lengths.
+    Returns: [batch, seq_shard, heads, head_dim], exact (up to fp) vs
+    full attention over the global sequence.
+    """
+    n = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % n != 0:
+        raise ValueError(
+            f"Ulysses needs n_heads ({h}) divisible by the '{axis_name}' "
+            f"axis size ({n}); use ring_attention for fewer heads than "
+            "shards")
+    # Reshard: full sequence, subset of heads.
+    q = _seq_to_heads(q, axis_name)
+    k = _seq_to_heads(k, axis_name)
+    v = _seq_to_heads(v, axis_name)
+    # Local attention over the full sequence — no comm inside softmax.
+    if use_flash:
+        from ..ops.flash_attention import flash_attention
+        out = flash_attention(q, k, v, causal, scale, 128, 128,
+                              flash_interpret)
+    else:
+        out = full_attention(q, k, v, causal=causal, scale=scale)
+    # Reshard back: full heads, sequence shard.
+    return _heads_to_seq(out, axis_name)
