@@ -1,0 +1,100 @@
+"""Example-as-smoke-test — the reference CI sed-shrinks and runs its real
+examples under ``mpirun -np 2`` (.travis.yml:113-157). Here each example
+runs as a real subprocess on the virtual CPU mesh with shrunken step
+counts; pass criterion is exit 0 plus the expected progress output.
+
+Marked slow: each example pays interpreter + jax startup (~20-60 s).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+def _run(name, env_extra=None, args=(), timeout=420, devices=8):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={devices}",
+        "STEPS": "8", "EPOCHS": "1",
+    })
+    env.update(env_extra or {})
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, name), *args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=EXAMPLES)
+    assert proc.returncode == 0, (
+        f"{name} failed\nstdout:\n{proc.stdout[-2000:]}\n"
+        f"stderr:\n{proc.stderr[-2000:]}")
+    return proc.stdout
+
+
+class TestExamples:
+    def test_jax_mnist(self):
+        out = _run("jax_mnist.py")
+        assert "loss" in out and "checkpoint written" in out
+
+    def test_jax_mnist_eager(self):
+        # 2 virtual devices: the eager fused collective rendezvous has a
+        # 40 s skew timeout, and 8 conv workloads sharing one CPU core
+        # can exceed it (real meshes have a core per device).
+        out = _run("jax_mnist_eager.py", {"STEPS": "4"}, devices=2)
+        assert "loss" in out
+
+    def test_jax_word2vec(self):
+        out = _run("jax_word2vec.py", {"STEPS": "30"})
+        assert "nce loss" in out and "nearest" in out
+
+    def test_pytorch_mnist(self):
+        out = _run("pytorch_mnist.py")
+        assert "acc" in out
+
+    def test_mxnet_mnist(self):
+        out = _run("mxnet_mnist.py")
+        assert "acc" in out
+
+    def test_mxnet_imagenet_resnet50(self):
+        out = _run("mxnet_imagenet_resnet50.py",
+                   args=("--batch-size", "2", "--image-size", "32"))
+        assert "loss" in out
+
+    def test_pytorch_imagenet_resnet50(self):
+        out = _run("pytorch_imagenet_resnet50.py",
+                   args=("--epochs", "1", "--batch-size", "2",
+                         "--image-size", "32",
+                         "--batches-per-allreduce", "2"))
+        assert "epoch 0" in out
+
+    def test_tensorflow_mnist(self):
+        out = _run("tensorflow_mnist.py")
+        assert "loss" in out and "checkpoint written" in out
+
+    def test_pytorch_synthetic_benchmark(self):
+        out = _run("pytorch_synthetic_benchmark.py",
+                   args=("--model", "resnet18", "--batch-size", "2",
+                         "--image-size", "32", "--num-iters", "1",
+                         "--num-batches-per-iter", "1",
+                         "--num-warmup-batches", "1"))
+        assert "Img/sec" in out
+
+    def test_runner_end_to_end(self):
+        out = _run("runner_end_to_end.py",
+                   {"NP": "2",
+                    "XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
+        assert "rank 0" in out and "rank 1" in out
+        assert "sample predictions" in out
+
+    def test_tensorflow_mnist_eager(self):
+        out = _run("tensorflow_mnist_eager.py")
+        assert "loss" in out
+
+    def test_keras_mnist(self):
+        out = _run("keras_mnist.py", timeout=600)
+        assert "accuracy" in out
