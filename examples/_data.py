@@ -50,6 +50,27 @@ def shard_for_rank(arrays, rank: int, size: int):
     return tuple(a[sl] for a in arrays)
 
 
+def torch_image_model(name: str, num_classes: int = 100):
+    """torchvision model when available (the reference's PyTorch examples
+    use torchvision); otherwise a small in-file conv net so the example
+    still runs — returns (model, actual_name) with the fallback clearly
+    relabeled so its numbers/checkpoints are never mistaken for the
+    requested model's."""
+    try:
+        import torchvision.models as tvm
+        return getattr(tvm, name)(num_classes=num_classes), name
+    except ImportError:
+        import torch.nn as nn
+        model = nn.Sequential(
+            nn.Conv2d(3, 32, 3, stride=2, padding=1), nn.ReLU(),
+            nn.Conv2d(32, 64, 3, stride=2, padding=1), nn.ReLU(),
+            nn.AdaptiveAvgPool2d(1), nn.Flatten(),
+            nn.Linear(64, num_classes))
+        actual = f"tiny-convnet (torchvision missing; NOT {name})"
+        print(f"torchvision not installed: training {actual}")
+        return model, actual
+
+
 def synthetic_imagenet(batch: int, image_size: int = 224, classes: int = 1000,
                        seed: int = 0):
     """Random images/labels for throughput benchmarks (the reference's

@@ -43,18 +43,8 @@ def main():
     hvd.init()
     torch.manual_seed(7)
 
-    try:
-        import torchvision.models as tvm
-        model = tvm.resnet50(num_classes=100)
-    except ImportError:
-        # torchvision-free fallback so the example runs anywhere
-        import torch.nn as nn
-        model = nn.Sequential(
-            nn.Conv2d(3, 64, 7, stride=2, padding=3), nn.ReLU(),
-            nn.Conv2d(64, 128, 3, stride=2, padding=1), nn.ReLU(),
-            nn.Conv2d(128, 256, 3, stride=2, padding=1), nn.ReLU(),
-            nn.AdaptiveAvgPool2d(1), nn.Flatten(),
-            nn.Linear(256, 100))
+    from _data import torch_image_model
+    model, _model_name = torch_image_model("resnet50")
 
     # Accumulation multiplies the effective batch; scale LR accordingly
     # (reference :117-124).

@@ -32,30 +32,12 @@ def parse_args():
     return p.parse_args()
 
 
-def _make_model(name, num_classes=100):
-    """torchvision model when available (the reference benchmarks
-    torchvision resnet50); otherwise a small in-file conv net so the
-    benchmark still runs — relabeled so its numbers are never mistaken
-    for the requested model's. Returns (model, actual_name)."""
-    try:
-        import torchvision.models as tvm
-        return getattr(tvm, name)(num_classes=num_classes), name
-    except ImportError:
-        import torch.nn as nn
-        model = nn.Sequential(
-            nn.Conv2d(3, 32, 3, stride=2, padding=1), nn.ReLU(),
-            nn.Conv2d(32, 64, 3, stride=2, padding=1), nn.ReLU(),
-            nn.AdaptiveAvgPool2d(1), nn.Flatten(),
-            nn.Linear(64, num_classes))
-        return model, f"tiny-convnet (torchvision missing; NOT {name})"
-
-
 def main():
     args = parse_args()
     hvd.init()
 
-    model, model_name = _make_model(args.model)
-    args.model = model_name
+    from _data import torch_image_model
+    model, args.model = torch_image_model(args.model)
 
     opt = torch.optim.SGD(model.parameters(), lr=0.01 * hvd.size())
     opt = hvd.DistributedOptimizer(

@@ -1,0 +1,38 @@
+"""Packaging with a native-core build step.
+
+The reference's setup.py is a 896-line probing build (MPI flags, CUDA/
+NCCL discovery, per-framework extensions, linker version scripts —
+setup.py:294-870). On TPU the data plane is XLA, so the only native
+artifact is the control-plane core, compiled by the same
+``horovod_tpu.runtime.build`` module the lazy in-process loader uses —
+one build recipe, not two.
+
+The build is best-effort at install time: without a toolchain the wheel
+still installs and the runtime rebuilds (or falls back to the Python
+control plane) on first use.
+"""
+
+import sys
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithNativeCore(build_py):
+    def run(self):
+        # Build FIRST: build_py copies package data (which includes the
+        # .so) into build_lib, so the artifact must exist in the source
+        # tree before the copy or the wheel ships stale/missing binaries.
+        try:
+            sys.path.insert(0, ".")
+            from horovod_tpu.runtime.build import build
+            path = build(verbose=True)
+            print(f"built native core: {path}")
+        except Exception as e:  # toolchain-less install stays usable
+            print(f"warning: native core not built ({e}); the runtime "
+                  "will build it on first use or fall back to the "
+                  "Python control plane", file=sys.stderr)
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildWithNativeCore})

@@ -18,6 +18,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLES = os.path.join(REPO, "examples")
 
 
+def _needs(module):
+    """Skip when the example's framework isn't installed — the same
+    importorskip convention the unit suites use (tests/test_keras.py:14).
+    Examples run as subprocesses, so importorskip alone can't gate them."""
+    pytest.importorskip(module)
+
+
 def _run(name, env_extra=None, args=(), timeout=420, devices=8):
     env = dict(os.environ)
     env.update({
@@ -53,6 +60,7 @@ class TestExamples:
         assert "nce loss" in out and "nearest" in out
 
     def test_pytorch_mnist(self):
+        _needs("torch")
         out = _run("pytorch_mnist.py")
         assert "acc" in out
 
@@ -66,6 +74,7 @@ class TestExamples:
         assert "loss" in out
 
     def test_pytorch_imagenet_resnet50(self):
+        _needs("torch")
         out = _run("pytorch_imagenet_resnet50.py",
                    args=("--epochs", "1", "--batch-size", "2",
                          "--image-size", "32",
@@ -73,10 +82,12 @@ class TestExamples:
         assert "epoch 0" in out
 
     def test_tensorflow_mnist(self):
+        _needs("tensorflow")
         out = _run("tensorflow_mnist.py")
         assert "loss" in out and "checkpoint written" in out
 
     def test_pytorch_synthetic_benchmark(self):
+        _needs("torch")
         out = _run("pytorch_synthetic_benchmark.py",
                    args=("--model", "resnet18", "--batch-size", "2",
                          "--image-size", "32", "--num-iters", "1",
@@ -92,9 +103,11 @@ class TestExamples:
         assert "sample predictions" in out
 
     def test_tensorflow_mnist_eager(self):
+        _needs("tensorflow")
         out = _run("tensorflow_mnist_eager.py")
         assert "loss" in out
 
     def test_keras_mnist(self):
+        _needs("keras")
         out = _run("keras_mnist.py", timeout=600)
         assert "accuracy" in out
