@@ -82,7 +82,7 @@ class FetchResponse:
 
 class _Entry:
     __slots__ = ("op_by_rank", "dtype_by_rank", "shape_by_rank",
-                 "root_by_rank", "nbytes", "ranks", "order")
+                 "root_by_rank", "nbytes", "ranks", "order", "first_seen")
 
     def __init__(self, order: int):
         self.op_by_rank: Dict[int, int] = {}
@@ -92,6 +92,7 @@ class _Entry:
         self.nbytes = 0
         self.ranks = set()
         self.order = order
+        self.first_seen = time.monotonic()
 
     @property
     def op(self) -> int:
@@ -125,6 +126,10 @@ class CoordinatorService(BasicService):
         self._acked: Dict[int, int] = {}
         self._order = 0
         self._shutdown = False
+        # Stall reporting (CheckForStalledTensors, operations.cc:1625-1672):
+        # the coordinator alone knows WHICH ranks are missing per tensor.
+        self.stall_warning_s = 60.0
+        self._last_stall_check = time.monotonic()
 
     # ------------------------------------------------------------- protocol
 
@@ -171,7 +176,38 @@ class CoordinatorService(BasicService):
                 self._cv.notify_all()
         return AnnounceResponse()
 
+    def check_stalls(self) -> List[str]:
+        """Warn about tensors announced by only a subset of ranks past the
+        stall window, naming the missing ranks — the reference
+        coordinator's report (operations.cc:1644-1668). Returns the
+        warning lines (also logged) for tests/monitoring."""
+        now = time.monotonic()
+        lines: List[str] = []
+        with self._mu:
+            if (self.stall_warning_s <= 0
+                    or now - self._last_stall_check < self.stall_warning_s):
+                return lines
+            self._last_stall_check = now
+            for name, e in sorted(self._table.items()):
+                if now - e.first_seen > self.stall_warning_s:
+                    missing = sorted(set(range(self._nproc)) - e.ranks)
+                    lines.append(
+                        f"{name} [missing ranks: "
+                        f"{', '.join(map(str, missing))}]")
+        if lines:
+            _log.warning(
+                "One or more tensors were submitted to be reduced, "
+                "gathered or broadcasted by subset of ranks and are "
+                "waiting for the remainder of ranks for more than %d "
+                "seconds. This may indicate that different ranks are "
+                "trying to submit different tensors or that only subset "
+                "of ranks is submitting tensors, which will cause "
+                "deadlock.\nStalled ops:\n%s",
+                int(self.stall_warning_s), "\n".join(lines))
+        return lines
+
     def _fetch(self, req: FetchRequest) -> FetchResponse:
+        self.check_stalls()
         deadline = time.monotonic() + max(0.0, req.wait_s)
         with self._cv:
             self._acked[req.rank] = max(self._acked.get(req.rank, 0),
@@ -334,6 +370,9 @@ def start_coordinator(nproc: int, fusion_threshold: int
     ep = control_endpoint()
     key = control_key() if (ep or os.environ.get(SECRET_ENV)) \
         else make_secret_key()
-    return CoordinatorService(nproc, key,
-                              fusion_threshold=fusion_threshold,
-                              port=ep[1] if ep else 0)
+    svc = CoordinatorService(nproc, key,
+                             fusion_threshold=fusion_threshold,
+                             port=ep[1] if ep else 0)
+    from ..utils import env as _env
+    svc.stall_warning_s = _env.stall_warning_secs()
+    return svc

@@ -12,10 +12,27 @@ still installs and the runtime rebuilds (or falls back to the Python
 control plane) on first use.
 """
 
+import importlib.util
+import os
 import sys
 
 from setuptools import setup
 from setuptools.command.build_py import build_py
+from setuptools.dist import Distribution
+
+
+def _load_native_builder():
+    """Load runtime/build.py directly by path: it is stdlib-only, while
+    importing it as horovod_tpu.runtime.build would execute the package
+    __init__ (which imports jax — absent from PEP 517 isolated build
+    environments)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "horovod_tpu", "runtime", "build.py")
+    spec = importlib.util.spec_from_file_location("_hvdtpu_native_build",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 class BuildWithNativeCore(build_py):
@@ -24,9 +41,8 @@ class BuildWithNativeCore(build_py):
         # .so) into build_lib, so the artifact must exist in the source
         # tree before the copy or the wheel ships stale/missing binaries.
         try:
-            sys.path.insert(0, ".")
-            from horovod_tpu.runtime.build import build
-            path = build(verbose=True)
+            builder = _load_native_builder()
+            path = builder.build(verbose=True)
             print(f"built native core: {path}")
         except Exception as e:  # toolchain-less install stays usable
             print(f"warning: native core not built ({e}); the runtime "
@@ -35,4 +51,15 @@ class BuildWithNativeCore(build_py):
         super().run()
 
 
-setup(cmdclass={"build_py": BuildWithNativeCore})
+class BinaryDistribution(Distribution):
+    """The wheel carries a compiled .so: mark it platform-specific so a
+    linux-x86_64 build is never installed as py3-none-any on another
+    platform (where the runtime would find a wrong-arch binary newer
+    than its sources and refuse to rebuild)."""
+
+    def has_ext_modules(self):
+        return True
+
+
+setup(cmdclass={"build_py": BuildWithNativeCore},
+      distclass=BinaryDistribution)

@@ -139,3 +139,27 @@ class TestNegotiation:
             g1.extend(g["names"] for g in c1.fetch(wait_s=2.0).groups)
         assert g0 == g1
         assert sorted(n for g in g0 for n in g) == sorted(names)
+
+
+class TestStallDetection:
+    def test_missing_ranks_reported(self, svc):
+        """Coordinator names the missing ranks per stalled tensor
+        (operations.cc:1644-1668)."""
+        c0 = _client(svc, 0)
+        c0.announce([_req("stuck.a"), _req("stuck.b")])
+        # Shrink the window and age past it.
+        svc.stall_warning_s = 0.05
+        svc._last_stall_check = 0.0
+        import time as _t
+        _t.sleep(0.1)
+        for e in svc._table.values():
+            e.first_seen -= 1.0
+        lines = svc.check_stalls()
+        assert len(lines) == 2
+        assert "stuck.a [missing ranks: 1]" in lines[0]
+
+    def test_no_report_inside_window(self, svc):
+        c0 = _client(svc, 0)
+        c0.announce([_req("fresh")])
+        svc.stall_warning_s = 60.0
+        assert svc.check_stalls() == []

@@ -138,3 +138,51 @@ class TestMultiProcessCollectives:
         results = run(fn, np=2, extra_env=dict(_ENV), start_timeout=300)
         for r in results:
             assert "Mismatched allreduce tensor shapes" in r
+
+
+class TestMultiDevicePerProcess:
+    def test_two_procs_two_devices_each(self):
+        """2 processes x 2 virtual devices: size == 4 virtual ranks; each
+        device contributes its process's eager value (the virtual-rank
+        semantics extended across hosts), and ragged allgather expands
+        per-process dims by local device count."""
+        env = {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        }
+
+        def worker():
+            import jax.numpy as jnp
+            import numpy as np
+
+            import horovod_tpu as hvd
+
+            hvd.init()
+            pr = hvd.process_rank()
+            out = {"size": hvd.size(), "local_size": hvd.local_size()}
+
+            # Each of this process's 2 devices contributes value pr+1:
+            # sum = 2*(1) + 2*(2) = 6.
+            s = hvd.allreduce(jnp.full((3,), float(pr + 1)),
+                              average=False, name="md.sum")
+            out["sum"] = np.asarray(s).tolist()
+
+            # allgather: one segment per device -> 4 copies, grouped by
+            # process (devices of a process are contiguous ranks).
+            g = hvd.allgather(jnp.full((1, 2), float(pr)), name="md.ag")
+            out["gather"] = np.asarray(g).tolist()
+
+            # ragged: process 0 contributes 1 row/device, process 1 two.
+            rg = hvd.allgather(jnp.full((pr + 1, 2), float(pr)),
+                               name="md.agv")
+            out["ragged_shape"] = list(np.asarray(rg).shape)
+            return out
+
+        results = run(worker, np=2, extra_env=env, start_timeout=300)
+        for r in results:
+            assert r["size"] == 4 and r["local_size"] == 2
+            assert r["sum"] == [6.0] * 3
+            assert r["gather"] == [[0.0, 0.0], [0.0, 0.0],
+                                   [1.0, 1.0], [1.0, 1.0]]
+            assert r["ragged_shape"] == [6, 2]   # 1+1+2+2 rows
+        assert results[0] == results[1]
