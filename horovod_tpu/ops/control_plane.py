@@ -128,7 +128,10 @@ class CoordinatorService(BasicService):
         self._shutdown = False
         # Stall reporting (CheckForStalledTensors, operations.cc:1625-1672):
         # the coordinator alone knows WHICH ranks are missing per tensor.
-        self.stall_warning_s = 60.0
+        # Window from env (HOROVOD_TPU_STALL_CHECK_DISABLE honored), the
+        # same knob source the engine uses (collective.py).
+        from ..utils import env as _envmod
+        self.stall_warning_s = _envmod.stall_warning_secs()
         self._last_stall_check = time.monotonic()
 
     # ------------------------------------------------------------- protocol
@@ -370,9 +373,6 @@ def start_coordinator(nproc: int, fusion_threshold: int
     ep = control_endpoint()
     key = control_key() if (ep or os.environ.get(SECRET_ENV)) \
         else make_secret_key()
-    svc = CoordinatorService(nproc, key,
-                             fusion_threshold=fusion_threshold,
-                             port=ep[1] if ep else 0)
-    from ..utils import env as _env
-    svc.stall_warning_s = _env.stall_warning_secs()
-    return svc
+    return CoordinatorService(nproc, key,
+                              fusion_threshold=fusion_threshold,
+                              port=ep[1] if ep else 0)
