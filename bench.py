@@ -34,7 +34,7 @@ BASELINE_IMG_SEC_PER_CHIP = 1656.82 / 16  # docs/benchmarks.md:22-38
 
 BATCH_PER_CHIP = int(os.environ.get("HVD_BENCH_BATCH", 64))  # ref --batch-size
 IMAGE_SIZE = int(os.environ.get("HVD_BENCH_IMAGE", 224))
-WARMUP_ITERS = int(os.environ.get("HVD_BENCH_WARMUP", 1))
+WARMUP_BATCHES = int(os.environ.get("HVD_BENCH_WARMUP", 10))  # ref :88-92
 NUM_ITERS = int(os.environ.get("HVD_BENCH_ITERS", 10))
 NUM_BATCHES_PER_ITER = int(os.environ.get("HVD_BENCH_BATCHES", 10))
 
@@ -101,11 +101,12 @@ def main():
         # (block_until_ready alone is unreliable through device tunnels).
         return float(jnp.sum(jax.tree_util.tree_leaves(params)[0]))
 
-    # Warmup (compile + stabilize), reference :88-92. Must use the SAME k
-    # as the timed iterations: k is a static argument, so a different
-    # warmup k would compile a different executable and the timed k's
-    # compile would land inside the first measured window.
-    for _ in range(WARMUP_ITERS):
+    # Warmup (compile + stabilize), reference :88-92. Warmup calls use
+    # the SAME static k as the timed iterations: a different k would
+    # compile a different executable, pushing the timed k's compile into
+    # the first measured window — so WARMUP_BATCHES rounds up to whole
+    # iterations.
+    for _ in range(-(-WARMUP_BATCHES // NUM_BATCHES_PER_ITER)):
         run_batches(NUM_BATCHES_PER_ITER)
 
     # Timed iterations (reference :94-101).

@@ -118,8 +118,13 @@ def main():
     # Warmup with the SAME static k as the timed iterations so the
     # timed executable is compiled before measurement (a different k
     # would be a separate trace+compile landing inside iter #0).
-    warmup_calls = max(1, args.num_warmup_batches
-                       // args.num_batches_per_iter)
+    # --num-warmup-batches 0 measures cold-start compile; other values
+    # round UP to whole iterations (announced, not silent).
+    warmup_calls = -(-args.num_warmup_batches // args.num_batches_per_iter)
+    actual = warmup_calls * args.num_batches_per_iter
+    if hvd.rank() == 0 and actual != args.num_warmup_batches:
+        print(f"warmup rounded to {actual} batches "
+              f"({warmup_calls} x {args.num_batches_per_iter})")
     for _ in range(warmup_calls):
         run(args.num_batches_per_iter)  # warmup (reference :88-92)
 

@@ -67,23 +67,51 @@ def _is_jax_tracer(x) -> bool:
 
 def _jax_inline_allreduce(g):
     """Inside a jitted Keras-JAX train step the reduction must be part of
-    the SPMD program. Under shard_map with a 'dp' axis, psum does it;
-    otherwise there is no data-parallel axis to reduce over and we fail
-    loudly instead of silently skipping the averaging."""
+    the SPMD program. Under shard_map with a 'dp' axis, psum does it.
+
+    Without an axis in scope, Keras 3's own jitted train step is an SPMD
+    program over sharded arrays: if a Keras distribution (DataParallel)
+    is active in this single-controller process, XLA already inserts the
+    gradient reduction from the shardings and the wrapper must pass
+    through (reducing twice would double-average). Only when neither an
+    axis nor a distribution can do the reduction do we fail loudly
+    instead of silently training divergent replicas (the multi-process
+    no-sharding case)."""
     import jax
     from jax import lax
     try:
         return lax.psum(g, "dp") / lax.psum(
             jax.numpy.ones((), g.dtype), "dp")
     except NameError as e:
+        # Other named axes in scope mean we are inside shard_map but the
+        # data axis has a different name — pass-through would silently
+        # train divergent shards, so fail with the rename guidance.
+        try:
+            from jax._src import core as _src_core
+            axes = dict(_src_core.get_axis_env().axis_sizes)
+        except Exception:  # API drift: fall back to no-axes assumption
+            axes = {}
+        if axes:
+            raise RuntimeError(
+                "horovod_tpu.keras.DistributedOptimizer reduces over the "
+                f"mesh axis named 'dp', but the axes in scope are "
+                f"{sorted(axes)}. Name your data-parallel shard_map axis "
+                "'dp' (or psum the gradients yourself).") from e
+        if jax.process_count() == 1:
+            # Plain jitted Keras step, no shard_map: either the arrays
+            # are replicated (identical gradients everywhere — averaging
+            # is the identity) or a keras.distribution shards them and
+            # XLA inserts the reduction from the shardings. Both cases
+            # pass through.
+            return g
         raise RuntimeError(
             "horovod_tpu.keras.DistributedOptimizer was traced into a "
-            "jitted train step with no 'dp' mesh axis in scope. With the "
-            "Keras JAX backend, either run the optimizer inside "
-            "shard_map over a mesh with a 'dp' axis, or use SPMD data "
-            "parallelism (keras.distribution.DataParallel / "
-            "horovod_tpu.parallel) where XLA inserts the gradient "
-            "reduction itself.") from e
+            "jitted train step with no 'dp' mesh axis in scope in a "
+            "multi-process job. With the Keras JAX backend, either run "
+            "the optimizer inside shard_map over a mesh with a 'dp' "
+            "axis, or use SPMD data parallelism "
+            "(keras.distribution.DataParallel / horovod_tpu.parallel) "
+            "where XLA inserts the gradient reduction itself.") from e
 
 
 def _tf_graph_allreduce(g, name: Optional[str], average: bool, wire_dtype):

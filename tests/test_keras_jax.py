@@ -1,0 +1,87 @@
+"""Keras shim on the JAX backend — the TPU-native Keras path.
+
+The main keras tests run on the torch backend (tests/test_keras.py);
+Keras fixes its backend at import, so the jax-backend path gets its own
+subprocess here: DistributedOptimizer inside Keras 3's jitted jax train
+step routes gradients through the inline psum (keras/__init__.py:68-87).
+
+Marked slow (subprocess + keras/jax startup).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SCRIPT = textwrap.dedent("""
+    import os
+    os.environ["KERAS_BACKEND"] = "jax"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    import keras
+
+    import horovod_tpu as hvd
+    import horovod_tpu.keras as hvd_keras
+
+    hvd.init()
+    assert hvd.size() == 8
+
+    keras.utils.set_random_seed(0)
+    model = keras.Sequential([
+        keras.layers.Input((8,)),
+        keras.layers.Dense(16, activation="relu"),
+        keras.layers.Dense(2),
+    ])
+    opt = hvd_keras.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=0.1))
+    model.compile(optimizer=opt, loss="mse", jit_compile=True)
+
+    x = np.random.rand(32, 8).astype("float32")
+    y = np.random.rand(32, 2).astype("float32")
+    before = [np.array(w) for w in model.get_weights()]
+    hist = model.fit(x, y, batch_size=16, epochs=2, verbose=0,
+                     shuffle=False)
+    after = model.get_weights()
+    assert any(not np.allclose(b, a) for b, a in zip(before, after)), \\
+        "weights did not move"
+    losses = hist.history["loss"]
+    assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
+
+    # Replicated virtual ranks: wrapped == unwrapped steps must match.
+    keras.utils.set_random_seed(0)
+    ref = keras.Sequential([
+        keras.layers.Input((8,)),
+        keras.layers.Dense(16, activation="relu"),
+        keras.layers.Dense(2),
+    ])
+    ref.compile(optimizer=keras.optimizers.SGD(learning_rate=0.1),
+                loss="mse", jit_compile=True)
+    ref.fit(x, y, batch_size=16, epochs=2, verbose=0,
+            shuffle=False)
+    for a, b in zip(after, ref.get_weights()):
+        np.testing.assert_allclose(np.array(a), np.array(b),
+                                   rtol=1e-4, atol=1e-5)
+    print("KERAS-JAX OK")
+""")
+
+
+def test_keras_jax_backend_fit():
+    pytest.importorskip("keras")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, "-c", _SCRIPT],
+                          capture_output=True, text=True, timeout=420,
+                          env=env, cwd=REPO)
+    assert proc.returncode == 0, (
+        f"stdout:\n{proc.stdout[-2000:]}\nstderr:\n{proc.stderr[-3000:]}")
+    assert "KERAS-JAX OK" in proc.stdout
