@@ -149,6 +149,7 @@ class CollectiveEngine:
         self.fusion_threshold = _env.fusion_threshold_bytes()
         self.cycle_time_s = _env.cycle_time_ms() / 1000.0
         self.timeline = None          # Python-mode timeline (fallback path)
+        self._timeline_tried = False  # decide once, off the hot path
         self.stall_warning_s = _env.stall_warning_secs()
         self._last_stall_check = time.monotonic()
         # Native control plane (C++ core, runtime/src/core.cc). When it
@@ -220,6 +221,28 @@ class CollectiveEngine:
             finally:
                 self._native_tried = True
         return self._native_core
+
+    def _ensure_timeline(self):
+        """Create the Python timeline writer for paths the native core
+        does not cover (Python fallback, multi-process). Rank 0 writes,
+        like the reference (operations.cc:1824-1829); an undeterminable
+        rank does NOT write (a second writer would truncate rank 0's
+        file). Decision is made once, under the engine lock."""
+        with self._lock:
+            if self._timeline_tried:
+                return self.timeline
+            self._timeline_tried = True
+            path = _env.timeline_path()
+            if not path or self._shutdown:
+                return None
+            try:
+                if _topo._get().process_index != 0:
+                    return None
+            except Exception:
+                return None
+            from .timeline_py import PyTimeline
+            self.timeline = PyTimeline(path)
+            return self.timeline
 
     def _is_multiprocess(self) -> bool:
         if self._mp is None:
@@ -294,6 +317,9 @@ class CollectiveEngine:
         if self._mp_service is not None:
             self._mp_service.shutdown()
             self._mp_service = None
+        if self.timeline is not None:
+            self.timeline.close()
+            self.timeline = None
 
     # --------------------------------------------------------------- enqueue
 
@@ -311,6 +337,7 @@ class CollectiveEngine:
         core = self._ensure_native()
         if core is not None:
             return self._enqueue_native(core, req)
+        self._ensure_timeline()
         with self._lock:
             if self._shutdown:
                 raise HorovodInternalError(
@@ -475,8 +502,15 @@ class CollectiveEngine:
                     if n in self._in_flight]
         if not reqs:
             return
+        tl = self.timeline
+        if tl is not None:
+            for r in reqs:
+                tl.negotiate_end(r.name)
+                tl.start(r.name, _op_name(r.op).upper())
         if group["error"]:
             for r in reqs:
+                if tl is not None:
+                    tl.end(r.name, None)
                 r.handle._fulfill(error=HorovodInternalError(group["error"]))
             return
         ex = self.executor
@@ -489,14 +523,30 @@ class CollectiveEngine:
             subgroups.setdefault(k, []).append(r)
         topo = _topo._get()
         for sub in subgroups.values():
+            sub_names = [r.name for r in sub]
+            if tl is not None:
+                if len(sub) > 1:
+                    tl.activity_start_all(sub_names,
+                                          "MEMCPY_IN_FUSION_BUFFER")
+                    tl.activity_end_all(sub_names)
+                tl.activity_start_all(sub_names,
+                                      _xla_activity(sub[0].op))
             try:
                 results = self._execute_group_mp(ex, sub, group, topo)
             except BaseException as e:
+                if tl is not None:
+                    tl.activity_end_all(sub_names)
+                    for n in sub_names:
+                        tl.end(n, None)
                 err = _as_error(e)
                 for r in sub:
                     r.handle._fulfill(error=err)
                 continue
+            if tl is not None:
+                tl.activity_end_all(sub_names)
             for r, out in zip(sub, results):
+                if tl is not None:
+                    tl.end(r.name, getattr(out, "shape", None))
                 r.handle._fulfill(result=out)
 
     def _execute_group_mp(self, ex: CollectiveExecutor,

@@ -1,0 +1,128 @@
+"""Python Chrome-trace timeline — the fallback/multi-process writer.
+
+The native runtime owns the timeline on the single-process path
+(runtime/src/timeline.cc, the reference's lock-free writer design,
+timeline.h:66-68). Two paths cannot use it: the Python control-plane
+fallback (no toolchain) and multi-process mode (where the native core's
+local negotiation is bypassed for the TCP coordinator). This module
+gives those paths the same artifact: catapult JSON with one "process"
+per tensor (pid = interned tensor index, timeline.cc:70-90) and the
+NEGOTIATE_* / op / activity phases the reference writes
+(operations.h:29-50), so ``chrome://tracing`` renders identically.
+
+Writer thread + queue mirror the native design at Python scale: events
+append to a deque; a daemon thread drains it so the enqueue path never
+blocks on file IO.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+from typing import Optional
+
+_OP_NAMES = {0: "ALLREDUCE", 1: "ALLGATHER", 2: "BROADCAST"}
+
+
+class PyTimeline:
+    """Chrome-trace writer with the reference's phase vocabulary."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._f = open(path, "w")
+        self._f.write("[\n")
+        self._start = time.monotonic()
+        self._pids = {}
+        self._queue = collections.deque()
+        self._wake = threading.Event()
+        self._stop = False
+        self._first = True
+        self._thread = threading.Thread(target=self._drain,
+                                        name="hvd-tpu-timeline",
+                                        daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- events
+
+    def _ts(self) -> int:
+        return int((time.monotonic() - self._start) * 1e6)
+
+    def _pid(self, tensor: str) -> int:
+        pid = self._pids.get(tensor)
+        if pid is None:
+            pid = len(self._pids)
+            self._pids[tensor] = pid
+            self._queue.append({"name": "process_name", "ph": "M",
+                                "pid": pid,
+                                "args": {"name": tensor}})
+        return pid
+
+    def _emit(self, tensor: str, ph: str, name: Optional[str] = None,
+              args: Optional[dict] = None):
+        ev = {"ph": ph, "ts": self._ts(), "pid": self._pid(tensor),
+              "tid": 0}
+        if name is not None:
+            ev["name"] = name
+        if args:
+            ev["args"] = args
+        self._queue.append(ev)
+        self._wake.set()
+
+    # Phase API — mirrors the native Timeline's surface used by the engine.
+
+    def negotiate_start(self, tensor: str, op: int):
+        self._emit(tensor, "B", f"NEGOTIATE_{_OP_NAMES.get(op, op)}")
+
+    def negotiate_rank_ready(self, tensor: str, rank: int):
+        self._emit(tensor, "i", str(rank))
+
+    def negotiate_end(self, tensor: str):
+        self._emit(tensor, "E")
+
+    def start(self, tensor: str, op_name: str):
+        self._emit(tensor, "B", op_name)
+
+    def activity_start_all(self, tensors, activity: str):
+        for t in tensors:
+            self._emit(t, "B", activity)
+
+    def activity_end_all(self, tensors):
+        for t in tensors:
+            self._emit(t, "E")
+
+    def end(self, tensor: str, shape=None):
+        args = {"shape": list(shape)} if shape is not None else None
+        self._emit(tensor, "E", args=args)
+
+    def mark_cycle(self):
+        self._emit("_cycles", "i", "CYCLE_START")
+
+    # ------------------------------------------------------------- writer
+
+    def _drain(self):
+        while True:
+            self._wake.wait(timeout=0.2)
+            self._wake.clear()
+            wrote = False
+            while self._queue:
+                ev = self._queue.popleft()
+                prefix = "" if self._first else ",\n"
+                self._first = False
+                self._f.write(prefix + json.dumps(ev))
+                wrote = True
+            if wrote:
+                self._f.flush()
+            if self._stop and not self._queue:
+                return
+
+    def close(self):
+        self._stop = True
+        self._wake.set()
+        self._thread.join(timeout=5.0)
+        try:
+            self._f.write("\n]\n")
+            self._f.close()
+        except ValueError:
+            pass  # already closed

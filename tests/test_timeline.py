@@ -7,6 +7,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 SCRIPT = r"""
 import os
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
@@ -53,3 +55,71 @@ def test_timeline_records_phases(tmp_path):
                and ln.strip() not in ("[", "]")]
     for ln in records[:50]:
         json.loads(ln)
+
+
+class TestPythonTimeline:
+    """The Python timeline writer covers the two paths the native core
+    cannot: the Python control-plane fallback and multi-process mode."""
+
+    def test_python_fallback_timeline(self, tmp_path):
+        tl = tmp_path / "py_timeline.json"
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "HOROVOD_TPU_DISABLE_NATIVE": "1",
+            "HOROVOD_TPU_TIMELINE": str(tl),
+            "PYTHONPATH": os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+        })
+        script = (
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "import jax.numpy as jnp\n"
+            "import horovod_tpu as hvd\n"
+            "from horovod_tpu.ops import collective\n"
+            "hvd.init()\n"
+            "hvd.allreduce(jnp.ones((8, 8)), name='pytl.allreduce')\n"
+            "hvd.broadcast(jnp.ones((4,)), 0, name='pytl.broadcast')\n"
+            "collective.engine().shutdown()\n")
+        proc = subprocess.run([sys.executable, "-c", script],
+                              capture_output=True, text=True, timeout=300,
+                              env=env)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        text = tl.read_text()
+        events = json.loads(text)   # valid catapult JSON
+        assert any(e.get("name") == "NEGOTIATE_ALLREDUCE" for e in events)
+        assert any(e.get("name") == "XLA_ALLREDUCE" for e in events)
+        assert "pytl.allreduce" in text and "pytl.broadcast" in text
+
+    @pytest.mark.slow
+    def test_multiprocess_timeline(self, tmp_path):
+        """Rank 0 writes the timeline in multi-process mode (reference:
+        rank-0-only, operations.cc:1824-1829)."""
+        from horovod_tpu.runner.api import run
+
+        tl = tmp_path / "mp_timeline.json"
+
+        def worker(path):
+            import os
+
+            import jax.numpy as jnp
+
+            import horovod_tpu as hvd
+            from horovod_tpu.ops import collective
+
+            os.environ["HOROVOD_TPU_TIMELINE"] = path
+            hvd.init()
+            hvd.allreduce(jnp.ones((8,)), name="mptl.sum")
+            hvd.allgather(jnp.ones((2, 2)), name="mptl.gather")
+            collective.engine().shutdown()
+            return hvd.process_rank()
+
+        env = {"JAX_PLATFORMS": "cpu",
+               "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+        results = run(worker, args=(str(tl),), np=2, extra_env=env,
+                      start_timeout=300)
+        assert sorted(results) == [0, 1]
+        text = tl.read_text()
+        assert "NEGOTIATE_ALLREDUCE" in text
+        assert "XLA_ALLREDUCE" in text and "XLA_ALLGATHER" in text
+        assert "mptl.sum" in text and "mptl.gather" in text
