@@ -91,6 +91,24 @@ def _fused_reduce(vals, reduce_fn, prescale: float, postscale: float):
     return tuple(results)
 
 
+def _hier_reduce(buf, ici: int):
+    """Hierarchical fused-buffer reduction (operations.cc:1284-1436 as
+    XLA collectives): psum_scatter over 'ici' -> psum over 'dcn' on the
+    scattered shard -> all_gather over 'ici'. The buffer pads so its
+    length divides the ici size, as the reference rounds its fusion
+    buffer to local_size x FUSION_BUFFER_ATOMIC_UNIT
+    (operations.cc:742-764). Shared by the single- and multi-process
+    allreduce programs."""
+    n = buf.size
+    pad = (-n) % ici
+    if pad:
+        buf = jnp.concatenate([buf, jnp.zeros((pad,), buf.dtype)])
+    piece = jax.lax.psum_scatter(buf, "ici", tiled=True)
+    piece = jax.lax.psum(piece, "dcn")
+    out = jax.lax.all_gather(piece, "ici", tiled=True)
+    return out[:n] if pad else out
+
+
 def _trim_concat(gathered, per_rank_dims):
     """Trim a padded [n, max_dim, ...] gather back to ragged segments and
     concatenate — the MPI_Allgatherv displacement math
@@ -165,22 +183,7 @@ class CollectiveExecutor:
         def reduce_buf(buf):
             if not hier:
                 return jax.lax.psum(buf, "dp")
-            # Hierarchical allreduce (operations.cc:1284-1436): NCCL
-            # ReduceScatter → cross-node MPI_Allreduce → NCCL Allgather
-            # becomes psum_scatter over 'ici' → psum over 'dcn' →
-            # all_gather over 'ici'. The buffer is padded so its length
-            # divides the ici size — the reference rounds the fusion buffer
-            # to local_size × FUSION_BUFFER_ATOMIC_UNIT for the same reason
-            # (operations.cc:742-764).
-            n = buf.size
-            pad = (-n) % ici
-            if pad:
-                buf = jnp.concatenate(
-                    [buf, jnp.zeros((pad,), buf.dtype)])
-            piece = jax.lax.psum_scatter(buf, "ici", tiled=True)
-            piece = jax.lax.psum(piece, "dcn")
-            out = jax.lax.all_gather(piece, "ici", tiled=True)
-            return out[:n] if pad else out
+            return _hier_reduce(buf, ici)
 
         def build():
             def fused(*xs):
@@ -390,46 +393,62 @@ class CollectiveExecutor:
     # requirement the reference meets with its MPI_Bcast'd response list,
     # operations.cc:2282-2287).
 
-    def _mp_stacked(self, x) -> jax.Array:
-        """Global [size, ...] dp-sharded array; every local device holds
-        this process's value."""
-        local_devices = [d for d in self.mesh.devices.flat
+    def _mp_stacked(self, x, mesh: Optional[Mesh] = None,
+                    axes=("dp",)) -> jax.Array:
+        """Global [size, ...] array with the leading axis sharded over
+        ``axes``; every local device holds this process's value."""
+        mesh = mesh if mesh is not None else self.mesh
+        local_devices = [d for d in mesh.devices.flat
                          if d.process_index == jax.process_index()]
         arr = np.asarray(x)
         local = np.broadcast_to(arr, (len(local_devices),) + arr.shape)
         return jax.make_array_from_process_local_data(
-            NamedSharding(self.mesh, P("dp")), local)
+            NamedSharding(mesh, P(axes)), local)
 
     def allreduce_fused_mp(self, tensors: Sequence[jax.Array],
                            prescale: float = 1.0,
                            postscale: float = 1.0) -> List[jax.Array]:
         """Fused sum-allreduce across processes: every virtual rank
-        (device) contributes its process's copy."""
-        mesh = self.mesh
+        (device) contributes its process's copy.
+
+        With hierarchical mode on, the reduction pipelines over the
+        ('dcn', 'ici') mesh — psum_scatter on ICI, psum across DCN on
+        the scattered shard, all_gather back on ICI — the reference's
+        2-level NCCL+MPI allreduce (operations.cc:1284-1436) as XLA
+        collectives; otherwise one flat psum over 'dp'.
+        """
+        hier = self.hierarchical_allreduce
+        mesh = self.hier_mesh if hier else self.mesh
+        axes = ("dcn", "ici") if hier else ("dp",)
+        ici = int(mesh.shape["ici"]) if hier else 1
         shapes = tuple(tuple(t.shape) for t in tensors)
         dtypes = tuple(str(t.dtype) for t in tensors)
         key = ("armp", shapes, dtypes, float(prescale), float(postscale),
-               id(mesh))
+               hier, id(mesh))
+
+        def reduce_buf(buf):
+            if not hier:
+                return jax.lax.psum(buf, "dp")
+            return _hier_reduce(buf, ici)
 
         def build():
             def fused(*xs):
                 def shard_fn(*ys):
                     # y[0]: this device's block of the [size, ...] axis.
-                    return _fused_reduce(
-                        [y[0] for y in ys],
-                        lambda buf: jax.lax.psum(buf, "dp"),
-                        prescale, postscale)
+                    return _fused_reduce([y[0] for y in ys], reduce_buf,
+                                         prescale, postscale)
 
                 return jax.shard_map(
                     shard_fn, mesh=mesh,
-                    in_specs=tuple(P("dp") for _ in xs),
+                    in_specs=tuple(P(axes) for _ in xs),
                     out_specs=tuple(P() for _ in xs),
                     check_vma=False)(*xs)
 
             return jax.jit(fused)
 
         prog = self._program(key, build)
-        outs = prog(*[self._mp_stacked(t) for t in tensors])
+        outs = prog(*[self._mp_stacked(t, mesh=mesh, axes=axes)
+                      for t in tensors])
         return list(outs)
 
     def broadcast_fused_mp(self, tensors: Sequence[jax.Array],

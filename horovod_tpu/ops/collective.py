@@ -150,6 +150,7 @@ class CollectiveEngine:
         self.cycle_time_s = _env.cycle_time_ms() / 1000.0
         self.timeline = None          # Python-mode timeline (fallback path)
         self._timeline_tried = False  # decide once, off the hot path
+        self._mark_cycles = _env.timeline_mark_cycles()
         self.stall_warning_s = _env.stall_warning_secs()
         self._last_stall_check = time.monotonic()
         # Native control plane (C++ core, runtime/src/core.cc). When it
@@ -227,7 +228,10 @@ class CollectiveEngine:
         does not cover (Python fallback, multi-process). Rank 0 writes,
         like the reference (operations.cc:1824-1829); an undeterminable
         rank does NOT write (a second writer would truncate rank 0's
-        file). Decision is made once, under the engine lock."""
+        file). Decision is made once; the monotonic flag makes the
+        unlocked fast-path read safe."""
+        if self._timeline_tried:
+            return self.timeline
         with self._lock:
             if self._timeline_tried:
                 return self.timeline
@@ -240,8 +244,15 @@ class CollectiveEngine:
                     return None
             except Exception:
                 return None
-            from .timeline_py import PyTimeline
-            self.timeline = PyTimeline(path)
+            try:
+                from .timeline_py import PyTimeline
+                self.timeline = PyTimeline(path)
+            except OSError as e:
+                # Unwritable path disables the timeline, as the native
+                # writer does (runtime/src/timeline.cc) — never fail the
+                # user's collective over tracing.
+                _log.warning("timeline disabled: cannot open %s: %s",
+                             path, e)
             return self.timeline
 
     def _is_multiprocess(self) -> bool:
@@ -348,7 +359,7 @@ class CollectiveEngine:
             self._in_flight[req.name] = req
             self._queue.append(req)
             if self.timeline is not None:
-                self.timeline.negotiate_start(req.name, req.op)
+                self.timeline.negotiate_start(req.name, _op_name(req.op))
         self._ensure_thread()
         self._wake.set()
         return req.handle
@@ -442,6 +453,8 @@ class CollectiveEngine:
             self._wake.clear()
             if self._shutdown:
                 return
+            if self._mark_cycles and self.timeline is not None:
+                self.timeline.mark_cycle()  # HOROVOD_TIMELINE_MARK_CYCLES
             with self._lock:
                 batch = self._queue
                 self._queue = []

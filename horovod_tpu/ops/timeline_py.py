@@ -23,9 +23,6 @@ import threading
 import time
 from typing import Optional
 
-_OP_NAMES = {0: "ALLREDUCE", 1: "ALLGATHER", 2: "BROADCAST"}
-
-
 class PyTimeline:
     """Chrome-trace writer with the reference's phase vocabulary."""
 
@@ -72,11 +69,8 @@ class PyTimeline:
 
     # Phase API — mirrors the native Timeline's surface used by the engine.
 
-    def negotiate_start(self, tensor: str, op: int):
-        self._emit(tensor, "B", f"NEGOTIATE_{_OP_NAMES.get(op, op)}")
-
-    def negotiate_rank_ready(self, tensor: str, rank: int):
-        self._emit(tensor, "i", str(rank))
+    def negotiate_start(self, tensor: str, op_name: str):
+        self._emit(tensor, "B", f"NEGOTIATE_{op_name.upper()}")
 
     def negotiate_end(self, tensor: str):
         self._emit(tensor, "E")
@@ -121,6 +115,12 @@ class PyTimeline:
         self._stop = True
         self._wake.set()
         self._thread.join(timeout=5.0)
+        if self._thread.is_alive():
+            # Drain thread stuck in a slow write (NFS, huge backlog):
+            # closing underneath it would interleave the footer with its
+            # writes and crash it on the closed handle. Leave the file
+            # open — a missing ']' is tolerated by trace viewers.
+            return
         try:
             self._f.write("\n]\n")
             self._f.close()

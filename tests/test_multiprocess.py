@@ -186,3 +186,37 @@ class TestMultiDevicePerProcess:
                                    [1.0, 1.0], [1.0, 1.0]]
             assert r["ragged_shape"] == [6, 2]   # 1+1+2+2 rows
         assert results[0] == results[1]
+
+
+class TestHierarchicalMultiProcess:
+    def test_hierarchical_allreduce_across_processes(self):
+        """HOROVOD_TPU_HIERARCHICAL_ALLREDUCE=1 in a 2-process x 2-device
+        job: psum_scatter over 'ici' + psum over 'dcn' + all_gather over
+        'ici' must give the same sums as the flat path."""
+        env = {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "HOROVOD_TPU_HIERARCHICAL_ALLREDUCE": "1",
+        }
+
+        def worker():
+            import jax.numpy as jnp
+            import numpy as np
+
+            import horovod_tpu as hvd
+
+            hvd.init()
+            pr = hvd.process_rank()
+            # 2 devices/process each contribute pr+1: total = 2*1+2*2 = 6
+            s = hvd.allreduce(jnp.full((5,), float(pr + 1)),
+                              average=False, name="hier.sum")
+            # odd size exercises the ici padding path
+            s2 = hvd.allreduce(jnp.full((7,), 1.0), average=True,
+                               name="hier.avg")
+            return (np.asarray(s).tolist(), np.asarray(s2).tolist())
+
+        results = run(worker, np=2, extra_env=env, start_timeout=300)
+        for s, s2 in results:
+            assert s == [6.0] * 5
+            assert s2 == [1.0] * 7
+        assert results[0] == results[1]
