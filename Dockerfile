@@ -1,0 +1,35 @@
+# horovod_tpu container — the reference's Dockerfile role (a ready-to-run
+# training image) for TPU VMs. Build args select the JAX flavor:
+#   docker build --build-arg JAX_PACKAGE="jax[tpu]" .     # TPU VM
+#   docker build --build-arg JAX_PACKAGE="jax" .          # CPU (CI/tests)
+FROM python:3.12-slim
+
+ARG JAX_PACKAGE="jax[tpu]"
+ARG EXTRAS="all"
+
+# g++ builds the native control-plane core at install time; ssh is the
+# launcher's remote-spawn transport (the rsh-agent role).
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ openssh-client && \
+    rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/horovod_tpu
+
+# Dependency layers first: a source edit must not invalidate the
+# multi-gigabyte framework installs (.dockerignore keeps .git/tests out).
+RUN pip install --no-cache-dir "${JAX_PACKAGE}" numpy flax optax \
+        cloudpickle
+RUN if [ "${EXTRAS}" = "all" ]; then \
+        pip install --no-cache-dir torch "keras>=3" tensorflow; fi
+
+COPY pyproject.toml setup.py README.md ./
+COPY horovod_tpu ./horovod_tpu
+RUN pip install --no-cache-dir --no-deps ".[${EXTRAS}]"
+
+# Smoke: import, init on whatever devices exist, one collective.
+RUN JAX_PLATFORMS=cpu python -c "\
+import horovod_tpu as hvd, jax.numpy as jnp; \
+hvd.init(); \
+assert float(hvd.allreduce(jnp.ones(()), average=False)) == hvd.size()"
+
+ENTRYPOINT ["python", "-m", "horovod_tpu.runner"]

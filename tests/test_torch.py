@@ -10,7 +10,8 @@ eager inputs are replicated, so allreduce(x) == size * x etc.
 
 import numpy as np
 import pytest
-import torch
+
+torch = pytest.importorskip("torch")
 
 import horovod_tpu as hvd
 import horovod_tpu.torch as hvd_torch
