@@ -24,7 +24,9 @@ RUN if [ "${EXTRAS}" = "all" ]; then \
 
 COPY pyproject.toml setup.py README.md ./
 COPY horovod_tpu ./horovod_tpu
-RUN pip install --no-cache-dir --no-deps ".[${EXTRAS}]"
+# Full resolve (no --no-deps): arbitrary EXTRAS values stay correct; the
+# pre-layers above just keep the big downloads cached across source edits.
+RUN pip install --no-cache-dir ".[${EXTRAS}]"
 
 # Smoke: import, init on whatever devices exist, one collective.
 RUN JAX_PLATFORMS=cpu python -c "\
