@@ -27,6 +27,7 @@ from .compression import Compression
 from .optimizer import (DistributedOptimizer, DistributedGradientTransformation,
                         broadcast_parameters, broadcast_optimizer_state,
                         broadcast_object, allreduce_gradients)
+from .utils.checkpoint import restore_checkpoint, save_checkpoint
 
 __version__ = "0.1.0"
 
@@ -45,4 +46,5 @@ __all__ = [
     "Compression", "DistributedOptimizer",
     "DistributedGradientTransformation", "broadcast_parameters",
     "broadcast_optimizer_state", "broadcast_object", "allreduce_gradients",
+    "save_checkpoint", "restore_checkpoint",
 ]

@@ -174,12 +174,17 @@ def _block(params, x, cfg: TransformerConfig, layer_idx: int):
 
     import jax as _jax
     flash_interp = _jax.default_backend() != "tpu"  # interpret off-TPU
-    # Auto policy: compiled flash from 4k *actual* sequence (the measured
-    # crossover, config field comment); never auto-select the interpreter
-    # off-TPU, and key on this trace's length, not max_seq — a short
-    # batch under a long-context config stays on XLA attention.
+    # Auto policy: compiled flash from 4k *attended* sequence (the
+    # measured crossover, config field comment); never auto-select the
+    # interpreter off-TPU, and key on this trace's length, not max_seq —
+    # a short batch under a long-context config stays on XLA attention.
+    # Under Ulysses the local attention runs over the GLOBAL sequence
+    # (all-to-all gathers it), so the threshold compares s * sp_size.
+    attended_s = s
+    if cfg.sp_axis and cfg.sp_impl == "ulysses":
+        attended_s = s * lax.axis_size(cfg.sp_axis)
     use_flash = (cfg.use_flash if cfg.use_flash is not None
-                 else (not flash_interp and s >= 4096))
+                 else (not flash_interp and attended_s >= 4096))
     if cfg.sp_axis and cfg.sp_impl == "ulysses":
         from ..parallel.ulysses import ulysses_attention
         attn = ulysses_attention(q, k, v, axis_name=cfg.sp_axis,

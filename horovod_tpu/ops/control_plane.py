@@ -311,7 +311,14 @@ class CoordinatorClient:
 
     def __init__(self, addresses: List[Tuple[str, int]], key: bytes,
                  rank: int):
-        self._client = BasicClient(addresses, key)
+        # Patient FIRST connection only: rank 0 binds the coordinator
+        # lazily on its first collective, which may come seconds after
+        # the other ranks' (e.g. rank 0 reads a checkpoint first) — the
+        # reference's workers block in MPI_Gather until rank 0 arrives.
+        # After rendezvous, failures retry briefly so a dead coordinator
+        # surfaces in seconds, not hours.
+        self._client = BasicClient(addresses, key, attempts=3,
+                                   connect_attempts=300)
         self._rank = rank
         self.last_seq = 0
 

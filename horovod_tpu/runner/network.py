@@ -199,13 +199,22 @@ class BasicClient:
     """
 
     def __init__(self, addresses, key: bytes, attempts: int = 3,
-                 timeout: float = 60.0):
+                 timeout: float = 60.0,
+                 connect_attempts: Optional[int] = None):
+        """``connect_attempts`` applies only until the FIRST successful
+        connection (rendezvous patience — the peer may come up seconds
+        later); once connected, failures retry ``attempts`` times so a
+        dead peer surfaces fast instead of being masked for minutes."""
         if isinstance(addresses, tuple) and len(addresses) == 2 \
                 and isinstance(addresses[0], str):
             addresses = [addresses]
         self._addresses: List[Tuple[str, int]] = list(addresses)
         self._wire = Wire(key)
         self._attempts = attempts
+        self._connect_attempts = (connect_attempts
+                                  if connect_attempts is not None
+                                  else attempts)
+        self._ever_connected = False
         self._timeout = timeout
         self._sock: Optional[socket.socket] = None
         self._mu = threading.Lock()
@@ -234,10 +243,13 @@ class BasicClient:
     def request(self, req: Any) -> Any:
         last: Optional[Exception] = None
         with self._mu:
-            for attempt in range(self._attempts):
+            n = (self._attempts if self._ever_connected
+                 else self._connect_attempts)
+            for attempt in range(n):
                 try:
                     if self._sock is None:
                         self._sock = self._connect()
+                        self._ever_connected = True
                     self._wire.write(self._sock, req)
                     return self._wire.read(self._sock)
                 except (OSError, ConnectionError) as e:
@@ -248,7 +260,7 @@ class BasicClient:
                         except OSError:
                             pass
                         self._sock = None
-                    if attempt + 1 < self._attempts:
+                    if attempt + 1 < n:
                         time.sleep(0.2)
         raise ConnectionError(
             f"could not reach service at {self._addresses}: {last}")

@@ -1,0 +1,95 @@
+"""Checkpoint convention helpers — rank-0 save, broadcast-on-restore.
+
+The reference ships no checkpoint engine; its *convention* is: save on
+rank 0 only and broadcast state on (re)start (SURVEY.md §5.4 —
+README usage steps 5-6, torch broadcast_parameters /
+broadcast_optimizer_state, the rank-0 `checkpoint_dir` gating in every
+example). These helpers make that convention one call each for JAX
+pytrees.
+
+Format: a single self-contained pickle of the host-fetched pytree. This
+is deliberate — it round-trips any pytree and stays readable regardless
+of how many processes exist at save vs. restore time. Orbax is the right
+tool for sharded/async multi-host checkpoints, but it runs its own
+cross-process barriers, which contradicts this module's rank-0-only
+contract (a rank-0-only orbax call in a multi-process job deadlocks);
+use orbax directly from all ranks if you want that machinery. Fancier
+checkpointing remains delegated to the host framework, exactly as the
+reference delegates it (docs/inference.md:1-16).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional
+
+import jax
+
+from .. import topology as _topo
+
+
+def _file(path: str, step: Optional[int]) -> str:
+    if step is not None:
+        if path.endswith(".pkl"):
+            raise ValueError(
+                "pass a directory path with step= (a '.pkl' file path "
+                "plus a step would create a directory named like a file)")
+        return os.path.join(path, f"{step}.pkl")
+    return path if path.endswith(".pkl") else path + ".pkl"
+
+
+def save_checkpoint(state: Any, path: str,
+                    *, step: Optional[int] = None) -> Optional[str]:
+    """Write ``state`` (any JAX pytree) to ``path`` from rank 0 only.
+
+    Returns the written file on rank 0, None elsewhere. Other ranks do
+    not wait — pair a later restore with the broadcast this module does,
+    or allreduce a dummy as a barrier if you need one.
+    """
+    if _topo._get().process_index != 0:
+        return None
+    target = _file(path, step)
+    parent = os.path.dirname(os.path.abspath(target))
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    # Atomic: a crash mid-write (spot/preemptible restarts are the whole
+    # point of checkpointing) must never truncate the previous copy.
+    tmp = target + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(jax.device_get(state), f)
+    os.replace(tmp, target)
+    return target
+
+
+def restore_checkpoint(path: str, *, step: Optional[int] = None,
+                       broadcast: bool = True) -> Any:
+    """Load a checkpoint and (by default) broadcast it from rank 0 so
+    every rank resumes from identical state — the reference's
+    load-on-rank-0 + BroadcastGlobalVariablesHook restart recipe. Only
+    rank 0 needs the file; with ``broadcast=False`` every caller reads
+    locally."""
+    topo = _topo._get()
+    state = None
+    err: Optional[str] = None
+    if topo.process_index == 0 or not broadcast:
+        try:
+            with open(_file(path, step), "rb") as f:
+                state = pickle.load(f)
+        except Exception as e:
+            if not broadcast or topo.process_count == 1:
+                raise
+            # The other ranks are (or will be) blocked in the broadcast;
+            # ship the failure so the job dies loudly on EVERY rank
+            # instead of hanging them on a rank-0-only exception.
+            err = f"{type(e).__name__}: {e}"
+    if not broadcast or topo.process_count == 1:
+        return state
+    from ..optimizer import broadcast_object
+    # Rank 0 ships the tree structure + leaves; everyone receives.
+    payload = broadcast_object({"state": state, "error": err}, root_rank=0)
+    if payload["error"] is not None:
+        raise RuntimeError(
+            f"rank 0 failed to load checkpoint {path!r}: "
+            f"{payload['error']}")
+    return payload["state"]

@@ -1,0 +1,69 @@
+"""Checkpoint convention helpers: rank-0 save + broadcast-on-restore
+(SURVEY.md §5.4 — the reference's restart recipe as one call each)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+from horovod_tpu.utils.checkpoint import restore_checkpoint, save_checkpoint
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    hvd.init()
+    yield
+
+
+def _state():
+    return {"params": {"w": jnp.arange(6.0).reshape(2, 3),
+                       "b": jnp.zeros(3)},
+            "step": 7}
+
+
+class TestCheckpoint:
+    def test_save_restore_roundtrip(self, tmp_path):
+        state = _state()
+        out = save_checkpoint(state, str(tmp_path / "ckpt"))
+        assert out is not None  # single process == rank 0
+        restored = restore_checkpoint(str(tmp_path / "ckpt"))
+        assert int(restored["step"]) == 7
+        assert np.allclose(np.asarray(restored["params"]["w"]),
+                           np.arange(6.0).reshape(2, 3))
+
+    def test_stepped_checkpoints(self, tmp_path):
+        state = _state()
+        save_checkpoint(state, str(tmp_path / "run"), step=3)
+        state["step"] = 9
+        save_checkpoint(state, str(tmp_path / "run"), step=4)
+        r3 = restore_checkpoint(str(tmp_path / "run"), step=3)
+        r4 = restore_checkpoint(str(tmp_path / "run"), step=4)
+        assert int(r3["step"]) == 7 and int(r4["step"]) == 9
+
+    @pytest.mark.slow
+    def test_multiprocess_restore_broadcasts(self, tmp_path):
+        """Rank 0 reads the file; every rank resumes identical state."""
+        from horovod_tpu.runner.api import run
+
+        # Rank 0 writes a checkpoint up front (shared tmp filesystem).
+        save_checkpoint(_state(), str(tmp_path / "mp"))
+
+        def worker(path):
+            import numpy as np
+
+            import horovod_tpu as hvd
+            from horovod_tpu.utils.checkpoint import restore_checkpoint
+
+            hvd.init()
+            state = restore_checkpoint(path)
+            return (hvd.process_rank(), int(state["step"]),
+                    float(np.asarray(state["params"]["w"]).sum()))
+
+        env = {"JAX_PLATFORMS": "cpu",
+               "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+        results = run(worker, args=(str(tmp_path / "mp"),), np=2,
+                      extra_env=env, start_timeout=300)
+        assert sorted(r[0] for r in results) == [0, 1]
+        for _, step, wsum in results:
+            assert step == 7 and wsum == 15.0
