@@ -243,13 +243,20 @@ class BasicClient:
     def request(self, req: Any) -> Any:
         last: Optional[Exception] = None
         with self._mu:
-            n = (self._attempts if self._ever_connected
-                 else self._connect_attempts)
-            for attempt in range(n):
+            # Rendezvous patience is a wall-clock deadline, not an attempt
+            # count: dropped SYNs block each connect() for up to the full
+            # socket timeout, so counting attempts would multiply that
+            # into hours. ~0.2 s/attempt of refused-connection pacing sets
+            # the budget; once connected, the short attempt count governs.
+            deadline = (None if self._ever_connected
+                        else time.monotonic() + 0.2 * self._connect_attempts)
+            attempt = 0
+            while True:
                 try:
                     if self._sock is None:
                         self._sock = self._connect()
                         self._ever_connected = True
+                        deadline = None
                     self._wire.write(self._sock, req)
                     return self._wire.read(self._sock)
                 except (OSError, ConnectionError) as e:
@@ -260,8 +267,13 @@ class BasicClient:
                         except OSError:
                             pass
                         self._sock = None
-                    if attempt + 1 < n:
-                        time.sleep(0.2)
+                    attempt += 1
+                    if deadline is not None:
+                        if time.monotonic() > deadline:
+                            break
+                    elif attempt >= self._attempts:
+                        break
+                    time.sleep(0.2)
         raise ConnectionError(
             f"could not reach service at {self._addresses}: {last}")
 

@@ -83,7 +83,9 @@ class TestExamples:
 
     def test_tensorflow_mnist(self):
         _needs("tensorflow")
-        out = _run("tensorflow_mnist.py")
+        # 2 devices: TF + JAX on one CPU core is contention-flaky at 8
+        # (same reasoning as test_jax_mnist_eager).
+        out = _run("tensorflow_mnist.py", {"STEPS": "6"}, devices=2)
         assert "loss" in out and "checkpoint written" in out
 
     def test_pytorch_synthetic_benchmark(self):
@@ -104,7 +106,7 @@ class TestExamples:
 
     def test_tensorflow_mnist_eager(self):
         _needs("tensorflow")
-        out = _run("tensorflow_mnist_eager.py")
+        out = _run("tensorflow_mnist_eager.py", {"STEPS": "6"}, devices=2)
         assert "loss" in out
 
     def test_keras_mnist(self):
