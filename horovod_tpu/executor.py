@@ -56,7 +56,8 @@ BROADCAST = 2
 def _accum_dtype(dtype) -> Optional[np.dtype]:
     """Accumulation dtype for exact small-float / bool reductions."""
     d = np.dtype(dtype)
-    if d == np.dtype(np.float16) or str(d) == "bfloat16":
+    if (d == np.dtype(np.float16) or str(d) == "bfloat16"
+            or str(d).startswith("float8")):
         return np.dtype(np.float32)
     if d == np.dtype(bool):
         return np.dtype(np.int32)

@@ -138,8 +138,9 @@ def _allreduce_grad(g, name: Optional[str], compression) -> object:
     """Average one backend gradient tensor across ranks, preserving its
     backend type."""
     wire = getattr(compression, "wire_dtype", None)
-    wire_np = np.dtype("float16") if wire is not None and "float16" in str(
-        wire) else (np.dtype("bfloat16") if wire is not None else None)
+    # np.dtype resolves jnp.float16 / bfloat16 / float8_* via ml_dtypes,
+    # so every cast-compressor's wire format passes through faithfully.
+    wire_np = np.dtype(wire) if wire is not None else None
     kb = _backend()
     if kb == "torch":
         from . import _torch_bridge

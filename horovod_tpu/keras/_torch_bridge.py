@@ -12,10 +12,29 @@ from ..compression import Compression as _JaxCompression
 
 
 def allreduce_average(g, name: Optional[str], compression):
+    import torch
+
     from .. import torch as _hvd_torch
-    comp = (_hvd_torch.Compression.fp16
-            if compression is _JaxCompression.fp16
-            else _hvd_torch.Compression.none)
+
+    comp = _hvd_torch.Compression.none
+    if compression is _JaxCompression.fp16:
+        comp = _hvd_torch.Compression.fp16
+    elif compression is _JaxCompression.bf16:
+        # bf16 crosses the torch<->engine boundary natively (the torch
+        # shim transports bf16 as uint16 bit patterns).
+        orig = g.dtype
+        out = _hvd_torch.mpi_ops.synchronize(
+            _hvd_torch.mpi_ops.allreduce_async(
+                g.to(torch.bfloat16), average=True, name=name))
+        return out.to(orig)
+    elif compression is not _JaxCompression.none and compression is not None:
+        # fp8 (and future wire formats) have no torch-side transport yet;
+        # degrade to fp16 LOUDLY rather than silently dropping compression.
+        import warnings
+        warnings.warn(
+            f"{getattr(compression, '__name__', compression)} has no "
+            "torch-backend transport; using fp16 wire compression instead")
+        comp = _hvd_torch.Compression.fp16
     wire, ctx = comp.compress(g)
     out = _hvd_torch.mpi_ops.synchronize(
         _hvd_torch.mpi_ops.allreduce_async(wire, average=True, name=name))

@@ -95,6 +95,19 @@ class Handle:
         return self._result
 
 
+def _plan_dtype(dtype) -> np.dtype:
+    """Size-equivalent numpy dtype for fusion planning (bfloat16 and fp8
+    have no stable numpy identity across paths; only itemsize and
+    same-key grouping matter here — execution dispatches on the real jax
+    dtype)."""
+    s = str(dtype)
+    if s == "bfloat16":
+        return np.dtype(np.float16)
+    if s.startswith("float8"):
+        return np.dtype(np.uint8)
+    return np.dtype(dtype)
+
+
 class _Request:
     __slots__ = ("name", "op", "tensor", "per_rank", "root_rank", "average",
                  "prescale", "postscale", "handle", "nbytes", "dtype",
@@ -114,12 +127,10 @@ class _Request:
         self.handle = handle
         self.sharded = sharded
         if tensor is not None:
-            self.dtype = np.dtype(tensor.dtype) if tensor.dtype != jnp.bfloat16 \
-                else np.dtype(np.float16)  # size-equivalent for planning
+            self.dtype = _plan_dtype(tensor.dtype)
             self.nbytes = int(np.prod(tensor.shape)) * self.dtype.itemsize
         else:
-            self.dtype = np.dtype(per_rank[0].dtype) if per_rank[0].dtype != jnp.bfloat16 \
-                else np.dtype(np.float16)
+            self.dtype = _plan_dtype(per_rank[0].dtype)
             self.nbytes = sum(int(np.prod(t.shape)) for t in per_rank) * \
                 self.dtype.itemsize
         self.enqueued_at = time.monotonic()

@@ -139,6 +139,12 @@ class NativeCore:
                 nbytes: int = 0) -> int:
         if str(dtype) == "bfloat16":
             enum = BFLOAT16_ENUM
+        elif str(dtype).startswith("float8"):
+            # The native planner only needs a size-consistent dtype key for
+            # fusion grouping and cross-rank validation; fp8 plans under
+            # the 1-byte uint8 slot and the executor dispatches on the
+            # real jax dtype.
+            enum = DTYPE_TO_ENUM[np.dtype(np.uint8)]
         else:
             enum = DTYPE_TO_ENUM[np.dtype(dtype)]
         arr = (ctypes.c_int64 * max(len(shape), 1))(*shape)
