@@ -33,14 +33,26 @@ def _run(name, env_extra=None, args=(), timeout=420, devices=8):
         "STEPS": "8", "EPOCHS": "1",
     })
     env.update(env_extra or {})
-    proc = subprocess.run(
-        [sys.executable, os.path.join(EXAMPLES, name), *args],
-        capture_output=True, text=True, timeout=timeout, env=env,
-        cwd=EXAMPLES)
-    assert proc.returncode == 0, (
-        f"{name} failed\nstdout:\n{proc.stdout[-2000:]}\n"
-        f"stderr:\n{proc.stderr[-2000:]}")
-    return proc.stdout
+    # One retry: these spawn full framework subprocesses on a shared
+    # 1-core box, where XLA's 40 s collective-rendezvous skew timeout
+    # occasionally trips under full-suite load. A deterministic breakage
+    # still fails twice; a scheduling hiccup passes on the second try.
+    detail = ""
+    for _ in (0, 1):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(EXAMPLES, name), *args],
+                capture_output=True, text=True, timeout=timeout, env=env,
+                cwd=EXAMPLES)
+        except subprocess.TimeoutExpired as e:
+            detail = f"timed out after {timeout}s: {e}"
+            continue  # a hang is the same flake class as a crash
+        if proc.returncode == 0:
+            return proc.stdout
+        detail = (f"exit {proc.returncode}\n"
+                  f"stdout:\n{proc.stdout[-2000:]}\n"
+                  f"stderr:\n{proc.stderr[-2000:]}")
+    pytest.fail(f"{name} failed twice: {detail}")
 
 
 class TestExamples:
