@@ -14,6 +14,11 @@ import sys
 _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path[:0] = [_HERE, os.path.dirname(_HERE)]  # _data + repo root (uninstalled runs)
 
+# tf.keras IS Keras 3 and honors KERAS_BACKEND; a stray
+# "torch"/"jax" value from the environment would silently run
+# this TF example on another backend and break GradientTape.
+os.environ["KERAS_BACKEND"] = "tensorflow"
+
 import numpy as np
 import tensorflow as tf
 

@@ -27,6 +27,9 @@ def _needs(module):
 
 def _run(name, env_extra=None, args=(), timeout=420, devices=8):
     env = dict(os.environ)
+    # Other test modules set KERAS_BACKEND at import (collection) time;
+    # examples must see a clean slate and choose their own backend.
+    env.pop("KERAS_BACKEND", None)
     env.update({
         "JAX_PLATFORMS": "cpu",
         "XLA_FLAGS": f"--xla_force_host_platform_device_count={devices}",
@@ -37,7 +40,7 @@ def _run(name, env_extra=None, args=(), timeout=420, devices=8):
     # 1-core box, where XLA's 40 s collective-rendezvous skew timeout
     # occasionally trips under full-suite load. A deterministic breakage
     # still fails twice; a scheduling hiccup passes on the second try.
-    detail = ""
+    details = []
     for _ in (0, 1):
         try:
             proc = subprocess.run(
@@ -45,14 +48,19 @@ def _run(name, env_extra=None, args=(), timeout=420, devices=8):
                 capture_output=True, text=True, timeout=timeout, env=env,
                 cwd=EXAMPLES)
         except subprocess.TimeoutExpired as e:
-            detail = f"timed out after {timeout}s: {e}"
+            def _txt(b):
+                return (b.decode() if isinstance(b, bytes) else (b or ""))
+            details.append(f"timed out after {timeout}s\n"
+                           f"stdout:\n{_txt(e.stdout)[-2000:]}\n"
+                           f"stderr:\n{_txt(e.stderr)[-2000:]}")
             continue  # a hang is the same flake class as a crash
         if proc.returncode == 0:
             return proc.stdout
-        detail = (f"exit {proc.returncode}\n"
-                  f"stdout:\n{proc.stdout[-2000:]}\n"
-                  f"stderr:\n{proc.stderr[-2000:]}")
-    pytest.fail(f"{name} failed twice: {detail}")
+        details.append(f"exit {proc.returncode}\n"
+                       f"stdout:\n{proc.stdout[-2000:]}\n"
+                       f"stderr:\n{proc.stderr[-2000:]}")
+    pytest.fail(f"{name} failed twice:\n--- attempt 1 ---\n{details[0]}\n"
+                f"--- attempt 2 ---\n{details[1]}")
 
 
 class TestExamples:
@@ -123,5 +131,7 @@ class TestExamples:
 
     def test_keras_mnist(self):
         _needs("keras")
-        out = _run("keras_mnist.py", timeout=600)
+        _needs("torch")  # the example's default Keras backend
+        out = _run("keras_mnist.py", timeout=600,
+                   env_extra={"KERAS_BACKEND": "torch"})
         assert "accuracy" in out
