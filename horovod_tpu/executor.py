@@ -110,6 +110,17 @@ def _hier_reduce(buf, ici: int):
     return out[:n] if pad else out
 
 
+def _hier_gather(x, tiled: bool):
+    """Two-stage hierarchical allgather (operations.cc:929-1032 — node
+    shared-memory window + cross-node MPI_Allgatherv — as XLA
+    collectives): gather within the slice over 'ici', then across slices
+    over 'dcn'. The hierarchical mesh is the flat device list reshaped to
+    (dcn, ici) (topology.py:112-117), so the dcn-major/ici-minor result
+    ordering is bit-identical to a flat all_gather over 'dp'."""
+    g = jax.lax.all_gather(x, "ici", axis=0, tiled=tiled)
+    return jax.lax.all_gather(g, "dcn", axis=0, tiled=True)
+
+
 def _trim_concat(gathered, per_rank_dims):
     """Trim a padded [n, max_dim, ...] gather back to ragged segments and
     concatenate — the MPI_Allgatherv displacement math
@@ -124,10 +135,12 @@ class CollectiveExecutor:
 
     def __init__(self, mesh: Optional[Mesh] = None,
                  hier_mesh: Optional[Mesh] = None,
-                 hierarchical_allreduce: bool = False):
+                 hierarchical_allreduce: bool = False,
+                 hierarchical_allgather: bool = False):
         self._mesh = mesh
         self._hier_mesh = hier_mesh
         self.hierarchical_allreduce = hierarchical_allreduce
+        self.hierarchical_allgather = hierarchical_allgather
         self._cache = {}
 
     @property
@@ -253,16 +266,23 @@ class CollectiveExecutor:
         Replicated input ⇒ output is ``size`` stacked copies along dim 0,
         exactly what the reference returns when all ranks pass the same
         tensor (operations.cc:843-1113). Per-rank distinct inputs use
-        :meth:`allgather_sharded`.
+        :meth:`allgather_sharded`. With ``hierarchical_allgather`` set
+        (HOROVOD_TPU_HIERARCHICAL_ALLGATHER), the gather runs in two
+        stages over ('ici', 'dcn') — the reference's shared-memory-window
+        + cross-node path (operations.cc:929-1032).
         """
-        mesh = self.mesh
+        hier = self.hierarchical_allgather
+        mesh = self.hier_mesh if hier else self.mesh
         shapes = tuple(t.shape for t in tensors)
         dtypes = tuple(str(t.dtype) for t in tensors)
-        key = ("ag", shapes, dtypes, id(mesh))
+        key = ("ag", shapes, dtypes, hier, id(mesh))
 
         def build():
             def fused(*xs):
                 def shard_fn(*ys):
+                    if hier:
+                        return tuple(_hier_gather(y, tiled=True)
+                                     for y in ys)
                     return tuple(
                         jax.lax.all_gather(y, "dp", axis=0, tiled=True)
                         for y in ys)
@@ -274,7 +294,8 @@ class CollectiveExecutor:
             return jax.jit(fused)
 
         prog = self._program(key, build)
-        ins = [self._replicated(t) for t in tensors]
+        ins = [jax.device_put(t, NamedSharding(mesh, P()))
+               for t in tensors]
         return list(prog(*ins))
 
     # ---------------------------------------------- per-rank (sharded) inputs
@@ -358,16 +379,20 @@ class CollectiveExecutor:
                     "except the first (mpi_message validation, "
                     "operations.cc:398-446)")
         m = max(first_dims)
-        mesh = self.mesh
+        hier = self.hierarchical_allgather
+        mesh = self.hier_mesh if hier else self.mesh
+        axes = ("dcn", "ici") if hier else ("dp",)
         key = ("agr", (m,) + tuple(rest), str(dtype), tuple(first_dims),
-               id(mesh))
+               hier, id(mesh))
 
         def build():
             def fn(stacked):
                 def shard_fn(z):
+                    if hier:
+                        return _hier_gather(z[0], tiled=False)
                     return jax.lax.all_gather(z[0], "dp", axis=0, tiled=False)
                 return jax.shard_map(
-                    shard_fn, mesh=mesh, in_specs=P("dp"),
+                    shard_fn, mesh=mesh, in_specs=P(axes),
                     out_specs=P(), check_vma=False)(stacked)
             return jax.jit(fn)
 
@@ -377,7 +402,7 @@ class CollectiveExecutor:
             padded[i, : first_dims[i]] = np.asarray(t)
         prog = self._program(key, build)
         gathered = prog(jax.device_put(
-            padded, NamedSharding(mesh, P("dp"))))
+            padded, NamedSharding(mesh, P(axes))))
         return _trim_concat(gathered, first_dims)
 
 
@@ -487,27 +512,34 @@ class CollectiveExecutor:
     def allgather_fused_mp(self, tensors: Sequence[jax.Array]
                            ) -> List[jax.Array]:
         """Cross-process allgather, equal first dims: one segment per
-        virtual rank, concatenated along dim 0."""
-        mesh = self.mesh
+        virtual rank, concatenated along dim 0. Hierarchical mode gathers
+        over 'ici' first (intra-host), then 'dcn' (operations.cc:929-1032)."""
+        hier = self.hierarchical_allgather
+        mesh = self.hier_mesh if hier else self.mesh
+        axes = ("dcn", "ici") if hier else ("dp",)
         shapes = tuple(tuple(t.shape) for t in tensors)
         dtypes = tuple(str(t.dtype) for t in tensors)
-        key = ("agmp", shapes, dtypes, id(mesh))
+        key = ("agmp", shapes, dtypes, hier, id(mesh))
 
         def build():
             def fused(*xs):
                 def shard_fn(*ys):
+                    if hier:
+                        return tuple(_hier_gather(y[0], tiled=True)
+                                     for y in ys)
                     return tuple(
                         jax.lax.all_gather(y[0], "dp", axis=0, tiled=True)
                         for y in ys)
                 return jax.shard_map(
                     shard_fn, mesh=mesh,
-                    in_specs=tuple(P("dp") for _ in xs),
+                    in_specs=tuple(P(axes) for _ in xs),
                     out_specs=tuple(P() for _ in xs),
                     check_vma=False)(*xs)
             return jax.jit(fused)
 
         prog = self._program(key, build)
-        return list(prog(*[self._mp_stacked(t) for t in tensors]))
+        return list(prog(*[self._mp_stacked(t, mesh=mesh, axes=axes)
+                           for t in tensors]))
 
     def allgather_sharded_mp(self, x: jax.Array) -> jax.Array:
         """Allgather of a global array already sharded P('dp') on the
@@ -515,47 +547,56 @@ class CollectiveExecutor:
         result is the same rows, replicated. (The single-process path
         routes this through allgather_ragged; a multi-host sharded array
         cannot be pulled to one host, so it is re-gathered in place.)"""
-        mesh = self.mesh
-        key = ("agsmp", tuple(x.shape), str(x.dtype), id(mesh))
+        hier = self.hierarchical_allgather
+        mesh = self.hier_mesh if hier else self.mesh
+        axes = ("dcn", "ici") if hier else ("dp",)
+        key = ("agsmp", tuple(x.shape), str(x.dtype), hier, id(mesh))
 
         def build():
             def fn(z):
                 def shard_fn(y):
+                    if hier:
+                        return _hier_gather(y, tiled=True)
                     return jax.lax.all_gather(y, "dp", axis=0, tiled=True)
                 return jax.shard_map(
-                    shard_fn, mesh=mesh, in_specs=P("dp"),
+                    shard_fn, mesh=mesh, in_specs=P(axes),
                     out_specs=P(), check_vma=False)(z)
             return jax.jit(fn)
 
-        return self._program(key, build)(x)
+        xin = jax.device_put(x, NamedSharding(mesh, P(axes)))
+        return self._program(key, build)(xin)
 
     def allgather_ragged_mp(self, tensor: jax.Array,
                             per_device_dims: Sequence[int]) -> jax.Array:
         """Cross-process MPI_Allgatherv: first dims differ per process.
         ``per_device_dims`` (one per virtual rank, from the coordinator's
         announced shapes) drives pad-to-max + gather + trim."""
-        mesh = self.mesh
+        hier = self.hierarchical_allgather
+        mesh = self.hier_mesh if hier else self.mesh
+        axes = ("dcn", "ici") if hier else ("dp",)
         n = self.world_size
         m = max(int(d) for d in per_device_dims)
         arr = np.asarray(tensor)
         rest = arr.shape[1:]
         key = ("agrmp", (m,) + tuple(rest), str(tensor.dtype),
-               tuple(int(d) for d in per_device_dims), id(mesh))
+               tuple(int(d) for d in per_device_dims), hier, id(mesh))
 
         def build():
             def fn(stacked):
                 def shard_fn(z):
+                    if hier:
+                        return _hier_gather(z[0], tiled=False)
                     return jax.lax.all_gather(z[0], "dp", axis=0,
                                               tiled=False)
                 return jax.shard_map(
-                    shard_fn, mesh=mesh, in_specs=P("dp"),
+                    shard_fn, mesh=mesh, in_specs=P(axes),
                     out_specs=P(), check_vma=False)(stacked)
             return jax.jit(fn)
 
         padded = np.zeros((m,) + rest, dtype=arr.dtype)
         padded[: arr.shape[0]] = arr
         prog = self._program(key, build)
-        gathered = prog(self._mp_stacked(padded))
+        gathered = prog(self._mp_stacked(padded, mesh=mesh, axes=axes))
         return _trim_concat(gathered, per_device_dims)
 
 
@@ -567,7 +608,8 @@ def default_executor() -> CollectiveExecutor:
     if _default_executor is None:
         from .utils import env as _env
         _default_executor = CollectiveExecutor(
-            hierarchical_allreduce=_env.hierarchical_allreduce())
+            hierarchical_allreduce=_env.hierarchical_allreduce(),
+            hierarchical_allgather=_env.hierarchical_allgather())
     return _default_executor
 
 

@@ -40,6 +40,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import wire_format as _wire_flags
 from .. import topology as _topo
 from ..executor import (ALLGATHER, ALLREDUCE, BROADCAST, CollectiveExecutor,
                         default_executor)
@@ -202,12 +203,15 @@ class CollectiveEngine:
         InitializeHorovodOnce spawning the C++ background thread,
         operations.cc:2384-2402). Falls back to the Python control plane
         when the toolchain is unavailable or it is disabled via
-        HOROVOD_TPU_DISABLE_NATIVE=1."""
-        if self._is_multiprocess():
-            # Cross-process negotiation runs through the TCP coordinator;
-            # the native core's planner is process-local and would diverge
-            # the SPMD program order (see control_plane.py docstring).
-            return None
+        HOROVOD_TPU_DISABLE_NATIVE=1.
+
+        In multi-process mode the native core IS the control plane too:
+        its background cycle serializes this process's request batch
+        (message.cc codec), hands it to :meth:`_native_transport` for the
+        TCP announce/long-poll-fetch legs, parses the coordinator-agreed
+        ResponseList, and delivers each group to :meth:`_on_native_group`
+        for XLA execution — the worker half of the reference's
+        RunLoopOnce (operations.cc:2323-2377) running in C++."""
         with self._lock:
             if self._native_tried:
                 return self._native_core
@@ -226,6 +230,9 @@ class CollectiveEngine:
                 core.init(topo.process_index, topo.process_count,
                           topo.local_size, topo.size)
                 core.set_execute_callback(self._on_native_execute)
+                if topo.process_count > 1:
+                    core.set_group_callback(self._on_native_group)
+                    core.set_transport_callback(self._native_transport)
                 self._native_core = core
             except Exception as e:  # pragma: no cover - degraded path
                 _log.warning("native control plane init failed: %s", e)
@@ -285,7 +292,8 @@ class CollectiveEngine:
             topo = _topo._get()
             if topo.process_index == 0:
                 self._mp_service = _cp.start_coordinator(
-                    topo.process_count, self.fusion_threshold)
+                    topo.process_count, self.fusion_threshold,
+                    virtual_size=topo.size)
                 self._mp_client = _cp.CoordinatorClient(
                     [("127.0.0.1", self._mp_service.port)],
                     self._mp_service.key, topo.process_index)
@@ -308,8 +316,10 @@ class CollectiveEngine:
         """Drain and stop; outstanding handles get SHUT_DOWN_ERROR
         (operations.cc:1942-1998)."""
         if self._mp_client is not None:
+            # Tell the controller first so peers' fetches see the flag;
+            # the client reference stays until the native core is down
+            # (its background thread may be mid-transport).
             self._mp_client.announce_shutdown()
-            self._mp_client = None
         core = self._native_core
         if core is not None:
             # Native path: the C++ shutdown drains its queue (the execute
@@ -323,6 +333,7 @@ class CollectiveEngine:
             for req in native_pending:
                 req.handle._fulfill(error=HorovodInternalError(
                     SHUT_DOWN_ERROR.format(op=_op_name(req.op))))
+        self._mp_client = None
         with self._lock:
             self._shutdown = True
             pending = list(self._queue) + list(self._in_flight.values())
@@ -447,6 +458,150 @@ class CollectiveEngine:
                 core.release(i)
                 r.handle._fulfill(result=out)
 
+    # ------------------------------------- native multi-process bridge
+
+    def _apply_fetch_side_channel(self, resp) -> None:
+        """Coordinator side-channel shared by the native and fallback MP
+        paths: log the authoritative missing-ranks stall report, and apply
+        tuned SCALAR knobs (SyncParams, parameter_manager.cc:213-246) —
+        cycle time paces this engine's announce cadence; program-affecting
+        flags arrive per group instead (SPMD lockstep)."""
+        for line in resp.stall:
+            _log.warning("stalled tensor (coordinator report): %s", line)
+        params = resp.params
+        if params:
+            cyc = params.get("cycle_time_ms")
+            if cyc and abs(cyc - self.cycle_time_s * 1000.0) > 1e-9:
+                self.cycle_time_s = cyc / 1000.0
+                core = self._native_core
+                if core is not None:
+                    core.cycle_time_ms = cyc
+            ft = params.get("fusion_threshold")
+            if ft:
+                self.fusion_threshold = int(ft)
+
+    def _fail_native_pending(self, err: BaseException) -> None:
+        """Fail every native-tracked in-flight request loudly — the MP
+        engine's _fail_all: clears the C++ tensor table (so names can be
+        reused after the error) and fulfills the Python handles."""
+        core = self._native_core
+        with self._lock:
+            pending = list(self._native_pending.items())
+            self._native_pending.clear()
+        for i, r in pending:
+            if core is not None:
+                core.complete([i], 2, str(err))
+                core.release(i)
+            r.handle._fulfill(error=_as_error(err))
+
+    def _native_transport(self, req_bytes: bytes, nreq: int,
+                          pending: int) -> bytes:
+        """The announce/fetch legs of the MP cycle, called from the native
+        background thread (core.cc TransportCallback): ship this process's
+        serialized RequestList to the rank-0 controller, long-poll the
+        agreed ResponseList, return its bytes for the C++ parser.
+        ``nreq == 0`` with a non-empty batch means retry-after-overflow
+        (native.py caches the payload), so only announce fresh batches.
+
+        A transport failure (coordinator unreachable past the client's
+        retries) is FATAL for the in-flight ops: the batch was already
+        drained from the native queue and will never be re-announced, so
+        peers would wait on quorum forever — fail the handles loudly
+        instead of hanging the fleet."""
+        try:
+            client = self._ensure_mp()
+            if nreq > 0:
+                client.announce_bytes(req_bytes)
+            if pending <= 0:
+                return b""
+            resp = client.fetch(wait_s=max(self.cycle_time_s, 0.05))
+        except BaseException as e:
+            _log.error("multi-process control plane failed: %s", e)
+            self._fail_native_pending(HorovodInternalError(
+                f"multi-process control plane failed: {e}"))
+            return b""
+        self._apply_fetch_side_channel(resp)
+        return resp.payload or b""
+
+    def _on_native_group(self, op: int, native_ids: List[int], nnames: int,
+                         sizes: List[int], flags: int, err: str):
+        """Execute one coordinator-agreed group (core.cc GroupCallback) —
+        the MP analogue of :meth:`_on_native_execute`, with group metadata
+        (ragged allgather sizes, hierarchical flags) from the wire."""
+        core = self._native_core
+        if core is None:
+            return
+        with self._lock:
+            pairs = [(i, self._native_pending.pop(i))
+                     for i in native_ids if i in self._native_pending]
+        if len(native_ids) != nnames or len(pairs) != nnames:
+            # Local/coordinator desync: peers will enter this group's SPMD
+            # program; skipping it here would deadlock them. Fail loudly
+            # (ADVICE r1) — every local in-flight op dies with a
+            # diagnostic instead of the job hanging.
+            desync = HorovodInternalError(
+                f"coordinator/local state desync: group of {nnames} "
+                f"tensors matched {len(pairs)} local handles; failing the "
+                "engine rather than skipping a collective the other ranks "
+                "will enter")
+            _log.error("%s", desync)
+            with self._lock:
+                extra = list(self._native_pending.items())
+                self._native_pending.clear()
+            for i, r in pairs + extra:
+                core.complete([i], 2, str(desync))
+                core.release(i)
+                r.handle._fulfill(error=desync)
+            return
+        if err:
+            ids = [i for i, _ in pairs]
+            core.complete(ids, 2, err)
+            for i, r in pairs:
+                core.release(i)
+                r.handle._fulfill(error=HorovodInternalError(err))
+            return
+        topo = _topo._get()
+        nproc = topo.process_count
+        # Per-process first dims in tensor_names (== handles) order.
+        sizes_of = {}
+        if op == ALLGATHER and len(sizes) == nnames * nproc:
+            for j, (_, r) in enumerate(pairs):
+                sizes_of[r.name] = sizes[j * nproc:(j + 1) * nproc]
+        meta = {"sizes": sizes_of}
+        ex = self.executor
+        # Plan-time flags rule execution for THIS group on every process —
+        # the engine thread is the only executor user, so the flip is safe.
+        ex.hierarchical_allreduce = bool(
+            flags & _wire_flags.FLAG_HIERARCHICAL_ALLREDUCE)
+        ex.hierarchical_allgather = bool(
+            flags & _wire_flags.FLAG_HIERARCHICAL_ALLGATHER)
+        subgroups: Dict[tuple, List] = {}
+        for i, r in pairs:
+            k = (r.sharded, r.average, r.prescale, r.postscale,
+                 r.root_rank)
+            subgroups.setdefault(k, []).append((i, r))
+        tl = core.timeline_enabled()
+        for sub in subgroups.values():
+            ids = [i for i, _ in sub]
+            reqs = [r for _, r in sub]
+            if tl:
+                for r in reqs:
+                    core.timeline_activity_end(r.name)       # close QUEUE
+                    core.timeline_activity_start(r.name, _xla_activity(op))
+            try:
+                results = self._execute_group_mp(ex, reqs, meta, topo, op)
+            except BaseException as e:
+                msg = str(e)
+                core.complete(ids, 2, msg)
+                for (i, r) in sub:
+                    core.release(i)
+                    r.handle._fulfill(error=_as_error(e))
+                continue
+            core.complete(ids, 0, "")
+            for (i, r), out in zip(sub, results):
+                core.release(i)
+                r.handle._fulfill(result=out)
+
     def make_handle(self, name: str) -> Handle:
         with self._lock:
             self._handle_counter += 1
@@ -510,7 +665,14 @@ class CollectiveEngine:
         if not waiting:
             return
         resp = client.fetch(wait_s=max(self.cycle_time_s, 0.05))
-        if resp.shutdown and not resp.groups:
+        self._apply_fetch_side_channel(resp)
+        if resp.shutdown:
+            # A peer announced shutdown — possibly from its teardown path,
+            # in which case it will never enter the still-pending SPMD
+            # programs; executing them would hang the surviving ranks in
+            # XLA collectives. Fail everything with SHUT_DOWN_ERROR
+            # instead, matching the reference's drain of queued tensors on
+            # shutdown (operations.cc:1942-1998).
             self._fail_all(HorovodInternalError(
                 SHUT_DOWN_ERROR.format(op="run")))
             return
@@ -520,12 +682,29 @@ class CollectiveEngine:
     def _execute_mp_group(self, group: dict):
         """Execute one coordinator-agreed group. All names were announced
         by this process (a group forms only when every process announced),
-        so the requests are in our in-flight table."""
+        so the requests MUST be in our in-flight table — a missing name
+        means local/coordinator desync (e.g. _fail_all cleared in-flight
+        after a cycle exception while announcements remained registered).
+        Skipping the collective while peers execute it would deadlock the
+        SPMD program, so desync is fatal for the engine instead."""
         with self._lock:
             reqs = [self._in_flight.pop(n) for n in group["names"]
                     if n in self._in_flight]
-        if not reqs:
-            return
+        if len(reqs) != len(group["names"]):
+            have = {r.name for r in reqs}
+            missing = [n for n in group["names"] if n not in have]
+            err = HorovodInternalError(
+                "coordinator/local state desync: coordinator group "
+                f"{group['names']} includes tensors this process no longer "
+                f"has in flight ({missing}); failing the engine rather than "
+                "skipping a collective the other ranks will enter")
+            _log.error("%s", err)
+            for r in reqs:
+                r.handle._fulfill(error=err)
+            # Propagate: _loop's guard fails every remaining in-flight
+            # request, so the job dies with a diagnostic instead of
+            # hanging all ranks.
+            raise err
         tl = self.timeline
         if tl is not None:
             for r in reqs:
@@ -538,12 +717,19 @@ class CollectiveEngine:
                 r.handle._fulfill(error=HorovodInternalError(group["error"]))
             return
         ex = self.executor
+        # Plan-time flags rule execution for this group on every process
+        # (SPMD lockstep; the engine thread is the executor's only user).
+        flags = int(group.get("flags", 0))
+        ex.hierarchical_allreduce = bool(
+            flags & _wire_flags.FLAG_HIERARCHICAL_ALLREDUCE)
+        ex.hierarchical_allgather = bool(
+            flags & _wire_flags.FLAG_HIERARCHICAL_ALLGATHER)
         # Execution-semantic attributes the coordinator doesn't track
         # subdivide the group — deterministically, since SPMD call sites
         # pass identical attributes on every process.
         subgroups: Dict[tuple, List[_Request]] = {}
         for r in reqs:
-            k = (r.sharded, r.average, r.prescale, r.postscale)
+            k = (r.sharded, r.average, r.prescale, r.postscale, r.root_rank)
             subgroups.setdefault(k, []).append(r)
         topo = _topo._get()
         for sub in subgroups.values():
@@ -574,8 +760,13 @@ class CollectiveEngine:
                 r.handle._fulfill(result=out)
 
     def _execute_group_mp(self, ex: CollectiveExecutor,
-                          group: List[_Request], meta: dict, topo) -> List:
-        op = group[0].op
+                          group: List[_Request], meta: dict, topo,
+                          op: Optional[int] = None) -> List:
+        """One coordinator-agreed subgroup as XLA programs — shared by the
+        native (_on_native_group) and fallback (_execute_mp_group) MP
+        paths; ``meta['sizes']`` carries the per-process allgather dims."""
+        if op is None:
+            op = group[0].op
         if op == ALLREDUCE:
             if group[0].sharded:
                 return [ex.allreduce_sharded(
@@ -591,8 +782,10 @@ class CollectiveEngine:
             if group[0].sharded:
                 return [ex.broadcast_sharded(r.tensor, r.root_rank)
                         for r in group]
+            # Root from the request (validated identical across ranks by
+            # the coordinator); the native wire carries no root field.
             return ex.broadcast_fused_mp([r.tensor for r in group],
-                                         meta["root_rank"])
+                                         group[0].root_rank)
         if op == ALLGATHER:
             outs: List = []
             for r in group:

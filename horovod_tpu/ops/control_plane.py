@@ -19,6 +19,14 @@ The coordinator's ordered group sequence provides that guarantee; cycle
 timing differences between processes can no longer diverge the fusion
 plan.
 
+The PLANNER is the native runtime (runtime/src/controller.cc wrapping
+coordinator.cc's MessageTable/ConstructResponse/FuseResponses, with
+message.cc's codec as the payload format — one planner, one wire); this
+module is the TCP transport around it plus a pure-Python fallback planner
+for hosts without the toolchain. Both planners speak the same wire format
+(ops/wire_format.py mirrors the native codec byte-for-byte) and are
+asserted to produce identical fusion plans in tests/test_native.py.
+
 Endpoint discovery: the launcher exports ``HOROVOD_TPU_CONTROL``
 (host:port, bound by process 0) and ``HOROVOD_TPU_SECRET_KEY``; workers
 poll with ``FetchGroups`` (the Bcast analogue) after announcing requests
@@ -32,6 +40,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from . import wire_format as _wire
 from ..runner.network import BasicClient, BasicService
 from ..runner.secret import SECRET_ENV, decode_key, make_secret_key
 from ..utils.logging import get_logger
@@ -50,12 +59,24 @@ _OP_NAMES = {0: "allreduce", 1: "allgather", 2: "broadcast"}
 
 class AnnounceRequest:
     """One process's newly-ready request metadata for this cycle — the
-    serialized MPIRequestList of the reference (mpi_message.h:88-105)."""
+    serialized MPIRequestList of the reference (mpi_message.h:88-105).
 
-    def __init__(self, rank: int, requests: List[dict], shutdown: bool = False):
+    ``announce_id`` is a per-rank monotonically increasing sequence number
+    making announces idempotent end-to-end: BasicClient retries a request
+    whose response was lost, and if the first delivery completed a quorum
+    (entry deleted), a blind re-apply would resurrect a stale one-rank
+    entry with last step's shape metadata. The coordinator drops ids it
+    has already processed instead."""
+
+    def __init__(self, rank: int, requests: List[dict], shutdown: bool = False,
+                 announce_id: int = 0, payload: Optional[bytes] = None):
         self.rank = rank
         self.requests = requests  # {name, op, dtype, shape, root_rank, nbytes}
         self.shutdown = shutdown
+        self.announce_id = announce_id
+        # Native-engine processes announce pre-serialized RequestList bytes
+        # (message.cc codec) instead of dicts; `requests` is then empty.
+        self.payload = payload
 
 
 class AnnounceResponse:
@@ -74,10 +95,23 @@ class FetchRequest:
 
 
 class FetchResponse:
-    def __init__(self, groups: List[dict], shutdown: bool):
-        self.groups = groups      # [{seq, op, names, error, root_rank,
+    def __init__(self, groups: List[dict], shutdown: bool,
+                 payload: Optional[bytes] = None,
+                 params: Optional[dict] = None,
+                 stall: Optional[List[str]] = None):
+        self.groups = groups      # [{seq, op, names, error, flags,
         #                            sizes: {name: [dim0 per process]}}]
         self.shutdown = shutdown
+        # Serialized ResponseList (message.cc codec) for native engines —
+        # the exact bytes the native core parses (the Bcast payload).
+        self.payload = payload
+        # Coordinator-tuned scalar knobs (SyncParams equivalent,
+        # parameter_manager.cc:213-246): fusion_threshold, cycle_time_ms,
+        # flags, autotune_active, autotune_done.
+        self.params = params or {}
+        # Coordinator stall-report lines (missing-ranks diagnostics,
+        # operations.cc:1625-1672), logged by every process.
+        self.stall = stall or []
 
 
 class _Entry:
@@ -105,11 +139,19 @@ class _Entry:
 
 class CoordinatorService(BasicService):
     """Rank-0 coordinator: counts announcements, validates, plans fusion,
-    serves the ordered group sequence."""
+    serves the ordered group sequence.
+
+    The planner is the native controller (runtime/src/controller.cc) when
+    the toolchain is available — the reference's C++ coordinator running
+    the real cross-process negotiation — with this class as TCP transport.
+    ``native=False`` forces the pure-Python fallback planner (used on
+    hosts without g++, and by the plan-equivalence tests)."""
 
     def __init__(self, nproc: int, key: bytes,
                  fusion_threshold: int = 64 * 1024 * 1024,
-                 port: int = 0):
+                 port: int = 0, native: object = "auto",
+                 virtual_size: int = 0,
+                 stall_warning_s: Optional[float] = None):
         super().__init__("horovod-tpu-coordinator", key, port=port)
         self.key = key
         self._nproc = nproc
@@ -126,13 +168,63 @@ class CoordinatorService(BasicService):
         self._acked: Dict[int, int] = {}
         self._order = 0
         self._shutdown = False
+        # Highest announce_id processed per rank — replay protection for
+        # client retries (a retried announce must be a no-op, or it can
+        # resurrect a quorum-deleted entry with stale shape metadata).
+        self._last_announce: Dict[int, int] = {}
         # Stall reporting (CheckForStalledTensors, operations.cc:1625-1672):
         # the coordinator alone knows WHICH ranks are missing per tensor.
         # Window from env (HOROVOD_TPU_STALL_CHECK_DISABLE honored), the
         # same knob source the engine uses (collective.py).
         from ..utils import env as _envmod
-        self.stall_warning_s = _envmod.stall_warning_secs()
+        self.stall_warning_s = (stall_warning_s if stall_warning_s is not None
+                                else _envmod.stall_warning_secs())
         self._last_stall_check = time.monotonic()
+        # Plan-affecting env knobs, stamped into every group so all
+        # processes execute the same program shape (Response::Flags).
+        self._flags = ((_wire.FLAG_HIERARCHICAL_ALLREDUCE
+                        if _envmod.hierarchical_allreduce() else 0)
+                       | (_wire.FLAG_HIERARCHICAL_ALLGATHER
+                          if _envmod.hierarchical_allgather() else 0))
+        self.cycle_time_ms = _envmod.cycle_time_ms()
+        self._ctl = None
+        if native is not False:
+            try:
+                from ..runtime import native as _native_mod
+                core = _native_mod.load(required=(native is True))
+                if core is not None:
+                    self._ctl = _native_mod.NativeController(
+                        core, nproc,
+                        virtual_size if virtual_size > 0 else (1 << 30),
+                        fusion_threshold, self.cycle_time_ms,
+                        self.stall_warning_s,
+                        _envmod.hierarchical_allreduce(),
+                        _envmod.hierarchical_allgather(),
+                        _envmod.autotune(),
+                        _envmod.autotune_log() or "")
+            except Exception as e:
+                if native is True:
+                    raise
+                _log.warning("native controller unavailable, using Python "
+                             "fallback planner: %s", e)
+
+    @property
+    def native_active(self) -> bool:
+        return self._ctl is not None
+
+    def history_len(self) -> int:
+        """Groups retained in the (pruned) history — observability/tests."""
+        if self._ctl is not None:
+            return self._ctl.group_count() - self._ctl.base_seq()
+        with self._mu:
+            return len(self._groups)
+
+    def base_seq(self) -> int:
+        """First un-pruned sequence number."""
+        if self._ctl is not None:
+            return self._ctl.base_seq()
+        with self._mu:
+            return self._base_seq
 
     # ------------------------------------------------------------- protocol
 
@@ -145,14 +237,39 @@ class CoordinatorService(BasicService):
 
     def _announce(self, req: AnnounceRequest) -> AnnounceResponse:
         with self._cv:
+            if req.announce_id:
+                if req.announce_id <= self._last_announce.get(req.rank, 0):
+                    return AnnounceResponse()  # duplicate delivery (retry)
+                self._last_announce[req.rank] = req.announce_id
             if req.shutdown:
                 # Any rank announcing shutdown stops the world — the
                 # reference ORs the shutdown flag into the response list
                 # (operations.cc:2125-2128).
                 self._shutdown = True
+                if self._ctl is not None:
+                    self._ctl.announce(_wire.encode_request_list(
+                        req.rank, [], shutdown=True))
                 self._cv.notify_all()
                 return AnnounceResponse()
-            for r in req.requests:
+            if self._ctl is not None:
+                # Native planner: feed message.cc-codec bytes (encoding
+                # dict announces from fallback-mode workers on the way in).
+                payload = req.payload
+                if payload is None:
+                    payload = _wire.encode_request_list(req.rank,
+                                                        req.requests)
+                self._ctl.announce(payload)
+                self._cv.notify_all()  # waiters recheck group_count
+                return AnnounceResponse()
+            requests = req.requests
+            if req.payload is not None:
+                decoded, sd = _wire.decode_request_list(req.payload)
+                if sd:
+                    self._shutdown = True
+                    self._cv.notify_all()
+                    return AnnounceResponse()
+                requests = decoded
+            for r in requests:
                 e = self._table.get(r["name"])
                 if e is None:
                     e = _Entry(self._order)
@@ -165,7 +282,13 @@ class CoordinatorService(BasicService):
                 e.dtype_by_rank[req.rank] = str(r["dtype"])
                 e.shape_by_rank[req.rank] = tuple(r["shape"])
                 e.root_by_rank[req.rank] = int(r.get("root_rank", -1))
-                e.nbytes = max(e.nbytes, int(r.get("nbytes", 0)))
+                # Payload bytes from shape × dtype, exactly as the native
+                # planner derives them from the wire Request — both
+                # planners must fuse identically.
+                nbytes = _wire.dtype_size(_wire.dtype_enum(str(r["dtype"])))
+                for d in r["shape"]:
+                    nbytes *= int(d)
+                e.nbytes = max(e.nbytes, nbytes)
                 # Mismatched op/dtype is detected in _validate once every
                 # rank has announced — SPMD code enqueues the same name on
                 # all ranks, so a colliding name still reaches quorum and
@@ -183,7 +306,8 @@ class CoordinatorService(BasicService):
         """Warn about tensors announced by only a subset of ranks past the
         stall window, naming the missing ranks — the reference
         coordinator's report (operations.cc:1644-1668). Returns the
-        warning lines (also logged) for tests/monitoring."""
+        warning lines (also logged, and shipped to every worker through
+        the fetch response) for tests/monitoring."""
         now = time.monotonic()
         lines: List[str] = []
         with self._mu:
@@ -191,12 +315,15 @@ class CoordinatorService(BasicService):
                     or now - self._last_stall_check < self.stall_warning_s):
                 return lines
             self._last_stall_check = now
-            for name, e in sorted(self._table.items()):
-                if now - e.first_seen > self.stall_warning_s:
-                    missing = sorted(set(range(self._nproc)) - e.ranks)
-                    lines.append(
-                        f"{name} [missing ranks: "
-                        f"{', '.join(map(str, missing))}]")
+            if self._ctl is not None:
+                lines = self._ctl.stalled()
+            else:
+                for name, e in sorted(self._table.items()):
+                    if now - e.first_seen > self.stall_warning_s:
+                        missing = sorted(set(range(self._nproc)) - e.ranks)
+                        lines.append(
+                            f"{name} [missing ranks: "
+                            f"{', '.join(map(str, missing))}]")
         if lines:
             _log.warning(
                 "One or more tensors were submitted to be reduced, "
@@ -210,8 +337,27 @@ class CoordinatorService(BasicService):
         return lines
 
     def _fetch(self, req: FetchRequest) -> FetchResponse:
-        self.check_stalls()
+        stall = self.check_stalls()
         deadline = time.monotonic() + max(0.0, req.wait_s)
+        if self._ctl is not None:
+            # Autotune cadence: rank 0's fetch marks one coordinator-side
+            # engine cycle (the reference samples once per RunLoopOnce,
+            # parameter_manager.cc:144-170).
+            if req.rank == 0:
+                self._ctl.tick()
+            with self._cv:
+                while (self._ctl.group_count() <= req.after_seq
+                       and not self._ctl.shutdown_flag()
+                       and time.monotonic() < deadline):
+                    self._cv.wait(timeout=max(0.0,
+                                              deadline - time.monotonic()))
+                payload = self._ctl.fetch(req.rank, req.after_seq)
+                groups, shutdown = _wire.decode_response_list(payload,
+                                                              self._nproc)
+                for i, g in enumerate(groups):
+                    g["seq"] = req.after_seq + i
+                return FetchResponse(groups, shutdown, payload=payload,
+                                     params=self._ctl.params(), stall=stall)
         with self._cv:
             self._acked[req.rank] = max(self._acked.get(req.rank, 0),
                                         req.after_seq)
@@ -227,7 +373,16 @@ class CoordinatorService(BasicService):
                                           deadline - time.monotonic()))
                 next_seq = len(self._groups) + self._base_seq
             start = max(0, req.after_seq - self._base_seq)
-            return FetchResponse(self._groups[start:], self._shutdown)
+            groups = self._groups[start:]
+            params = {"fusion_threshold": self.fusion_threshold,
+                      "cycle_time_ms": self.cycle_time_ms,
+                      "flags": self._flags, "autotune_active": False,
+                      "autotune_done": False}
+            return FetchResponse(
+                groups, self._shutdown,
+                payload=_wire.encode_response_list(groups, self._shutdown,
+                                                   self._nproc),
+                params=params, stall=stall)
 
     # ------------------------------------------------------------- planning
 
@@ -256,10 +411,13 @@ class CoordinatorService(BasicService):
                         "must agree on every dimension except the first "
                         f"across ranks; got {sorted(set(shapes))}")
         if e.op == 2:  # broadcast: same root everywhere
-            roots = set(e.root_by_rank.values())
+            roots = sorted(set(e.root_by_rank.values()))
             if len(roots) > 1:
-                return (f"Mismatched broadcast root ranks for tensor "
-                        f"{name}: {sorted(roots)}")
+                # Same wording as ConstructResponse (coordinator.cc) /
+                # the reference (operations.cc:448-478).
+                return (f"Mismatched root ranks: One rank specified root "
+                        f"rank {roots[0]}, but another rank specified "
+                        f"root rank {roots[1]}.")
         return ""
 
     def _plan_locked(self):
@@ -273,10 +431,12 @@ class CoordinatorService(BasicService):
             name, e = remaining.pop(0)
             err = self._validate(name, e)
             if err:
+                # op 3 == Response::ERROR — same verdict encoding as the
+                # native planner (message.h) so plans stay identical.
                 self._groups.append({
-                    "seq": len(self._groups) + self._base_seq, "op": e.op,
+                    "seq": len(self._groups) + self._base_seq, "op": 3,
                     "names": [name], "error": err, "root_rank": -1,
-                    "sizes": {}})
+                    "sizes": {}, "flags": self._flags})
                 continue
             group_names = [name]
             sizes = {}
@@ -302,7 +462,17 @@ class CoordinatorService(BasicService):
                 "seq": len(self._groups) + self._base_seq, "op": e.op,
                 "names": group_names, "error": "",
                 "root_rank": next(iter(e.root_by_rank.values()), -1),
-                "sizes": sizes})
+                "sizes": sizes, "flags": self._flags})
+
+
+    def shutdown(self) -> None:
+        # The native controller handle is deliberately NOT destroyed:
+        # socketserver handler threads can still be mid-request after
+        # shutdown() returns, and a freed controller under a live call is
+        # a use-after-free. The reference keeps its global state for the
+        # process lifetime for the same reason (operations.cc comment at
+        # hvdtpu_shutdown); a controller is a few KB.
+        super().shutdown()
 
 
 class CoordinatorClient:
@@ -321,9 +491,21 @@ class CoordinatorClient:
                                    connect_attempts=300)
         self._rank = rank
         self.last_seq = 0
+        self._announce_seq = 0
 
     def announce(self, requests: List[dict]) -> None:
-        self._client.request(AnnounceRequest(self._rank, requests))
+        self._announce_seq += 1
+        self._client.request(AnnounceRequest(self._rank, requests,
+                                             announce_id=self._announce_seq))
+
+    def announce_bytes(self, payload: bytes) -> None:
+        """Announce a pre-serialized RequestList (message.cc codec) — the
+        native engine's path: the bytes the C++ core serialized travel
+        verbatim to the controller's C++ parser."""
+        self._announce_seq += 1
+        self._client.request(AnnounceRequest(
+            self._rank, [], announce_id=self._announce_seq,
+            payload=payload))
 
     def fetch(self, wait_s: float = 0.0) -> FetchResponse:
         resp = self._client.request(
@@ -371,8 +553,8 @@ def control_endpoint() -> Optional[Tuple[str, int]]:
     return host, int(port)
 
 
-def start_coordinator(nproc: int, fusion_threshold: int
-                      ) -> CoordinatorService:
+def start_coordinator(nproc: int, fusion_threshold: int,
+                      virtual_size: int = 0) -> CoordinatorService:
     """Start the rank-0 coordinator, binding the launcher-published port
     from HOROVOD_TPU_CONTROL. Without a published endpoint (single-host
     tests talking to it in-process) an ephemeral port and a random key
@@ -382,4 +564,5 @@ def start_coordinator(nproc: int, fusion_threshold: int
         else make_secret_key()
     return CoordinatorService(nproc, key,
                               fusion_threshold=fusion_threshold,
-                              port=ep[1] if ep else 0)
+                              port=ep[1] if ep else 0,
+                              virtual_size=virtual_size)

@@ -1,0 +1,213 @@
+"""Pure-Python mirror of the native control-plane codec (N2).
+
+The cross-process control plane's wire format is defined by the native
+runtime (runtime/src/message.{h,cc} — the TPU-native equivalent of the
+reference's FlatBuffers wire, horovod/common/mpi_message.cc:134-230):
+little-endian fixed-width ints and length-prefixed strings. The rank-0
+controller parses announce payloads and serializes response lists in C++;
+this module is the byte-exact Python mirror used by
+
+  - processes whose native toolchain is unavailable (degraded mode — they
+    still speak the same wire format, so mixed fleets interoperate), and
+  - the Python fallback planner and tests.
+
+``tests/test_native.py`` asserts byte-for-byte round-trips against the
+native codec; any format change must land in both files.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_I32 = struct.Struct("<i")
+_I64 = struct.Struct("<q")
+
+# Wire op enums (message.h Request::Type / Response::Type).
+ALLREDUCE, ALLGATHER, BROADCAST, ERROR = 0, 1, 2, 3
+
+# Response flags (message.h Response::Flags) — plan-time execution-mode
+# bits every process applies for the group (SPMD lockstep).
+FLAG_HIERARCHICAL_ALLREDUCE = 1 << 0
+FLAG_HIERARCHICAL_ALLGATHER = 1 << 1
+
+# Dtype enum (runtime/src/common.h DataType; reference mpi_message.h:26-37
+# plus bfloat16). fp8 dtypes plan under the 1-byte uint8 slot, matching
+# runtime/native.py's enqueue convention.
+_DTYPE_TO_ENUM = {
+    "uint8": 0, "int8": 1, "uint16": 2, "int16": 3, "int32": 4,
+    "int64": 5, "float16": 6, "float32": 7, "float64": 8, "bool": 9,
+    "bfloat16": 10,
+}
+_ENUM_TO_DTYPE = {v: k for k, v in _DTYPE_TO_ENUM.items()}
+_DTYPE_SIZE = {0: 1, 1: 1, 2: 2, 3: 2, 4: 4, 5: 8, 6: 2, 7: 4, 8: 8,
+               9: 1, 10: 2}
+
+
+def dtype_enum(name: str) -> int:
+    if name.startswith("float8"):
+        return _DTYPE_TO_ENUM["uint8"]
+    return _DTYPE_TO_ENUM[name]
+
+
+def dtype_name(enum: int) -> str:
+    return _ENUM_TO_DTYPE.get(enum, "unknown")
+
+
+def dtype_size(enum: int) -> int:
+    return _DTYPE_SIZE.get(enum, 0)
+
+
+class _Writer:
+    def __init__(self):
+        self.parts: List[bytes] = []
+
+    def i32(self, v: int):
+        self.parts.append(_I32.pack(v))
+
+    def i64(self, v: int):
+        self.parts.append(_I64.pack(v))
+
+    def s(self, v: str):
+        b = v.encode()
+        self.i32(len(b))
+        self.parts.append(b)
+
+    def bytes(self) -> bytes:
+        return b"".join(self.parts)
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.off = 0
+
+    def i32(self) -> int:
+        v = _I32.unpack_from(self.data, self.off)[0]
+        self.off += 4
+        return v
+
+    def i64(self) -> int:
+        v = _I64.unpack_from(self.data, self.off)[0]
+        self.off += 8
+        return v
+
+    def s(self) -> str:
+        n = self.i32()
+        v = self.data[self.off:self.off + n].decode()
+        self.off += n
+        return v
+
+
+# --------------------------------------------------------------- requests
+
+def encode_request(w: _Writer, rank: int, op: int, dtype: str, name: str,
+                   root_rank: int, device: int,
+                   shape: Sequence[int]) -> None:
+    w.i32(rank)
+    w.i32(op)
+    w.i32(dtype_enum(dtype))
+    w.s(name)
+    w.i32(root_rank)
+    w.i32(device)
+    w.i32(len(shape))
+    for d in shape:
+        w.i64(int(d))
+
+
+def encode_request_list(rank: int, requests: List[dict],
+                        shutdown: bool = False) -> bytes:
+    """Serialize one process's announce — requests are the engine's dicts
+    {name, op, dtype, shape, root_rank}. Mirrors RequestList::SerializeTo."""
+    w = _Writer()
+    w.i32(1 if shutdown else 0)
+    w.i32(len(requests))
+    for r in requests:
+        encode_request(w, rank, int(r["op"]), str(r["dtype"]),
+                       str(r["name"]), int(r.get("root_rank", -1)),
+                       int(r.get("device", -1)), tuple(r["shape"]))
+    return w.bytes()
+
+
+def decode_request_list(data: bytes) -> Tuple[List[dict], bool]:
+    """Parse a RequestList into planner dicts. Mirrors
+    RequestList::ParseFrom."""
+    r = _Reader(data)
+    shutdown = r.i32() != 0
+    n = r.i32()
+    out: List[dict] = []
+    for _ in range(n):
+        rank = r.i32()
+        op = r.i32()
+        dt = r.i32()
+        name = r.s()
+        root = r.i32()
+        device = r.i32()
+        ndims = r.i32()
+        shape = tuple(r.i64() for _ in range(ndims))
+        nbytes = dtype_size(dt)
+        for d in shape:
+            nbytes *= d
+        out.append({"rank": rank, "op": op, "dtype": dtype_name(dt),
+                    "name": name, "root_rank": root, "device": device,
+                    "shape": shape, "nbytes": nbytes})
+    return out, shutdown
+
+
+# -------------------------------------------------------------- responses
+
+def encode_response_list(groups: List[dict], shutdown: bool = False,
+                         nproc: int = 1) -> bytes:
+    """Serialize planner group dicts ({op, names, error, sizes, flags}) as
+    a ResponseList. ``sizes`` maps name -> per-process first dims; the wire
+    flattens them in tensor_names order (mpi_message.h:147-152)."""
+    w = _Writer()
+    w.i32(1 if shutdown else 0)
+    w.i32(len(groups))
+    for g in groups:
+        op = ERROR if g.get("error") else int(g["op"])
+        w.i32(op)
+        names = list(g["names"])
+        w.i32(len(names))
+        for n in names:
+            w.s(n)
+        w.s(g.get("error", "") or "")
+        w.i32(0)  # devices (CPU_DEVICE_ID implied; not used on TPU path)
+        sizes = g.get("sizes") or {}
+        flat: List[int] = []
+        if sizes and not g.get("error"):
+            for n in names:
+                flat.extend(int(x) for x in sizes.get(n, ()))
+        w.i32(len(flat))
+        for v in flat:
+            w.i64(v)
+        w.i32(int(g.get("flags", 0)))
+    return w.bytes()
+
+
+def decode_response_list(data: bytes, nproc: int) -> Tuple[List[dict], bool]:
+    """Parse a ResponseList into engine group dicts. Per-tensor allgather
+    sizes are re-grouped from the flat wire layout (nproc entries per
+    tensor, tensor_names order)."""
+    r = _Reader(data)
+    shutdown = r.i32() != 0
+    count = r.i32()
+    groups: List[dict] = []
+    for _ in range(count):
+        op = r.i32()
+        n_names = r.i32()
+        names = [r.s() for _ in range(n_names)]
+        error = r.s()
+        n_dev = r.i32()
+        for _ in range(n_dev):
+            r.i32()
+        n_sizes = r.i32()
+        flat = [r.i64() for _ in range(n_sizes)]
+        flags = r.i32()
+        sizes: Dict[str, List[int]] = {}
+        if flat and nproc > 0 and len(flat) == len(names) * nproc:
+            for i, nm in enumerate(names):
+                sizes[nm] = flat[i * nproc:(i + 1) * nproc]
+        groups.append({"op": op, "names": names, "error": error,
+                       "sizes": sizes, "flags": flags})
+    return groups, shutdown

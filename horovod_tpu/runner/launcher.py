@@ -103,6 +103,54 @@ def _ssh_spawn_spec(host: str, env: Dict[str, str], args: List[str],
     return argv, payload
 
 
+def _probe_remote_ports(host: str, ports: List[int],
+                        timeout: float = 20.0) -> Optional[List[int]]:
+    """Bind-check ``ports`` on ``host`` via the bootstrap's --probe mode.
+    Returns the free subset, or None when the probe could not run (no
+    ssh / no python on the remote) — callers then fall back to a blind
+    pick, the pre-probe behavior."""
+    import json as _json
+    import subprocess
+    argv = (["ssh", "-o", "StrictHostKeyChecking=no", host, "python3",
+             "-m", "horovod_tpu.runner.remote_bootstrap", "--probe"]
+            + [str(p) for p in ports])
+    try:
+        out = subprocess.run(argv, capture_output=True, timeout=timeout)
+        if out.returncode != 0:
+            return None
+        return list(_json.loads(out.stdout.decode().strip())["free"])
+    except Exception:
+        return None
+
+
+def _pick_remote_ports(host: str, coordinator_port: Optional[int]
+                       ) -> Tuple[int, int]:
+    """Choose (coordinator, control) ports for a remote rank-0 host,
+    probing candidates over ssh. A pinned ``coordinator_port`` that turns
+    out busy raises with a message naming the knob."""
+    import random
+    rnd = random.SystemRandom()
+    for _ in range(3):
+        coord = (coordinator_port if coordinator_port is not None
+                 else rnd.randrange(20000, 60000))
+        ctrl = rnd.randrange(20000, 60000)
+        while ctrl == coord:
+            ctrl = rnd.randrange(20000, 60000)
+        free = _probe_remote_ports(host, [coord, ctrl])
+        if free is None:
+            return coord, ctrl  # probe unavailable: keep the blind pick
+        if coord in free and ctrl in free:
+            return coord, ctrl
+        if coordinator_port is not None and coord not in free:
+            raise RuntimeError(
+                f"coordinator_port {coordinator_port} is already in use "
+                f"on {host}; pick a different coordinator_port or free "
+                "the port")
+    raise RuntimeError(
+        f"could not find free coordinator/control ports on {host} after "
+        "3 probe attempts; pass coordinator_port to pin a known-free one")
+
+
 class LaunchedJob:
     def __init__(self, workers: List[ManagedProcess]):
         self.workers = workers
@@ -196,17 +244,14 @@ def launch(command: List[str], np: int, hosts: Optional[str] = None,
         while ctrl_port == coord_port:
             ctrl_port = find_free_port()
     else:
-        # Rank 0 binds on a remote machine we cannot probe; an entropy-
-        # backed pick from the high range keeps collisions between
-        # concurrent launches rare (not impossible — pass
-        # coordinator_port to pin it).
-        import random
-        rnd = random.SystemRandom()
-        coord_port = (coordinator_port if coordinator_port is not None
-                      else rnd.randrange(20000, 60000))
-        ctrl_port = rnd.randrange(20000, 60000)
-        while ctrl_port == coord_port:
-            ctrl_port = rnd.randrange(20000, 60000)
+        # Rank 0 binds on a remote machine: verify candidate ports over
+        # the ssh hop (remote_bootstrap --probe) before committing, so a
+        # collision with an existing listener fails HERE with a clear
+        # message instead of as a confusing startup error (or the control
+        # plane dialing a stranger's service). Falls back to the blind
+        # entropy-backed pick only if the probe itself cannot run.
+        coord_port, ctrl_port = _pick_remote_ports(first_host,
+                                                   coordinator_port)
 
     # Local workers must be able to import horovod_tpu (and task_exec)
     # regardless of the caller's cwd — e.g. a script run from examples/

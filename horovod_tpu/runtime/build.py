@@ -18,6 +18,7 @@ _OUT = os.path.join(os.path.dirname(__file__), "libhorovod_tpu_core.so")
 SOURCES = [
     "message.cc",
     "coordinator.cc",
+    "controller.cc",
     "fusion_buffer.cc",
     "logging.cc",
     "half.cc",

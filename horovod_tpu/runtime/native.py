@@ -13,7 +13,7 @@ from __future__ import annotations
 import ctypes
 import os
 import threading
-from typing import Callable, Optional, Sequence
+from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
@@ -40,13 +40,27 @@ EXECUTE_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_int32,
                               ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
                               ctypes.c_char_p)
 
+# Multi-process transport bridge: (user, req_bytes, req_len, nreq, pending,
+# resp_buf, resp_cap) -> resp_len (see core.cc TransportCallback).
+TRANSPORT_CB = ctypes.CFUNCTYPE(
+    ctypes.c_int64, ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
+    ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64)
+
+# Group delivery: (user, op, handles, count, nnames, sizes, nsizes, flags,
+# error) (see core.cc GroupCallback).
+GROUP_CB = ctypes.CFUNCTYPE(
+    None, ctypes.c_void_p, ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
+    ctypes.c_int32, ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
+    ctypes.c_int32, ctypes.c_int32, ctypes.c_char_p)
+
 
 class NativeCore:
     """Typed wrapper over the hvdtpu_* C API."""
 
     def __init__(self, lib: ctypes.CDLL):
         self._lib = lib
-        self._cb_ref = None  # keep callback alive (ctypes requirement)
+        self._cb_refs = {}  # keep callbacks alive (ctypes requirement)
         self._configure()
 
     def _configure(self):
@@ -68,6 +82,36 @@ class NativeCore:
         lib.hvdtpu_release_handle.argtypes = [ctypes.c_int64]
         lib.hvdtpu_set_execute_callback.argtypes = [EXECUTE_CB,
                                                     ctypes.c_void_p]
+        lib.hvdtpu_set_transport_callback.argtypes = [TRANSPORT_CB,
+                                                      ctypes.c_void_p]
+        lib.hvdtpu_set_group_callback.argtypes = [GROUP_CB, ctypes.c_void_p]
+        lib.hvdtpu_ctl_create.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int64, ctypes.c_double,
+            ctypes.c_double, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p]
+        lib.hvdtpu_ctl_create.restype = ctypes.c_void_p
+        lib.hvdtpu_ctl_destroy.argtypes = [ctypes.c_void_p]
+        lib.hvdtpu_ctl_announce.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
+        lib.hvdtpu_ctl_announce.restype = ctypes.c_int64
+        lib.hvdtpu_ctl_group_count.argtypes = [ctypes.c_void_p]
+        lib.hvdtpu_ctl_group_count.restype = ctypes.c_int64
+        lib.hvdtpu_ctl_base_seq.argtypes = [ctypes.c_void_p]
+        lib.hvdtpu_ctl_base_seq.restype = ctypes.c_int64
+        lib.hvdtpu_ctl_shutdown_flag.argtypes = [ctypes.c_void_p]
+        lib.hvdtpu_ctl_shutdown_flag.restype = ctypes.c_int
+        lib.hvdtpu_ctl_fetch.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
+        lib.hvdtpu_ctl_fetch.restype = ctypes.c_int64
+        lib.hvdtpu_ctl_tick.argtypes = [ctypes.c_void_p]
+        lib.hvdtpu_ctl_params.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)]
+        lib.hvdtpu_ctl_stalled.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
+        lib.hvdtpu_ctl_stalled.restype = ctypes.c_int64
         lib.hvdtpu_set_fusion_threshold.argtypes = [ctypes.c_int64]
         lib.hvdtpu_get_fusion_threshold.restype = ctypes.c_int64
         lib.hvdtpu_set_cycle_time_ms.argtypes = [ctypes.c_double]
@@ -116,7 +160,7 @@ class NativeCore:
 
     def shutdown(self) -> None:
         self._lib.hvdtpu_shutdown()
-        self._cb_ref = None
+        self._cb_refs.clear()
 
     def set_execute_callback(
             self, fn: Callable[[int, list, str], None]) -> None:
@@ -131,8 +175,69 @@ class NativeCore:
             except BaseException as e:  # never let exceptions cross into C
                 _log.error("execute callback raised: %s", e)
 
-        self._cb_ref = trampoline
+        self._cb_refs["execute"] = trampoline
         self._lib.hvdtpu_set_execute_callback(trampoline, None)
+
+    def set_transport_callback(
+            self, fn: Callable[[bytes, int, int], Optional[bytes]]) -> None:
+        """``fn(request_list_bytes, nreq, pending) -> response_list_bytes``
+        — the MP cycle's announce+fetch leg, called from the native
+        background thread. ``nreq == 0`` means the batch was already
+        announced (retry after a short response buffer); return b"" (or
+        None) for "nothing to deliver"."""
+
+        # Overflow cache: when a fetched ResponseList exceeds the native
+        # cycle's buffer, the payload must survive until the C++ retry —
+        # the client's fetch cursor has already advanced past these
+        # groups, so dropping them would lose agreed collectives and
+        # deadlock the SPMD fleet.
+        state = {"pending": None}
+
+        @TRANSPORT_CB
+        def trampoline(_user, req_ptr, req_len, nreq, pending, resp_buf,
+                       resp_cap):
+            try:
+                if state["pending"] is not None:
+                    resp = state["pending"]
+                    state["pending"] = None
+                else:
+                    data = (ctypes.string_at(req_ptr, req_len)
+                            if req_len > 0 else b"")
+                    resp = fn(data, int(nreq), int(pending))
+                if not resp:
+                    return 0
+                if len(resp) > resp_cap:
+                    state["pending"] = resp
+                    return -len(resp)
+                ctypes.memmove(resp_buf, resp, len(resp))
+                return len(resp)
+            except BaseException as e:  # never let exceptions cross into C
+                _log.error("transport callback raised: %s", e)
+                return 0
+
+        self._cb_refs["transport"] = trampoline
+        self._lib.hvdtpu_set_transport_callback(trampoline, None)
+
+    def set_group_callback(
+            self, fn: Callable[[int, list, int, list, int, str], None]
+    ) -> None:
+        """``fn(op, handle_ids, nnames, sizes, flags, error)`` — delivery
+        of one coordinator-agreed group for XLA execution (core.cc
+        GroupCallback)."""
+
+        @GROUP_CB
+        def trampoline(_user, op, handles_ptr, count, nnames, sizes_ptr,
+                       nsizes, flags, err):
+            ids = [handles_ptr[i] for i in range(count)]
+            sizes = [sizes_ptr[i] for i in range(nsizes)] if nsizes else []
+            try:
+                fn(int(op), ids, int(nnames), sizes, int(flags),
+                   err.decode() if err else "")
+            except BaseException as e:  # never let exceptions cross into C
+                _log.error("group callback raised: %s", e)
+
+        self._cb_refs["group"] = trampoline
+        self._lib.hvdtpu_set_group_callback(trampoline, None)
 
     def enqueue(self, op: int, name: str, dtype, shape: Sequence[int],
                 root_rank: int = -1, device: int = -1,
@@ -257,6 +362,86 @@ class NativeCore:
             src_bits.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
             dst_bits.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
             src_bits.size)
+
+
+class NativeController:
+    """The rank-0 multi-process controller (runtime/src/controller.cc):
+    MessageTable + ConstructResponse + FuseResponses + ParameterManager
+    behind a C handle, fed/drained by the Python TCP service with
+    message.cc-codec payloads. ONE planner and ONE wire for cross-process
+    negotiation (the reference's coordinator half of RunLoopOnce)."""
+
+    def __init__(self, core: NativeCore, nproc: int, virtual_size: int,
+                 fusion_threshold: int, cycle_time_ms: float,
+                 stall_warning_sec: float, hier_allreduce: bool,
+                 hier_allgather: bool, autotune: bool,
+                 autotune_log: str = ""):
+        self._lib = core._lib
+        self._h = self._lib.hvdtpu_ctl_create(
+            nproc, virtual_size, fusion_threshold, cycle_time_ms,
+            stall_warning_sec, int(hier_allreduce), int(hier_allgather),
+            int(autotune), autotune_log.encode())
+        self.nproc = nproc
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.hvdtpu_ctl_destroy(self._h)
+            self._h = None
+
+    def announce(self, payload: bytes) -> int:
+        """Feed one serialized RequestList; returns total group count."""
+        buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+        n = int(self._lib.hvdtpu_ctl_announce(self._h, buf, len(payload)))
+        if n < 0:
+            raise ValueError("controller could not parse announce payload")
+        return n
+
+    def group_count(self) -> int:
+        return int(self._lib.hvdtpu_ctl_group_count(self._h))
+
+    def base_seq(self) -> int:
+        return int(self._lib.hvdtpu_ctl_base_seq(self._h))
+
+    def shutdown_flag(self) -> bool:
+        return bool(self._lib.hvdtpu_ctl_shutdown_flag(self._h))
+
+    def fetch(self, rank: int, after_seq: int) -> bytes:
+        """Serialized ResponseList of groups with seq >= after_seq."""
+        cap = 1 << 16
+        while True:
+            buf = (ctypes.c_uint8 * cap)()
+            n = int(self._lib.hvdtpu_ctl_fetch(self._h, rank, after_seq,
+                                               buf, cap))
+            if n >= 0:
+                return bytes(buf[:n])
+            cap = -n
+
+    def tick(self) -> None:
+        self._lib.hvdtpu_ctl_tick(self._h)
+
+    def params(self) -> dict:
+        fusion = ctypes.c_int64()
+        cycle = ctypes.c_double()
+        flags = ctypes.c_int32()
+        active = ctypes.c_int32()
+        done = ctypes.c_int32()
+        self._lib.hvdtpu_ctl_params(self._h, ctypes.byref(fusion),
+                                    ctypes.byref(cycle), ctypes.byref(flags),
+                                    ctypes.byref(active), ctypes.byref(done))
+        return {"fusion_threshold": fusion.value,
+                "cycle_time_ms": cycle.value, "flags": flags.value,
+                "autotune_active": bool(active.value),
+                "autotune_done": bool(done.value)}
+
+    def stalled(self) -> List[str]:
+        cap = 1 << 16
+        while True:
+            buf = (ctypes.c_uint8 * cap)()
+            n = int(self._lib.hvdtpu_ctl_stalled(self._h, buf, cap))
+            if n >= 0:
+                text = bytes(buf[:n]).decode()
+                return text.split("\n") if text else []
+            cap = -n
 
 
 _core: Optional[NativeCore] = None

@@ -1,0 +1,277 @@
+// Multi-process controller — the rank-0 coordinator of the reference's
+// RunLoopOnce (horovod/common/operations.cc:2030-2380) as a native object
+// behind a C API, driven by the Python TCP service (ops/control_plane.py).
+//
+// The reference's coordinator gathers serialized MPIRequestLists from every
+// rank each cycle (MPI_Gather/Gatherv, operations.cc:2088-2134), counts
+// announcements in a MessageTable (IncrementTensorCount, :287-313),
+// validates cross-rank consistency (ConstructMPIResponse, :321-523), fuses
+// ready tensors with look-ahead (:2149-2265), and broadcasts the ordered
+// MPIResponseList (:2282-2287). This controller is that exact pipeline:
+// the transport is the launcher's HMAC TCP RPC instead of MPI, the wire
+// format is message.cc's codec (the N2 equivalent), and the planner is
+// coordinator.cc's MessageTable/ConstructResponse/FuseResponses — ONE
+// planner and ONE wire for cross-process negotiation.
+//
+// It also owns the cross-process autotuner (parameter_manager.cc:64-78,
+// 213-246 SyncParams role): the controller tunes (fusion threshold, cycle
+// time, hierarchical flag) from observed throughput; plan-affecting flags
+// are stamped into each Response (SPMD-safe lockstep), and scalar knobs are
+// served to workers through the fetch RPC.
+//
+// Threading: all entry points lock the controller mutex; the Python service
+// calls from its handler threads. Long-poll waiting lives in Python (the
+// service's condition variable), not here.
+
+#include <algorithm>
+#include <chrono>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "coordinator.h"
+#include "logging.h"
+#include "message.h"
+#include "parameter_manager.h"
+
+namespace hvdtpu {
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Controller {
+  std::mutex mu;
+  int nproc = 1;
+  int virtual_size = 1;
+  bool shutdown = false;
+
+  MessageTable table;
+  // Payload bytes and dtypes for fusion planning, keyed by tensor name
+  // (the byte totals the reference reads off TensorTableEntry).
+  std::unordered_map<std::string, int64_t> sizes_bytes;
+  std::unordered_map<std::string, DataType> dtypes;
+
+  // Ordered group log. Serialized lazily at fetch; kept as objects so the
+  // stall report and tests can inspect them. Pruned once every rank acked.
+  std::vector<Response> groups;
+  int64_t base_seq = 0;
+  std::unordered_map<int32_t, int64_t> acked;
+
+  // Autotuning (N5/N6): tuner lives HERE, on the coordinator, exactly as
+  // the reference's (parameter_manager.cc:64-78). Hierarchical flags are
+  // stamped per group; fusion threshold applies to this planner directly.
+  ParameterManager pm;
+  int64_t fusion_threshold = 64LL * 1024 * 1024;
+  double cycle_time_ms = 1.0;
+  bool env_hier_allgather = false;
+  bool env_hier_allreduce = false;
+  int64_t bytes_since_tick = 0;
+  Clock::time_point last_tick = Clock::now();
+
+  double stall_warning_sec = 60.0;
+};
+
+int32_t CurrentFlags(Controller& c) {
+  int32_t f = 0;
+  bool hier_ar = c.env_hier_allreduce ||
+                 (c.pm.IsAutoTuning() && c.pm.HierarchicalAllreduce());
+  if (hier_ar) f |= Response::HIERARCHICAL_ALLREDUCE;
+  if (c.env_hier_allgather) f |= Response::HIERARCHICAL_ALLGATHER;
+  return f;
+}
+
+// Plan every fully-announced tensor into fused response groups and append
+// them to the group log (the coordinator half of RunLoopOnce).
+void PlanLocked(Controller& c, std::deque<Response> ready) {
+  if (ready.empty()) return;
+  auto plans = FuseResponses(std::move(ready), c.sizes_bytes, c.dtypes,
+                             c.fusion_threshold);
+  int32_t flags = CurrentFlags(c);
+  for (auto& resp : plans) {
+    resp.flags = flags;
+    for (const auto& n : resp.tensor_names) {
+      auto it = c.sizes_bytes.find(n);
+      if (it != c.sizes_bytes.end()) c.bytes_since_tick += it->second;
+    }
+    c.groups.push_back(std::move(resp));
+  }
+}
+
+}  // namespace
+}  // namespace hvdtpu
+
+using namespace hvdtpu;
+
+extern "C" {
+
+void* hvdtpu_ctl_create(int nproc, int virtual_size,
+                        int64_t fusion_threshold, double cycle_time_ms,
+                        double stall_warning_sec, int hier_allreduce,
+                        int hier_allgather, int autotune,
+                        const char* autotune_log) {
+  auto* c = new Controller();
+  c->nproc = nproc;
+  c->virtual_size = virtual_size > 0 ? virtual_size : nproc;
+  c->fusion_threshold = fusion_threshold;
+  c->cycle_time_ms = cycle_time_ms;
+  c->stall_warning_sec = stall_warning_sec;
+  c->env_hier_allreduce = hier_allreduce != 0;
+  c->env_hier_allgather = hier_allgather != 0;
+  if (autotune) {
+    c->pm.Initialize(0, autotune_log ? autotune_log : "");
+    c->pm.SetCurrent(fusion_threshold / (1024.0 * 1024.0), cycle_time_ms);
+    c->pm.SetAutoTuning(true);
+  }
+  return c;
+}
+
+void hvdtpu_ctl_destroy(void* h) { delete static_cast<Controller*>(h); }
+
+// Feed one process's serialized RequestList. Returns the new total group
+// count (base_seq + groups), or -1 on parse failure. Idempotency across
+// RPC retries is enforced by the Python service layer (announce ids).
+int64_t hvdtpu_ctl_announce(void* h, const uint8_t* data, int64_t len) {
+  auto* c = static_cast<Controller*>(h);
+  RequestList rl;
+  if (!RequestList::ParseFrom(data, static_cast<size_t>(len), &rl))
+    return -1;
+  std::lock_guard<std::mutex> lk(c->mu);
+  if (rl.shutdown) {
+    // Any rank announcing shutdown stops the world — the reference ORs
+    // the flag into the response list (operations.cc:2125-2128).
+    c->shutdown = true;
+    return c->base_seq + static_cast<int64_t>(c->groups.size());
+  }
+  std::deque<Response> ready;
+  for (auto& req : rl.requests) {
+    const std::string name = req.tensor_name;
+    c->sizes_bytes[name] =
+        req.tensor_shape.num_elements() * DataTypeSize(req.tensor_type);
+    c->dtypes[name] = req.tensor_type;
+    if (c->table.Increment(req, c->nproc)) {
+      auto reqs = c->table.Take(name);
+      ready.push_back(ConstructResponse(reqs, c->nproc, c->virtual_size));
+    }
+  }
+  PlanLocked(*c, std::move(ready));
+  return c->base_seq + static_cast<int64_t>(c->groups.size());
+}
+
+int64_t hvdtpu_ctl_group_count(void* h) {
+  auto* c = static_cast<Controller*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  return c->base_seq + static_cast<int64_t>(c->groups.size());
+}
+
+// First un-pruned sequence number (observability/test surface).
+int64_t hvdtpu_ctl_base_seq(void* h) {
+  auto* c = static_cast<Controller*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  return c->base_seq;
+}
+
+int hvdtpu_ctl_shutdown_flag(void* h) {
+  auto* c = static_cast<Controller*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  return c->shutdown ? 1 : 0;
+}
+
+// Serialize all groups with seq >= after_seq into a ResponseList (the
+// response-list Bcast, operations.cc:2282-2287). Also records the caller's
+// ack (after_seq), pruning history once every rank has acked (a days-long
+// job must not grow coordinator memory linearly). Returns bytes written,
+// or -(needed) when the buffer is too small.
+int64_t hvdtpu_ctl_fetch(void* h, int32_t rank, int64_t after_seq,
+                         uint8_t* out, int64_t cap) {
+  auto* c = static_cast<Controller*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  auto it = c->acked.find(rank);
+  if (it == c->acked.end() || it->second < after_seq)
+    c->acked[rank] = after_seq;
+  if (static_cast<int>(c->acked.size()) == c->nproc) {
+    int64_t floor = INT64_MAX;
+    for (const auto& kv : c->acked) floor = std::min(floor, kv.second);
+    if (floor > c->base_seq) {
+      int64_t drop = std::min<int64_t>(floor - c->base_seq,
+                                       static_cast<int64_t>(c->groups.size()));
+      c->groups.erase(c->groups.begin(), c->groups.begin() + drop);
+      c->base_seq += drop;
+    }
+  }
+  ResponseList out_list;
+  out_list.shutdown = c->shutdown;
+  int64_t start = std::max<int64_t>(0, after_seq - c->base_seq);
+  for (size_t i = static_cast<size_t>(start); i < c->groups.size(); ++i)
+    out_list.responses.push_back(c->groups[i]);
+  std::vector<uint8_t> buf;
+  out_list.SerializeTo(&buf);
+  if (static_cast<int64_t>(buf.size()) > cap)
+    return -static_cast<int64_t>(buf.size());
+  std::memcpy(out, buf.data(), buf.size());
+  return static_cast<int64_t>(buf.size());
+}
+
+// Autotune tick — called once per coordinator-side engine cycle. Feeds the
+// tuner the bytes planned since the last tick over the elapsed wall time
+// (the reference scores bytes over the whole cycle interval,
+// parameter_manager.cc:144-170). Applies a changed fusion threshold to the
+// planner; scalar knobs are read back via hvdtpu_ctl_params.
+void hvdtpu_ctl_tick(void* h) {
+  auto* c = static_cast<Controller*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  auto now = Clock::now();
+  double secs = std::chrono::duration<double>(now - c->last_tick).count();
+  c->last_tick = now;
+  int64_t bytes = c->bytes_since_tick;
+  c->bytes_since_tick = 0;
+  if (!c->pm.IsAutoTuning()) return;
+  if (c->pm.Update(bytes, secs)) {
+    c->fusion_threshold = c->pm.TensorFusionThresholdBytes();
+    c->cycle_time_ms = c->pm.CycleTimeMs();
+  }
+}
+
+// Current (possibly tuned) knobs, served to workers in the fetch RPC so
+// every process flips scalar knobs in lockstep (SyncParams,
+// parameter_manager.cc:213-246).
+void hvdtpu_ctl_params(void* h, int64_t* fusion_bytes, double* cycle_ms,
+                       int32_t* flags, int32_t* autotune_active,
+                       int32_t* autotune_done) {
+  auto* c = static_cast<Controller*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  if (fusion_bytes) *fusion_bytes = c->fusion_threshold;
+  if (cycle_ms) *cycle_ms = c->cycle_time_ms;
+  if (flags) *flags = CurrentFlags(*c);
+  if (autotune_active) *autotune_active = c->pm.IsAutoTuning() ? 1 : 0;
+  if (autotune_done) *autotune_done = c->pm.IsDone() ? 1 : 0;
+}
+
+// Stall report: tensors announced by only a subset of ranks for longer
+// than the warning window, naming ready and missing ranks — the
+// coordinator's diagnostic (CheckForStalledTensors, operations.cc:
+// 1625-1672). Lines are newline-joined; returns bytes written (0 if
+// nothing stalled), or -(needed) if cap is too small.
+int64_t hvdtpu_ctl_stalled(void* h, uint8_t* out, int64_t cap) {
+  auto* c = static_cast<Controller*>(h);
+  std::vector<std::string> lines;
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    if (c->stall_warning_sec <= 0) return 0;
+    lines = c->table.StalledTensors(c->nproc, c->stall_warning_sec);
+  }
+  std::string joined;
+  for (const auto& l : lines) {
+    if (!joined.empty()) joined += "\n";
+    joined += l;
+  }
+  if (static_cast<int64_t>(joined.size()) > cap)
+    return -static_cast<int64_t>(joined.size());
+  std::memcpy(out, joined.data(), joined.size());
+  return static_cast<int64_t>(joined.size());
+}
+
+}  // extern "C"

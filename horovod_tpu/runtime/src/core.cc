@@ -50,6 +50,31 @@ typedef void (*ExecuteCallback)(void* user, int32_t op,
                                 const int64_t* handles, int32_t count,
                                 const char* error_message);
 
+// Multi-process transport bridge (the MPI_Gatherv/Bcast legs of the
+// reference cycle, operations.cc:2324-2345, carried by Python over the
+// launcher's TCP control plane). The background thread hands Python this
+// process's serialized RequestList; Python announces it to the rank-0
+// controller and long-polls the agreed ResponseList, whose bytes it
+// writes into resp_buf. Returns bytes written, 0 for "nothing yet", or
+// -(needed) when resp_cap is too small (the cycle retries with a larger
+// buffer).
+typedef int64_t (*TransportCallback)(void* user, const uint8_t* req_bytes,
+                                     int64_t req_len, int32_t nreq,
+                                     int64_t pending, uint8_t* resp_buf,
+                                     int64_t resp_cap);
+
+// Delivery of one coordinator-agreed group to Python for XLA execution
+// (the PerformOperation dispatch, operations.cc:768-791). `nnames` is the
+// group's tensor count as planned; `count` the handles found locally —
+// a mismatch means local/coordinator desync, which Python treats as fatal
+// rather than skipping a collective its peers will enter. `sizes` carries
+// the per-rank first dims for allgather (nnames * nproc entries in
+// tensor_names order); `flags` the plan-time execution-mode bits.
+typedef void (*GroupCallback)(void* user, int32_t op, const int64_t* handles,
+                              int32_t count, int32_t nnames,
+                              const int64_t* sizes, int32_t nsizes,
+                              int32_t flags, const char* error_message);
+
 struct PendingEntry {
   int64_t handle;
   Request request;
@@ -85,6 +110,10 @@ struct GlobalState {
 
   ExecuteCallback execute_cb = nullptr;
   void* execute_user = nullptr;
+  TransportCallback transport_cb = nullptr;
+  void* transport_user = nullptr;
+  GroupCallback group_cb = nullptr;
+  void* group_user = nullptr;
 
   // Knobs (operations.cc:1824-1909).
   std::atomic<int64_t> fusion_threshold{64LL * 1024 * 1024};
@@ -112,6 +141,162 @@ void EmitTimelineStartGroup(GlobalState& st, const Response& resp) {
       st.timeline.ActivityStart(name, "QUEUE");
     }
   }
+}
+
+// Deliver the coordinator's agreed groups to Python (multi-process mode).
+// Mirrors the worker half of the reference cycle after the response Bcast
+// (operations.cc:2361-2377): every process executes the SAME groups in the
+// SAME order — here as jitted SPMD programs launched by the group callback.
+void HandleResponsesMP(GlobalState& st, ResponseList& list) {
+  GroupCallback cb;
+  void* user;
+  {
+    std::lock_guard<std::mutex> lk(st.mu);
+    cb = st.group_cb;
+    user = st.group_user;
+  }
+  if (list.shutdown) {
+    // A peer announced shutdown — possibly from its teardown path, in
+    // which case it will never enter the SPMD programs for the groups
+    // delivered alongside the flag. Executing them could hang this rank
+    // in an XLA collective, so fail EVERYTHING not yet executing with
+    // SHUT_DOWN_ERROR (matching the reference's drain of queued tensors,
+    // operations.cc:1942-1998, and the Python fallback's behavior —
+    // mixed fleets must make the same call or they deadlock each other).
+    std::vector<int64_t> hs;
+    {
+      std::lock_guard<std::mutex> lk(st.mu);
+      for (const auto& kv : st.tensor_table)
+        if (!kv.second.executing) hs.push_back(kv.second.handle);
+      st.message_queue.clear();
+    }
+    if (!hs.empty() && cb)
+      cb(user, static_cast<int32_t>(Response::ERROR), hs.data(),
+         static_cast<int32_t>(hs.size()), static_cast<int32_t>(hs.size()),
+         nullptr, 0, 0,
+         "Horovod has been shut down. This was caused by an exception on "
+         "one of the ranks or an attempt to run a collective after one of "
+         "the ranks finished execution.");
+    st.shutdown_requested.store(true);
+    return;
+  }
+  for (auto& resp : list.responses) {
+    EmitTimelineStartGroup(st, resp);
+    std::vector<int64_t> hs;
+    {
+      std::lock_guard<std::mutex> lk(st.mu);
+      for (const auto& name : resp.tensor_names) {
+        auto it = st.tensor_table.find(name);
+        if (it != st.tensor_table.end()) {
+          it->second.executing = true;
+          hs.push_back(it->second.handle);
+        }
+      }
+    }
+    if (cb)
+      cb(user, static_cast<int32_t>(resp.response_type), hs.data(),
+         static_cast<int32_t>(hs.size()),
+         static_cast<int32_t>(resp.tensor_names.size()),
+         resp.tensor_sizes.data(),
+         static_cast<int32_t>(resp.tensor_sizes.size()), resp.flags,
+         resp.error_message.c_str());
+  }
+}
+
+// Multi-process cycle: serialize the drained batch, hand it to the Python
+// transport (announce + long-poll fetch over TCP), parse the agreed
+// ResponseList, dispatch groups. The reference's RunLoopOnce worker half
+// (operations.cc:2323-2377) with message.cc's codec as the wire format.
+bool RunLoopOnceMP(GlobalState& st) {
+  auto cycle_start = Clock::now();
+  st.timeline.MarkCycleStart();
+
+  std::deque<PendingEntry> batch;
+  {
+    std::lock_guard<std::mutex> lk(st.mu);
+    batch = std::move(st.message_queue);
+    st.message_queue.clear();
+  }
+  RequestList rl;
+  for (auto& pe : batch) rl.requests.push_back(pe.request);
+
+  int64_t pending;
+  TransportCallback cb;
+  void* user;
+  {
+    std::lock_guard<std::mutex> lk(st.mu);
+    pending = static_cast<int64_t>(st.tensor_table.size());
+    cb = st.transport_cb;
+    user = st.transport_user;
+  }
+
+  if (cb && (!rl.requests.empty() || pending > 0)) {
+    std::vector<uint8_t> req_buf;
+    rl.SerializeTo(&req_buf);
+    static thread_local std::vector<uint8_t> resp_buf(1 << 20);
+    int64_t n = cb(user, req_buf.data(),
+                   static_cast<int64_t>(req_buf.size()),
+                   static_cast<int32_t>(rl.requests.size()), pending,
+                   resp_buf.data(), static_cast<int64_t>(resp_buf.size()));
+    if (n < 0) {
+      resp_buf.resize(static_cast<size_t>(-n));
+      n = cb(user, req_buf.data(), static_cast<int64_t>(req_buf.size()),
+             0 /*already announced*/, pending, resp_buf.data(),
+             static_cast<int64_t>(resp_buf.size()));
+    }
+    if (n > 0) {
+      ResponseList list;
+      if (ResponseList::ParseFrom(resp_buf.data(), static_cast<size_t>(n),
+                                  &list)) {
+        HandleResponsesMP(st, list);
+      } else {
+        HVD_LOG(WARNING) << "could not parse coordinator response list ("
+                         << n << " bytes); skipping cycle";
+      }
+    }
+  }
+
+  // Local stall hint (names only): the coordinator's fetch responses carry
+  // the authoritative missing-ranks report (hvdtpu_ctl_stalled), which
+  // Python logs on every process.
+  if (st.stall_warning_sec > 0) {
+    auto now = Clock::now();
+    if (std::chrono::duration<double>(now - st.last_stall_check).count() >
+        st.stall_warning_sec) {
+      st.last_stall_check = now;
+      std::vector<std::string> stalled;
+      {
+        std::lock_guard<std::mutex> lk(st.mu);
+        for (const auto& kv : st.tensor_table)
+          if (!kv.second.executing) {
+            double age =
+                std::chrono::duration<double>(now - kv.second.enqueued)
+                    .count();
+            if (age > st.stall_warning_sec) stalled.push_back(kv.first);
+          }
+      }
+      if (!stalled.empty()) {
+        std::string names;
+        for (const auto& n : stalled)
+          names += (names.empty() ? "" : ", ") + n;
+        HVD_LOG(WARNING)
+            << "One or more tensors were submitted to be reduced, gathered "
+            << "or broadcasted by subset of ranks and are waiting for "
+            << "remainder of ranks for more than " << st.stall_warning_sec
+            << " seconds. Stalled ops: " << names;
+      }
+    }
+  }
+
+  if (st.shutdown_requested.load()) {
+    std::lock_guard<std::mutex> lk(st.mu);
+    if (st.message_queue.empty()) return false;
+  }
+
+  auto elapsed = Clock::now() - cycle_start;
+  auto cycle = std::chrono::microseconds(st.cycle_time_us.load());
+  if (elapsed < cycle) std::this_thread::sleep_for(cycle - elapsed);
+  return true;
 }
 
 // One cycle of the background loop (RunLoopOnce, operations.cc:2030-2380).
@@ -253,8 +438,11 @@ bool RunLoopOnce(GlobalState& st) {
 
 void BackgroundThreadLoop(GlobalState& st) {
   // (BackgroundThreadLoop, operations.cc:1695-1999 — minus MPI bring-up,
-  // which jax.distributed handles before this thread starts.)
-  while (RunLoopOnce(st)) {
+  // which jax.distributed handles before this thread starts.) With more
+  // than one host process, the cycle negotiates through the rank-0
+  // controller over the Python transport instead of planning locally.
+  const bool mp = st.size > 1;
+  while (mp ? RunLoopOnceMP(st) : RunLoopOnce(st)) {
   }
   {
     std::lock_guard<std::mutex> lk(st.mu);
@@ -385,6 +573,14 @@ void hvdtpu_shutdown() {
   st.shutdown_requested.store(true);
   if (st.background.joinable()) st.background.join();
   st.timeline.Shutdown();
+  {
+    // Python drops its trampoline references after shutdown; a stale
+    // pointer surviving into a re-init would be a use-after-free.
+    std::lock_guard<std::mutex> lk(st.mu);
+    st.execute_cb = nullptr;
+    st.transport_cb = nullptr;
+    st.group_cb = nullptr;
+  }
   st.initialized.store(false);
 }
 
@@ -395,6 +591,26 @@ void hvdtpu_set_execute_callback(void (*cb)(void*, int32_t, const int64_t*,
   std::lock_guard<std::mutex> lk(g_state->mu);
   g_state->execute_cb = cb;
   g_state->execute_user = user;
+}
+
+void hvdtpu_set_transport_callback(
+    int64_t (*cb)(void*, const uint8_t*, int64_t, int32_t, int64_t,
+                  uint8_t*, int64_t),
+    void* user) {
+  if (!g_state) return;
+  std::lock_guard<std::mutex> lk(g_state->mu);
+  g_state->transport_cb = cb;
+  g_state->transport_user = user;
+}
+
+void hvdtpu_set_group_callback(
+    void (*cb)(void*, int32_t, const int64_t*, int32_t, int32_t,
+               const int64_t*, int32_t, int32_t, const char*),
+    void* user) {
+  if (!g_state) return;
+  std::lock_guard<std::mutex> lk(g_state->mu);
+  g_state->group_cb = cb;
+  g_state->group_user = user;
 }
 
 // Returns handle > 0, or -1 for duplicate name (DUPLICATE_NAME_ERROR,

@@ -181,6 +181,7 @@ void Response::SerializeTo(std::vector<uint8_t>* out) const {
   for (auto d : devices) PutI32(out, d);
   PutI32(out, static_cast<int32_t>(tensor_sizes.size()));
   for (auto s : tensor_sizes) PutI64(out, s);
+  PutI32(out, flags);
 }
 
 bool Response::ParseFrom(const uint8_t* data, size_t len, size_t* consumed,
@@ -202,6 +203,7 @@ bool Response::ParseFrom(const uint8_t* data, size_t len, size_t* consumed,
   out->tensor_sizes.resize(n);
   for (int i = 0; i < n; ++i)
     if (!r.I64(&out->tensor_sizes[i])) return false;
+  if (!r.I32(&out->flags)) return false;
   *consumed = r.off;
   return true;
 }

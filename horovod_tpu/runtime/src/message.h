@@ -58,6 +58,17 @@ struct Response {
     ERROR = 3,
   };
 
+  // Execution-mode flags stamped by the coordinator at plan time. SPMD
+  // execution requires every process to run the SAME program for a group,
+  // so knobs that change the program (hierarchical modes — autotuned or
+  // env-set) travel WITH the group instead of being applied independently
+  // per process (the synchronization the reference gets from SyncParams
+  // inside its lockstep cycle, parameter_manager.cc:213-246).
+  enum Flags : int32_t {
+    HIERARCHICAL_ALLREDUCE = 1 << 0,
+    HIERARCHICAL_ALLGATHER = 1 << 1,
+  };
+
   Type response_type = ALLREDUCE;
   std::vector<std::string> tensor_names;
   std::string error_message;
@@ -65,6 +76,7 @@ struct Response {
   // Allgather: first-dimension size contributed by each rank
   // (mpi_message.h:147-152 tensor_sizes).
   std::vector<int64_t> tensor_sizes;
+  int32_t flags = 0;
 
   void SerializeTo(std::vector<uint8_t>* out) const;
   static bool ParseFrom(const uint8_t* data, size_t len, size_t* consumed,

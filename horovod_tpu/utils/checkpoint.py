@@ -16,6 +16,13 @@ contract (a rank-0-only orbax call in a multi-process job deadlocks);
 use orbax directly from all ranks if you want that machinery. Fancier
 checkpointing remains delegated to the host framework, exactly as the
 reference delegates it (docs/inference.md:1-16).
+
+.. warning::
+   Pickle executes code during deserialization. Only restore checkpoints
+   you trust: loading a file from an untrusted path is arbitrary code
+   execution on every rank (``restore_checkpoint`` broadcasts the loaded
+   object, re-pickling it across the control plane). The same applies to
+   any pickle-based loader (``torch.load``, joblib, …).
 """
 
 from __future__ import annotations
@@ -68,7 +75,12 @@ def restore_checkpoint(path: str, *, step: Optional[int] = None,
     every rank resumes from identical state — the reference's
     load-on-rank-0 + BroadcastGlobalVariablesHook restart recipe. Only
     rank 0 needs the file; with ``broadcast=False`` every caller reads
-    locally."""
+    locally.
+
+    .. warning::
+       The file is unpickled: restoring a checkpoint from an untrusted
+       source is arbitrary code execution. Only load checkpoints you
+       (or your job) wrote."""
     topo = _topo._get()
     state = None
     err: Optional[str] = None

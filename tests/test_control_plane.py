@@ -28,9 +28,11 @@ def _client(svc, rank):
     return CoordinatorClient([("127.0.0.1", svc.port)], svc.key, rank)
 
 
-def _req(name, op=0, dtype="float32", shape=(4,), root=-1, nbytes=16):
+def _req(name, op=0, dtype="float32", shape=(4,), root=-1):
+    # Payload bytes are derived from shape × dtype by both planners (the
+    # native wire carries no byte count — mpi_message.h:44-86).
     return {"name": name, "op": op, "dtype": dtype, "shape": shape,
-            "root_rank": root, "nbytes": nbytes}
+            "root_rank": root}
 
 
 class TestNegotiation:
@@ -49,8 +51,8 @@ class TestNegotiation:
 
     def test_fusion_same_dtype_under_threshold(self, svc):
         c0, c1 = _client(svc, 0), _client(svc, 1)
-        reqs = [_req("a", nbytes=400), _req("b", nbytes=400),
-                _req("c", nbytes=400)]
+        reqs = [_req("a", shape=(100,)), _req("b", shape=(100,)),
+                _req("c", shape=(100,))]  # 400 bytes each (float32)
         c0.announce(reqs)
         c1.announce(reqs)
         groups = c0.fetch(wait_s=2.0).groups
@@ -86,7 +88,7 @@ class TestNegotiation:
         c0.announce([_req("t", op=2, root=0)])
         c1.announce([_req("t", op=2, root=1)])
         groups = c0.fetch(wait_s=2.0).groups
-        assert "Mismatched broadcast root ranks" in groups[0]["error"]
+        assert "Mismatched root ranks" in groups[0]["error"]
 
     def test_allgather_sizes_per_rank(self, svc):
         c0, c1 = _client(svc, 0), _client(svc, 1)
@@ -100,16 +102,16 @@ class TestNegotiation:
         c0, c1 = _client(svc, 0), _client(svc, 1)
         for i in range(5):
             c0.announce([_req(f"t{i}", dtype="int32" if i % 2 else "float32",
-                              nbytes=2000)])
+                              shape=(500,))])
             c1.announce([_req(f"t{i}", dtype="int32" if i % 2 else "float32",
-                              nbytes=2000)])
+                              shape=(500,))])
             assert c0.fetch(wait_s=2.0).groups
             assert c1.fetch(wait_s=2.0).groups
         # both clients acked everything -> history collapses
         c0.fetch(wait_s=0.01)
         c1.fetch(wait_s=0.01)
-        assert len(svc._groups) <= 1
-        assert svc._base_seq >= 4
+        assert svc.history_len() <= 1
+        assert svc.base_seq() >= 4
 
     def test_shutdown_propagates(self, svc):
         c0, c1 = _client(svc, 0), _client(svc, 1)
@@ -126,7 +128,7 @@ class TestNegotiation:
 
         def announce(client, order):
             for n in order:
-                client.announce([_req(n, nbytes=600)])
+                client.announce([_req(n, shape=(150,))])  # 600 bytes
 
         t0 = threading.Thread(target=announce, args=(c0, names))
         t1 = threading.Thread(target=announce, args=(c1, list(reversed(
@@ -141,22 +143,56 @@ class TestNegotiation:
         assert sorted(n for g in g0 for n in g) == sorted(names)
 
 
+class TestAnnounceIdempotency:
+    def test_retried_announce_is_dropped(self, svc):
+        """A retry of an announce whose response was lost (same
+        announce_id re-delivered) must not resurrect a quorum-deleted
+        entry with stale shape metadata (ADVICE r1, medium)."""
+        c0, c1 = _client(svc, 0), _client(svc, 1)
+        c0.announce([_req("t", op=1, shape=(3, 2))])
+        c1.announce([_req("t", op=1, shape=(5, 2))])
+        groups = c0.fetch(wait_s=2.0).groups
+        assert len(groups) == 1
+        assert groups[0]["sizes"]["t"] == [3, 5]
+        # Simulate the retry: re-deliver rank 0's announce with the SAME
+        # announce_id straight to the service handler (BasicClient would
+        # do this after a lost response).
+        svc._handle(AnnounceRequest(0, [_req("t", op=1, shape=(3, 2))],
+                                    announce_id=c0._announce_seq), None)
+        with svc._mu:
+            assert "t" not in svc._table  # no stale one-rank entry
+        # The next step's announce of the same tensor name must form a
+        # FRESH quorum with the NEW shapes, not reuse last step's sizes.
+        c0.announce([_req("t", op=1, shape=(7, 2))])
+        c1.announce([_req("t", op=1, shape=(1, 2))])
+        groups = c0.fetch(wait_s=2.0).groups
+        assert len(groups) == 1
+        assert groups[0]["sizes"]["t"] == [7, 1]
+
+
 class TestStallDetection:
-    def test_missing_ranks_reported(self, svc):
+    @pytest.mark.parametrize("native", [True, False],
+                             ids=["native", "python"])
+    def test_missing_ranks_reported(self, native):
         """Coordinator names the missing ranks per stalled tensor
-        (operations.cc:1644-1668)."""
-        c0 = _client(svc, 0)
-        c0.announce([_req("stuck.a"), _req("stuck.b")])
-        # Shrink the window and age past it.
-        svc.stall_warning_s = 0.05
-        svc._last_stall_check = 0.0
-        import time as _t
-        _t.sleep(0.1)
-        for e in svc._table.values():
-            e.first_seen -= 1.0
-        lines = svc.check_stalls()
-        assert len(lines) == 2
-        assert "stuck.a [missing ranks: 1]" in lines[0]
+        (operations.cc:1644-1668) — with both the native controller and
+        the Python fallback planner."""
+        svc = CoordinatorService(nproc=2, key=make_secret_key(),
+                                 fusion_threshold=1024, native=native,
+                                 stall_warning_s=0.05)
+        try:
+            assert svc.native_active is native
+            c0 = _client(svc, 0)
+            c0.announce([_req("stuck.a"), _req("stuck.b")])
+            import time as _t
+            _t.sleep(0.1)
+            svc._last_stall_check = 0.0
+            lines = svc.check_stalls()
+            assert len(lines) == 2
+            assert "stuck.a" in lines[0] and "missing ranks" in lines[0]
+            assert "1" in lines[0].split("missing ranks")[1]
+        finally:
+            svc.shutdown()
 
     def test_no_report_inside_window(self, svc):
         c0 = _client(svc, 0)

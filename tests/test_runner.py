@@ -155,6 +155,27 @@ _NO_JAX_ENV = {
 
 
 @pytest.mark.slow
+class TestPortProbe:
+    def test_probe_reports_busy_and_free(self):
+        """remote_bootstrap --probe distinguishes a port with a live
+        listener from a free one (ADVICE r1: remote rank-0 ports were
+        drawn blind with no liveness check)."""
+        import socket
+        from horovod_tpu.runner.remote_bootstrap import probe_ports
+        from horovod_tpu.runner.network import find_free_port
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.bind(("", 0))
+        srv.listen(1)
+        busy_port = srv.getsockname()[1]
+        free_port = find_free_port()
+        try:
+            res = probe_ports([busy_port, free_port])
+            assert busy_port in res["busy"]
+            assert free_port in res["free"]
+        finally:
+            srv.close()
+
+
 class TestRun:
     def test_run_collects_results_in_rank_order(self):
         from horovod_tpu.runner import run
