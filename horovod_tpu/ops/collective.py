@@ -59,6 +59,13 @@ SHUT_DOWN_ERROR = (
     "the ranks or an attempt to {op} a tensor after one of the ranks "
     "finished execution.")
 
+# Enqueue-burst debounce for the fallback dispatcher (mirrors core.cc
+# kDrainDebounceNs/kDrainMaxDeferNs): defer draining while a burst is
+# still arriving so one step's requests always fuse into the same groups
+# — stable compositions are what make the fused-program jit cache hit.
+_DRAIN_DEBOUNCE_S = 0.002
+_DRAIN_MAX_DEFER_S = 0.020
+
 
 class HorovodInternalError(RuntimeError):
     pass
@@ -155,6 +162,9 @@ class CollectiveEngine:
         self._thread: Optional[threading.Thread] = None
         self._shutdown = False
         self._wake = threading.Event()
+        self._last_enqueue_t = 0.0
+        self._oldest_enqueue_t = 0.0
+        self.mp_params: Dict = {}
         # Knobs — reference defaults: 64 MiB fusion, 5 ms cycle
         # (operations.cc:1838,1846). We default the cycle to 1 ms: there is
         # no MPI round-trip to amortize on the single-controller path.
@@ -379,7 +389,10 @@ class CollectiveEngine:
                 raise ValueError(DUPLICATE_NAME_ERROR.format(
                     op=_op_name(req.op)))
             self._in_flight[req.name] = req
+            if not self._queue:
+                self._oldest_enqueue_t = time.monotonic()
             self._queue.append(req)
+            self._last_enqueue_t = time.monotonic()
             if self.timeline is not None:
                 self.timeline.negotiate_start(req.name, _op_name(req.op))
         self._ensure_thread()
@@ -479,6 +492,9 @@ class CollectiveEngine:
             ft = params.get("fusion_threshold")
             if ft:
                 self.fusion_threshold = int(ft)
+            # Last coordinator-served params (autotune_active/done etc.)
+            # for tests and observability.
+            self.mp_params = dict(params)
 
     def _fail_native_pending(self, err: BaseException) -> None:
         """Fail every native-tracked in-flight request loudly — the MP
@@ -514,7 +530,14 @@ class CollectiveEngine:
                 client.announce_bytes(req_bytes)
             if pending <= 0:
                 return b""
-            resp = client.fetch(wait_s=max(self.cycle_time_s, 0.05))
+            # Short poll while this process is actively announcing (the
+            # burst may have more chunks queued behind this cycle — a long
+            # fetch here would delay them past the coordinator's
+            # quiescence window and split the fusion group); long-poll
+            # only when there is nothing further to announce.
+            wait = (self.cycle_time_s if nreq > 0
+                    else max(self.cycle_time_s, 0.05))
+            resp = client.fetch(wait_s=wait)
         except BaseException as e:
             _log.error("multi-process control plane failed: %s", e)
             self._fail_native_pending(HorovodInternalError(
@@ -622,8 +645,26 @@ class CollectiveEngine:
             if self._mark_cycles and self.timeline is not None:
                 self.timeline.mark_cycle()  # HOROVOD_TIMELINE_MARK_CYCLES
             with self._lock:
-                batch = self._queue
-                self._queue = []
+                # Burst debounce (mirrors core.cc DrainShouldDefer):
+                # draining mid-burst cuts timing-dependent fusion groups,
+                # and every distinct composition is a distinct compiled
+                # program. Bounded so a continuous stream cannot starve
+                # dispatch.
+                now = time.monotonic()
+                defer = (bool(self._queue)
+                         and now - self._last_enqueue_t < _DRAIN_DEBOUNCE_S
+                         and now - self._oldest_enqueue_t
+                         < _DRAIN_MAX_DEFER_S)
+                if defer:
+                    batch = []
+                else:
+                    batch = self._queue
+                    self._queue = []
+            if defer:
+                # Also skip the MP fetch: a long-poll here would hold the
+                # rest of the burst back past the coordinator's quiet
+                # window.
+                continue
             if mp:
                 try:
                     self._mp_cycle(batch)
@@ -664,7 +705,11 @@ class CollectiveEngine:
             waiting = bool(self._in_flight)
         if not waiting:
             return
-        resp = client.fetch(wait_s=max(self.cycle_time_s, 0.05))
+        # Short poll while announcing (see _native_transport: a long fetch
+        # would hold back the rest of the burst and split the fusion
+        # group); long-poll only when quiet.
+        resp = client.fetch(wait_s=(self.cycle_time_s if batch
+                                    else max(self.cycle_time_s, 0.05)))
         self._apply_fetch_side_channel(resp)
         if resp.shutdown:
             # A peer announced shutdown — possibly from its teardown path,

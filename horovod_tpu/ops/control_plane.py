@@ -47,6 +47,15 @@ from ..utils.logging import get_logger
 
 _log = get_logger("control_plane")
 
+# Quiet window before the coordinator cuts fusion groups: plan only once no
+# announce has arrived for this long (and no tensor is partially
+# announced), so one training step's burst of announces — which worker
+# cycles deliver in several chunks — always fuses into the same group
+# composition. Every distinct composition is a distinct fused XLA program;
+# determinism here is what makes the executor's jit cache hit across
+# steps. Must match controller.cc's Controller::plan_debounce_s.
+PLAN_DEBOUNCE_S = 0.002
+
 CONTROL_ENV = "HOROVOD_TPU_CONTROL"
 
 # Wire op enums shared with the engine (executor.ALLREDUCE etc.).
@@ -172,6 +181,9 @@ class CoordinatorService(BasicService):
         # client retries (a retried announce must be a no-op, or it can
         # resurrect a quorum-deleted entry with stale shape metadata).
         self._last_announce: Dict[int, int] = {}
+        # Wall time of the last announce — the quiescence-planner clock
+        # (_maybe_plan_locked).
+        self._last_announce_t = time.monotonic()
         # Stall reporting (CheckForStalledTensors, operations.cc:1625-1672):
         # the coordinator alone knows WHICH ranks are missing per tensor.
         # Window from env (HOROVOD_TPU_STALL_CHECK_DISABLE honored), the
@@ -259,6 +271,7 @@ class CoordinatorService(BasicService):
                     payload = _wire.encode_request_list(req.rank,
                                                         req.requests)
                 self._ctl.announce(payload)
+                self._last_announce_t = time.monotonic()
                 self._cv.notify_all()  # waiters recheck group_count
                 return AnnounceResponse()
             requests = req.requests
@@ -297,10 +310,26 @@ class CoordinatorService(BasicService):
                 if len(e.ranks) == self._nproc:
                     self._ready.append((r["name"], e))
                     del self._table[r["name"]]
-            self._plan_locked()
-            if self._groups:
-                self._cv.notify_all()
+            # No planning here: groups are cut by _maybe_plan_locked once
+            # the announce stream is quiescent (mirrors the native
+            # controller). Cutting groups at announce-chunk boundaries
+            # would make group composition timing-dependent, and every
+            # distinct composition is a distinct fused XLA program — a
+            # recompile per step instead of a cache hit.
+            self._last_announce_t = time.monotonic()
+            self._cv.notify_all()
         return AnnounceResponse()
+
+    def _maybe_plan_locked(self) -> None:
+        """Quiescence planner (native: hvdtpu_ctl_maybe_plan): plan once
+        no tensor is partially announced and no announce has arrived for
+        PLAN_DEBOUNCE_S — i.e. every rank's cycle-chunked announces of one
+        burst have landed, so the group composition is the full burst,
+        deterministic across steps."""
+        if (self._ready and not self._table
+                and time.monotonic() - self._last_announce_t
+                >= PLAN_DEBOUNCE_S):
+            self._plan_locked()
 
     def check_stalls(self) -> List[str]:
         """Warn about tensors announced by only a subset of ranks past the
@@ -349,8 +378,27 @@ class CoordinatorService(BasicService):
                 while (self._ctl.group_count() <= req.after_seq
                        and not self._ctl.shutdown_flag()
                        and time.monotonic() < deadline):
-                    self._cv.wait(timeout=max(0.0,
-                                              deadline - time.monotonic()))
+                    # Sliced wait: each slice polls the quiescence
+                    # planner so groups are cut PLAN_DEBOUNCE_S after the
+                    # announce stream goes quiet.
+                    self._cv.wait(timeout=max(0.0, min(
+                        PLAN_DEBOUNCE_S,
+                        deadline - time.monotonic())))
+                    if self._ctl.maybe_plan() > req.after_seq:
+                        self._cv.notify_all()
+                        break
+                if (self._ctl.group_count() <= req.after_seq
+                        and not self._ctl.shutdown_flag()
+                        and time.monotonic() - self._last_announce_t
+                        >= PLAN_DEBOUNCE_S):
+                    # Timed out with nothing new AND the announce stream
+                    # is quiet: fire the planning valve so fully-announced
+                    # tensors are not stalled behind a lingering partial
+                    # announce. The quiet guard keeps a short-wait fetch
+                    # (issued mid-burst) from force-cutting a partial
+                    # burst into a timing-dependent group.
+                    if self._ctl.plan() > req.after_seq:
+                        self._cv.notify_all()
                 payload = self._ctl.fetch(req.rank, req.after_seq)
                 groups, shutdown = _wire.decode_response_list(payload,
                                                               self._nproc)
@@ -369,9 +417,23 @@ class CoordinatorService(BasicService):
             next_seq = len(self._groups) + self._base_seq
             while (next_seq <= req.after_seq and not self._shutdown
                    and time.monotonic() < deadline):
-                self._cv.wait(timeout=max(0.0,
-                                          deadline - time.monotonic()))
+                # Sliced wait polling the quiescence planner (see the
+                # native branch above).
+                self._cv.wait(timeout=max(0.0, min(
+                    PLAN_DEBOUNCE_S, deadline - time.monotonic())))
+                self._maybe_plan_locked()
                 next_seq = len(self._groups) + self._base_seq
+                if next_seq > req.after_seq:
+                    self._cv.notify_all()
+            if (next_seq <= req.after_seq and not self._shutdown
+                    and time.monotonic() - self._last_announce_t
+                    >= PLAN_DEBOUNCE_S):
+                # Timed out AND quiet: planning valve (see the native
+                # branch) — serve fully-announced work past a lingering
+                # partial without cutting an in-progress burst.
+                self._plan_locked()
+                if len(self._groups) + self._base_seq > next_seq:
+                    self._cv.notify_all()
             start = max(0, req.after_seq - self._base_seq)
             groups = self._groups[start:]
             params = {"fusion_threshold": self.fusion_threshold,

@@ -37,17 +37,22 @@ FLAG_HIERARCHICAL_ALLGATHER = 1 << 1
 _DTYPE_TO_ENUM = {
     "uint8": 0, "int8": 1, "uint16": 2, "int16": 3, "int32": 4,
     "int64": 5, "float16": 6, "float32": 7, "float64": 8, "bool": 9,
-    "bfloat16": 10,
+    "bfloat16": 10, "uint32": 11, "uint64": 12,
 }
 _ENUM_TO_DTYPE = {v: k for k, v in _DTYPE_TO_ENUM.items()}
 _DTYPE_SIZE = {0: 1, 1: 1, 2: 2, 3: 2, 4: 4, 5: 8, 6: 2, 7: 4, 8: 8,
-               9: 1, 10: 2}
+               9: 1, 10: 2, 11: 4, 12: 8}
 
 
 def dtype_enum(name: str) -> int:
     if name.startswith("float8"):
         return _DTYPE_TO_ENUM["uint8"]
-    return _DTYPE_TO_ENUM[name]
+    try:
+        return _DTYPE_TO_ENUM[name]
+    except KeyError:
+        raise ValueError(
+            f"dtype {name!r} is not supported on the collective wire "
+            f"(supported: {sorted(_DTYPE_TO_ENUM)})") from None
 
 
 def dtype_name(enum: int) -> str:

@@ -33,6 +33,8 @@ DTYPE_TO_ENUM = {
     np.dtype(np.float32): 7,
     np.dtype(np.float64): 8,
     np.dtype(bool): 9,
+    np.dtype(np.uint32): 11,
+    np.dtype(np.uint64): 12,
 }
 BFLOAT16_ENUM = 10
 
@@ -105,6 +107,10 @@ class NativeCore:
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
         lib.hvdtpu_ctl_fetch.restype = ctypes.c_int64
         lib.hvdtpu_ctl_tick.argtypes = [ctypes.c_void_p]
+        lib.hvdtpu_ctl_plan.argtypes = [ctypes.c_void_p]
+        lib.hvdtpu_ctl_plan.restype = ctypes.c_int64
+        lib.hvdtpu_ctl_maybe_plan.argtypes = [ctypes.c_void_p]
+        lib.hvdtpu_ctl_maybe_plan.restype = ctypes.c_int64
         lib.hvdtpu_ctl_params.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int32),
@@ -121,6 +127,7 @@ class NativeCore:
         lib.hvdtpu_timeline_activity_end.argtypes = [ctypes.c_char_p]
         lib.hvdtpu_timeline_enabled.restype = ctypes.c_int
         lib.hvdtpu_autotune_active.restype = ctypes.c_int
+        lib.hvdtpu_autotune_done.restype = ctypes.c_int
         lib.hvdtpu_wire_make_request.argtypes = [
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_char_p,
             ctypes.c_int32, ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
@@ -251,7 +258,14 @@ class NativeCore:
             # real jax dtype.
             enum = DTYPE_TO_ENUM[np.dtype(np.uint8)]
         else:
-            enum = DTYPE_TO_ENUM[np.dtype(dtype)]
+            try:
+                enum = DTYPE_TO_ENUM[np.dtype(dtype)]
+            except KeyError:
+                raise ValueError(
+                    f"dtype {dtype!r} is not supported on the collective "
+                    f"wire (supported: "
+                    f"{sorted(str(d) for d in DTYPE_TO_ENUM)} + bfloat16/"
+                    "float8)") from None
         arr = (ctypes.c_int64 * max(len(shape), 1))(*shape)
         return int(self._lib.hvdtpu_enqueue(
             op, name.encode(), enum, arr, len(shape), root_rank, device,
@@ -300,6 +314,11 @@ class NativeCore:
 
     def autotune_active(self) -> bool:
         return bool(self._lib.hvdtpu_autotune_active())
+
+    def autotune_done(self) -> bool:
+        """True once the tuner converged and froze to its best point
+        (parameter_manager.cc:173-209 semantics)."""
+        return bool(self._lib.hvdtpu_autotune_done())
 
     # wire/test surface ----------------------------------------------------
 
@@ -418,6 +437,18 @@ class NativeController:
 
     def tick(self) -> None:
         self._lib.hvdtpu_ctl_tick(self._h)
+
+    def plan(self) -> int:
+        """Fetch-timeout valve: cut groups from whatever is fully
+        announced even while some tensor is still partial. Returns the
+        new total group count."""
+        return int(self._lib.hvdtpu_ctl_plan(self._h))
+
+    def maybe_plan(self) -> int:
+        """Quiescence planner: cut groups once the announce stream has
+        been quiet for the debounce window and no tensor is partial.
+        Returns the total group count."""
+        return int(self._lib.hvdtpu_ctl_maybe_plan(self._h))
 
     def params(self) -> dict:
         fusion = ctypes.c_int64()

@@ -65,6 +65,10 @@ enum class DataType : int32_t {
   HVD_FLOAT64 = 8,
   HVD_BOOL = 9,
   HVD_BFLOAT16 = 10,
+  // Beyond the reference's 10 dtypes (mpi_message.h:26-37): jax PRNG
+  // keys are uint32, so the TPU wire must carry unsigned 32/64-bit.
+  HVD_UINT32 = 11,
+  HVD_UINT64 = 12,
 };
 
 const char* DataTypeName(DataType t);

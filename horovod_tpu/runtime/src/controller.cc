@@ -55,6 +55,22 @@ struct Controller {
   std::unordered_map<std::string, int64_t> sizes_bytes;
   std::unordered_map<std::string, DataType> dtypes;
 
+  // Fully-announced tensors awaiting planning. Groups are cut only when
+  // the announce stream is QUIESCENT — no tensor partially announced and
+  // no announce for >= plan_debounce_s (hvdtpu_ctl_maybe_plan, driven by
+  // the service's fetch long-poll) — or via the fetch-timeout valve
+  // (hvdtpu_ctl_plan). Planning eagerly on each announce would cut
+  // groups at arbitrary announce-chunk boundaries (worker cycles drain
+  // mid-burst), and on TPU every distinct group composition is a
+  // distinct fused XLA program — nondeterministic chunking means a
+  // recompile per step instead of a cache hit.
+  std::deque<Response> pending;
+  Clock::time_point last_announce = Clock::now();
+  // Quiet window before cutting groups; must match the Python fallback
+  // service (ops/control_plane.py PLAN_DEBOUNCE_S) so both planners see
+  // the same stream shape.
+  double plan_debounce_s = 0.002;
+
   // Ordered group log. Serialized lazily at fetch; kept as objects so the
   // stall report and tests can inspect them. Pruned once every rank acked.
   std::vector<Response> groups;
@@ -84,10 +100,12 @@ int32_t CurrentFlags(Controller& c) {
   return f;
 }
 
-// Plan every fully-announced tensor into fused response groups and append
-// them to the group log (the coordinator half of RunLoopOnce).
-void PlanLocked(Controller& c, std::deque<Response> ready) {
-  if (ready.empty()) return;
+// Plan every pending fully-announced tensor into fused response groups and
+// append them to the group log (the coordinator half of RunLoopOnce).
+void PlanLocked(Controller& c) {
+  if (c.pending.empty()) return;
+  std::deque<Response> ready;
+  ready.swap(c.pending);
   auto plans = FuseResponses(std::move(ready), c.sizes_bytes, c.dtypes,
                              c.fusion_threshold);
   int32_t flags = CurrentFlags(c);
@@ -95,7 +113,11 @@ void PlanLocked(Controller& c, std::deque<Response> ready) {
     resp.flags = flags;
     for (const auto& n : resp.tensor_names) {
       auto it = c.sizes_bytes.find(n);
-      if (it != c.sizes_bytes.end()) c.bytes_since_tick += it->second;
+      if (it != c.sizes_bytes.end()) {
+        c.bytes_since_tick += it->second;
+        c.sizes_bytes.erase(it);  // names are per-op unique: drop planned
+      }
+      c.dtypes.erase(n);  // entries or coordinator memory grows forever
     }
     c.groups.push_back(std::move(resp));
   }
@@ -146,7 +168,6 @@ int64_t hvdtpu_ctl_announce(void* h, const uint8_t* data, int64_t len) {
     c->shutdown = true;
     return c->base_seq + static_cast<int64_t>(c->groups.size());
   }
-  std::deque<Response> ready;
   for (auto& req : rl.requests) {
     const std::string name = req.tensor_name;
     c->sizes_bytes[name] =
@@ -154,10 +175,36 @@ int64_t hvdtpu_ctl_announce(void* h, const uint8_t* data, int64_t len) {
     c->dtypes[name] = req.tensor_type;
     if (c->table.Increment(req, c->nproc)) {
       auto reqs = c->table.Take(name);
-      ready.push_back(ConstructResponse(reqs, c->nproc, c->virtual_size));
+      c->pending.push_back(
+          ConstructResponse(reqs, c->nproc, c->virtual_size));
     }
   }
-  PlanLocked(*c, std::move(ready));
+  c->last_announce = Clock::now();
+  return c->base_seq + static_cast<int64_t>(c->groups.size());
+}
+
+// Quiescence planner, polled from the service's fetch long-poll: cut
+// groups once no tensor is partially announced and the announce stream
+// has been quiet for the debounce window (all ranks' cycle-chunked
+// announces of one burst have landed). Returns the total group count.
+int64_t hvdtpu_ctl_maybe_plan(void* h) {
+  auto* c = static_cast<Controller*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  if (!c->pending.empty() && c->table.size() == 0 &&
+      std::chrono::duration<double>(Clock::now() - c->last_announce)
+              .count() >= c->plan_debounce_s)
+    PlanLocked(*c);
+  return c->base_seq + static_cast<int64_t>(c->groups.size());
+}
+
+// Fetch-timeout valve: plan whatever is fully announced even though some
+// tensor is still partial (a lingering partial must not stall ready
+// work — the reference plans per coordinator cycle regardless,
+// operations.cc:2142-2147). Returns the new total group count.
+int64_t hvdtpu_ctl_plan(void* h) {
+  auto* c = static_cast<Controller*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  PlanLocked(*c);
   return c->base_seq + static_cast<int64_t>(c->groups.size());
 }
 

@@ -127,7 +127,37 @@ struct GlobalState {
 
   // Cycle stats for the autotuner.
   std::atomic<int64_t> cycle_bytes{0};
+
+  // Enqueue-burst debounce: steady_clock nanos of the newest and oldest
+  // queued request. A cycle defers draining while a burst is still
+  // arriving (< kDrainDebounceNs since the last enqueue) so one training
+  // step's requests always fuse into the same groups — every distinct
+  // group composition is a distinct fused XLA program, and timing-
+  // dependent chunking would mean a fresh compile per step instead of a
+  // cache hit. kDrainMaxDeferNs bounds the wait so a continuous enqueue
+  // stream cannot starve dispatch.
+  std::atomic<int64_t> last_enqueue_ns{0};
+  std::atomic<int64_t> oldest_enqueue_ns{0};
 };
+
+constexpr int64_t kDrainDebounceNs = 2'000'000;    // 2 ms
+constexpr int64_t kDrainMaxDeferNs = 20'000'000;   // 20 ms
+
+int64_t NowNs() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             Clock::now().time_since_epoch())
+      .count();
+}
+
+// True while an enqueue burst is still arriving (defer the drain).
+bool DrainShouldDefer(GlobalState& st) {
+  if (st.shutdown_requested.load()) return false;  // drain for teardown
+  std::lock_guard<std::mutex> lk(st.mu);
+  if (st.message_queue.empty()) return false;
+  int64_t now = NowNs();
+  if (now - st.oldest_enqueue_ns.load() >= kDrainMaxDeferNs) return false;
+  return now - st.last_enqueue_ns.load() < kDrainDebounceNs;
+}
 
 GlobalState* g_state = nullptr;
 
@@ -211,6 +241,16 @@ bool RunLoopOnceMP(GlobalState& st) {
   auto cycle_start = Clock::now();
   st.timeline.MarkCycleStart();
 
+  // Burst debounce, as in RunLoopOnce: announcing a partial burst would
+  // chunk the coordinator's view and destabilize fusion groups. While
+  // deferring, skip the transport leg entirely — its fetch long-poll
+  // would hold the rest of the burst back for up to 50 ms.
+  if (DrainShouldDefer(st)) {
+    auto elapsed = Clock::now() - cycle_start;
+    auto cycle = std::chrono::microseconds(st.cycle_time_us.load());
+    if (elapsed < cycle) std::this_thread::sleep_for(cycle - elapsed);
+    return true;  // next cycle drains (defer is max-defer bounded)
+  }
   std::deque<PendingEntry> batch;
   {
     std::lock_guard<std::mutex> lk(st.mu);
@@ -305,9 +345,12 @@ bool RunLoopOnce(GlobalState& st) {
   auto cycle_start = Clock::now();
   st.timeline.MarkCycleStart();
 
-  // Drain local queue under lock (operations.cc:2050-2058).
+  // Drain local queue under lock (operations.cc:2050-2058) — unless an
+  // enqueue burst is still arriving (DrainShouldDefer): draining
+  // mid-burst would cut timing-dependent fusion groups and recompile
+  // their XLA programs every step.
   std::deque<PendingEntry> batch;
-  {
+  if (!DrainShouldDefer(st)) {
     std::lock_guard<std::mutex> lk(st.mu);
     batch = std::move(st.message_queue);
     st.message_queue.clear();
@@ -640,7 +683,11 @@ int64_t hvdtpu_enqueue(int32_t op, const char* name, int32_t dtype,
   pe.handle = h;
   st.handles[h] = HandleState{pe.request.tensor_name, -1, ""};
   st.tensor_table.emplace(pe.request.tensor_name, pe);
+  bool was_empty = st.message_queue.empty();
   st.message_queue.push_back(std::move(pe));
+  int64_t now = NowNs();
+  st.last_enqueue_ns.store(now);
+  if (was_empty) st.oldest_enqueue_ns.store(now);
   if (st.timeline.Initialized()) {
     st.timeline.NegotiateStart(name, op);
     st.timeline.NegotiateRankReady(name, st.rank);
@@ -726,6 +773,9 @@ int hvdtpu_autotune_active() {
   return g_state && g_state->param_manager.IsAutoTuning() &&
                  !g_state->param_manager.IsDone()
              ? 1 : 0;
+}
+int hvdtpu_autotune_done() {
+  return g_state && g_state->param_manager.IsDone() ? 1 : 0;
 }
 
 // Host staging arena (FusionBufferManager bridge).

@@ -71,6 +71,8 @@ const char* DataTypeName(DataType t) {
     case DataType::HVD_FLOAT64: return "float64";
     case DataType::HVD_BOOL: return "bool";
     case DataType::HVD_BFLOAT16: return "bfloat16";
+    case DataType::HVD_UINT32: return "uint32";
+    case DataType::HVD_UINT64: return "uint64";
   }
   return "unknown";
 }
@@ -88,9 +90,11 @@ int64_t DataTypeSize(DataType t) {
       return 2;
     case DataType::HVD_INT32:
     case DataType::HVD_FLOAT32:
+    case DataType::HVD_UINT32:
       return 4;
     case DataType::HVD_INT64:
     case DataType::HVD_FLOAT64:
+    case DataType::HVD_UINT64:
       return 8;
   }
   return 0;

@@ -8,6 +8,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 SCRIPT = r"""
 import os, time
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
@@ -35,6 +37,40 @@ collective.engine().shutdown()
 """
 
 
+CONVERGE_SCRIPT = r"""
+import os, time, json
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import horovod_tpu as hvd
+from horovod_tpu.ops import collective
+
+hvd.init()
+x = jnp.ones((256, 256))
+hvd.allreduce(x, average=False, name="cv.prime")  # attaches the native core
+core = collective.engine()._native_core
+assert core is not None, "native core required for autotune test"
+# Keep traffic flowing until the tuner converges and freezes
+# (kMaxSteps * kSamplesPerStep * kCyclesPerSample + warmups cycles at a
+# 1 ms cycle): scores must be nonzero so freeze-to-best is meaningful.
+deadline = time.monotonic() + 120
+i = 0
+while not core.autotune_done() and time.monotonic() < deadline:
+    out = hvd.allreduce(x, average=False, name=f"cv.{i}")
+    i += 1
+print(json.dumps({
+    "done": core.autotune_done(),
+    "fusion_mb": core.fusion_threshold / (1024.0 * 1024.0),
+    "cycle_ms": core.cycle_time_ms,
+    "steps": i,
+}))
+collective.engine().shutdown()
+"""
+
+
 def test_autotune_explores_and_logs(tmp_path):
     log = tmp_path / "autotune.csv"
     env = dict(os.environ)
@@ -55,3 +91,37 @@ def test_autotune_explores_and_logs(tmp_path):
     assert len(parts) == 4
     assert 0.0 <= float(parts[0]) <= 64.0
     assert 1.0 <= float(parts[1]) <= 100.0
+
+
+@pytest.mark.slow
+def test_autotune_convergence_quality(tmp_path):
+    """VERDICT r1 #9: BO must explore >= 3 distinct points, converge,
+    freeze to the best-scoring sampled point (parameter_manager.cc:
+    173-209), and the frozen knobs must be applied to the live engine."""
+    log = tmp_path / "autotune.csv"
+    env = dict(os.environ)
+    env["HOROVOD_AUTOTUNE"] = "1"
+    env["HOROVOD_AUTOTUNE_LOG"] = str(log)
+    env["HOROVOD_CYCLE_TIME"] = "1"
+    proc = subprocess.run([sys.executable, "-c", CONVERGE_SCRIPT], env=env,
+                          capture_output=True, text=True, timeout=300,
+                          cwd=os.path.dirname(os.path.dirname(__file__)))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    import json
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["done"], f"tuner did not converge: {out}"
+
+    lines = log.read_text().strip().splitlines()
+    assert lines[0] == "fusion_mb,cycle_ms,hierarchical,score"
+    rows = [tuple(float(v) for v in ln.split(",")) for ln in lines[1:]]
+    # Exploration: >= 3 distinct (fusion, cycle) points, not an RNG's
+    # single default.
+    points = {(r[0], r[1]) for r in rows}
+    assert len(points) >= 3, points
+    # Freeze-to-best: the frozen knobs equal the best-scoring sampled
+    # row (ties by score allowed; knobs logged at %.3f precision).
+    best_score = max(r[3] for r in rows)
+    best_points = {(r[0], r[1]) for r in rows
+                   if abs(r[3] - best_score) < 1e-9}
+    frozen = (round(out["fusion_mb"], 3), round(out["cycle_ms"], 3))
+    assert frozen in best_points, (frozen, best_points)

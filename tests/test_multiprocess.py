@@ -65,6 +65,12 @@ class TestMultiProcessCollectives:
             g = hvd.allgather(jnp.full((2,), float(r)), name="mp.ag")
             out["gather"] = np.asarray(g).tolist()
 
+            # uint32 broadcast: the dtype of jax PRNG keys — must ride
+            # the wire (HVD_UINT32), not die in the codec.
+            key = hvd.broadcast(jnp.asarray([r + 7, r + 9], jnp.uint32),
+                                root_rank=0, name="mp.key")
+            out["key"] = np.asarray(key).tolist()
+
             # ragged allgather: rank r contributes r+1 rows
             rg = hvd.allgather(jnp.full((r + 1, 2), float(r)),
                                name="mp.agv")
@@ -80,6 +86,7 @@ class TestMultiProcessCollectives:
             assert r["f2"] == [4.0] * 5
             assert r["bcast"] == [20.0, 20.0]     # root = rank 1
             assert r["gather"] == [0.0, 0.0, 1.0, 1.0]
+            assert r["key"] == [7, 9]             # rank 0's uint32 values
         ragged = np.array(results[0]["ragged"])
         assert ragged.shape == (3, 2)             # 1 row + 2 rows
         assert np.allclose(ragged, [[0, 0], [1, 1], [1, 1]])
@@ -347,3 +354,48 @@ class TestFourProcesses:
                 assert v == 4.0 * (i + 1), (nm, v)
             assert r["bcast"] == [3.0, 3.0]
         assert all(r == results[0] for r in results[1:])
+
+
+class TestCrossProcessAutotune:
+    def test_knobs_move_in_lockstep(self):
+        """VERDICT r1 #4: with HOROVOD_AUTOTUNE=1 the rank-0 controller
+        tunes (fusion threshold, cycle time) and serves them through the
+        fetch response (SyncParams, parameter_manager.cc:64-78,213-246);
+        every process must apply the same knob sequence — knobs MOVE
+        (the tuner explores) and END identical across processes."""
+        env = {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "HOROVOD_AUTOTUNE": "1",
+            "HOROVOD_CYCLE_TIME": "1",
+        }
+
+        def worker():
+            import jax.numpy as jnp
+
+            import horovod_tpu as hvd
+            from horovod_tpu.ops.collective import engine
+
+            hvd.init()
+            x = jnp.ones((64, 64))
+            seen = []
+            for i in range(160):
+                hvd.allreduce(x, average=False, name=f"tune.{i}")
+                eng = engine()
+                knobs = (round(eng.fusion_threshold / (1024.0 * 1024.0), 3),
+                         round(eng.cycle_time_s * 1000.0, 3))
+                if not seen or seen[-1] != knobs:
+                    seen.append(knobs)
+            active = bool(engine().mp_params.get("autotune_active")
+                          or engine().mp_params.get("autotune_done"))
+            return {"seen": seen, "tuner_on": active}
+
+        results = run(worker, np=2, extra_env=env, start_timeout=600)
+        for r in results:
+            assert r["tuner_on"], r
+            # The tuner explored: at least one knob change was applied.
+            assert len(r["seen"]) >= 2, r["seen"]
+        # Lockstep: both processes end on the SAME coordinator-tuned
+        # knobs (the sequences may be sampled at different cycle points,
+        # but the final state must agree).
+        assert results[0]["seen"][-1] == results[1]["seen"][-1], results

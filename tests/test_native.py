@@ -203,6 +203,10 @@ class TestPyWireMirror:
                 {"name": "g", "op": ALLGATHER, "dtype": "float32",
                  "shape": (rank + 1, 3)},
             ]))
+        # Planning is deferred until the announce stream is quiescent
+        # (or the service's fetch-timeout valve fires); driving the
+        # controller directly, cut the groups explicitly.
+        ctl.plan()
         raw = ctl.fetch(0, 0)
         groups, shutdown = wf.decode_response_list(raw, 2)
         assert not shutdown
@@ -237,7 +241,14 @@ class TestPlannerEquivalence:
                 aid += 1
                 svc._handle(AnnounceRequest(rank, reqs, announce_id=aid),
                             None)
-            resp = svc._handle(FetchRequest(0, 0, wait_s=0.0), None)
+            # Let the announce stream go quiescent, then fetch with a
+            # window long enough for the timeout valve (which plans past
+            # the deliberately-partial entries in some streams).
+            import time as _t
+            from horovod_tpu.ops.control_plane import PLAN_DEBOUNCE_S
+            _t.sleep(PLAN_DEBOUNCE_S * 2)
+            resp = svc._handle(
+                FetchRequest(0, 0, wait_s=PLAN_DEBOUNCE_S * 4), None)
             return [(g["op"], tuple(g["names"]),
                      {k: tuple(v) for k, v in (g.get("sizes") or {}).items()},
                      bool(g["error"]), g.get("flags", 0))
@@ -270,10 +281,12 @@ class TestPlannerEquivalence:
         python_plan = self._drive(False, stream)
         assert native_plan == python_plan
         # Sanity on the shared plan: fusion respected the 1024-byte
-        # threshold (a+b = 800 bytes; c spilled into the next group).
+        # threshold with look-ahead over the whole quiescent stream —
+        # a+b = 800 bytes, c (400) would overflow and spilled, d (200)
+        # was pulled forward into the 1000-byte group.
         names = [set(g[1]) for g in native_plan]
-        assert {"a", "b"} in names and {"c"} not in [
-            s for s in names if "a" in s]
+        assert {"a", "b", "d"} in names
+        assert all("c" not in s for s in names if "a" in s)
 
     def test_identical_plans_under_hierarchical_env(self, monkeypatch):
         monkeypatch.setenv("HOROVOD_TPU_HIERARCHICAL_ALLGATHER", "1")
