@@ -140,18 +140,24 @@ def run_chip_bench():
 
     train_k = build_step(model, opt)
 
-    # FLOPs per optimizer step from XLA's cost analysis of the k=1
-    # program (the fori_loop body is counted once regardless of trip
-    # count, so a k=1 compile gives an unambiguous per-step figure).
+    # FLOPs per optimizer step from XLA's cost analysis of a k=1
+    # program. This is a second, dedicated compile on purpose: cost
+    # analysis of a k>1 executable reports a NON-linear flop total
+    # (measured: k=10 gives ~1.5x the k=1 figure, not 10x — loop
+    # canonicalization), so the k=1 program is the only unambiguous
+    # per-step basis. HVD_BENCH_SKIP_MFU=1 skips it (CI smoke, where
+    # the duplicate compile is the dominant cost and MFU is meaningless
+    # on CPU anyway).
     flops_per_step = 0.0
-    try:
-        cost = train_k.lower(params, batch_stats, opt_state, images,
-                             labels, 1).compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else {}
-        flops_per_step = float(cost.get("flops", 0.0))
-    except Exception:
-        pass
+    if os.environ.get("HVD_BENCH_SKIP_MFU") != "1":
+        try:
+            cost = train_k.lower(params, batch_stats, opt_state, images,
+                                 labels, 1).compile().cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            flops_per_step = float(cost.get("flops", 0.0))
+        except Exception:
+            pass
 
     def run_batches(k):
         nonlocal params, batch_stats, opt_state

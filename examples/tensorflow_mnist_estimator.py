@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""TensorFlow MNIST with a MonitoredTrainingSession + SessionRunHook —
+the TPU-native equivalent of examples/tensorflow_mnist_estimator.py (214
+LoC: Estimator training with BroadcastGlobalVariablesHook) and the
+hook-based half of examples/tensorflow_mnist.py.
+
+The reference attaches ``hvd.BroadcastGlobalVariablesHook(0)`` so every
+worker starts from rank 0's initial weights (tensorflow/__init__.py:
+117-148); rank 0 alone writes checkpoints. This mirrors that session/
+hook training loop on a TF1-compat graph: the hook broadcasts all global
+variables after session creation, the DistributedOptimizer averages
+gradients through ONE bridged engine group per step, and only rank 0
+passes a checkpoint_dir.
+"""
+
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path[:0] = [_HERE, os.path.dirname(_HERE)]  # _data + repo root
+
+os.environ["KERAS_BACKEND"] = "tensorflow"
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+from _data import synthetic_mnist, shard_for_rank  # noqa: E402
+
+BATCH = 64
+STEPS = int(os.environ.get("STEPS", 60))
+CKPT = os.environ.get("CKPT_DIR", "/tmp/hvd_tpu_tf_mnist_estimator")
+
+
+def main():
+    hvd.init()
+
+    images, labels = synthetic_mnist()
+    images, labels = shard_for_rank((images, labels),
+                                    hvd.rank(), hvd.size())
+    images = images.reshape(-1, 784).astype(np.float32)
+    labels = labels.astype(np.int32)
+
+    tf.compat.v1.disable_eager_execution()
+    graph = tf.Graph()
+    with graph.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, [None, 784], name="x")
+        y = tf.compat.v1.placeholder(tf.int32, [None], name="y")
+
+        w1 = tf.compat.v1.get_variable(
+            "w1", [784, 128],
+            initializer=tf.compat.v1.glorot_uniform_initializer(
+                seed=hvd.rank()))  # per-rank init: the hook must fix this
+        b1 = tf.compat.v1.get_variable(
+            "b1", [128], initializer=tf.compat.v1.zeros_initializer())
+        w2 = tf.compat.v1.get_variable(
+            "w2", [128, 10],
+            initializer=tf.compat.v1.glorot_uniform_initializer(
+                seed=100 + hvd.rank()))
+        b2 = tf.compat.v1.get_variable(
+            "b2", [10], initializer=tf.compat.v1.zeros_initializer())
+
+        hidden = tf.nn.relu(x @ w1 + b1)
+        logits = hidden @ w2 + b2
+        loss = tf.reduce_mean(
+            tf.nn.sparse_softmax_cross_entropy_with_logits(
+                labels=y, logits=logits))
+
+        # Scale LR by world size, as the reference example does; the v1
+        # optimizer path exercises the reference's compute_gradients
+        # override (tensorflow/__init__.py:151-249).
+        opt = hvd.DistributedOptimizer(
+            tf.compat.v1.train.GradientDescentOptimizer(
+                0.05 * hvd.size()))
+        global_step = tf.compat.v1.train.get_or_create_global_step()
+        train_op = opt.minimize(loss, global_step=global_step)
+
+        hooks = [
+            # Sync initial state from rank 0 (the reference's hook).
+            hvd.BroadcastGlobalVariablesHook(0),
+            tf.compat.v1.train.StopAtStepHook(last_step=STEPS),
+        ]
+
+        # Rank 0 alone writes checkpoints (SURVEY.md §5.4 convention).
+        ckpt_dir = CKPT if hvd.rank() == 0 else None
+        rng = np.random.RandomState(hvd.rank())
+        losses = []
+        with tf.compat.v1.train.MonitoredTrainingSession(
+                checkpoint_dir=ckpt_dir, hooks=hooks,
+                config=tf.compat.v1.ConfigProto()) as sess:
+            while not sess.should_stop():
+                idx = rng.randint(0, len(images), BATCH)
+                l, _ = sess.run(
+                    [loss, train_op],
+                    feed_dict={x: images[idx], y: labels[idx]})
+                losses.append(l)
+
+    print(f"rank {hvd.rank()}: first loss {losses[0]:.4f}, "
+          f"final loss {losses[-1]:.4f}")
+    assert np.isfinite(losses).all(), "loss diverged"
+    if STEPS >= 30:  # too few steps to demand progress in smoke runs
+        assert min(losses) < losses[0], "loss did not decrease"
+    print("DONE")
+
+
+if __name__ == "__main__":
+    main()

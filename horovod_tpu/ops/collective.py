@@ -164,7 +164,11 @@ class CollectiveEngine:
         self._wake = threading.Event()
         self._last_enqueue_t = 0.0
         self._oldest_enqueue_t = 0.0
+        self._last_seen_qlen = 0
         self.mp_params: Dict = {}
+        # name -> (latest coordinator missing-ranks stall line, wall time)
+        # in MP mode; entries expire after 2x the warning window.
+        self._coord_stall_lines: Dict[str, tuple] = {}
         # Knobs — reference defaults: 64 MiB fusion, 5 ms cycle
         # (operations.cc:1838,1846). We default the cycle to 1 ms: there is
         # no MPI round-trip to amortize on the single-controller path.
@@ -481,6 +485,14 @@ class CollectiveEngine:
         flags arrive per group instead (SPMD lockstep)."""
         for line in resp.stall:
             _log.warning("stalled tensor (coordinator report): %s", line)
+            # Keep the authoritative missing-ranks line per tensor so the
+            # engine's own stall warning can name the missing processes
+            # (CheckForStalledTensors, operations.cc:1644-1668). Stamped
+            # so stale lines (tensor completed, name reused later) are
+            # never reported and the cache cannot grow unboundedly.
+            name = line.split(" [", 1)[0].strip()
+            if name:
+                self._coord_stall_lines[name] = (line, time.monotonic())
         params = resp.params
         if params:
             cyc = params.get("cycle_time_ms")
@@ -651,7 +663,13 @@ class CollectiveEngine:
                 # program. Bounded so a continuous stream cannot starve
                 # dispatch.
                 now = time.monotonic()
-                defer = (bool(self._queue)
+                qlen = len(self._queue)
+                grew = qlen > self._last_seen_qlen
+                self._last_seen_qlen = qlen
+                # Defer only while the burst is still GROWING — a lone
+                # blocking caller's single request must not pay the
+                # debounce (its submitter is stuck on the handle).
+                defer = (qlen > 0 and grew
                          and now - self._last_enqueue_t < _DRAIN_DEBOUNCE_S
                          and now - self._oldest_enqueue_t
                          < _DRAIN_MAX_DEFER_S)
@@ -660,6 +678,7 @@ class CollectiveEngine:
                 else:
                     batch = self._queue
                     self._queue = []
+                    self._last_seen_qlen = 0
             if defer:
                 # Also skip the MP fetch: a long-poll here would hold the
                 # rest of the burst back past the coordinator's quiet
@@ -856,7 +875,13 @@ class CollectiveEngine:
 
     def _maybe_check_stalls(self):
         """Stall detector (CheckForStalledTensors, operations.cc:1625-1672):
-        warn about requests stuck in flight past the warning time."""
+        warn about requests stuck in flight past the warning time, with
+        the reference report's per-tensor diagnostic quality — op type,
+        wait duration, and (multi-process) WHICH ranks are missing, taken
+        from the coordinator's authoritative table. In single-process
+        mode every virtual rank is driven by this process, so no rank can
+        be 'missing' — a stall there means the dispatcher is wedged or an
+        async handle was never awaited, and the report says so."""
         if self.stall_warning_s <= 0:
             return
         now = time.monotonic()
@@ -864,17 +889,43 @@ class CollectiveEngine:
             return
         self._last_stall_check = now
         with self._lock:
-            stalled = [r.name for r in self._in_flight.values()
+            stalled = [(r.name, _op_name(r.op), now - r.enqueued_at)
+                       for r in self._in_flight.values()
                        if now - r.enqueued_at > self.stall_warning_s]
-        if stalled:
-            _log.warning(
-                "One or more tensors were submitted to be reduced, gathered "
-                "or broadcasted by subset of ranks and are waiting for "
-                "remainder of ranks for more than %d seconds. This may "
-                "indicate that different ranks are trying to submit "
-                "different tensors or that only subset of ranks is "
-                "submitting tensors, which will cause deadlock. Stalled ops: "
-                "%s", int(self.stall_warning_s), ", ".join(sorted(stalled)))
+        if not stalled:
+            return
+        mp = self._is_multiprocess()
+        # Expire coordinator lines from a PREVIOUS stall episode: a line
+        # older than two warning windows describes ranks that were
+        # missing then, not now (names are commonly reused per step).
+        cutoff = now - 2.0 * self.stall_warning_s
+        self._coord_stall_lines = {
+            n: (ln, ts) for n, (ln, ts) in self._coord_stall_lines.items()
+            if ts >= cutoff}
+        lines = []
+        for name, op, age in sorted(stalled):
+            coord = self._coord_stall_lines.get(name)
+            if coord is not None:
+                lines.append(f"{coord[0]} [{op}, waiting {int(age)}s]")
+            elif mp:
+                lines.append(
+                    f"{name} [{op}, waiting {int(age)}s; announced, "
+                    "awaiting coordinator grouping — see coordinator "
+                    "report for missing ranks]")
+            else:
+                lines.append(
+                    f"{name} [{op}, waiting {int(age)}s; single-process: "
+                    "all virtual ranks are local, so no rank is missing — "
+                    "likely a wedged dispatcher or an unawaited handle]")
+        _log.warning(
+            "One or more tensors were submitted to be reduced, gathered "
+            "or broadcasted by subset of ranks and are waiting for "
+            "remainder of ranks for more than %d seconds. This may "
+            "indicate that different ranks are trying to submit "
+            "different tensors or that only subset of ranks is "
+            "submitting tensors, which will cause deadlock.\n"
+            "Stalled ops:\n%s",
+            int(self.stall_warning_s), "\n".join(lines))
 
     # ------------------------------------------------------------- execution
 

@@ -135,9 +135,13 @@ struct GlobalState {
   // group composition is a distinct fused XLA program, and timing-
   // dependent chunking would mean a fresh compile per step instead of a
   // cache hit. kDrainMaxDeferNs bounds the wait so a continuous enqueue
-  // stream cannot starve dispatch.
+  // stream cannot starve dispatch, and a queue that did not GROW since
+  // the previous check drains immediately — a lone blocking caller's
+  // single request must not pay the debounce (its submitter is stuck on
+  // the handle; no burst can follow).
   std::atomic<int64_t> last_enqueue_ns{0};
   std::atomic<int64_t> oldest_enqueue_ns{0};
+  size_t last_seen_qlen = 0;  // background thread only
 };
 
 constexpr int64_t kDrainDebounceNs = 2'000'000;    // 2 ms
@@ -153,7 +157,11 @@ int64_t NowNs() {
 bool DrainShouldDefer(GlobalState& st) {
   if (st.shutdown_requested.load()) return false;  // drain for teardown
   std::lock_guard<std::mutex> lk(st.mu);
-  if (st.message_queue.empty()) return false;
+  size_t qlen = st.message_queue.size();
+  size_t last = st.last_seen_qlen;
+  st.last_seen_qlen = qlen;
+  if (qlen == 0) return false;
+  if (qlen <= last) return false;  // burst stopped growing: drain now
   int64_t now = NowNs();
   if (now - st.oldest_enqueue_ns.load() >= kDrainMaxDeferNs) return false;
   return now - st.last_enqueue_ns.load() < kDrainDebounceNs;
@@ -256,6 +264,7 @@ bool RunLoopOnceMP(GlobalState& st) {
     std::lock_guard<std::mutex> lk(st.mu);
     batch = std::move(st.message_queue);
     st.message_queue.clear();
+    st.last_seen_qlen = 0;
   }
   RequestList rl;
   for (auto& pe : batch) rl.requests.push_back(pe.request);
@@ -354,6 +363,7 @@ bool RunLoopOnce(GlobalState& st) {
     std::lock_guard<std::mutex> lk(st.mu);
     batch = std::move(st.message_queue);
     st.message_queue.clear();
+    st.last_seen_qlen = 0;
   }
 
   // Negotiation: every enqueue on the single-controller path announces the

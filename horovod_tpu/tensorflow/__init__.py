@@ -39,10 +39,16 @@ from ..topology import (init, shutdown, is_initialized, rank, local_rank,
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "local_rank", "size",
     "local_size", "mpi_threads_supported", "Compression",
-    "allreduce", "allgather", "broadcast", "broadcast_variables",
-    "broadcast_global_variables", "DistributedOptimizer",
-    "DistributedGradientTape", "BroadcastGlobalVariablesCallback",
+    "allreduce", "grouped_allreduce", "allgather", "broadcast",
+    "broadcast_variables", "broadcast_global_variables",
+    "DistributedOptimizer", "DistributedGradientTape",
+    "BroadcastGlobalVariablesCallback", "BroadcastGlobalVariablesHook",
 ]
+
+# Host-bridge call counter (observability/tests): index 0 counts how many
+# py_function/host crossings carried a GROUP of tensors — the fusion-
+# restoring path. A tape with 50 gradients must cost 1 bridge, not 50.
+_bridge_calls = [0]
 
 
 # ---------------------------------------------------------------------------
@@ -125,6 +131,67 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None,
         return out, grad
 
     return _op(tf.convert_to_tensor(tensor))
+
+
+def grouped_allreduce(tensors, average: bool = True,
+                      name: Optional[str] = None,
+                      compression=Compression.none):
+    """Allreduce a LIST of tensors through ONE host bridge.
+
+    The reference's AsyncOpKernels all enqueue into the coordinator and
+    the background cycle fuses them (tensorflow/mpi_ops.cc:276-463 +
+    operations.cc:2149-2265); a per-tensor ``tf.py_function`` would
+    instead serialize one host round-trip per gradient. This is the
+    fusion-restoring path: one ``py_function`` (one bridge) submits the
+    whole group to the engine as a single burst — the engine fuses it
+    into as few XLA collectives as the threshold allows — and waits all
+    handles. Differentiable: the gradient is a grouped allreduce of the
+    incoming gradients, matching allreduce's registered gradient
+    (tensorflow/mpi_ops.py:94-105).
+    """
+    tensors = [tf.convert_to_tensor(t) for t in tensors]
+    if not tensors:
+        return []
+    nm = _auto_name("grouped", name)
+
+    @tf.custom_gradient
+    def _op(*xs):
+        wires = []
+        ctxs = []
+        for x in xs:
+            if compression is not Compression.none and x.dtype.is_floating:
+                wires.append(tf.cast(x, tf.float16))
+                ctxs.append(x.dtype)
+            else:
+                wires.append(x)
+                ctxs.append(None)
+
+        def host(*vs):
+            _bridge_calls[0] += 1
+            handles = [
+                _ops.allreduce_async(_np(v), average=average,
+                                     name=f"{nm}.{i}")
+                for i, v in enumerate(vs)]
+            return [np.asarray(h.wait()) for h in handles]
+
+        outs = tf.py_function(host, list(wires),
+                              Tout=[w.dtype for w in wires])
+        if len(wires) == 1:
+            outs = [outs] if not isinstance(outs, (list, tuple)) else outs
+        res = []
+        for o, x, ctx in zip(outs, xs, ctxs):
+            o.set_shape(x.shape)
+            res.append(tf.cast(o, ctx) if ctx is not None else o)
+
+        def grad(*dys):
+            return grouped_allreduce(
+                list(dys), average=average,
+                name=_auto_name("grouped", None), compression=compression)
+
+        return res, grad
+
+    out = _op(*tensors)
+    return list(out) if isinstance(out, (list, tuple)) else [out]
 
 
 def allgather(tensor, name: Optional[str] = None):
@@ -210,6 +277,63 @@ def broadcast_global_variables(root_rank: int = 0, variables=None) -> None:
     broadcast_variables(variables, root_rank)
 
 
+try:  # SessionRunHook base: v1 compat surface (removed in some builds)
+    _SessionRunHook = tf.compat.v1.train.SessionRunHook
+except AttributeError:  # pragma: no cover - ancient/minimal TF builds
+    _SessionRunHook = object
+
+
+class BroadcastGlobalVariablesHook(_SessionRunHook):
+    """SessionRunHook that broadcasts all global variables from
+    ``root_rank`` once the session is created — the reference's
+    estimator/MonitoredTrainingSession integration
+    (tensorflow/__init__.py:117-148, examples/tensorflow_mnist.py).
+
+    Graph mode: ``begin()`` builds one grouped assign op over
+    ``tf.compat.v1.global_variables()``; ``after_create_session()`` runs
+    it. Eager contexts should use
+    :class:`BroadcastGlobalVariablesCallback` instead.
+    """
+
+    def __init__(self, root_rank: int = 0, device: str = ""):
+        self.root_rank = root_rank
+        self.device = device  # accepted for API parity; placement is XLA's
+        self.bcast_op = None
+
+    def begin(self):
+        gvars = tf.compat.v1.global_variables()
+        if not gvars:
+            self.bcast_op = tf.no_op()
+            return
+        # ONE bridged group for all variables (like grouped_allreduce):
+        # per-variable py_functions would leave fusion to TF's inter-op
+        # scheduling racing the engine's drain debounce — hundreds of
+        # serialized host round-trips in the worst case.
+        nm = _auto_name("hook.bcast", None)
+        root = self.root_rank
+
+        def host(*vs):
+            _bridge_calls[0] += 1
+            handles = [
+                _ops.broadcast_async(_np(v), root, name=f"{nm}.{i}")
+                for i, v in enumerate(vs)]
+            return [np.asarray(h.wait()) for h in handles]
+
+        outs = tf.py_function(host, list(gvars),
+                              Tout=[v.dtype.base_dtype for v in gvars])
+        if len(gvars) == 1 and not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        assigns = []
+        for v, o in zip(gvars, outs):
+            o.set_shape(v.shape)
+            assigns.append(tf.compat.v1.assign(v, o))
+        self.bcast_op = tf.group(*assigns)
+
+    def after_create_session(self, session, coord):
+        if self.bcast_op is not None:
+            session.run(self.bcast_op)
+
+
 class BroadcastGlobalVariablesCallback:
     """Callable hook: invoke once after the first step (when optimizer
     slots exist) to sync all state from ``root_rank`` — the TF2 analogue
@@ -235,12 +359,80 @@ class BroadcastGlobalVariablesCallback:
 # DistributedOptimizer / DistributedGradientTape
 # ---------------------------------------------------------------------------
 
+def _reduce_grad_list(grads, prefix: str, compression,
+                      sparse_as_dense: bool):
+    """Average a list of gradients: dense ones in ONE bridged group
+    (engine-fused), IndexedSlices through the sparse allgather path."""
+    grads = list(grads)
+    if sparse_as_dense:
+        grads = [tf.convert_to_tensor(g)
+                 if isinstance(g, tf.IndexedSlices) else g for g in grads]
+    dense_idx = [i for i, g in enumerate(grads)
+                 if g is not None and not isinstance(g, tf.IndexedSlices)]
+    reduced = grouped_allreduce([grads[i] for i in dense_idx],
+                                average=True, name=f"{prefix}.grads",
+                                compression=compression)
+    for i, rg in zip(dense_idx, reduced):
+        grads[i] = rg
+    for i, g in enumerate(grads):
+        if isinstance(g, tf.IndexedSlices):
+            grads[i] = allreduce(g, average=True, name=f"{prefix}.grad.{i}",
+                                 compression=compression)
+    return grads
+
+
+def _make_v1_distributed_optimizer(optimizer, name, compression,
+                                   sparse_as_dense):
+    """The reference's actual shape: a ``tf.compat.v1.train.Optimizer``
+    subclass delegating to the wrapped optimizer, with
+    ``compute_gradients`` allreduce-averaging every gradient
+    (tensorflow/__init__.py:151-249)."""
+    v1 = tf.compat.v1.train
+
+    class _DistributedOptimizerV1(v1.Optimizer):
+        def __init__(self):
+            self._optimizer = optimizer
+            self._hvd_prefix = (name or
+                                f"Distributed{type(optimizer).__name__}")
+            super().__init__(name=self._hvd_prefix, use_locking=False)
+
+        def compute_gradients(self, *args, **kwargs):
+            gvs = self._optimizer.compute_gradients(*args, **kwargs)
+            grads = _reduce_grad_list([g for g, _ in gvs],
+                                      self._hvd_prefix, compression,
+                                      sparse_as_dense)
+            return [(g, v) for g, (_, v) in zip(grads, gvs)]
+
+        def apply_gradients(self, *args, **kwargs):
+            return self._optimizer.apply_gradients(*args, **kwargs)
+
+        def get_slot(self, *args, **kwargs):
+            return self._optimizer.get_slot(*args, **kwargs)
+
+        def get_slot_names(self, *args, **kwargs):
+            return self._optimizer.get_slot_names(*args, **kwargs)
+
+        def variables(self, *args, **kwargs):
+            return self._optimizer.variables(*args, **kwargs)
+
+    return _DistributedOptimizerV1()
+
+
 def DistributedOptimizer(optimizer, name: Optional[str] = None,
                          compression=Compression.none,
                          sparse_as_dense: bool = False):
-    """Wrap a ``tf.keras.optimizers``-style optimizer: gradients passed to
-    ``apply_gradients`` are allreduce-averaged first
-    (tensorflow/__init__.py:151-249)."""
+    """Wrap an optimizer so gradients are allreduce-averaged before the
+    update (tensorflow/__init__.py:151-249). Dispatches on flavor:
+    ``tf.compat.v1.train.Optimizer`` gets the reference's delegation
+    wrapper overriding ``compute_gradients`` (graph/MonitoredSession
+    loops); Keras-style optimizers get a dynamic subclass whose
+    ``apply_gradients`` reduces first."""
+    try:
+        if isinstance(optimizer, tf.compat.v1.train.Optimizer):
+            return _make_v1_distributed_optimizer(
+                optimizer, name, compression, sparse_as_dense)
+    except AttributeError:  # pragma: no cover - minimal TF builds
+        pass
     prefix = name or f"Distributed{optimizer.__class__.__name__}"
 
     class _Wrapped(optimizer.__class__):
@@ -248,17 +440,11 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
 
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
             gv = list(grads_and_vars)
-            reduced = []
-            for i, (g, v) in enumerate(gv):
-                if g is None:
-                    reduced.append((g, v))
-                    continue
-                if sparse_as_dense and isinstance(g, tf.IndexedSlices):
-                    g = tf.convert_to_tensor(g)
-                reduced.append((allreduce(
-                    g, average=True, name=f"{prefix}.grad.{i}",
-                    compression=compression), v))
-            return super().apply_gradients(reduced, *args, **kwargs)
+            reduced = _reduce_grad_list([g for g, _ in gv], prefix,
+                                        compression, sparse_as_dense)
+            return super().apply_gradients(
+                [(g, v) for g, (_, v) in zip(reduced, gv)],
+                *args, **kwargs)
 
     new = _Wrapped.from_config(optimizer.get_config())
     return new
@@ -276,15 +462,9 @@ class DistributedGradientTape(tf.GradientTape):
 
     def gradient(self, target, sources, *args, **kwargs):
         grads = super().gradient(target, sources, *args, **kwargs)
-        flat = tf.nest.flatten(grads)
-        out = []
-        for i, g in enumerate(flat):
-            if g is None:
-                out.append(None)
-                continue
-            if self._hvd_sparse_as_dense and isinstance(g, tf.IndexedSlices):
-                g = tf.convert_to_tensor(g)
-            out.append(allreduce(g, average=True,
-                                 name=_auto_name("tape.grad", None),
-                                 compression=self._hvd_compression))
-        return tf.nest.pack_sequence_as(grads, out)
+        # One bridged group for all dense gradients (the reference's
+        # fused AsyncOpKernel behavior); sparse stays per-tensor.
+        flat = _reduce_grad_list(
+            tf.nest.flatten(grads), _auto_name("tape", None),
+            self._hvd_compression, self._hvd_sparse_as_dense)
+        return tf.nest.pack_sequence_as(grads, flat)

@@ -129,6 +129,12 @@ class TestExamples:
         out = _run("tensorflow_mnist_eager.py", {"STEPS": "6"}, devices=2)
         assert "loss" in out
 
+    def test_tensorflow_mnist_estimator(self):
+        _needs("tensorflow")
+        out = _run("tensorflow_mnist_estimator.py", {"STEPS": "8"},
+                   devices=2)
+        assert "DONE" in out
+
     def test_keras_mnist(self):
         _needs("keras")
         _needs("torch")  # the example's default Keras backend

@@ -163,3 +163,88 @@ class TestDistributedGradientTape:
 
         cb(model=M())
         assert cb._done
+
+
+class TestGroupedBridge:
+    def test_tape_many_variables_one_bridge(self):
+        """VERDICT r1 #7 'done' condition: a tape with >= 20 variables
+        crosses the host bridge ONCE per gradient call (one engine-fused
+        burst), not once per variable."""
+        n_vars = 24
+        vs = [tf.Variable(tf.fill([3], float(i + 1))) for i in range(n_vars)]
+        with hvd_tf.DistributedGradientTape() as tape:
+            loss = tf.add_n([tf.reduce_sum(v * v) for v in vs])
+        before = hvd_tf._bridge_calls[0]
+        grads = tape.gradient(loss, vs)
+        bridged = hvd_tf._bridge_calls[0] - before
+        assert bridged == 1, f"{bridged} host bridges for {n_vars} grads"
+        for i, g in enumerate(grads):
+            # Replicated virtual ranks: average == local value (2 * v).
+            np.testing.assert_allclose(g.numpy(), 2.0 * (i + 1), rtol=1e-5)
+
+    def test_grouped_allreduce_values_and_grad(self):
+        xs = [tf.constant([1.0, 2.0]), tf.constant([[3.0]]),
+              tf.constant([4.0, 5.0, 6.0])]
+        outs = hvd_tf.grouped_allreduce(xs, average=False)
+        for x, o in zip(xs, outs):
+            np.testing.assert_allclose(o.numpy(), x.numpy() * hvd.size())
+        # Differentiable through the group.
+        v = tf.Variable([2.0, 3.0])
+        with tf.GradientTape() as tape:
+            out = hvd_tf.grouped_allreduce([v * v], average=True)[0]
+            loss = tf.reduce_sum(out)
+        g = tape.gradient(loss, v)
+        np.testing.assert_allclose(g.numpy(), 2.0 * v.numpy(), rtol=1e-5)
+
+    def test_grouped_allreduce_mixed_dtypes(self):
+        outs = hvd_tf.grouped_allreduce(
+            [tf.constant([1.0, 2.0]), tf.constant([3], tf.int32)],
+            average=False)
+        np.testing.assert_allclose(outs[0].numpy(),
+                                   [hvd.size(), 2.0 * hvd.size()])
+        assert outs[1].numpy().tolist() == [3 * hvd.size()]
+        assert outs[1].dtype == tf.int32
+
+    def test_v1_optimizer_compute_gradients_one_bridge(self):
+        """The reference-shaped v1 wrapper (compute_gradients override,
+        tensorflow/__init__.py:151-249): 21 variables cross in ONE
+        bridged group, and the update applies. (A Keras-3 optimizer is
+        not used here because other suite files pin the in-process Keras
+        backend to torch; the Keras path is covered in
+        tests/test_keras_tf.py's subprocess.)"""
+        vs = [tf.Variable(tf.ones([2]) * (i + 1)) for i in range(21)]
+        opt = hvd_tf.DistributedOptimizer(
+            tf.compat.v1.train.GradientDescentOptimizer(0.1))
+
+        def loss():
+            return tf.add_n([tf.reduce_sum(v * v) for v in vs])
+
+        before = hvd_tf._bridge_calls[0]
+        gvs = opt.compute_gradients(loss, var_list=vs)
+        assert hvd_tf._bridge_calls[0] - before == 1
+        opt.apply_gradients(gvs)
+        for i, v in enumerate(vs):
+            # g = 2v -> v' = v - 0.1 * 2v = 0.8 * (i+1)
+            np.testing.assert_allclose(v.numpy(), 0.8 * (i + 1),
+                                       rtol=1e-5)
+
+
+class TestSessionRunHook:
+    def test_broadcast_hook_graph_mode(self):
+        """SessionRunHook-shaped estimator integration
+        (tensorflow/__init__.py:117-148): begin() builds the grouped
+        assign over global variables; after_create_session runs it."""
+        with tf.Graph().as_default():
+            v1 = tf.compat.v1.get_variable(
+                "hook_v1", initializer=tf.constant([1.0, 2.0]))
+            v2 = tf.compat.v1.get_variable(
+                "hook_v2", initializer=tf.constant(5.0))
+            hook = hvd_tf.BroadcastGlobalVariablesHook(root_rank=0)
+            hook.begin()
+            assert hook.bcast_op is not None
+            with tf.compat.v1.Session() as sess:
+                sess.run(tf.compat.v1.global_variables_initializer())
+                hook.after_create_session(sess, None)
+                out1, out2 = sess.run([v1, v2])
+        np.testing.assert_allclose(out1, [1.0, 2.0])
+        np.testing.assert_allclose(out2, 5.0)
