@@ -15,6 +15,7 @@ passes a checkpoint_dir.
 
 import os
 import sys
+import tempfile
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path[:0] = [_HERE, os.path.dirname(_HERE)]  # _data + repo root
@@ -30,7 +31,10 @@ from _data import synthetic_mnist, shard_for_rank  # noqa: E402
 
 BATCH = 64
 STEPS = int(os.environ.get("STEPS", 60))
-CKPT = os.environ.get("CKPT_DIR", "/tmp/hvd_tpu_tf_mnist_estimator")
+# Fresh run directory by default: a persisted global_step from a prior
+# run would make StopAtStepHook stop the restored session immediately.
+CKPT = os.environ.get("CKPT_DIR") or tempfile.mkdtemp(
+    prefix="hvd_tpu_tf_mnist_estimator.")
 
 
 def main():

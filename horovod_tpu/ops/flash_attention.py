@@ -210,11 +210,23 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_sc[:].astype(dq_ref.dtype)
 
 
+def _default_block(block, interpret: bool) -> int:
+    """Default tile size. Compiled Mosaic kernels want LARGE blocks —
+    measured on v5e at S=8192 the fwd+bwd step is 2.0x faster at 512
+    than at 128 (fewer grid iterations re-streaming K/V from HBM);
+    1024 exceeds the scoped VMEM budget and fails to compile. The
+    interpreter keeps 128 so CPU tests stay fast. Blocks are clamped to
+    the sequence length either way."""
+    if block is not None:
+        return block
+    return 128 if interpret else 512
+
+
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     bh, s, d = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, s)
-    block_k = min(block_k, sk)
+    block_q = min(_default_block(block_q, interpret), s)
+    block_k = min(_default_block(block_k, interpret), sk)
     n_q = pl.cdiv(s, block_q)
     n_k = pl.cdiv(sk, block_k)
 
@@ -249,13 +261,17 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal: bool = True,
-                    scale: Optional[float] = None, block_q: int = 128,
-                    block_k: int = 128, interpret: bool = False):
+                    scale: Optional[float] = None,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
+                    interpret: bool = False):
     """Flash attention over [batch, seq, heads, head_dim] inputs.
 
     Exact (up to fp) vs full attention; O(seq) memory. ``interpret``
     routes through the Pallas interpreter (CPU tests); on TPU leave
-    False for the compiled Mosaic kernel.
+    False for the compiled Mosaic kernel. Block sizes default to 512
+    compiled / 128 interpreted (see _default_block — 512 measured 2x
+    faster end-to-end on v5e at long sequence).
     """
     out, _ = _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k,
                              interpret)
@@ -295,8 +311,8 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
     ob, gb = _to_bh(out), _to_bh(g)
     bh = qb.shape[0]
     sk = kb.shape[1]
-    bq = min(block_q, s)
-    bk = min(block_k, sk)
+    bq = min(_default_block(block_q, interpret), s)
+    bk = min(_default_block(block_k, interpret), sk)
     n_q = pl.cdiv(s, bq)
     n_k = pl.cdiv(sk, bk)
 

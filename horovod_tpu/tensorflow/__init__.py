@@ -79,6 +79,29 @@ def _py_collective(host_fn, inputs: tf.Tensor, out_dtype, out_shape):
     return out
 
 
+def _grouped_bridge(submit_async, tensors):
+    """ONE py_function crossing for a whole tensor group: submit every
+    tensor to the engine as a single burst (fused there), wait all
+    handles, return outputs with shapes restored. ``submit_async(i, arr)``
+    must return an engine Handle. Shared by grouped_allreduce and the
+    broadcast hook so bridge counting and singleton normalization live
+    in one place."""
+
+    def host(*vs):
+        _bridge_calls[0] += 1
+        handles = [submit_async(i, _np(v)) for i, v in enumerate(vs)]
+        return [np.asarray(h.wait()) for h in handles]
+
+    outs = tf.py_function(host, list(tensors),
+                          Tout=[t.dtype.base_dtype if hasattr(t, "dtype")
+                                else t.dtype for t in tensors])
+    if len(tensors) == 1 and not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    for t, o in zip(tensors, outs):
+        o.set_shape(t.shape)
+    return list(outs)
+
+
 _name_counter = [0]
 
 
@@ -166,22 +189,12 @@ def grouped_allreduce(tensors, average: bool = True,
                 wires.append(x)
                 ctxs.append(None)
 
-        def host(*vs):
-            _bridge_calls[0] += 1
-            handles = [
-                _ops.allreduce_async(_np(v), average=average,
-                                     name=f"{nm}.{i}")
-                for i, v in enumerate(vs)]
-            return [np.asarray(h.wait()) for h in handles]
-
-        outs = tf.py_function(host, list(wires),
-                              Tout=[w.dtype for w in wires])
-        if len(wires) == 1:
-            outs = [outs] if not isinstance(outs, (list, tuple)) else outs
-        res = []
-        for o, x, ctx in zip(outs, xs, ctxs):
-            o.set_shape(x.shape)
-            res.append(tf.cast(o, ctx) if ctx is not None else o)
+        outs = _grouped_bridge(
+            lambda i, arr: _ops.allreduce_async(arr, average=average,
+                                                name=f"{nm}.{i}"),
+            wires)
+        res = [tf.cast(o, ctx) if ctx is not None else o
+               for o, ctx in zip(outs, ctxs)]
 
         def grad(*dys):
             return grouped_allreduce(
@@ -311,23 +324,12 @@ class BroadcastGlobalVariablesHook(_SessionRunHook):
         # serialized host round-trips in the worst case.
         nm = _auto_name("hook.bcast", None)
         root = self.root_rank
-
-        def host(*vs):
-            _bridge_calls[0] += 1
-            handles = [
-                _ops.broadcast_async(_np(v), root, name=f"{nm}.{i}")
-                for i, v in enumerate(vs)]
-            return [np.asarray(h.wait()) for h in handles]
-
-        outs = tf.py_function(host, list(gvars),
-                              Tout=[v.dtype.base_dtype for v in gvars])
-        if len(gvars) == 1 and not isinstance(outs, (list, tuple)):
-            outs = [outs]
-        assigns = []
-        for v, o in zip(gvars, outs):
-            o.set_shape(v.shape)
-            assigns.append(tf.compat.v1.assign(v, o))
-        self.bcast_op = tf.group(*assigns)
+        outs = _grouped_bridge(
+            lambda i, arr: _ops.broadcast_async(arr, root,
+                                                name=f"{nm}.{i}"),
+            list(gvars))
+        self.bcast_op = tf.group(*[
+            tf.compat.v1.assign(v, o) for v, o in zip(gvars, outs)])
 
     def after_create_session(self, session, coord):
         if self.bcast_op is not None:

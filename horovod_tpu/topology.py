@@ -170,6 +170,21 @@ def init(*, coordinator_address: Optional[str] = None,
                 process_id=pid,
             )
 
+        # Opt-in persistent XLA compilation cache: TPU compiles of a big
+        # training step cost tens of seconds and are identical across
+        # restarts of the same job — a restart-heavy workflow (the
+        # rank-0-checkpoint convention, SURVEY.md §5.4) should not pay
+        # them twice. Off by default: the cache directory must be
+        # per-user/per-cluster policy, not a framework guess.
+        cache_dir = os.environ.get("HOROVOD_TPU_COMPILE_CACHE")
+        if cache_dir:
+            try:
+                jax.config.update("jax_compilation_cache_dir", cache_dir)
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 1.0)
+            except Exception:  # pragma: no cover - jax API drift
+                pass
+
         devs = tuple(devices) if devices is not None else tuple(jax.devices())
         _topology = _build_topology(
             devs, jax.process_index(), jax.process_count())
