@@ -483,8 +483,39 @@ class CollectiveExecutor:
                 return jax.lax.psum(buf, "dp")
             return _hier_reduce(buf, ici)
 
-        # Group by accumulation dtype (one collective per dtype, exactly
-        # like one fused response per dtype, operations.cc:2149-2265).
+        def build(padded, buf_dt):
+            def fused(x):
+                def shard_fn(y):
+                    v = y[0]  # this device's block of [size, n]
+                    if prescale != 1.0:
+                        v = v * prescale
+                    red = reduce_buf(v)
+                    if postscale != 1.0:
+                        red = red * postscale
+                    return red
+
+                return jax.shard_map(
+                    shard_fn, mesh=mesh, in_specs=P(axes),
+                    out_specs=P(), check_vma=False)(x)
+
+            return jax.jit(fused)
+
+        return self._run_fused_buffers(
+            tensors, build,
+            key_fn=lambda padded, dt: ("armp_buf", padded, dt,
+                                       float(prescale), float(postscale),
+                                       hier, id(mesh)),
+            mesh=mesh, axes=axes)
+
+    def _run_fused_buffers(self, tensors, build, key_fn, mesh, axes):
+        """Shared host-assembled fusion-buffer scaffolding for the MP
+        collectives (the reference's memcpy into the fusion buffer,
+        operations.cc:1221-1243): group by accumulation dtype (one
+        collective per dtype, like one fused response per dtype,
+        operations.cc:2149-2265), pack into a size-QUANTIZED flat buffer
+        so the compiled program is keyed by padded size instead of group
+        composition, run ``build(padded, dtype_str)``'s program, and
+        unpack device-side (no D2H round trip of the payload)."""
         arrs = [np.asarray(t) for t in tensors]
         by_dtype: Dict = {}
         for i, a in enumerate(arrs):
@@ -502,31 +533,10 @@ class CollectiveExecutor:
                 buf[off:off + flat.size] = flat.astype(buf_dt)
                 off += flat.size
 
-            key = ("armp_buf", padded, str(buf_dt), float(prescale),
-                   float(postscale), hier, id(mesh))
-
-            def build():
-                def fused(x):
-                    def shard_fn(y):
-                        v = y[0]  # this device's block of [size, n]
-                        if prescale != 1.0:
-                            v = v * prescale
-                        red = reduce_buf(v)
-                        if postscale != 1.0:
-                            red = red * postscale
-                        return red
-
-                    return jax.shard_map(
-                        shard_fn, mesh=mesh, in_specs=P(axes),
-                        out_specs=P(), check_vma=False)(x)
-
-                return jax.jit(fused)
-
-            prog = self._program(key, build)
+            key = key_fn(padded, str(buf_dt))
+            prog = self._program(
+                key, lambda: build(padded, buf_dt))
             out = prog(self._mp_stacked(buf, mesh=mesh, axes=axes))
-            # Split device-side (eager slice/reshape/cast ops, cached by
-            # shape): the reduced buffer stays on device — no D2H+H2D
-            # round trip of the full gradient set per group.
             off = 0
             for i in idxs:
                 a = arrs[i]
@@ -537,35 +547,35 @@ class CollectiveExecutor:
 
     def broadcast_fused_mp(self, tensors: Sequence[jax.Array],
                            root_rank: int) -> List[jax.Array]:
-        """Cross-process broadcast from virtual rank ``root_rank``."""
-        mesh = self.mesh
-        shapes = tuple(tuple(t.shape) for t in tensors)
-        dtypes = tuple(str(t.dtype) for t in tensors)
-        key = ("bcmp", shapes, dtypes, int(root_rank), id(mesh))
+        """Cross-process broadcast from virtual rank ``root_rank``.
 
-        def build():
-            def fused(*xs):
-                def shard_fn(*ys):
+        Host-assembled, size-quantized fusion buffer like
+        allreduce_fused_mp: a parameter-broadcast burst (hundreds of
+        variables at job start) must compile one program keyed by padded
+        buffer size, not one per group composition.
+        """
+        mesh = self.mesh
+
+        def build(padded, buf_dt):
+            def fused(x):
+                def shard_fn(y):
+                    v = y[0]
                     idx = jax.lax.axis_index("dp")
-                    outs = []
-                    for y in ys:
-                        v = y[0]
-                        acc = _accum_dtype(v.dtype)
-                        z = v.astype(acc) if acc is not None else v
-                        masked = jnp.where(idx == root_rank, z,
-                                           jnp.zeros_like(z))
-                        outs.append(
-                            jax.lax.psum(masked, "dp").astype(v.dtype))
-                    return tuple(outs)
+                    masked = jnp.where(idx == root_rank, v,
+                                       jnp.zeros_like(v))
+                    return jax.lax.psum(masked, "dp")
+
                 return jax.shard_map(
-                    shard_fn, mesh=mesh,
-                    in_specs=tuple(P("dp") for _ in xs),
-                    out_specs=tuple(P() for _ in xs),
-                    check_vma=False)(*xs)
+                    shard_fn, mesh=mesh, in_specs=P("dp"),
+                    out_specs=P(), check_vma=False)(x)
+
             return jax.jit(fused)
 
-        prog = self._program(key, build)
-        return list(prog(*[self._mp_stacked(t) for t in tensors]))
+        return self._run_fused_buffers(
+            tensors, build,
+            key_fn=lambda padded, dt: ("bcmp_buf", padded, dt,
+                                       int(root_rank), id(mesh)),
+            mesh=mesh, axes=("dp",))
 
     def allgather_fused_mp(self, tensors: Sequence[jax.Array]
                            ) -> List[jax.Array]:

@@ -114,33 +114,10 @@ def _jax_inline_allreduce(g):
             "where XLA inserts the gradient reduction itself.") from e
 
 
-def _tf_graph_allreduce(g, name: Optional[str], average: bool, wire_dtype):
-    """Bridge a symbolic tf.function tensor to the eager engine through
-    tf.py_function — the host-callback analogue of the reference's TF
-    AsyncOpKernel enqueue (tensorflow/mpi_ops.cc:281-303)."""
-    import tensorflow as tf
-
-    def _host(x):
-        arr = x.numpy()
-        if wire_dtype is not None and np.issubdtype(arr.dtype, np.floating):
-            out = _ops.allreduce(arr.astype(wire_dtype), average=average,
-                                 name=name)
-        else:
-            out = _ops.allreduce(arr, average=average, name=name)
-        return np.asarray(out, dtype=arr.dtype)
-
-    out = tf.py_function(_host, [g], Tout=g.dtype)
-    out.set_shape(g.shape)
-    return out
-
-
 def _allreduce_grad(g, name: Optional[str], compression) -> object:
     """Average one backend gradient tensor across ranks, preserving its
-    backend type."""
-    wire = getattr(compression, "wire_dtype", None)
-    # np.dtype resolves jnp.float16 / bfloat16 / float8_* via ml_dtypes,
-    # so every cast-compressor's wire format passes through faithfully.
-    wire_np = np.dtype(wire) if wire is not None else None
+    backend type. Single-tensor convenience over the batch helpers (one
+    copy of every backend branch lives in the *_batch functions)."""
     kb = _backend()
     if kb == "torch":
         from . import _torch_bridge
@@ -148,31 +125,67 @@ def _allreduce_grad(g, name: Optional[str], compression) -> object:
     if kb == "tensorflow":
         import tensorflow as tf
         if not tf.executing_eagerly():
-            return _tf_graph_allreduce(g, name, True, wire_np)
-        arr = g.numpy()
-        out = _engine_allreduce(arr, name, compression)
+            return _tf_graph_allreduce_batch([g], [name], compression)[0]
+        out = _engine_allreduce_batch([g.numpy()], [name], compression)[0]
         return tf.constant(out, dtype=g.dtype)
     if kb == "jax":
         if _is_jax_tracer(g):
             return _jax_inline_allreduce(g)
-        return _engine_allreduce(np.asarray(g), name, compression,
-                                 like=g)
+        import jax.numpy as jnp
+        return jnp.asarray(_engine_allreduce_batch(
+            [np.asarray(g)], [name], compression)[0])
     # numpy / anything array-like
     arr = keras.ops.convert_to_numpy(g)
     return keras.ops.convert_to_tensor(
-        _engine_allreduce(arr, name, compression))
+        _engine_allreduce_batch([arr], [name], compression)[0])
 
 
-def _engine_allreduce(arr: np.ndarray, name: Optional[str], compression,
-                      like=None):
-    wire, ctx = compression.compress(arr) if compression is not None else (
-        arr, None)
-    out = _ops.allreduce(wire, average=True, name=name)
-    if compression is not None:
-        out = compression.decompress(out, ctx)
-    if like is not None:
-        return out  # jax array already
-    return np.asarray(out, dtype=arr.dtype)
+def _engine_allreduce_batch(arrs, names, compression):
+    """ONE engine burst for a list of host arrays: submit every gradient
+    async (the engine fuses the burst into as few XLA collectives as the
+    threshold allows), then wait all handles — the Keras-side counterpart
+    of the TF shim's grouped bridge. Sequential blocking submits would
+    pay one negotiation round-trip per gradient."""
+    comp = compression if compression is not None else Compression.none
+    handles = []
+    for arr, nm in zip(arrs, names):
+        wire, ctx = comp.compress(arr)
+        handles.append((_ops.allreduce_async(wire, average=True, name=nm),
+                        ctx, arr.dtype))
+    outs = []
+    for h, ctx, dt in handles:
+        out = comp.decompress(h.wait(), ctx)
+        outs.append(np.asarray(out, dtype=dt))
+    return outs
+
+
+def _tf_graph_allreduce_batch(gs, names, compression):
+    """One py_function crossing for the whole gradient group inside a
+    traced tf.function (mirrors tensorflow._grouped_bridge)."""
+    import tensorflow as tf
+    wire = getattr(compression, "wire_dtype", None)
+    wire_np = np.dtype(wire) if wire is not None else None
+
+    def host(*xs):
+        handles = []
+        dts = []
+        for x, nm in zip(xs, names):
+            arr = x.numpy()
+            dts.append(arr.dtype)
+            if wire_np is not None and np.issubdtype(arr.dtype,
+                                                     np.floating):
+                arr = arr.astype(wire_np)
+            handles.append(_ops.allreduce_async(arr, average=True,
+                                                name=nm))
+        return [np.asarray(h.wait(), dtype=dt)
+                for h, dt in zip(handles, dts)]
+
+    outs = tf.py_function(host, list(gs), Tout=[g.dtype for g in gs])
+    if len(gs) == 1 and not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    for g, o in zip(gs, outs):
+        o.set_shape(g.shape)
+    return list(outs)
 
 
 # ---------------------------------------------------------------------------
@@ -195,11 +208,55 @@ class _DistributedOptimizer:
             init()
         if _topo.size() > 1:
             prefix = self._hvd_name or f"Distributed{type(self).__name__}"
-            grads = [
-                g if g is None else _allreduce_grad(
-                    g, f"{prefix}.grad.{i}", self._hvd_compression)
-                for i, g in enumerate(grads)]
+            grads = self._hvd_reduce(list(grads), prefix)
         return super(self.__class__, self).apply(grads, trainable_variables)
+
+    def _hvd_reduce(self, grads, prefix):
+        """Average the gradient list across ranks in ONE batched
+        submission where the backend allows it (eager TF / concrete jax
+        / numpy via an engine burst; traced tf.function via a single
+        py_function group); jax tracers stay per-leaf (inline psum —
+        XLA fuses those itself), torch delegates to its bridge."""
+        comp = self._hvd_compression
+        names = [f"{prefix}.grad.{i}" for i in range(len(grads))]
+        idx = [i for i, g in enumerate(grads) if g is not None]
+        if not idx:
+            return grads
+        kb = _backend()
+        out = list(grads)
+        if kb == "tensorflow":
+            import tensorflow as tf
+            if not tf.executing_eagerly():
+                red = _tf_graph_allreduce_batch(
+                    [grads[i] for i in idx], [names[i] for i in idx],
+                    comp)
+                for i, r in zip(idx, red):
+                    out[i] = r
+                return out
+            arrs = [grads[i].numpy() for i in idx]
+            red = _engine_allreduce_batch(arrs,
+                                          [names[i] for i in idx], comp)
+            for i, r in zip(idx, red):
+                out[i] = tf.constant(r, dtype=grads[i].dtype)
+            return out
+        if kb == "jax" and not any(_is_jax_tracer(grads[i]) for i in idx):
+            arrs = [np.asarray(grads[i]) for i in idx]
+            red = _engine_allreduce_batch(arrs,
+                                          [names[i] for i in idx], comp)
+            import jax.numpy as jnp
+            for i, r in zip(idx, red):
+                out[i] = jnp.asarray(r)
+            return out
+        if kb == "numpy":
+            arrs = [keras.ops.convert_to_numpy(grads[i]) for i in idx]
+            red = _engine_allreduce_batch(arrs,
+                                          [names[i] for i in idx], comp)
+            for i, r in zip(idx, red):
+                out[i] = keras.ops.convert_to_tensor(r)
+            return out
+        # torch backend / jax tracers: per-leaf path.
+        return [g if g is None else _allreduce_grad(g, nm, comp)
+                for g, nm in zip(grads, names)]
 
 
 def _make_wrapped_class(cls):

@@ -205,7 +205,9 @@ def _block(params, x, cfg: TransformerConfig, layer_idx: int):
         attn = ring_attention(q, k, v, axis_name=cfg.sp_axis, causal=True)
     elif use_flash:
         from ..ops.flash_attention import flash_attention
-        attn = flash_attention(q, k, v, True, None, 128, 128, flash_interp)
+        # block sizes None -> tuned defaults (512 compiled / 128 interp)
+        attn = flash_attention(q, k, v, True, None, None, None,
+                               flash_interp)
     else:
         attn = full_attention(q, k, v, causal=True)
     attn = attn.reshape(b, s, h_local * hd)

@@ -81,7 +81,8 @@ def ulysses_attention(q, k, v, *, axis_name: str = "sp",
     # Local attention over the full sequence — no comm inside softmax.
     if use_flash:
         from ..ops.flash_attention import flash_attention
-        out = flash_attention(q, k, v, causal, scale, 128, 128,
+        # block sizes None -> tuned defaults (512 compiled / 128 interp)
+        out = flash_attention(q, k, v, causal, scale, None, None,
                               flash_interpret)
     else:
         out = full_attention(q, k, v, causal=causal, scale=scale)
