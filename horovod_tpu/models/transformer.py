@@ -37,13 +37,18 @@ from ..parallel.expert import moe_apply, moe_init
 class TransformerConfig:
     vocab: int = 32000
     d_model: int = 512
-    # TPU sizing: pick n_heads so head_dim = d_model / n_heads == 128 —
-    # the MXU is 128 lanes wide, and every attention matmul contracts
-    # over head_dim, so head_dim 64 runs the systolic array half empty.
-    # Measured (v5e, 12 layers, d_model 768, seq 8192): 12 heads (d=64)
-    # 8.1k tok/s vs 6 heads (d=128) 16.9k tok/s — 2.1x from this knob
-    # alone.
-    n_heads: int = 4
+    # TPU sizing: when n_heads is None it is derived as
+    # max(1, d_model // 128) so head_dim == 128 — the MXU is 128 lanes
+    # wide, and every attention matmul contracts over head_dim, so
+    # head_dim 64 runs the systolic array half empty. Measured (v5e, 12
+    # layers, d_model 768, seq 8192): 12 heads (d=64) 8.1k tok/s vs 6
+    # heads (d=128) 16.9k tok/s — 2.1x from this knob alone.
+    # CHANGELOG: before round 3 the default was a fixed head count (8 in
+    # round 1, 4 in round 2). QKV projection shapes are d_model x d_model
+    # either way, so old checkpoints LOAD cleanly but compute different
+    # attention under a different head count — pass n_heads explicitly
+    # when restoring a checkpoint trained under an old default.
+    n_heads: Optional[int] = None
     n_layers: int = 4
     d_ff: int = 2048
     max_seq: int = 2048
@@ -71,6 +76,14 @@ class TransformerConfig:
     remat: bool = True
 
     def __post_init__(self):
+        if self.n_heads is None:
+            # Largest head count that DIVIDES d_model with head_dim >=
+            # 128 (a blind d_model // 128 can fail the divisibility
+            # check, e.g. d_model=448 -> 3).
+            n = max(1, self.d_model // 128)
+            while self.d_model % n:
+                n -= 1
+            object.__setattr__(self, "n_heads", n)
         if self.num_experts and not self.ep_axis:
             raise ValueError(
                 "num_experts > 0 requires ep_axis (the expert-parallel mesh "

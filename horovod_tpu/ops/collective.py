@@ -483,14 +483,15 @@ class CollectiveEngine:
         tuned SCALAR knobs (SyncParams, parameter_manager.cc:213-246) —
         cycle time paces this engine's announce cadence; program-affecting
         flags arrive per group instead (SPMD lockstep)."""
-        for line in resp.stall:
+        for name, line in resp.stall:
             _log.warning("stalled tensor (coordinator report): %s", line)
             # Keep the authoritative missing-ranks line per tensor so the
             # engine's own stall warning can name the missing processes
-            # (CheckForStalledTensors, operations.cc:1644-1668). Stamped
-            # so stale lines (tensor completed, name reused later) are
-            # never reported and the cache cannot grow unboundedly.
-            name = line.split(" [", 1)[0].strip()
+            # (CheckForStalledTensors, operations.cc:1644-1668). The name
+            # arrives as structured data in the (name, line) pair — never
+            # parsed out of the display text. Stamped so stale lines
+            # (tensor completed, name reused later) are never reported
+            # and the cache cannot grow unboundedly.
             if name:
                 self._coord_stall_lines[name] = (line, time.monotonic())
         params = resp.params

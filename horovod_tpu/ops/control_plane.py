@@ -56,6 +56,12 @@ _log = get_logger("control_plane")
 # steps. Must match controller.cc's Controller::plan_debounce_s.
 PLAN_DEBOUNCE_S = 0.002
 
+# Bounded-defer valve (native: Controller::kMaxDeferFactor): under
+# continuously overlapping announce bursts the quiet window never opens,
+# so plan unconditionally once the oldest ready tensor has waited this
+# many debounce windows — mirroring the client-side kDrainMaxDeferNs cap.
+PLAN_MAX_DEFER_FACTOR = 10.0
+
 CONTROL_ENV = "HOROVOD_TPU_CONTROL"
 
 # Wire op enums shared with the engine (executor.ALLREDUCE etc.).
@@ -107,7 +113,7 @@ class FetchResponse:
     def __init__(self, groups: List[dict], shutdown: bool,
                  payload: Optional[bytes] = None,
                  params: Optional[dict] = None,
-                 stall: Optional[List[str]] = None):
+                 stall: Optional[List[Tuple[str, str]]] = None):
         self.groups = groups      # [{seq, op, names, error, flags,
         #                            sizes: {name: [dim0 per process]}}]
         self.shutdown = shutdown
@@ -118,8 +124,9 @@ class FetchResponse:
         # parameter_manager.cc:213-246): fusion_threshold, cycle_time_ms,
         # flags, autotune_active, autotune_done.
         self.params = params or {}
-        # Coordinator stall-report lines (missing-ranks diagnostics,
-        # operations.cc:1625-1672), logged by every process.
+        # Coordinator stall report as (tensor_name, display_line) pairs
+        # (missing-ranks diagnostics, operations.cc:1625-1672), logged by
+        # every process; keyed by name so no one re-parses display text.
         self.stall = stall or []
 
 
@@ -184,6 +191,9 @@ class CoordinatorService(BasicService):
         # Wall time of the last announce — the quiescence-planner clock
         # (_maybe_plan_locked).
         self._last_announce_t = time.monotonic()
+        # When the oldest currently-ready tensor became ready — the
+        # bounded-defer clock (PLAN_MAX_DEFER_FACTOR).
+        self._oldest_ready_t: Optional[float] = None
         # Stall reporting (CheckForStalledTensors, operations.cc:1625-1672):
         # the coordinator alone knows WHICH ranks are missing per tensor.
         # Window from env (HOROVOD_TPU_STALL_CHECK_DISABLE honored), the
@@ -308,6 +318,8 @@ class CoordinatorService(BasicService):
                 # becomes an error group (operations.cc:321-395) rather
                 # than a divergent program.
                 if len(e.ranks) == self._nproc:
+                    if not self._ready and self._oldest_ready_t is None:
+                        self._oldest_ready_t = time.monotonic()
                     self._ready.append((r["name"], e))
                     del self._table[r["name"]]
             # No planning here: groups are cut by _maybe_plan_locked once
@@ -326,19 +338,26 @@ class CoordinatorService(BasicService):
         PLAN_DEBOUNCE_S — i.e. every rank's cycle-chunked announces of one
         burst have landed, so the group composition is the full burst,
         deterministic across steps."""
-        if (self._ready and not self._table
-                and time.monotonic() - self._last_announce_t
-                >= PLAN_DEBOUNCE_S):
+        if not self._ready:
+            return
+        now = time.monotonic()
+        quiet = (not self._table
+                 and now - self._last_announce_t >= PLAN_DEBOUNCE_S)
+        overdue = (self._oldest_ready_t is not None
+                   and now - self._oldest_ready_t
+                   >= PLAN_DEBOUNCE_S * PLAN_MAX_DEFER_FACTOR)
+        if quiet or overdue:
             self._plan_locked()
 
-    def check_stalls(self) -> List[str]:
+    def check_stalls(self) -> List[Tuple[str, str]]:
         """Warn about tensors announced by only a subset of ranks past the
         stall window, naming the missing ranks — the reference
-        coordinator's report (operations.cc:1644-1668). Returns the
-        warning lines (also logged, and shipped to every worker through
-        the fetch response) for tests/monitoring."""
+        coordinator's report (operations.cc:1644-1668). Returns
+        (tensor_name, display_line) pairs (also logged, and shipped to
+        every worker through the fetch response) so consumers key on the
+        structured name instead of re-parsing the display text."""
         now = time.monotonic()
-        lines: List[str] = []
+        lines: List[Tuple[str, str]] = []
         with self._mu:
             if (self.stall_warning_s <= 0
                     or now - self._last_stall_check < self.stall_warning_s):
@@ -351,8 +370,9 @@ class CoordinatorService(BasicService):
                     if now - e.first_seen > self.stall_warning_s:
                         missing = sorted(set(range(self._nproc)) - e.ranks)
                         lines.append(
-                            f"{name} [missing ranks: "
-                            f"{', '.join(map(str, missing))}]")
+                            (name,
+                             f"{name} [missing ranks: "
+                             f"{', '.join(map(str, missing))}]"))
         if lines:
             _log.warning(
                 "One or more tensors were submitted to be reduced, "
@@ -362,7 +382,8 @@ class CoordinatorService(BasicService):
                 "trying to submit different tensors or that only subset "
                 "of ranks is submitting tensors, which will cause "
                 "deadlock.\nStalled ops:\n%s",
-                int(self.stall_warning_s), "\n".join(lines))
+                int(self.stall_warning_s),
+                "\n".join(line for _, line in lines))
         return lines
 
     def _fetch(self, req: FetchRequest) -> FetchResponse:
@@ -489,6 +510,7 @@ class CoordinatorService(BasicService):
         error groups."""
         remaining = self._ready
         self._ready = []
+        self._oldest_ready_t = None
         while remaining:
             name, e = remaining.pop(0)
             err = self._validate(name, e)

@@ -210,23 +210,32 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_sc[:].astype(dq_ref.dtype)
 
 
-def _default_block(block, interpret: bool) -> int:
+def _default_block(block, interpret: bool, head_dim: int = 128) -> int:
     """Default tile size. Compiled Mosaic kernels want LARGE blocks —
-    measured on v5e at S=8192 the fwd+bwd step is 2.0x faster at 512
-    than at 128 (fewer grid iterations re-streaming K/V from HBM);
-    1024 exceeds the scoped VMEM budget and fails to compile. The
-    interpreter keeps 128 so CPU tests stay fast. Blocks are clamped to
-    the sequence length either way."""
+    measured on v5e at S=8192 with head_dim 128 the fwd+bwd step is 2.0x
+    faster at 512 than at 128 (fewer grid iterations re-streaming K/V
+    from HBM); 1024 exceeds the scoped VMEM budget and fails to compile.
+    The VMEM footprint scales with block*head_dim, so the compiled
+    default keeps block*head_dim ~= 512*128: smaller blocks for larger
+    head dims (256 at d=256) and larger for smaller ones (up to 1024 at
+    d<=64), rounded DOWN to a multiple of 128 for the TPU lane/sublane
+    tiling and floored at 128 (so a huge head_dim still gets a legal —
+    if over-budget — block; pass explicit sizes there). The interpreter
+    keeps 128 so CPU tests stay fast. Blocks are clamped to the sequence
+    length either way."""
     if block is not None:
         return block
-    return 128 if interpret else 512
+    if interpret:
+        return 128
+    b = 512 * 128 // max(head_dim, 1)
+    return max(128, min(1024, b // 128 * 128))
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     bh, s, d = q.shape
     sk = k.shape[1]
-    block_q = min(_default_block(block_q, interpret), s)
-    block_k = min(_default_block(block_k, interpret), sk)
+    block_q = min(_default_block(block_q, interpret, d), s)
+    block_k = min(_default_block(block_k, interpret, d), sk)
     n_q = pl.cdiv(s, block_q)
     n_k = pl.cdiv(sk, block_k)
 
@@ -311,8 +320,8 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
     ob, gb = _to_bh(out), _to_bh(g)
     bh = qb.shape[0]
     sk = kb.shape[1]
-    bq = min(_default_block(block_q, interpret), s)
-    bk = min(_default_block(block_k, interpret), sk)
+    bq = min(_default_block(block_q, interpret, d), s)
+    bk = min(_default_block(block_k, interpret, d), sk)
     n_q = pl.cdiv(s, bq)
     n_k = pl.cdiv(sk, bk)
 

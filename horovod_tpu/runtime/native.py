@@ -464,14 +464,24 @@ class NativeController:
                 "autotune_active": bool(active.value),
                 "autotune_done": bool(done.value)}
 
-    def stalled(self) -> List[str]:
+    def stalled(self) -> List[tuple]:
+        """(tensor_name, display_line) pairs — the native wire is one
+        "name\\tdisplay" line per stalled tensor (coordinator.cc
+        StalledTensors), split here so consumers never parse display
+        text."""
         cap = 1 << 16
         while True:
             buf = (ctypes.c_uint8 * cap)()
             n = int(self._lib.hvdtpu_ctl_stalled(self._h, buf, cap))
             if n >= 0:
                 text = bytes(buf[:n]).decode()
-                return text.split("\n") if text else []
+                if not text:
+                    return []
+                out = []
+                for raw in text.split("\n"):
+                    name, _, line = raw.partition("\t")
+                    out.append((name, line or raw))
+                return out
             cap = -n
 
 

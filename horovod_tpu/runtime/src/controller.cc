@@ -66,10 +66,20 @@ struct Controller {
   // recompile per step instead of a cache hit.
   std::deque<Response> pending;
   Clock::time_point last_announce = Clock::now();
+  // When the oldest currently-pending response became ready. Bounds how
+  // long quiescence-deferral can starve fully-announced work: under
+  // continuously overlapping announce bursts (async submission, pipelined
+  // steps) last_announce keeps refreshing and the quiet window never
+  // opens, so maybe_plan cuts unconditionally once the oldest pending
+  // response has waited kMaxDeferFactor debounce windows — mirroring the
+  // client-side kDrainMaxDeferNs escape hatch in core.cc.
+  Clock::time_point oldest_pending = Clock::now();
+  bool has_pending_ts = false;
   // Quiet window before cutting groups; must match the Python fallback
   // service (ops/control_plane.py PLAN_DEBOUNCE_S) so both planners see
   // the same stream shape.
   double plan_debounce_s = 0.002;
+  static constexpr double kMaxDeferFactor = 10.0;
 
   // Ordered group log. Serialized lazily at fetch; kept as objects so the
   // stall report and tests can inspect them. Pruned once every rank acked.
@@ -106,6 +116,7 @@ void PlanLocked(Controller& c) {
   if (c.pending.empty()) return;
   std::deque<Response> ready;
   ready.swap(c.pending);
+  c.has_pending_ts = false;
   auto plans = FuseResponses(std::move(ready), c.sizes_bytes, c.dtypes,
                              c.fusion_threshold);
   int32_t flags = CurrentFlags(c);
@@ -175,6 +186,10 @@ int64_t hvdtpu_ctl_announce(void* h, const uint8_t* data, int64_t len) {
     c->dtypes[name] = req.tensor_type;
     if (c->table.Increment(req, c->nproc)) {
       auto reqs = c->table.Take(name);
+      if (c->pending.empty() && !c->has_pending_ts) {
+        c->oldest_pending = Clock::now();
+        c->has_pending_ts = true;
+      }
       c->pending.push_back(
           ConstructResponse(reqs, c->nproc, c->virtual_size));
     }
@@ -190,10 +205,18 @@ int64_t hvdtpu_ctl_announce(void* h, const uint8_t* data, int64_t len) {
 int64_t hvdtpu_ctl_maybe_plan(void* h) {
   auto* c = static_cast<Controller*>(h);
   std::lock_guard<std::mutex> lk(c->mu);
-  if (!c->pending.empty() && c->table.size() == 0 &&
-      std::chrono::duration<double>(Clock::now() - c->last_announce)
-              .count() >= c->plan_debounce_s)
-    PlanLocked(*c);
+  auto now = Clock::now();
+  bool quiet =
+      c->table.size() == 0 &&
+      std::chrono::duration<double>(now - c->last_announce).count() >=
+          c->plan_debounce_s;
+  // Bounded valve: never let continuous announce traffic defer ready
+  // work past kMaxDeferFactor debounce windows.
+  bool overdue =
+      c->has_pending_ts &&
+      std::chrono::duration<double>(now - c->oldest_pending).count() >=
+          c->plan_debounce_s * Controller::kMaxDeferFactor;
+  if (!c->pending.empty() && (quiet || overdue)) PlanLocked(*c);
   return c->base_seq + static_cast<int64_t>(c->groups.size());
 }
 

@@ -40,7 +40,10 @@ std::vector<std::string> MessageTable::StalledTensors(
       if (r.request_rank >= 0 && r.request_rank < size)
         seen[r.request_rank] = true;
     std::ostringstream os;
-    os << kv.first << " [ready ranks:";
+    // "<name>\t<display line>": the tab-separated name prefix is the
+    // STRUCTURED key consumers (native.py stalled()) split on, so the
+    // engine's missing-ranks merge never re-parses the display text.
+    os << kv.first << "\t" << kv.first << " [ready ranks:";
     for (int i = 0; i < size; ++i)
       if (seen[i]) os << " " << i;
     os << "; missing ranks:";

@@ -102,6 +102,20 @@ def _grouped_bridge(submit_async, tensors):
     return list(outs)
 
 
+def _wire_tf_dtype(compression):
+    """tf.DType the compression transmits on the wire, or None for
+    pass-through. Honors ``compression.wire_dtype`` (fp16/bf16/fp8) the
+    way keras._tf_graph_allreduce_batch does, instead of assuming fp16.
+    A custom compressor that is not Compression.none but declares no
+    wire_dtype keeps the historical fp16 wire."""
+    wire = getattr(compression, "wire_dtype", None)
+    if wire is None:
+        if compression is not Compression.none:
+            return tf.float16
+        return None
+    return tf.as_dtype(np.dtype(wire))
+
+
 _name_counter = [0]
 
 
@@ -135,9 +149,9 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None,
     def _op(x):
         wire = x
         ctx = None
-        if compression is not Compression.none:
-            warr = tf.cast(x, tf.float16) if x.dtype.is_floating else x
-            wire, ctx = warr, x.dtype
+        wire_dt = _wire_tf_dtype(compression)
+        if wire_dt is not None and x.dtype.is_floating:
+            wire, ctx = tf.cast(x, wire_dt), x.dtype
 
         def host(v):
             return _hvd_allreduce_host(v, average, nm)
@@ -181,9 +195,10 @@ def grouped_allreduce(tensors, average: bool = True,
     def _op(*xs):
         wires = []
         ctxs = []
+        wire_dt = _wire_tf_dtype(compression)
         for x in xs:
-            if compression is not Compression.none and x.dtype.is_floating:
-                wires.append(tf.cast(x, tf.float16))
+            if wire_dt is not None and x.dtype.is_floating:
+                wires.append(tf.cast(x, wire_dt))
                 ctxs.append(x.dtype)
             else:
                 wires.append(x)

@@ -189,8 +189,10 @@ class TestStallDetection:
             svc._last_stall_check = 0.0
             lines = svc.check_stalls()
             assert len(lines) == 2
-            assert "stuck.a" in lines[0] and "missing ranks" in lines[0]
-            assert "1" in lines[0].split("missing ranks")[1]
+            name0, line0 = lines[0]
+            assert name0 == "stuck.a"
+            assert "stuck.a" in line0 and "missing ranks" in line0
+            assert "1" in line0.split("missing ranks")[1]
         finally:
             svc.shutdown()
 
@@ -199,3 +201,41 @@ class TestStallDetection:
         c0.announce([_req("fresh")])
         svc.stall_warning_s = 60.0
         assert svc.check_stalls() == []
+
+
+class TestBoundedPlanDefer:
+    @pytest.mark.parametrize("native", [True, False],
+                             ids=["native", "python"])
+    def test_continuous_announces_cannot_starve_ready_work(self, native):
+        """ADVICE r2: a fully-announced tensor must be planned even when
+        the announce stream NEVER goes quiet (overlapping bursts from
+        async submission keep refreshing last_announce). The bounded
+        valve (PLAN_MAX_DEFER_FACTOR debounce windows, mirroring the
+        client-side kDrainMaxDeferNs cap) fires regardless of quiet."""
+        import time
+
+        svc = CoordinatorService(nproc=2, key=make_secret_key(),
+                                 fusion_threshold=1024, native=native)
+        try:
+            assert svc.native_active is native
+            c0, c1 = _client(svc, 0), _client(svc, 1)
+            c0.announce([_req("ready")])
+            c1.announce([_req("ready")])
+            # Noise: rank 0 announces a new PARTIAL tensor every ~1ms so
+            # the 2ms quiet window never opens.
+            got = []
+            deadline = time.monotonic() + 2.0
+            i = 0
+            while time.monotonic() < deadline:
+                c0.announce([_req(f"noise.{i}")])
+                i += 1
+                groups = c0.fetch(wait_s=0.003).groups
+                if groups:
+                    got = groups
+                    break
+            assert got, "ready tensor starved by continuous announces"
+            assert got[0]["names"] == ["ready"]
+            elapsed = 2.0 - (deadline - time.monotonic())
+            assert elapsed < 1.0, f"valve fired too late: {elapsed:.3f}s"
+        finally:
+            svc.shutdown()
