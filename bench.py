@@ -185,6 +185,11 @@ def run_chip_bench():
         img_secs.append(batch * NUM_BATCHES_PER_ITER / dt)
 
     per_chip = float(np.mean(img_secs)) / n
+    # Mean ± 1.96σ over the iteration windows — the reference's reported
+    # uncertainty (tensorflow_synthetic_benchmark.py:88-107). Throughput
+    # on a shared/tunneled chip drifts run to run; the CI makes
+    # round-over-round deltas interpretable.
+    ci95 = float(1.96 * np.std(img_secs)) / n
     peak = peak_tflops(jax.devices()[0])
     # MFU on the same basis as the reported rate: sustained FLOP/s =
     # (reported img/sec/chip) x (FLOPs per image), so the two headline
@@ -199,6 +204,9 @@ def run_chip_bench():
         "value": round(per_chip, 2),
         "unit": "img/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_IMG_SEC_PER_CHIP, 3),
+        "ci95": round(ci95, 2),
+        "iters": NUM_ITERS,
+        "batches_per_iter": NUM_BATCHES_PER_ITER,
         "mfu": round(mfu, 4),
         "tflops_per_chip": round(tflops, 1),
         "peak_tflops": peak,
@@ -255,14 +263,31 @@ def _scaling_worker():
         params = optax.apply_updates(params, updates)
         return params, bs, opt_state
 
-    # Warmup (compile both programs + prime the engine).
-    params, bs, opt_state = step(params, bs, opt_state, "w")
-    t0 = time.perf_counter()
-    for i in range(steps):
-        params, bs, opt_state = step(params, bs, opt_state, i)
+    # Warmup (compile both programs + prime the engine). THREE steps, not
+    # one: the first step's outputs are committed engine/device arrays
+    # while the init pytree is uncommitted, so jit sees a different
+    # argument signature for ~2 steps before the executable set reaches
+    # its fixpoint — a single warmup left a full recompile (measured
+    # ~7 s on the CPU mesh) inside the timed window.
+    for w in range(3):
+        params, bs, opt_state = step(params, bs, opt_state, f"w{w}")
     jax.block_until_ready(params)
-    dt = time.perf_counter() - t0
-    return batch_per * steps * n / dt  # global img/sec
+    # Two timed windows of `steps` each, median window throughput
+    # (reference method: mean over iteration windows,
+    # tensorflow_synthetic_benchmark.py:94-101). Windows, not per-step
+    # sync: blocking every step would forbid the step pipelining real
+    # training has; the median across windows still rejects a
+    # descheduling stall on the shared CI host.
+    import numpy as _np
+    rates = []
+    for w in range(2):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            params, bs, opt_state = step(params, bs, opt_state,
+                                         f"{w}.{i}")
+        jax.block_until_ready(params)
+        rates.append(batch_per * steps * n / (time.perf_counter() - t0))
+    return float(_np.median(rates))  # global img/sec
 
 
 def run_weak_scaling(sizes):
@@ -290,19 +315,33 @@ def run_weak_scaling(sizes):
         # Efficiency is defined against thr(1); measure it rather than
         # fabricating a perfect-scaling baseline from the smallest N.
         sizes = [1] + list(sizes)
-    results = {}
-    for n in sizes:
-        out = hvd_run(_scaling_worker, np=n, extra_env=dict(env),
-                      start_timeout=600)
-        results[n] = float(np.median(out))
-    base = results[1]
+    # Efficiency is a RATIO of two jobs, and absolute throughput on a
+    # shared host drifts between runs minutes apart — measuring all of
+    # thr(1) and then all of thr(N) bakes that drift into every ratio.
+    # So rounds INTERLEAVE the sizes ([1, N1, N2, ..] per round), each
+    # round's ratios use ITS OWN thr(1), and the reported number is the
+    # median ratio across rounds (the in-process A/B discipline; the
+    # reference's mean-over-iterations, synthetic_benchmark.py:94-101,
+    # assumes a dedicated machine this host is not).
+    repeats = int(os.environ.get("HVD_BENCH_SCALE_REPEATS", 3))
+    rounds = []
+    for _ in range(max(1, repeats)):
+        rnd = {}
+        for n in sizes:
+            out = hvd_run(_scaling_worker, np=n, extra_env=dict(env),
+                          start_timeout=600)
+            rnd[n] = float(np.median(out))
+        rounds.append(rnd)
     table = {}
     for n in sizes:
-        eff = results[n] / (n * base) if base else 0.0
-        cap = results[n] / (min(n, cores) * base) if base else 0.0
-        table[str(n)] = {"img_sec": round(results[n], 1),
-                         "efficiency": round(eff, 3),
-                         "capacity_adjusted": round(cap, 3)}
+        effs = [r[n] / (n * r[1]) for r in rounds if r[1]]
+        caps = [r[n] / (min(n, cores) * r[1]) for r in rounds if r[1]]
+        table[str(n)] = {
+            "img_sec": round(float(np.median([r[n] for r in rounds])), 1),
+            "efficiency": round(float(np.median(effs)), 3),
+            "capacity_adjusted": round(float(np.median(caps)), 3),
+            "capacity_adjusted_runs": [round(c, 3) for c in caps],
+        }
     table["_host_cores"] = cores
     return table
 

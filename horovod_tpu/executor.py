@@ -161,6 +161,8 @@ class CollectiveExecutor:
         self.hierarchical_allreduce = hierarchical_allreduce
         self.hierarchical_allgather = hierarchical_allgather
         self._cache = {}
+        self._shm_checked = False
+        self._shm_transport = None
 
     @property
     def mesh(self) -> Mesh:
@@ -438,6 +440,33 @@ class CollectiveExecutor:
     # requirement the reference meets with its MPI_Bcast'd response list,
     # operations.cc:2282-2287).
 
+    def _shm(self):
+        """Shared-memory data plane for same-host jobs (ops/shm_transport
+        — the reference's MPI shared-memory CPU path), or None. Gated on
+        the launcher's placement verdict (HOROVOD_TPU_ALL_LOCAL) or the
+        explicit HOROVOD_TPU_SHM knob; every process of a job sees the
+        same launcher env, so the fleet gates identically."""
+        if not self._shm_checked:
+            self._shm_checked = True
+            from .utils import env as _env
+            if _env.shm_data_plane() and jax.process_count() > 1:
+                # The shm reduction scales the process-sum by ONE local
+                # device count and maps virtual root ranks by division,
+                # both valid only for homogeneous placements (equal
+                # devices per process) — the same init-time invariant
+                # the reference asserts (operations.cc:1772-1790).
+                homogeneous = (jax.local_device_count() * jax.process_count()
+                               == jax.device_count())
+                try:
+                    homogeneous = homogeneous and _topo._get().is_homogeneous
+                except Exception:
+                    pass
+                if homogeneous:
+                    from .ops import shm_transport
+                    self._shm_transport = shm_transport.get(
+                        jax.process_index(), jax.process_count())
+        return self._shm_transport
+
     def _mp_stacked(self, x, mesh: Optional[Mesh] = None,
                     axes=("dp",)) -> jax.Array:
         """Global [size, ...] array with the leading axis sharded over
@@ -478,6 +507,27 @@ class CollectiveExecutor:
         axes = ("dcn", "ici") if hier else ("dp",)
         ici = int(mesh.shape["ici"]) if hier else 1
 
+        shm = None if hier else self._shm()
+        if shm is not None:
+            # Same-host fast path: reduce the host-staged fusion buffer
+            # through /dev/shm instead of a socket ring. Every VIRTUAL
+            # rank contributes its process's copy, so the process-sum is
+            # scaled by the (homogeneous) local device count.
+            local = max(1, self.world_size // jax.process_count())
+
+            def host_op(buf):
+                if prescale != 1.0:
+                    buf = buf * prescale
+                out = shm.allreduce(buf)
+                scale = float(local) * postscale
+                if scale != 1.0:
+                    out = out * scale
+                return out
+
+            return self._run_fused_buffers(
+                tensors, None, key_fn=None, mesh=mesh, axes=axes,
+                host_op=host_op)
+
         def reduce_buf(buf):
             if not hier:
                 return jax.lax.psum(buf, "dp")
@@ -507,7 +557,8 @@ class CollectiveExecutor:
                                        hier, id(mesh)),
             mesh=mesh, axes=axes)
 
-    def _run_fused_buffers(self, tensors, build, key_fn, mesh, axes):
+    def _run_fused_buffers(self, tensors, build, key_fn, mesh, axes,
+                           host_op=None):
         """Shared host-assembled fusion-buffer scaffolding for the MP
         collectives (the reference's memcpy into the fusion buffer,
         operations.cc:1221-1243): group by accumulation dtype (one
@@ -515,7 +566,12 @@ class CollectiveExecutor:
         operations.cc:2149-2265), pack into a size-QUANTIZED flat buffer
         so the compiled program is keyed by padded size instead of group
         composition, run ``build(padded, dtype_str)``'s program, and
-        unpack device-side (no D2H round trip of the payload)."""
+        unpack device-side (no D2H round trip of the payload).
+
+        ``host_op(buf) -> np.ndarray`` replaces the XLA program with a
+        host-side reduction over the packed buffer (the shared-memory
+        data plane); pack and unpack stay in numpy — no device round
+        trip at all."""
         arrs = [np.asarray(t) for t in tensors]
         by_dtype: Dict = {}
         for i, a in enumerate(arrs):
@@ -532,6 +588,17 @@ class CollectiveExecutor:
                 flat = arrs[i].ravel()
                 buf[off:off + flat.size] = flat.astype(buf_dt)
                 off += flat.size
+
+            if host_op is not None:
+                out = np.asarray(host_op(buf))
+                off = 0
+                for i in idxs:
+                    a = arrs[i]
+                    piece = out[off:off + a.size]
+                    results[i] = piece.reshape(a.shape).astype(
+                        a.dtype, copy=False)
+                    off += a.size
+                continue
 
             key = key_fn(padded, str(buf_dt))
             prog = self._program(
@@ -555,6 +622,15 @@ class CollectiveExecutor:
         buffer size, not one per group composition.
         """
         mesh = self.mesh
+        shm = self._shm()
+        if shm is not None:
+            # Root VIRTUAL rank maps to its owning process (homogeneous
+            # local device counts, checked at init).
+            local = max(1, self.world_size // jax.process_count())
+            root_proc = int(root_rank) // local
+            return self._run_fused_buffers(
+                tensors, None, key_fn=None, mesh=mesh, axes=("dp",),
+                host_op=lambda buf: shm.broadcast(buf, root_proc))
 
         def build(padded, buf_dt):
             def fused(x):

@@ -148,10 +148,12 @@ def _engine_allreduce_batch(arrs, names, compression):
     pay one negotiation round-trip per gradient."""
     comp = compression if compression is not None else Compression.none
     handles = []
-    for arr, nm in zip(arrs, names):
-        wire, ctx = comp.compress(arr)
-        handles.append((_ops.allreduce_async(wire, average=True, name=nm),
-                        ctx, arr.dtype))
+    with _ops.engine().burst():
+        for arr, nm in zip(arrs, names):
+            wire, ctx = comp.compress(arr)
+            handles.append((_ops.allreduce_async(wire, average=True,
+                                                 name=nm),
+                            ctx, arr.dtype))
     outs = []
     for h, ctx, dt in handles:
         out = comp.decompress(h.wait(), ctx)
@@ -169,14 +171,15 @@ def _tf_graph_allreduce_batch(gs, names, compression):
     def host(*xs):
         handles = []
         dts = []
-        for x, nm in zip(xs, names):
-            arr = x.numpy()
-            dts.append(arr.dtype)
-            if wire_np is not None and np.issubdtype(arr.dtype,
-                                                     np.floating):
-                arr = arr.astype(wire_np)
-            handles.append(_ops.allreduce_async(arr, average=True,
-                                                name=nm))
+        with _ops.engine().burst():
+            for x, nm in zip(xs, names):
+                arr = x.numpy()
+                dts.append(arr.dtype)
+                if wire_np is not None and np.issubdtype(arr.dtype,
+                                                         np.floating):
+                    arr = arr.astype(wire_np)
+                handles.append(_ops.allreduce_async(arr, average=True,
+                                                    name=nm))
         return [np.asarray(h.wait(), dtype=dt)
                 for h, dt in zip(handles, dts)]
 

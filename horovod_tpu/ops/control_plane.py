@@ -84,7 +84,8 @@ class AnnounceRequest:
     has already processed instead."""
 
     def __init__(self, rank: int, requests: List[dict], shutdown: bool = False,
-                 announce_id: int = 0, payload: Optional[bytes] = None):
+                 announce_id: int = 0, payload: Optional[bytes] = None,
+                 complete: bool = False):
         self.rank = rank
         self.requests = requests  # {name, op, dtype, shape, root_rank, nbytes}
         self.shutdown = shutdown
@@ -92,6 +93,26 @@ class AnnounceRequest:
         # Native-engine processes announce pre-serialized RequestList bytes
         # (message.cc codec) instead of dicts; `requests` is then empty.
         self.payload = payload
+        # True when this announce carries a COMPLETE enqueue burst (the
+        # worker drained after debounce-quiet or a flush hint, not via the
+        # max-defer valve): once every rank's complete announce has landed
+        # and no tensor is partial, the coordinator plans IMMEDIATELY —
+        # the quiet window exists only to guard against mid-burst
+        # chunking, which the marker rules out.
+        self.complete = complete
+
+
+class AnnounceFetchRequest:
+    """Combined announce + long-poll fetch — ONE control-plane round trip
+    per worker cycle instead of two. The reference pays one MPI_Gatherv +
+    one MPI_Bcast per cycle (operations.cc:2088-2287); over TCP each leg
+    is a full RPC, and on a busy host the second round trip is pure added
+    step latency, so the worker ships both legs in one request."""
+
+    def __init__(self, announce: Optional[AnnounceRequest],
+                 fetch: FetchRequest):
+        self.announce = announce  # None for fetch-only cycles
+        self.fetch = fetch
 
 
 class AnnounceResponse:
@@ -132,13 +153,17 @@ class FetchResponse:
 
 class _Entry:
     __slots__ = ("op_by_rank", "dtype_by_rank", "shape_by_rank",
-                 "root_by_rank", "nbytes", "ranks", "order", "first_seen")
+                 "root_by_rank", "device_by_rank", "nbytes", "ranks",
+                 "order", "first_seen")
 
     def __init__(self, order: int):
         self.op_by_rank: Dict[int, int] = {}
         self.dtype_by_rank: Dict[int, str] = {}
         self.shape_by_rank: Dict[int, Tuple[int, ...]] = {}
         self.root_by_rank: Dict[int, int] = {}
+        # Execution-semantics fingerprint per rank (the wire's device
+        # slot — collective._semantics_fingerprint).
+        self.device_by_rank: Dict[int, int] = {}
         self.nbytes = 0
         self.ranks = set()
         self.order = order
@@ -251,6 +276,10 @@ class CoordinatorService(BasicService):
     # ------------------------------------------------------------- protocol
 
     def _handle(self, req, client_address):
+        if isinstance(req, AnnounceFetchRequest):
+            if req.announce is not None:
+                self._announce(req.announce)
+            return self._fetch(req.fetch)
         if isinstance(req, AnnounceRequest):
             return self._announce(req)
         if isinstance(req, FetchRequest):
@@ -281,6 +310,11 @@ class CoordinatorService(BasicService):
                     payload = _wire.encode_request_list(req.rank,
                                                         req.requests)
                 self._ctl.announce(payload)
+                if req.complete:
+                    # Burst-complete announce: plan NOW if no tensor is
+                    # left partial — the last completing rank cuts the
+                    # groups, skipping the quiet window entirely.
+                    self._ctl.plan_ready()
                 self._last_announce_t = time.monotonic()
                 self._cv.notify_all()  # waiters recheck group_count
                 return AnnounceResponse()
@@ -305,6 +339,7 @@ class CoordinatorService(BasicService):
                 e.dtype_by_rank[req.rank] = str(r["dtype"])
                 e.shape_by_rank[req.rank] = tuple(r["shape"])
                 e.root_by_rank[req.rank] = int(r.get("root_rank", -1))
+                e.device_by_rank[req.rank] = int(r.get("device", -1))
                 # Payload bytes from shape × dtype, exactly as the native
                 # planner derives them from the wire Request — both
                 # planners must fuse identically.
@@ -322,12 +357,16 @@ class CoordinatorService(BasicService):
                         self._oldest_ready_t = time.monotonic()
                     self._ready.append((r["name"], e))
                     del self._table[r["name"]]
-            # No planning here: groups are cut by _maybe_plan_locked once
-            # the announce stream is quiescent (mirrors the native
+            # Plan ONLY on a burst-complete announce with no partial
+            # tensor left (the last completing rank cuts the groups);
+            # otherwise groups are cut by _maybe_plan_locked once the
+            # announce stream is quiescent (mirrors the native
             # controller). Cutting groups at announce-chunk boundaries
             # would make group composition timing-dependent, and every
             # distinct composition is a distinct fused XLA program — a
             # recompile per step instead of a cache hit.
+            if req.complete and not self._table and self._ready:
+                self._plan_locked()
             self._last_announce_t = time.monotonic()
             self._cv.notify_all()
         return AnnounceResponse()
@@ -501,6 +540,16 @@ class CoordinatorService(BasicService):
                 return (f"Mismatched root ranks: One rank specified root "
                         f"rank {roots[0]}, but another rank specified "
                         f"root rank {roots[1]}.")
+        # Execution-semantics fingerprint (the wire device slot — the
+        # reference's device-consistency role, operations.cc:480-497):
+        # ranks passing different average/prescale/postscale/sharded
+        # would execute DIFFERENT programs for one agreed group.
+        devs = set(e.device_by_rank.values())
+        if len(devs) > 1:
+            return (f"Mismatched execution attributes for tensor {name}: "
+                    "ranks passed different average/prescale/postscale/"
+                    "sharded arguments (fingerprints "
+                    f"{sorted(devs)}).")
         return ""
 
     def _plan_locked(self):
@@ -533,6 +582,7 @@ class CoordinatorService(BasicService):
                 if (e2.op == e.op and e2.dtype == e.dtype
                         and not self._validate(name2, e2)
                         and e2.root_by_rank == e.root_by_rank
+                        and e2.device_by_rank == e.device_by_rank
                         and total + e2.nbytes <= self.fusion_threshold):
                     group_names.append(name2)
                     total += e2.nbytes
@@ -577,23 +627,45 @@ class CoordinatorClient:
         self.last_seq = 0
         self._announce_seq = 0
 
-    def announce(self, requests: List[dict]) -> None:
+    def announce(self, requests: List[dict],
+                 complete: bool = False) -> None:
         self._announce_seq += 1
         self._client.request(AnnounceRequest(self._rank, requests,
-                                             announce_id=self._announce_seq))
+                                             announce_id=self._announce_seq,
+                                             complete=complete))
 
-    def announce_bytes(self, payload: bytes) -> None:
+    def announce_bytes(self, payload: bytes,
+                       complete: bool = False) -> None:
         """Announce a pre-serialized RequestList (message.cc codec) — the
         native engine's path: the bytes the C++ core serialized travel
         verbatim to the controller's C++ parser."""
         self._announce_seq += 1
         self._client.request(AnnounceRequest(
             self._rank, [], announce_id=self._announce_seq,
-            payload=payload))
+            payload=payload, complete=complete))
 
     def fetch(self, wait_s: float = 0.0) -> FetchResponse:
         resp = self._client.request(
             FetchRequest(self._rank, self.last_seq, wait_s))
+        if resp.groups:
+            self.last_seq = resp.groups[-1]["seq"] + 1
+        return resp
+
+    def announce_fetch(self, requests: Optional[List[dict]] = None,
+                       payload: Optional[bytes] = None,
+                       complete: bool = False,
+                       wait_s: float = 0.0) -> FetchResponse:
+        """Both cycle legs in ONE round trip (AnnounceFetchRequest):
+        announce newly-ready requests (dicts or pre-serialized bytes),
+        then long-poll the agreed group sequence."""
+        ann = None
+        if requests or payload is not None:
+            self._announce_seq += 1
+            ann = AnnounceRequest(self._rank, requests or [],
+                                  announce_id=self._announce_seq,
+                                  payload=payload, complete=complete)
+        resp = self._client.request(AnnounceFetchRequest(
+            ann, FetchRequest(self._rank, self.last_seq, wait_s)))
         if resp.groups:
             self.last_seq = resp.groups[-1]["seq"] + 1
         return resp

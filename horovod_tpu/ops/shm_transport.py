@@ -1,0 +1,305 @@
+"""Shared-memory data plane for same-host eager collectives.
+
+The reference reduces CPU tensors through MPI, which uses a shared-memory
+BTL for ranks on one host (the path behind HOROVOD_CPU_OPERATIONS and the
+hierarchical local stage, operations.cc:1284-1436) — same-host gradient
+bytes never touch a socket. The TPU-native eager engine stages its fused
+buffer host-side (executor._run_fused_buffers), so the analogous fast
+path is direct shared memory: every process maps the same /dev/shm
+segments, writes its buffer, reduces its 1/N slice in place, and reads
+the peers' reduced slices — ~4 memcpy passes over the buffer in total,
+against a TCP-loopback ring's 2(N-1) socket stages (measured on the
+8-process CPU mesh: a 33 MB fused allreduce drops from ~1.45 s through
+the gloo ring to the memcpy cost).
+
+Used only when every process of the job is on ONE host (the launcher is
+the placement authority and exports HOROVOD_TPU_ALL_LOCAL); multi-host
+jobs keep the XLA collective data plane. All processes of a job must
+gate identically (the launcher env guarantees it) or the fleet would
+split between two data planes and deadlock.
+
+Synchronization is flag-based: a per-(bucket, rank) sequence number is
+written AFTER the payload; peers spin (sched_yield) until the flag
+reaches the expected sequence. Engines execute coordinator-agreed groups
+in one global order, so per-bucket sequence counters advance identically
+on every process. x86-TSO store ordering makes the flag-after-payload
+protocol safe without explicit fences; the spin deadline turns a dead
+peer into a loud HorovodInternalError instead of a silent hang.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import mmap
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..utils.logging import get_logger
+
+_log = get_logger("shm")
+
+_HEADER_BYTES = 16  # [in_seq int64][out_seq int64]
+_SPIN_DEADLINE_S = 120.0
+_DIR = "/dev/shm"
+
+
+class ShmTimeout(RuntimeError):
+    pass
+
+
+def job_tag() -> Optional[str]:
+    """Job-unique segment namespace from the launch secret (unique per
+    launch, shared by all ranks) — stale segments of a crashed previous
+    job can never alias a live one. Returns None when no launch secret
+    exists: without a shared per-run nonce, two runs would share a tag
+    and a peer could map a crashed run's stale segment whose sequence
+    flags are already past the expected value — silently reducing dead
+    bytes. No secret -> no shm plane (the XLA path takes over)."""
+    from ..runner.secret import SECRET_ENV
+    secret = os.environ.get(SECRET_ENV, "")
+    if not secret:
+        return None
+    return hashlib.sha256(secret.encode()).hexdigest()[:12]
+
+
+class _Segment:
+    """One mapped /dev/shm file: header + input area + output area.
+
+    Plain mmap on a /dev/shm file instead of multiprocessing.shared_memory
+    — the stdlib's resource tracker unlinks attached segments on process
+    exit (it cannot tell owner from peer), which would tear the data plane
+    down under the surviving ranks.
+    """
+
+    def __init__(self, path: str, size: int, create: bool):
+        self.path = path
+        flags = os.O_RDWR | (os.O_CREAT if create else 0)
+        fd = os.open(path, flags, 0o600)
+        try:
+            if create:
+                os.ftruncate(fd, size)
+            self.mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        self.header = np.frombuffer(self.mm, np.int64, count=2)
+        self.size = size
+
+    def body(self, dtype, count: int, offset: int) -> np.ndarray:
+        return np.frombuffer(self.mm, dtype, count=count,
+                             offset=_HEADER_BYTES + offset)
+
+    def close(self, unlink: bool = False) -> None:
+        self.header = None
+        try:
+            self.mm.close()
+        except BufferError:  # pragma: no cover - outstanding views
+            pass
+        if unlink:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+
+def _spin(predicate, what: str) -> None:
+    """Wait for a peer's flag. A few sched_yields for the fast path, then
+    sleep with backoff: on an oversubscribed host a hard spin burns
+    exactly the core the working peer needs (measured: pure sched_yield
+    spinning roughly doubles the 8-process fused-allreduce time)."""
+    deadline = time.monotonic() + _SPIN_DEADLINE_S
+    pause = 0.0002
+    for _ in range(20):
+        if predicate():
+            return
+        os.sched_yield()
+    while not predicate():
+        if time.monotonic() > deadline:
+            raise ShmTimeout(
+                f"shared-memory data plane timed out waiting for {what} "
+                f"after {_SPIN_DEADLINE_S:.0f}s — a peer process died or "
+                "is wedged")
+        time.sleep(pause)
+        pause = min(pause * 1.5, 0.004)
+
+
+class ShmTransport:
+    """Fused-buffer allreduce/broadcast over /dev/shm for one-host jobs.
+
+    Per (bucket=padded byte size) each process owns one segment:
+    ``{dir}/hvdtpu_{tag}_{bucket}_{rank}`` with layout
+    ``[in_seq][out_seq][input bucket bytes][output bucket bytes]``.
+    Reduction is slice-parallel: process r sums slice r over all input
+    areas into its own output area (deterministic rank order — same
+    float-sum order on every process), then reads peers' reduced slices.
+    """
+
+    def __init__(self, rank: int, nproc: int, tag: Optional[str] = None):
+        self.rank = rank
+        self.nproc = nproc
+        self.tag = tag or job_tag()
+        self._own: Dict[int, _Segment] = {}
+        self._peers: Dict[Tuple[int, int], _Segment] = {}
+        self._seq: Dict[int, int] = {}
+        self._closed = False
+
+    # ------------------------------------------------------------- plumbing
+
+    def _path(self, bucket: int, rank: int) -> str:
+        return os.path.join(_DIR, f"hvdtpu_{self.tag}_{bucket}_{rank}")
+
+    def _segment_size(self, bucket: int) -> int:
+        return _HEADER_BYTES + 2 * bucket
+
+    def _own_segment(self, bucket: int) -> _Segment:
+        seg = self._own.get(bucket)
+        if seg is None:
+            path = self._path(bucket, self.rank)
+            try:
+                os.unlink(path)  # stale file from a dead same-tag run
+            except OSError:
+                pass
+            seg = _Segment(path, self._segment_size(bucket), create=True)
+            seg.header[0] = 0
+            seg.header[1] = 0
+            self._own[bucket] = seg
+        return seg
+
+    def _peer_segment(self, bucket: int, rank: int) -> _Segment:
+        if rank == self.rank:
+            return self._own_segment(bucket)
+        seg = self._peers.get((bucket, rank))
+        if seg is None:
+            path = self._path(bucket, rank)
+            size = self._segment_size(bucket)
+
+            def ready():
+                try:
+                    return os.path.getsize(path) >= size
+                except OSError:
+                    return False
+
+            _spin(ready, f"rank {rank}'s segment {path}")
+            seg = _Segment(path, size, create=False)
+            self._peers[(bucket, rank)] = seg
+        return seg
+
+    def _slice(self, n: int, r: int) -> Tuple[int, int]:
+        q = n // self.nproc
+        lo = r * q
+        hi = n if r == self.nproc - 1 else lo + q
+        return lo, hi
+
+    # ------------------------------------------------------------------ ops
+
+    def allreduce(self, buf: np.ndarray) -> np.ndarray:
+        """Sum-allreduce a flat fused buffer across all processes. The
+        buffer size must be identical on every process (the engine's
+        size-quantized fusion buffer guarantees it)."""
+        n = int(buf.size)
+        bucket = int(buf.nbytes)
+        seq = self._seq[bucket] = self._seq.get(bucket, 0) + 1
+        own = self._own_segment(bucket)
+        segs = [self._peer_segment(bucket, r) for r in range(self.nproc)]
+        item = buf.dtype.itemsize
+
+        own.body(buf.dtype, n, 0)[:] = buf.ravel()
+        own.header[0] = seq  # payload visible before the flag (x86 TSO)
+
+        for r, seg in enumerate(segs):
+            if r != self.rank:
+                _spin(lambda s=seg: s.header[0] >= seq,
+                      f"rank {r}'s input (seq {seq})")
+
+        lo, hi = self._slice(n, self.rank)
+        if hi > lo:
+            acc = own.body(buf.dtype, hi - lo, bucket + lo * item)
+            np.copyto(acc, segs[0].body(buf.dtype, hi - lo, lo * item))
+            for seg in segs[1:]:
+                acc += seg.body(buf.dtype, hi - lo, lo * item)
+        own.header[1] = seq
+
+        for r, seg in enumerate(segs):
+            if r != self.rank:
+                _spin(lambda s=seg: s.header[1] >= seq,
+                      f"rank {r}'s reduced slice (seq {seq})")
+
+        out = np.empty((n,), buf.dtype)
+        for r, seg in enumerate(segs):
+            lo, hi = self._slice(n, r)
+            if hi > lo:
+                out[lo:hi] = seg.body(buf.dtype, hi - lo, bucket + lo * item)
+        return out
+
+    def broadcast(self, buf: np.ndarray, root_process: int) -> np.ndarray:
+        """Broadcast the root process's flat buffer to every process."""
+        n = int(buf.size)
+        bucket = int(buf.nbytes)
+        seq = self._seq[bucket] = self._seq.get(bucket, 0) + 1
+        own = self._own_segment(bucket)
+        root = self._peer_segment(bucket, root_process)
+        if root_process == self.rank:
+            own.body(buf.dtype, n, 0)[:] = buf.ravel()
+            own.header[0] = seq
+            # Wait for every reader's ack (out_seq) before the next use of
+            # this bucket may overwrite the payload.
+            for r in range(self.nproc):
+                if r != self.rank:
+                    _spin(lambda s=self._peer_segment(bucket, r):
+                          s.header[1] >= seq, f"rank {r}'s bcast ack")
+            own.header[1] = seq
+            return np.array(buf.ravel(), copy=True)
+        _spin(lambda: root.header[0] >= seq,
+              f"root {root_process}'s bcast payload (seq {seq})")
+        out = np.array(root.body(buf.dtype, n, 0), copy=True)
+        own.header[1] = seq  # ack
+        return out
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for seg in self._peers.values():
+            seg.close(unlink=False)
+        for seg in self._own.values():
+            seg.close(unlink=True)
+        self._peers.clear()
+        self._own.clear()
+
+
+_transport: Optional[ShmTransport] = None
+_failed = False
+
+
+def get(rank: int, nproc: int) -> Optional[ShmTransport]:
+    """Process-wide transport, or None when unavailable (non-Linux, no
+    /dev/shm). Callers gate on the ALL_LOCAL/SHM env before asking."""
+    global _transport, _failed
+    if _failed:
+        return None
+    if _transport is None:
+        try:
+            if not os.path.isdir(_DIR):
+                raise OSError(f"{_DIR} not present")
+            tag = job_tag()
+            if tag is None:
+                raise OSError(
+                    "no launch secret for a job-unique segment namespace")
+            _transport = ShmTransport(rank, nproc, tag=tag)
+        except Exception as e:  # pragma: no cover - platform fallback
+            _failed = True
+            _log.warning("shared-memory data plane unavailable (%s); "
+                         "using XLA collectives", e)
+            return None
+    return _transport
+
+
+def reset() -> None:
+    """Test hook / engine shutdown: drop the transport and its segments."""
+    global _transport, _failed
+    if _transport is not None:
+        _transport.close()
+    _transport = None
+    _failed = False

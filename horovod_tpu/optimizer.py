@@ -86,11 +86,16 @@ def allreduce_gradients(grads, *, average: bool = True,
     eng = _coll.engine()
     sfx = eng._next_name(name_prefix)
     handles = []
-    for nm, leaf in zip(names, leaves):
-        c, ctx = compression.compress(jnp.asarray(leaf))
-        h = _coll.allreduce_async(c, average=average,
-                                  name=f"{name_prefix}{nm}.{sfx}")
-        handles.append((h, ctx))
+    # Explicit burst: the whole gradient set fuses as ONE deterministic
+    # group — without the scope, an enqueuer descheduled mid-loop on a
+    # busy host splits the burst into a timing-dependent composition,
+    # recompiling the fused XLA program every step.
+    with eng.burst():
+        for nm, leaf in zip(names, leaves):
+            c, ctx = compression.compress(jnp.asarray(leaf))
+            h = _coll.allreduce_async(c, average=average,
+                                      name=f"{name_prefix}{nm}.{sfx}")
+            handles.append((h, ctx))
     out = [compression.decompress(h.wait(), ctx) for h, ctx in handles]
     return jax.tree_util.tree_unflatten(treedef, out)
 
@@ -195,9 +200,10 @@ def broadcast_parameters(params, root_rank: int = 0):
     eng = _coll.engine()
     sfx = eng._next_name("bcastp")
     handles = []
-    for nm, leaf in zip(names, leaves):
-        handles.append(_coll.broadcast_async(
-            jnp.asarray(leaf), root_rank, name=f"param{nm}.{sfx}"))
+    with eng.burst():
+        for nm, leaf in zip(names, leaves):
+            handles.append(_coll.broadcast_async(
+                jnp.asarray(leaf), root_rank, name=f"param{nm}.{sfx}"))
     out = [h.wait() for h in handles]
     return jax.tree_util.tree_unflatten(treedef, out)
 
@@ -213,15 +219,16 @@ def broadcast_optimizer_state(opt_state, root_rank: int = 0):
     sfx = eng._next_name("bcasts")
     handles = []
     metas = []
-    for nm, leaf in zip(names, leaves):
-        if isinstance(leaf, (int, float, bool, np.number)):
-            arr = jnp.asarray(leaf)
-            metas.append(type(leaf))
-        else:
-            arr = jnp.asarray(leaf)
-            metas.append(None)
-        handles.append(_coll.broadcast_async(
-            arr, root_rank, name=f"state{nm}.{sfx}"))
+    with eng.burst():
+        for nm, leaf in zip(names, leaves):
+            if isinstance(leaf, (int, float, bool, np.number)):
+                arr = jnp.asarray(leaf)
+                metas.append(type(leaf))
+            else:
+                arr = jnp.asarray(leaf)
+                metas.append(None)
+            handles.append(_coll.broadcast_async(
+                arr, root_rank, name=f"state{nm}.{sfx}"))
     out = []
     for h, meta in zip(handles, metas):
         val = h.wait()

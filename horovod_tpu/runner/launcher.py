@@ -282,6 +282,10 @@ def launch(command: List[str], np: int, hosts: Optional[str] = None,
         env["HOROVOD_TPU_COORDINATOR"] = f"{coord_host}:{coord_port}"
         env["HOROVOD_TPU_NUM_PROCESSES"] = str(np)
         env["HOROVOD_TPU_PROCESS_ID"] = str(rank)
+        # Single-host jobs may use the shared-memory data plane for eager
+        # host-staged collectives (the reference's MPI shared-memory CPU
+        # path); the launcher is the authority on placement.
+        env["HOROVOD_TPU_ALL_LOCAL"] = "0" if any_remote else "1"
         env["HOROVOD_TPU_CONTROL"] = f"{coord_host}:{ctrl_port}"
         env[SECRET_ENV] = secret
         local_rank = local_counts.get(host, 0)

@@ -42,11 +42,13 @@ EXECUTE_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_int32,
                               ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
                               ctypes.c_char_p)
 
-# Multi-process transport bridge: (user, req_bytes, req_len, nreq, pending,
-# resp_buf, resp_cap) -> resp_len (see core.cc TransportCallback).
+# Multi-process transport bridge: (user, req_bytes, req_len, nreq,
+# complete, pending, resp_buf, resp_cap) -> resp_len (see core.cc
+# TransportCallback). `complete` marks the batch a complete enqueue burst
+# (eager-plannable by the coordinator).
 TRANSPORT_CB = ctypes.CFUNCTYPE(
     ctypes.c_int64, ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
-    ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
+    ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
     ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64)
 
 # Group delivery: (user, op, handles, count, nnames, sizes, nsizes, flags,
@@ -109,6 +111,12 @@ class NativeCore:
         lib.hvdtpu_ctl_tick.argtypes = [ctypes.c_void_p]
         lib.hvdtpu_ctl_plan.argtypes = [ctypes.c_void_p]
         lib.hvdtpu_ctl_plan.restype = ctypes.c_int64
+        lib.hvdtpu_ctl_plan_ready.argtypes = [ctypes.c_void_p]
+        lib.hvdtpu_ctl_plan_ready.restype = ctypes.c_int64
+        lib.hvdtpu_flush.restype = None
+        lib.hvdtpu_burst_begin.restype = None
+        lib.hvdtpu_burst_end.restype = None
+        lib.hvdtpu_current_flags.restype = ctypes.c_int32
         lib.hvdtpu_ctl_maybe_plan.argtypes = [ctypes.c_void_p]
         lib.hvdtpu_ctl_maybe_plan.restype = ctypes.c_int64
         lib.hvdtpu_ctl_params.argtypes = [
@@ -186,12 +194,14 @@ class NativeCore:
         self._lib.hvdtpu_set_execute_callback(trampoline, None)
 
     def set_transport_callback(
-            self, fn: Callable[[bytes, int, int], Optional[bytes]]) -> None:
-        """``fn(request_list_bytes, nreq, pending) -> response_list_bytes``
-        — the MP cycle's announce+fetch leg, called from the native
-        background thread. ``nreq == 0`` means the batch was already
-        announced (retry after a short response buffer); return b"" (or
-        None) for "nothing to deliver"."""
+            self, fn: Callable[[bytes, int, int, int],
+                               Optional[bytes]]) -> None:
+        """``fn(request_list_bytes, nreq, complete, pending) ->
+        response_list_bytes`` — the MP cycle's announce+fetch leg, called
+        from the native background thread. ``nreq == 0`` means the batch
+        was already announced (retry after a short response buffer);
+        ``complete`` marks the batch a complete enqueue burst; return
+        b"" (or None) for "nothing to deliver"."""
 
         # Overflow cache: when a fetched ResponseList exceeds the native
         # cycle's buffer, the payload must survive until the C++ retry —
@@ -201,8 +211,8 @@ class NativeCore:
         state = {"pending": None}
 
         @TRANSPORT_CB
-        def trampoline(_user, req_ptr, req_len, nreq, pending, resp_buf,
-                       resp_cap):
+        def trampoline(_user, req_ptr, req_len, nreq, complete, pending,
+                       resp_buf, resp_cap):
             try:
                 if state["pending"] is not None:
                     resp = state["pending"]
@@ -210,7 +220,7 @@ class NativeCore:
                 else:
                     data = (ctypes.string_at(req_ptr, req_len)
                             if req_len > 0 else b"")
-                    resp = fn(data, int(nreq), int(pending))
+                    resp = fn(data, int(nreq), int(complete), int(pending))
                 if not resp:
                     return 0
                 if len(resp) > resp_cap:
@@ -271,6 +281,22 @@ class NativeCore:
             op, name.encode(), enum, arr, len(shape), root_rank, device,
             nbytes))
 
+    def flush(self) -> None:
+        """Declare the current enqueue burst complete (a submitter is
+        about to block on a handle): the background cycle drains and
+        announces it immediately instead of waiting out the drain
+        debounce."""
+        self._lib.hvdtpu_flush()
+
+    def burst_begin(self) -> None:
+        """Open an explicit burst scope: the cycle defers draining until
+        the matching burst_end (bounded by the max-defer valve), so the
+        whole submission fuses as ONE deterministic group."""
+        self._lib.hvdtpu_burst_begin()
+
+    def burst_end(self) -> None:
+        self._lib.hvdtpu_burst_end()
+
     def complete(self, handles: Sequence[int], status: int = 0,
                  reason: str = "") -> None:
         arr = (ctypes.c_int64 * max(len(handles), 1))(*handles)
@@ -314,6 +340,12 @@ class NativeCore:
 
     def autotune_active(self) -> bool:
         return bool(self._lib.hvdtpu_autotune_active())
+
+    def current_flags(self) -> int:
+        """Single-process tuner's execution-mode flags (Response::Flags
+        bits) — applied by the execute callback so a tuned hierarchical
+        mode actually switches the executor's path."""
+        return int(self._lib.hvdtpu_current_flags())
 
     def autotune_done(self) -> bool:
         """True once the tuner converged and froze to its best point
@@ -449,6 +481,12 @@ class NativeController:
         been quiet for the debounce window and no tensor is partial.
         Returns the total group count."""
         return int(self._lib.hvdtpu_ctl_maybe_plan(self._h))
+
+    def plan_ready(self) -> int:
+        """Eager planner for burst-complete announces: plan iff no
+        tensor is partially announced (no quiet-window wait). Returns
+        the total group count."""
+        return int(self._lib.hvdtpu_ctl_plan_ready(self._h))
 
     def params(self) -> dict:
         fusion = ctypes.c_int64()

@@ -103,10 +103,11 @@ struct Controller {
 
 int32_t CurrentFlags(Controller& c) {
   int32_t f = 0;
-  bool hier_ar = c.env_hier_allreduce ||
-                 (c.pm.IsAutoTuning() && c.pm.HierarchicalAllreduce());
-  if (hier_ar) f |= Response::HIERARCHICAL_ALLREDUCE;
-  if (c.env_hier_allgather) f |= Response::HIERARCHICAL_ALLGATHER;
+  bool tuning = c.pm.IsAutoTuning();
+  if (c.env_hier_allreduce || (tuning && c.pm.HierarchicalAllreduce()))
+    f |= Response::HIERARCHICAL_ALLREDUCE;
+  if (c.env_hier_allgather || (tuning && c.pm.HierarchicalAllgather()))
+    f |= Response::HIERARCHICAL_ALLGATHER;
   return f;
 }
 
@@ -217,6 +218,19 @@ int64_t hvdtpu_ctl_maybe_plan(void* h) {
       std::chrono::duration<double>(now - c->oldest_pending).count() >=
           c->plan_debounce_s * Controller::kMaxDeferFactor;
   if (!c->pending.empty() && (quiet || overdue)) PlanLocked(*c);
+  return c->base_seq + static_cast<int64_t>(c->groups.size());
+}
+
+// Eager planner for burst-complete announces: when a worker declares its
+// announce a COMPLETE burst and no tensor is left partially announced,
+// every rank's burst has landed in full — the group composition is
+// already the whole burst, so cut it NOW instead of waiting out the
+// quiet window (the window exists only to guard against mid-burst
+// chunking, which a complete marker rules out). Returns the group count.
+int64_t hvdtpu_ctl_plan_ready(void* h) {
+  auto* c = static_cast<Controller*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  if (!c->pending.empty() && c->table.size() == 0) PlanLocked(*c);
   return c->base_seq + static_cast<int64_t>(c->groups.size());
 }
 

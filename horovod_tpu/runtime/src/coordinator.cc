@@ -188,8 +188,22 @@ Response ConstructResponse(const std::vector<Request>& requests, int size,
     }
   }
 
-  // Device consistency (operations.cc:480-497) — all ranks must be on the
-  // same kind of device; record per-rank devices in rank order.
+  // Device consistency (operations.cc:480-497). On the TPU path the
+  // device slot carries an execution-semantics fingerprint
+  // (collective._semantics_fingerprint: average/prescale/postscale/
+  // sharded) — ranks disagreeing would execute DIFFERENT programs for
+  // one agreed group, so a mismatch is an error verdict, not a silent
+  // local subdivision.
+  for (const auto& r : requests) {
+    if (r.device != first.device) {
+      std::ostringstream os;
+      os << "Mismatched execution attributes for tensor " << name
+         << ": ranks passed different average/prescale/postscale/sharded "
+         << "arguments (fingerprints " << first.device << " vs "
+         << r.device << ").";
+      return ErrorResponse(name, os.str());
+    }
+  }
   std::vector<int32_t> devices(size, CPU_DEVICE_ID);
   for (const auto& r : requests) devices[r.request_rank] = r.device;
 

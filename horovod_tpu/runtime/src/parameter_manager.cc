@@ -15,8 +15,12 @@ std::vector<std::pair<double, double>> KnobBounds() {
 }
 }  // namespace
 
-ParameterManager::ParameterManager()
-    : bo_flat_(KnobBounds(), 0.01, 41), bo_hier_(KnobBounds(), 0.01, 43) {}
+ParameterManager::ParameterManager() {
+  // One BO per (hier_allreduce, hier_allgather) combination, distinct
+  // seeds so exploration differs across categories.
+  for (int c = 0; c < 4; ++c)
+    bo_.emplace_back(KnobBounds(), 0.01, 41 + 2 * c);
+}
 
 void ParameterManager::Initialize(int rank, const std::string& log_path) {
   rank_ = rank;
@@ -37,7 +41,9 @@ void ParameterManager::Initialize(int rank, const std::string& log_path) {
   }
   if (rank == 0 && !log_path.empty()) {
     log_ = std::fopen(log_path.c_str(), "w");
-    if (log_) std::fputs("fusion_mb,cycle_ms,hierarchical,score\n", log_);
+    if (log_)
+      std::fputs("fusion_mb,cycle_ms,hier_allreduce,hier_allgather,score\n",
+                 log_);
   }
 }
 
@@ -47,7 +53,13 @@ int64_t ParameterManager::TensorFusionThresholdBytes() const {
 
 double ParameterManager::CycleTimeMs() const { return cycle_ms_; }
 
-bool ParameterManager::HierarchicalAllreduce() const { return hierarchical_; }
+bool ParameterManager::HierarchicalAllreduce() const {
+  return hier_allreduce_;
+}
+
+bool ParameterManager::HierarchicalAllgather() const {
+  return hier_allgather_;
+}
 
 bool ParameterManager::Update(int64_t bytes, double seconds) {
   if (!active_ || done_) return false;
@@ -79,14 +91,14 @@ bool ParameterManager::Update(int64_t bytes, double seconds) {
 }
 
 void ParameterManager::Tune(double median_score) {
-  // Record the observation for the active category.
+  // Record the observation for the active categorical combination.
   std::vector<double> point = {fusion_mb_, cycle_ms_};
-  (hierarchical_ ? bo_hier_ : bo_flat_).AddSample(point, median_score);
+  bo_[Combo()].AddSample(point, median_score);
   if (median_score > best_score_) {
     best_score_ = median_score;
     best_fusion_mb_ = fusion_mb_;
     best_cycle_ms_ = cycle_ms_;
-    best_hierarchical_ = hierarchical_;
+    best_combo_ = Combo();
   }
 
   if (++steps_ >= kMaxSteps) {
@@ -94,34 +106,40 @@ void ParameterManager::Tune(double median_score) {
     return;
   }
 
-  // Alternate the categorical flag (CategoricalParameter sweep) and ask the
-  // corresponding BO for its next point.
-  category_ = (category_ + 1) % 4;           // explore hierarchical 1 in 4
-  bool next_hier = category_ == 3;
-  auto next = (next_hier ? bo_hier_ : bo_flat_).NextSample();
-  ApplyPoint(next, next_hier);
+  // Sweep both categoricals (the reference's two CategoricalParameter
+  // managers, parameter_manager.cc:41-54): mostly-flat schedule with
+  // each non-flat combination explored once per period.
+  static const int kSchedule[8] = {0, 2, 0, 1, 0, 3, 0, 0};
+  category_ = (category_ + 1) % 8;
+  int next_combo = kSchedule[category_];
+  auto next = bo_[next_combo].NextSample();
+  ApplyPoint(next, next_combo);
   HVD_LOG(DEBUG) << "autotune step " << steps_ << ": fusion_mb=" << fusion_mb_
-                 << " cycle_ms=" << cycle_ms_ << " hier=" << hierarchical_
+                 << " cycle_ms=" << cycle_ms_
+                 << " hier_ar=" << hier_allreduce_
+                 << " hier_ag=" << hier_allgather_
                  << " (median score " << median_score << ")";
 }
 
-void ParameterManager::ApplyPoint(const std::vector<double>& p,
-                                  bool hierarchical) {
+void ParameterManager::ApplyPoint(const std::vector<double>& p, int combo) {
   fusion_mb_ = std::min(64.0, std::max(0.0, p[0]));
   cycle_ms_ = std::min(100.0, std::max(1.0, p[1]));
-  hierarchical_ = hierarchical;
+  hier_allreduce_ = (combo & 2) != 0;
+  hier_allgather_ = (combo & 1) != 0;
 }
 
 void ParameterManager::SetDone() {
   // Freeze to best (parameter_manager.cc:173-209).
   fusion_mb_ = best_fusion_mb_;
   cycle_ms_ = best_cycle_ms_;
-  hierarchical_ = best_hierarchical_;
+  hier_allreduce_ = (best_combo_ & 2) != 0;
+  hier_allgather_ = (best_combo_ & 1) != 0;
   done_ = true;
   if (rank_ == 0) {
     HVD_LOG(INFO) << "autotune converged: fusion_mb=" << fusion_mb_
                   << " cycle_ms=" << cycle_ms_
-                  << " hierarchical=" << hierarchical_
+                  << " hier_allreduce=" << hier_allreduce_
+                  << " hier_allgather=" << hier_allgather_
                   << " score=" << best_score_;
   }
   if (log_) {
@@ -133,8 +151,8 @@ void ParameterManager::SetDone() {
 
 void ParameterManager::LogSample(double score) {
   if (log_) {
-    std::fprintf(log_, "%.3f,%.3f,%d,%.6f\n", fusion_mb_, cycle_ms_,
-                 hierarchical_ ? 1 : 0, score);
+    std::fprintf(log_, "%.3f,%.3f,%d,%d,%.6f\n", fusion_mb_, cycle_ms_,
+                 hier_allreduce_ ? 1 : 0, hier_allgather_ ? 1 : 0, score);
     std::fflush(log_);
   }
 }

@@ -2,9 +2,12 @@
 // horovod/common/parameter_manager.{h,cc} (N5).
 //
 // Tunes the fusion-buffer threshold (MB) and cycle time (ms) jointly with
-// Bayesian optimization, and the hierarchical-allreduce flag categorically,
-// to maximize throughput score = bytes / microsecond — the reference's
-// knobs and score exactly (parameter_manager.cc:28-54, 144-170). Scoring
+// Bayesian optimization, and BOTH hierarchical flags (allreduce AND
+// allgather) categorically, to maximize throughput score = bytes /
+// microsecond — the reference's knobs and score exactly
+// (parameter_manager.cc:41-54, 144-170: CategoricalParameterManagers over
+// {false,true} for hierarchical_allreduce and hierarchical_allgather,
+// BayesianParameter for the scalars). Scoring
 // protocol kept: samples are accumulated over a fixed number of cycles,
 // several warmup samples are discarded, and the median of recent samples
 // drives each tuning step (parameter_manager.h:211-213).
@@ -44,6 +47,7 @@ class ParameterManager {
   int64_t TensorFusionThresholdBytes() const;
   double CycleTimeMs() const;
   bool HierarchicalAllreduce() const;
+  bool HierarchicalAllgather() const;
 
   // Freeze to best-seen values (reference convergence path,
   // parameter_manager.cc:173-209).
@@ -52,8 +56,13 @@ class ParameterManager {
 
  private:
   void Tune(double score);
-  void ApplyPoint(const std::vector<double>& p, bool hierarchical);
+  // `combo` indexes the categorical pair: bit 1 = hierarchical
+  // allreduce, bit 0 = hierarchical allgather.
+  void ApplyPoint(const std::vector<double>& p, int combo);
   void LogSample(double score);
+  int Combo() const {
+    return (hier_allreduce_ ? 2 : 0) | (hier_allgather_ ? 1 : 0);
+  }
 
   bool active_ = false;
   bool done_ = false;
@@ -62,11 +71,12 @@ class ParameterManager {
   // Current / best values.
   double fusion_mb_ = 64.0;   // default operations.cc:1838
   double cycle_ms_ = 5.0;     // default operations.cc:1846
-  bool hierarchical_ = false;
+  bool hier_allreduce_ = false;
+  bool hier_allgather_ = false;
   double best_score_ = -1.0;
   double best_fusion_mb_ = 64.0;
   double best_cycle_ms_ = 5.0;
-  bool best_hierarchical_ = false;
+  int best_combo_ = 0;
 
   // Scoring accumulation (parameter_manager.cc:28-29: 10 cycles/sample,
   // median of 5 samples, 3 warmup discards).
@@ -82,11 +92,11 @@ class ParameterManager {
   int warmups_left_ = kWarmupSamples;
   int steps_ = 0;
 
-  // One BO instance per categorical value of the hierarchical flag, the
-  // reference's CategoricalParameter × BayesianParameter structure.
-  BayesianOptimization bo_flat_;
-  BayesianOptimization bo_hier_;
-  int category_ = 0;  // alternate exploration between categories
+  // One BO instance per (hier_allreduce, hier_allgather) combination,
+  // the reference's CategoricalParameter × BayesianParameter structure
+  // with both categoricals (parameter_manager.cc:41-54).
+  std::vector<BayesianOptimization> bo_;
+  int category_ = 0;  // position in the categorical exploration schedule
 
   std::FILE* log_ = nullptr;
 };

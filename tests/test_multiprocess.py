@@ -146,6 +146,29 @@ class TestMultiProcessCollectives:
         for r in results:
             assert "Mismatched allreduce tensor shapes" in r
 
+    def test_mismatched_average_errors_not_hangs(self):
+        """VERDICT r2 #5 done-condition: two processes passing different
+        ``average`` for one tensor get a Mismatched error, not a hang
+        (the attribute rides the wire's device slot as an
+        execution-semantics fingerprint)."""
+        def fn():
+            import jax.numpy as jnp
+
+            import horovod_tpu as hvd
+            from horovod_tpu.ops import HorovodInternalError
+
+            hvd.init()
+            avg = hvd.rank() == 0
+            try:
+                hvd.allreduce(jnp.ones((4,)), average=avg, name="mp.avgmix")
+                return "no error"
+            except (HorovodInternalError, ValueError) as e:
+                return f"error: {e}"
+
+        results = run(fn, np=2, extra_env=dict(_ENV), start_timeout=300)
+        for r in results:
+            assert "Mismatched execution attributes" in r
+
 
 class TestMultiDevicePerProcess:
     def test_two_procs_two_devices_each(self):

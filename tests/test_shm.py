@@ -1,0 +1,115 @@
+"""Shared-memory data plane unit tests (ops/shm_transport.py) — the
+same-host fast path for eager fused collectives (the reference's MPI
+shared-memory CPU path). Protocol-level tests run the per-rank state
+machines in threads; the cross-process integration runs in
+tests/test_multiprocess.py (the runner exports HOROVOD_TPU_ALL_LOCAL=1,
+so every all-local MP test exercises this plane end to end).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from horovod_tpu.ops.shm_transport import ShmTransport, ShmTimeout
+
+
+def _fleet(n, tag):
+    return [ShmTransport(r, n, tag=tag) for r in range(n)]
+
+
+def _run_all(fns):
+    out = [None] * len(fns)
+    errs = []
+
+    def call(i, fn):
+        try:
+            out[i] = fn()
+        except BaseException as e:  # pragma: no cover - surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=call, args=(i, fn))
+          for i, fn in enumerate(fns)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    if errs:
+        raise errs[0]
+    return out
+
+
+class TestShmTransport:
+    def test_allreduce_sums_across_ranks(self):
+        fleet = _fleet(4, "test-ar")
+        try:
+            bufs = [np.full((1024,), float(r + 1), np.float32)
+                    for r in range(4)]
+            outs = _run_all([lambda t=t, b=b: t.allreduce(b)
+                             for t, b in zip(fleet, bufs)])
+            for o in outs:
+                assert np.allclose(o, 1 + 2 + 3 + 4)
+        finally:
+            for t in fleet:
+                t.close()
+
+    def test_sequence_reuse_same_bucket(self):
+        """Back-to-back ops on one bucket must not read stale payloads."""
+        fleet = _fleet(2, "test-seq")
+        try:
+            for step in range(5):
+                bufs = [np.full((257,), float(step * 10 + r), np.float64)
+                        for r in range(2)]
+                outs = _run_all([lambda t=t, b=b: t.allreduce(b)
+                                 for t, b in zip(fleet, bufs)])
+                expect = (step * 10) + (step * 10 + 1)
+                for o in outs:
+                    assert np.allclose(o, expect), (step, o[:3])
+        finally:
+            for t in fleet:
+                t.close()
+
+    def test_distinct_buckets_coexist(self):
+        fleet = _fleet(2, "test-bkt")
+        try:
+            for n in (64, 4096, 64):  # revisit the first bucket
+                bufs = [np.full((n,), 1.0, np.float32) for _ in range(2)]
+                outs = _run_all([lambda t=t, b=b: t.allreduce(b)
+                                 for t, b in zip(fleet, bufs)])
+                for o in outs:
+                    assert o.shape == (n,) and np.allclose(o, 2.0)
+        finally:
+            for t in fleet:
+                t.close()
+
+    def test_broadcast_from_root(self):
+        fleet = _fleet(3, "test-bc")
+        try:
+            payload = np.arange(100, dtype=np.float32)
+            bufs = [payload if r == 1 else np.zeros((100,), np.float32)
+                    for r in range(3)]
+            outs = _run_all([lambda t=t, b=b: t.broadcast(b, 1)
+                             for t, b in zip(fleet, bufs)])
+            for o in outs:
+                assert np.array_equal(o, payload)
+        finally:
+            for t in fleet:
+                t.close()
+
+    def test_dead_peer_times_out_loudly(self, monkeypatch):
+        from horovod_tpu.ops import shm_transport as st
+        monkeypatch.setattr(st, "_SPIN_DEADLINE_S", 0.2)
+        t0 = ShmTransport(0, 2, tag="test-dead")
+        try:
+            with pytest.raises(ShmTimeout):
+                t0.allreduce(np.ones((16,), np.float32))
+        finally:
+            t0.close()
+
+    def test_close_unlinks_own_segments(self):
+        import glob
+        t0 = ShmTransport(0, 1, tag="test-clean")
+        t0.allreduce(np.ones((16,), np.float32))
+        assert glob.glob("/dev/shm/hvdtpu_test-clean_*")
+        t0.close()
+        assert not glob.glob("/dev/shm/hvdtpu_test-clean_*")
