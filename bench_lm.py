@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Transformer-LM single-chip benchmark — tok/s + MFU for the flagship
+GPT-style model (111M params: 12 layers, d_model 768, vocab 32000),
+fwd+bwd+AdamW per step.
+
+Method: K steps per jitted fori_loop (host dispatch off the timed path),
+host-readback sync (block_until_ready is unreliable through device
+tunnels), per-config median over R timed windows, and all configs run
+INTERLEAVED in ONE process — absolute throughput on a shared chip
+drifts +-30% between runs, so only in-process A/B is trustworthy.
+
+MFU uses the MODEL-FLOPs convention (6·N·T + attention FLOPs), NOT
+XLA cost_analysis: with rematerialization the executed-FLOP count
+includes recomputation, which would inflate "utilization" for doing
+redundant work. Peak bf16 from the device kind (bench.py table).
+
+Usage:
+    python bench_lm.py                 # default config sweep, one JSON line
+    python bench_lm.py --configs base,tuned
+"""
+
+import argparse
+import json
+import time
+from functools import partial
+
+import numpy as np
+
+K = 5            # steps per jitted loop
+WINDOWS = 3      # timed windows per config (median)
+
+# (name, dict of TransformerConfig overrides + batch). The cumulative
+# tuning ladder measured on v5e (docs/benchmarks.md LM section and
+# BENCH_LM.json): 31.4k -> 126.4k tok/s (12.4% -> 50.1% model MFU) in
+# one interleaved run. Dead ends kept out: remat (full or dots policy)
+# at batch 16/32 always lost to batch-8 no-remat, and batch>=16
+# without flash OOMs (the XLA attention score tensors + fp32 logits
+# exceed the 15.75G HBM).
+CONFIGS = {
+    # Round-2 recorded configuration (the 17.5%-model-MFU baseline).
+    # Every pre-flash ladder row pins use_flash=False: the auto-select
+    # now turns flash on from seq 1024, which would smuggle the flash
+    # step into earlier rows and make the ladder non-cumulative.
+    "base": dict(n_heads=12, batch=8, remat=True, use_flash=False),
+    # head_dim 128 (MXU-filling contraction).
+    "heads128": dict(n_heads=6, batch=8, remat=True, use_flash=False),
+    # + no recompute (activations fit HBM at seq 2048).
+    "noremat": dict(n_heads=6, batch=8, remat=False, use_flash=False),
+    # + bf16 logits matmul (softmax stays fp32).
+    "bf16logits": dict(n_heads=6, batch=8, remat=False,
+                       logits_bf16=True, use_flash=False),
+    # + chunked cross-entropy: the fp32 [B,S,V] never materializes.
+    # use_flash pinned OFF so this row isolates the loss change (the
+    # auto-select would otherwise already turn flash on at seq 2048).
+    "chunked": dict(n_heads=6, batch=8, remat=False,
+                    logits_bf16=True, loss_chunk=512, use_flash=False),
+    # + Pallas flash attention (the 512-block kernel crossover is ~1k).
+    "flash": dict(n_heads=6, batch=8, remat=False,
+                  logits_bf16=True, loss_chunk=512, use_flash=True),
+    # + batch 16 (fits once flash kills the score tensor): the winner.
+    "tuned": dict(n_heads=6, batch=16, remat=False,
+                  logits_bf16=True, loss_chunk=512, use_flash=True),
+    # In-process A/B control: the winner minus flash.
+    "tuned_xla_attn": dict(n_heads=6, batch=8, remat=False,
+                           logits_bf16=True, loss_chunk=512,
+                           use_flash=False),
+}
+
+
+def model_flops_per_step(n_params, batch, seq, n_layers, d_model):
+    """6·N·T parameter FLOPs + causal attention FLOPs (fwd is
+    2·B·S²·d per layer for QK^T+AV halved by causality; bwd doubles)."""
+    tokens = batch * seq
+    param_f = 6.0 * n_params * tokens
+    attn_fwd = n_layers * 2.0 * batch * seq * seq * d_model / 2.0 * 2.0
+    return param_f + 3.0 * attn_fwd
+
+
+def bench_config(name, overrides, seq, peak):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.models import transformer as tfm
+
+    batch = overrides.pop("batch")
+    cfg = tfm.TransformerConfig(
+        vocab=32000, d_model=768, n_layers=12, d_ff=3072, max_seq=seq,
+        dtype=jnp.bfloat16, **overrides)
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(params))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
+                                32000)
+    targets = jnp.roll(tokens, -1, axis=1)
+    opt = optax.adamw(3e-4)
+    state = opt.init(params)
+
+    def loss_fn(p):
+        return tfm.loss_fn(p, tokens, targets, cfg)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_k(p, s):
+        def body(_, carry):
+            p, s = carry
+            _, g = jax.value_and_grad(loss_fn)(p)
+            up, s = opt.update(g, s, p)
+            return optax.apply_updates(p, up), s
+        return jax.lax.fori_loop(0, K, body, (p, s))
+
+    params, state = train_k(params, state)  # compile + warm
+    float(jnp.sum(params["ln_f"]))          # force sync (tunnel-safe)
+    rates = []
+    for _ in range(WINDOWS):
+        t0 = time.perf_counter()
+        params, state = train_k(params, state)
+        float(jnp.sum(params["ln_f"]))
+        dt = time.perf_counter() - t0
+        rates.append(batch * seq * K / dt)
+    tok_s = float(np.median(rates))
+    flops = model_flops_per_step(n_params, batch, seq, cfg.n_layers,
+                                 cfg.d_model)
+    tf_s = tok_s / (batch * seq) * flops / 1e12
+    return {"tok_s": round(tok_s, 0), "tflops": round(tf_s, 1),
+            "mfu": round(tf_s / peak, 4) if peak else 0.0,
+            "params_m": round(n_params / 1e6, 1), "batch": batch,
+            "heads": cfg.n_heads, "remat": cfg.remat}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", default=",".join(CONFIGS))
+    ap.add_argument("--seq", type=int, default=2048)
+    args = ap.parse_args()
+
+    import jax
+    from bench import peak_tflops
+    peak = peak_tflops(jax.devices()[0])
+
+    results = {}
+    for name in args.configs.split(","):
+        results[name] = bench_config(name, dict(CONFIGS[name]), args.seq,
+                                     peak)
+        print(f"# {name}: {results[name]}", flush=True)
+    best = max(results, key=lambda n: results[n]["tok_s"])
+    print(json.dumps({
+        "metric": "transformer_lm_tok_s",
+        "value": results[best]["tok_s"],
+        "unit": "tok/s",
+        "vs_baseline": results[best]["mfu"],
+        "seq": args.seq, "best_config": best, "peak_tflops": peak,
+        "configs": results,
+    }))
+
+
+if __name__ == "__main__":
+    main()

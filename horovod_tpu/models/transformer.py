@@ -62,10 +62,13 @@ class TransformerConfig:
     sp_impl: str = "ring"
     # single-shard attention via the Pallas flash kernel
     # (ops/flash_attention.py) instead of XLA full attention. None (the
-    # default) auto-selects by sequence length: measured on v5e, XLA wins
-    # at 2k (32.6k vs 20.5k tok/s full step, 125M params) and flash wins
-    # 8.1x at 8k (8.8k vs 1.1k tok/s) — crossover ~4k, where the [S, S]
-    # score matrix stops fitting on chip.
+    # default) auto-selects by sequence length: with the 512-block
+    # kernel, measured on v5e (111M LM, full train step, in-process
+    # A/B, BENCH_LM.json): flash wins ~1.5x at 2048 (126.4k vs 82.1k
+    # tok/s) and 1.14x at 1024; XLA edges it at 512 (90.8k vs 86.3k)
+    # — crossover ~1k.
+    # (The round-2 128-block kernel crossed at ~4k; the block tuning
+    # moved it.)
     use_flash: Optional[bool] = None
     # MoE: when set, every other block's MLP is a top-1 MoE
     num_experts: int = 0
@@ -74,6 +77,28 @@ class TransformerConfig:
     # long sequences / big models); when activations fit HBM, turning it
     # off is worth ~1.3x (measured v5e, seq 8192: 16.9k -> 21.5k tok/s).
     remat: bool = True
+    # Checkpoint policy when remat is on: "full" recomputes everything;
+    # "dots" saves matmul outputs and recomputes only elementwise ops
+    # (jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims) — the
+    # standard middle ground that buys most of no-remat's speed at a
+    # fraction of its memory.
+    remat_policy: str = "full"
+    # Compute the vocab-projection matmul in the activation dtype (bf16)
+    # instead of fp32, casting to fp32 only for the softmax. The [d,V]
+    # contraction is the single largest matmul in the model and fp32
+    # runs the MXU at a fraction of its bf16 rate; loss numerics keep an
+    # fp32 softmax either way. Off by default (bit-compatibility with
+    # checkpointed logits).
+    logits_bf16: bool = False
+    # Chunked cross-entropy: compute the vocab projection + log-softmax
+    # over sequence chunks of this many tokens (0 = whole sequence).
+    # The fp32 [B, S, V] logits tensor is the largest allocation of an
+    # LM step (batch 32, seq 2048, vocab 32000: 8.4 GB — more than the
+    # model); chunking with per-chunk rematerialization caps it at
+    # [B, chunk, V] and unlocks batch sizes the monolithic loss cannot
+    # fit. Applies to loss_fn (training); apply() still returns full
+    # logits for inference callers.
+    loss_chunk: int = 0
 
     def __post_init__(self):
         if self.n_heads is None:
@@ -92,6 +117,13 @@ class TransformerConfig:
             raise ValueError(
                 f"sp_impl must be 'ring' or 'ulysses', got "
                 f"{self.sp_impl!r}")
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"remat_policy must be 'full' or 'dots', got "
+                f"{self.remat_policy!r}")
+        if self.loss_chunk < 0:
+            raise ValueError(
+                f"loss_chunk must be >= 0, got {self.loss_chunk}")
 
 
 def _axis_size(axis: Optional[str]) -> int:
@@ -196,7 +228,7 @@ def _block(params, x, cfg: TransformerConfig, layer_idx: int):
 
     import jax as _jax
     flash_interp = _jax.default_backend() != "tpu"  # interpret off-TPU
-    # Auto policy: compiled flash from 4k *attended* sequence (the
+    # Auto policy: compiled flash from 1k *attended* sequence (the
     # measured crossover, config field comment); never auto-select the
     # interpreter off-TPU, and key on this trace's length, not max_seq —
     # a short batch under a long-context config stays on XLA attention.
@@ -206,7 +238,7 @@ def _block(params, x, cfg: TransformerConfig, layer_idx: int):
     if cfg.sp_axis and cfg.sp_impl == "ulysses":
         attended_s = s * lax.axis_size(cfg.sp_axis)
     use_flash = (cfg.use_flash if cfg.use_flash is not None
-                 else (not flash_interp and attended_s >= 4096))
+                 else (not flash_interp and attended_s >= 1024))
     if cfg.sp_axis and cfg.sp_impl == "ulysses":
         from ..parallel.ulysses import ulysses_attention
         attn = ulysses_attention(q, k, v, axis_name=cfg.sp_axis,
@@ -255,11 +287,10 @@ def _block(params, x, cfg: TransformerConfig, layer_idx: int):
     return x + m
 
 
-def apply(params, tokens, cfg: TransformerConfig):
-    """Forward pass (shard_map-level). tokens: [B, S_local] int32.
-    Returns logits [B, S_local, vocab] (fp32)."""
+def apply_hidden(params, tokens, cfg: TransformerConfig):
+    """Forward pass up to the final layernorm (shard_map-level).
+    tokens: [B, S_local] int32; returns hidden [B, S_local, d]."""
     dt = cfg.dtype
-    sp_n = _axis_size(cfg.sp_axis)
     s_local = tokens.shape[1]
     if cfg.sp_axis:
         offset = lax.axis_index(cfg.sp_axis) * s_local
@@ -271,19 +302,60 @@ def apply(params, tokens, cfg: TransformerConfig):
 
     block = _block
     if cfg.remat:
-        block = jax.checkpoint(_block, static_argnums=(2, 3))
+        policy = None
+        if cfg.remat_policy == "dots":
+            policy = (jax.checkpoint_policies
+                      .checkpoint_dots_with_no_batch_dims)
+        block = jax.checkpoint(_block, static_argnums=(2, 3),
+                               policy=policy)
     for i, layer in enumerate(params["layers"]):
         x = block(layer, x, cfg, i)
 
-    x = _layernorm(x, params["ln_f"])
-    logits = x.astype(jnp.float32) @ params["embed"].T
-    return logits
+    return _layernorm(x, params["ln_f"])
+
+
+def _project_logits(params, x, cfg: TransformerConfig):
+    if cfg.logits_bf16:
+        return (x @ params["embed"].astype(cfg.dtype).T).astype(
+            jnp.float32)
+    return x.astype(jnp.float32) @ params["embed"].T
+
+
+def apply(params, tokens, cfg: TransformerConfig):
+    """Forward pass (shard_map-level). tokens: [B, S_local] int32.
+    Returns logits [B, S_local, vocab] (fp32)."""
+    return _project_logits(params, apply_hidden(params, tokens, cfg), cfg)
 
 
 def loss_fn(params, tokens, targets, cfg: TransformerConfig):
     """Next-token cross-entropy, mean over local tokens; psum-mean over
-    'dp'/'sp' happens via the caller's pmean."""
-    logits = apply(params, tokens, cfg)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -ll.mean()
+    'dp'/'sp' happens via the caller's pmean.
+
+    With ``cfg.loss_chunk`` the vocab projection + log-softmax run over
+    sequence chunks under per-chunk rematerialization, so the fp32
+    [B, S, V] logits tensor — the largest allocation of an LM train
+    step — never materializes (memory: [B, chunk, V])."""
+    if not cfg.loss_chunk:
+        logits = apply(params, tokens, cfg)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return -ll.mean()
+
+    h = apply_hidden(params, tokens, cfg)
+    b, s, _ = h.shape
+    chunk = min(cfg.loss_chunk, s)
+    if s % chunk:
+        raise ValueError(
+            f"loss_chunk ({chunk}) must divide the local sequence ({s})")
+
+    @jax.checkpoint
+    def chunk_nll(c):
+        hs = lax.dynamic_slice_in_dim(h, c * chunk, chunk, axis=1)
+        tg = lax.dynamic_slice_in_dim(targets, c * chunk, chunk, axis=1)
+        logits = _project_logits(params, hs, cfg)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, tg[..., None], axis=-1)[..., 0]
+        return -ll.sum()
+
+    total = lax.map(chunk_nll, jnp.arange(s // chunk))
+    return total.sum() / (b * s)

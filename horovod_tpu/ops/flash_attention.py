@@ -216,19 +216,21 @@ def _default_block(block, interpret: bool, head_dim: int = 128) -> int:
     faster at 512 than at 128 (fewer grid iterations re-streaming K/V
     from HBM); 1024 exceeds the scoped VMEM budget and fails to compile.
     The VMEM footprint scales with block*head_dim, so the compiled
-    default keeps block*head_dim ~= 512*128: smaller blocks for larger
-    head dims (256 at d=256) and larger for smaller ones (up to 1024 at
-    d<=64), rounded DOWN to a multiple of 128 for the TPU lane/sublane
-    tiling and floored at 128 (so a huge head_dim still gets a legal —
-    if over-budget — block; pass explicit sizes there). The interpreter
-    keeps 128 so CPU tests stay fast. Blocks are clamped to the sequence
-    length either way."""
+    default SHRINKS for larger head dims (256 at d=256), rounded DOWN
+    to a multiple of 128 for the TPU lane/sublane tiling and floored at
+    128 (so a huge head_dim still gets a legal — if over-budget —
+    block; pass explicit sizes there). It does NOT grow above 512 for
+    small head dims: block 1024 at head_dim 64 has the same nominal
+    footprint as 512x128 but overflows the 16M scoped-vmem stack in the
+    backward kernel (measured: 16.7M > 16M limit). The interpreter
+    keeps 128 so CPU tests stay fast. Blocks are clamped to the
+    sequence length either way."""
     if block is not None:
         return block
     if interpret:
         return 128
     b = 512 * 128 // max(head_dim, 1)
-    return max(128, min(1024, b // 128 * 128))
+    return max(128, min(512, b // 128 * 128))
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
