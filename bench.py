@@ -184,11 +184,14 @@ def run_chip_bench():
         dt = time.perf_counter() - t0
         img_secs.append(batch * NUM_BATCHES_PER_ITER / dt)
 
-    per_chip = float(np.mean(img_secs)) / n
-    # Mean ± 1.96σ over the iteration windows — the reference's reported
-    # uncertainty (tensorflow_synthetic_benchmark.py:88-107). Throughput
-    # on a shared/tunneled chip drifts run to run; the CI makes
-    # round-over-round deltas interpretable.
+    # Median over the iteration windows as the headline (one tunnel
+    # stall out of 10 windows drags a mean by tens of percent — measured
+    # ci95 of ±63% with a single stalled window); the reference's
+    # mean ± 1.96σ (tensorflow_synthetic_benchmark.py:88-107) is still
+    # reported so round-over-round deltas stay interpretable on its
+    # convention too.
+    per_chip = float(np.median(img_secs)) / n
+    mean = float(np.mean(img_secs)) / n
     ci95 = float(1.96 * np.std(img_secs)) / n
     peak = peak_tflops(jax.devices()[0])
     # MFU on the same basis as the reported rate: sustained FLOP/s =
@@ -204,6 +207,7 @@ def run_chip_bench():
         "value": round(per_chip, 2),
         "unit": "img/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_IMG_SEC_PER_CHIP, 3),
+        "mean": round(mean, 2),
         "ci95": round(ci95, 2),
         "iters": NUM_ITERS,
         "batches_per_iter": NUM_BATCHES_PER_ITER,

@@ -188,6 +188,12 @@ class TestTrainStepParity:
         err = max(float(jnp.max(jnp.abs(a - b))) for a, b in zip(
             jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g1)))
         assert err < 1e-5, f"chunked-loss grad divergence {err}"
+        # remat_policy="dots" changes memory, never values.
+        ld, gd = loss_with(remat=True, remat_policy="dots")
+        assert abs(float(l0) - float(ld)) < 1e-6
+        errd = max(float(jnp.max(jnp.abs(a - b))) for a, b in zip(
+            jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(gd)))
+        assert errd < 1e-5, f"dots-policy grad divergence {errd}"
         # chunk must divide the sequence
         import pytest as _pytest
         with _pytest.raises(ValueError):
