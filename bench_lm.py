@@ -62,6 +62,11 @@ CONFIGS = {
     # ahead, sometimes behind — batch is a weak knob past batch 8.
     "tuned": dict(n_heads=6, batch=16, remat=False,
                   logits_bf16=True, loss_chunk=512, use_flash=True),
+    # Long-context row (seq 8192, batch 2 — pass --seq 8192): the
+    # round-2 recorded config (left) vs + bf16 logits + chunked loss.
+    "long_base": dict(n_heads=6, batch=2, remat=False, use_flash=True),
+    "long_tuned": dict(n_heads=6, batch=2, remat=False, use_flash=True,
+                       logits_bf16=True, loss_chunk=512),
     # In-process A/B control: "flash" minus the flash kernel (batch 8).
     "tuned_xla_attn": dict(n_heads=6, batch=8, remat=False,
                            logits_bf16=True, loss_chunk=512,
