@@ -449,23 +449,72 @@ class CollectiveExecutor:
         if not self._shm_checked:
             self._shm_checked = True
             from .utils import env as _env
+            # Everything below the (launcher-uniform) env gate and the
+            # (uniform) process count is per-process fallible, so the
+            # fleet-wide agreement must run UNCONDITIONALLY once past
+            # those two gates — a rank whose topology probe or segment
+            # creation failed must still vote, or the fleet's XLA
+            # program order diverges at the handshake itself.
             if _env.shm_data_plane() and jax.process_count() > 1:
-                # The shm reduction scales the process-sum by ONE local
-                # device count and maps virtual root ranks by division,
-                # both valid only for homogeneous placements (equal
-                # devices per process) — the same init-time invariant
-                # the reference asserts (operations.cc:1772-1790).
-                homogeneous = (jax.local_device_count() * jax.process_count()
-                               == jax.device_count())
+                transport = None
                 try:
-                    homogeneous = homogeneous and _topo._get().is_homogeneous
-                except Exception:
-                    pass
-                if homogeneous:
+                    # The shm reduction scales the process-sum by ONE
+                    # local device count and maps virtual root ranks by
+                    # division, both valid only for homogeneous
+                    # placements (equal devices per process) — the same
+                    # init-time invariant the reference asserts
+                    # (operations.cc:1772-1790).
+                    homogeneous = (
+                        jax.local_device_count() * jax.process_count()
+                        == jax.device_count())
+                    homogeneous = (homogeneous
+                                   and _topo._get().is_homogeneous)
+                    if homogeneous:
+                        from .ops import shm_transport
+                        transport = shm_transport.get(
+                            jax.process_index(), jax.process_count())
+                except Exception as e:
+                    transport = None
+                    from .utils.logging import get_logger
+                    get_logger("executor").warning(
+                        "shared-memory data plane disabled: %s "
+                        "(falling back to XLA collectives)", e)
+                # Readiness handshake: the launcher env gates all ranks
+                # identically, but the plane can still fail on a SUBSET
+                # (per-process segment-creation error) — and a split
+                # fleet deadlocks: shm-side ranks die on the 120 s spin
+                # while XLA-side ranks hang in collective rendezvous.
+                # Agree once through the XLA data plane (always
+                # available, same program on every process at this point
+                # in the agreed group order): the plane is used only if
+                # EVERY process reports it up.
+                if self._agree_all(transport is not None):
+                    self._shm_transport = transport
+                elif transport is not None:
+                    # Release the locally-created segments — the job
+                    # keeps running on XLA and must not pin bucket-sized
+                    # /dev/shm allocations for its lifetime.
                     from .ops import shm_transport
-                    self._shm_transport = shm_transport.get(
-                        jax.process_index(), jax.process_count())
+                    shm_transport.reset()
+                    from .utils.logging import get_logger
+                    get_logger("executor").warning(
+                        "shared-memory data plane up locally but not on "
+                        "every process; whole fleet falls back to XLA "
+                        "collectives")
         return self._shm_transport
+
+    def _agree_all(self, ok: bool) -> bool:
+        """True iff every process votes ``ok`` — one tiny psum over the
+        'dp' mesh (each device votes its process's verdict)."""
+        mesh = self.mesh
+        arr = self._mp_stacked(
+            np.asarray([1.0 if ok else 0.0], np.float32), mesh=mesh)
+        prog = self._program(
+            ("shm_agree", id(mesh)),
+            lambda: jax.jit(jax.shard_map(
+                lambda y: jax.lax.psum(y[0], "dp"), mesh=mesh,
+                in_specs=P("dp"), out_specs=P(), check_vma=False)))
+        return float(np.asarray(prog(arr))[0]) >= self.world_size
 
     def _mp_stacked(self, x, mesh: Optional[Mesh] = None,
                     axes=("dp",)) -> jax.Array:
@@ -590,13 +639,18 @@ class CollectiveExecutor:
                 off += flat.size
 
             if host_op is not None:
-                out = np.asarray(host_op(buf))
+                # jnp.asarray ONCE on the fused buffer, then slice on
+                # device (same pattern as the XLA branch below): the XLA
+                # path fulfills handles with device-committed jax.Arrays
+                # and the two data planes must hand callers the same
+                # type — but per-tensor transfers would pay hundreds of
+                # small H2D round-trips on a parameter-broadcast burst.
+                out = jnp.asarray(np.asarray(host_op(buf)))
                 off = 0
                 for i in idxs:
                     a = arrs[i]
-                    piece = out[off:off + a.size]
-                    results[i] = piece.reshape(a.shape).astype(
-                        a.dtype, copy=False)
+                    piece = jax.lax.dynamic_slice(out, (off,), (a.size,))
+                    results[i] = piece.reshape(a.shape).astype(a.dtype)
                     off += a.size
                 continue
 

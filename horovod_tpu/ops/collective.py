@@ -202,8 +202,14 @@ class CollectiveEngine:
         # handle declared the burst fully enqueued — drain NOW.
         self._flush = False
         # Explicit burst scope depth (see burst()): while > 0 the drain
-        # defers regardless of queue growth.
+        # defers regardless of queue growth. Owner threads (ident ->
+        # open-scope count) are tracked so a FOREIGN waiter's flush hint
+        # (a thread with no open scope blocking on a handle) cuts the
+        # scope instead of being consumed by it — otherwise that wait
+        # stalls until the 1 s burst valve fires.
         self._burst_depth = 0
+        self._burst_owners: Dict[int, int] = {}
+        self._foreign_flush = False
         self.mp_params: Dict = {}
         # name -> (latest coordinator missing-ranks stall line, wall time)
         # in MP mode; entries expire after 2x the warning window.
@@ -713,6 +719,24 @@ class CollectiveEngine:
         if core is not None:
             core.flush()
         with self._lock:
+            if threading.get_ident() not in self._burst_owners:
+                # Foreign waiter: must not be consumed by an open burst
+                # scope (see _loop's burst branch). Marked regardless of
+                # CURRENT depth — a hint landing just before another
+                # thread's burst() would otherwise be consumed by that
+                # scope (the loop may not run in between); a stale mark
+                # with no scope open is cleared by the loop. Scope exits
+                # flush via _flush_now, never through here.
+                self._foreign_flush = True
+            self._flush = True
+        self._wake.set()
+
+    def _flush_now(self) -> None:
+        """Scope-exit flush for the Python fallback dispatcher: drain
+        immediately without the foreign-waiter marking (the exit IS the
+        burst boundary, not a cut of it). The native path never comes
+        here — hvdtpu_burst_end sets its flush hint in C++."""
+        with self._lock:
             self._flush = True
         self._wake.set()
 
@@ -727,13 +751,21 @@ class CollectiveEngine:
         composition, and every distinct composition is a distinct
         compiled XLA program (measured: an unstable 53-leaf ResNet burst
         recompiled ~1 s/step on the CPU mesh; stable compositions hit
-        the jit cache). Exiting the outermost scope flushes."""
+        the jit cache). Exiting the outermost scope flushes.
+
+        Scope-owner threads are tracked: a blocking ``Handle.wait`` from
+        a thread with NO open scope (a foreign waiter) cuts the scope
+        and drains immediately instead of stalling until the 1 s
+        max-defer valve — only the owner's own flush hints are
+        superseded by the scope."""
         core = self._ensure_native()
+        tid = threading.get_ident()
         if core is not None:
             core.burst_begin()
         else:
             with self._lock:
                 self._burst_depth += 1
+                self._burst_owners[tid] = self._burst_owners.get(tid, 0) + 1
         try:
             yield
         finally:
@@ -743,8 +775,12 @@ class CollectiveEngine:
                 with self._lock:
                     self._burst_depth -= 1
                     outermost = self._burst_depth == 0
+                    if self._burst_owners.get(tid, 0) <= 1:
+                        self._burst_owners.pop(tid, None)
+                    else:
+                        self._burst_owners[tid] -= 1
                 if outermost:
-                    self.flush_hint()
+                    self._flush_now()
 
     # ------------------------------------------------------------ background
 
@@ -776,17 +812,25 @@ class CollectiveEngine:
                     # Explicit burst scope open: defer regardless of
                     # growth (the growth heuristic misfires when the
                     # enqueuer is descheduled on a busy host), bounded
-                    # by the burst valve. A concurrent waiter's flush
-                    # hint is consumed — the scope supersedes it (its
-                    # own exit will flush). Mirrors DrainShouldDefer.
+                    # by the burst valve. The scope OWNER's flush hint
+                    # is consumed — the scope supersedes it (its own
+                    # exit will flush). A FOREIGN waiter's hint cuts
+                    # the scope: stalling that wait until the 1 s valve
+                    # is worse than one timing-dependent composition.
+                    # Mirrors DrainShouldDefer.
                     self._flush = False
-                    if (now - self._oldest_enqueue_t
+                    if self._foreign_flush:
+                        self._foreign_flush = False
+                        defer = False
+                        complete = False  # mid-scope cut
+                    elif (now - self._oldest_enqueue_t
                             >= _BURST_MAX_DEFER_S):
                         defer = False
                         complete = False  # valve cut a mid-scope burst
                     else:
                         defer = True
                 else:
+                    self._foreign_flush = False
                     flush = self._flush
                     # Defer only while the burst is still GROWING — a
                     # lone blocking caller's single request must not pay

@@ -32,6 +32,7 @@ from __future__ import annotations
 import hashlib
 import mmap
 import os
+import platform
 import time
 from typing import Dict, Optional, Tuple
 
@@ -281,6 +282,14 @@ def get(rank: int, nproc: int) -> Optional[ShmTransport]:
         return None
     if _transport is None:
         try:
+            if platform.machine() not in ("x86_64", "AMD64"):
+                # The flag-after-payload protocol relies on x86-TSO store
+                # ordering (module docstring); on weaker memory models
+                # (aarch64) the un-fenced numpy stores can be observed
+                # reordered — torn or stale payloads, silently reduced.
+                raise OSError(
+                    f"flag-sequenced protocol requires x86-TSO ordering "
+                    f"(machine is {platform.machine()})")
             if not os.path.isdir(_DIR):
                 raise OSError(f"{_DIR} not present")
             tag = job_tag()

@@ -159,6 +159,14 @@ struct GlobalState {
   // window, the cycle sees "stopped growing" and drains a PARTIAL burst
   // — a new fusion composition, hence a fresh XLA compile, every step.
   std::atomic<int32_t> burst_depth{0};
+  // Burst-scope owner threads (per-thread open-scope count). A flush
+  // hint from a thread that owns NO open scope — a foreign waiter
+  // blocking on a handle while another thread's scope is open — must
+  // cut the scope instead of being consumed, or the waiter stalls until
+  // the 1 s burst valve fires (a per-op latency landmine).
+  std::mutex burst_owner_mu;
+  std::unordered_map<std::thread::id, int32_t> burst_owners;
+  std::atomic<bool> foreign_flush{false};
   std::condition_variable cycle_cv;
   std::mutex cycle_mu;
 };
@@ -194,18 +202,35 @@ bool DrainShouldDefer(GlobalState& st, bool* complete) {
   if (st.burst_depth.load() > 0 && qlen > 0) {
     // Submitter declared a burst open: defer regardless of growth (the
     // growth heuristic misfires when the enqueuer is descheduled on a
-    // busy host), bounded by the burst valve. A concurrent waiter's
-    // flush hint is consumed here — the open scope supersedes it (its
-    // own burst_end will flush), and leaving it set would defeat
-    // CycleSleep's pacing for the rest of the scope (a hot spin).
+    // busy host), bounded by the burst valve. The scope OWNER's flush
+    // hint is consumed here — the open scope supersedes it (its own
+    // burst_end will flush), and leaving it set would defeat
+    // CycleSleep's pacing for the rest of the scope (a hot spin). A
+    // FOREIGN waiter's hint (a thread with no open scope blocking on a
+    // handle, hvdtpu_flush) cuts the scope instead: stalling that
+    // waiter until the 1 s valve is a worse failure mode than one
+    // timing-dependent group composition.
     st.flush_hint.store(false);
+    if (st.foreign_flush.exchange(false)) {
+      *complete = false;  // mid-scope cut: the burst may still be arriving
+      return false;
+    }
     if (NowNs() - st.oldest_enqueue_ns.load() >= kBurstMaxDeferNs) {
       *complete = false;
       return false;
     }
     return true;
   }
-  if (st.flush_hint.exchange(false)) return false;  // submitter says done
+  // No open scope. Clear a foreign mark ONLY together with consuming
+  // its paired flush hint — hvdtpu_flush stores foreign_flush first,
+  // then flush_hint, and a cycle landing between the two stores must
+  // not wipe the mark (the waiter hints only once; losing the mark and
+  // then having a scope open re-creates the 1 s stall). A mark whose
+  // hint has not landed yet survives to the next cycle.
+  if (st.flush_hint.exchange(false)) {
+    st.foreign_flush.store(false);
+    return false;  // submitter says done
+  }
   if (qlen == 0) return false;
   if (qlen <= last) return false;  // burst stopped growing: drain now
   int64_t now = NowNs();
@@ -647,6 +672,11 @@ int hvdtpu_init(int rank, int size, int local_size, int virtual_size) {
       st.background_done = false;
       st.flush_hint.store(false);
       st.burst_depth.store(0);
+      st.foreign_flush.store(false);
+      {
+        std::lock_guard<std::mutex> olk(st.burst_owner_mu);
+        st.burst_owners.clear();
+      }
       st.rank = rank;
       st.size = size;
       st.local_size = local_size;
@@ -748,6 +778,22 @@ int32_t hvdtpu_current_flags() {
 void hvdtpu_flush() {
   if (!g_state || !g_state->initialized.load()) return;
   {
+    // A waiter with no open scope of its own must not have its hint
+    // consumed by a burst scope (see DrainShouldDefer) — mark it
+    // foreign so the cycle cuts the scope instead of deferring. Marked
+    // regardless of CURRENT depth: a hint landing just before another
+    // thread's burst_begin would otherwise be consumed by that scope
+    // (the cycle may not run in between). A stale mark with no scope
+    // open is cleared by the cycle's no-scope branch. Scope exits set
+    // flush_hint directly in hvdtpu_burst_end, never through here, so
+    // the per-step exit flush is never mistaken for a foreign waiter.
+    std::lock_guard<std::mutex> lk(g_state->burst_owner_mu);
+    if (g_state->burst_owners.find(std::this_thread::get_id()) ==
+        g_state->burst_owners.end()) {
+      g_state->foreign_flush.store(true);
+    }
+  }
+  {
     // Store under cycle_mu: CycleSleep checks the predicate under the
     // same lock, so an unserialized store+notify could land between its
     // check and its block — a lost wakeup that waits out the full cycle.
@@ -764,11 +810,22 @@ void hvdtpu_flush() {
 // scope flushes: the cycle drains immediately.
 void hvdtpu_burst_begin() {
   if (!g_state || !g_state->initialized.load()) return;
+  {
+    std::lock_guard<std::mutex> lk(g_state->burst_owner_mu);
+    g_state->burst_owners[std::this_thread::get_id()]++;
+  }
   g_state->burst_depth.fetch_add(1);
 }
 
 void hvdtpu_burst_end() {
   if (!g_state || !g_state->initialized.load()) return;
+  {
+    std::lock_guard<std::mutex> lk(g_state->burst_owner_mu);
+    auto it = g_state->burst_owners.find(std::this_thread::get_id());
+    if (it != g_state->burst_owners.end() && --it->second <= 0) {
+      g_state->burst_owners.erase(it);
+    }
+  }
   if (g_state->burst_depth.fetch_sub(1) <= 1) {
     {
       std::lock_guard<std::mutex> lk(g_state->cycle_mu);  // see hvdtpu_flush

@@ -134,12 +134,18 @@ def test_autotune_convergence_quality(tmp_path):
     assert any(r[2] == 1.0 for r in rows), "hier allreduce never explored"
     assert any(r[3] == 1.0 for r in rows), "hier allgather never explored"
     # Freeze-to-best: the frozen knobs equal the best-scoring sampled
-    # row (ties by score allowed; knobs logged at %.3f precision).
+    # row (ties by score allowed).  The CSV logs knobs at %.3f printf
+    # precision while the frozen values come back as raw doubles, and
+    # printf rounding vs round() can disagree in the last digit
+    # (e.g. 73.9825 -> "73.983" vs round() -> 73.982), so compare with
+    # a half-ULP-of-%.3f tolerance instead of exact set membership.
     best_score = max(r[4] for r in rows)
     best_points = {(r[0], r[1]) for r in rows
                    if abs(r[4] - best_score) < 1e-9}
-    frozen = (round(out["fusion_mb"], 3), round(out["cycle_ms"], 3))
-    assert frozen in best_points, (frozen, best_points)
+    frozen = (out["fusion_mb"], out["cycle_ms"])
+    assert any(abs(frozen[0] - p[0]) <= 5e-4 and
+               abs(frozen[1] - p[1]) <= 5e-4
+               for p in best_points), (frozen, best_points)
     # The SP tuner's execution-mode verdict is APPLIED: after the final
     # allreduce the live executor's hierarchical flags equal
     # hvdtpu_current_flags (VERDICT r2 #4 — a tuned flag must visibly
