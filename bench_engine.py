@@ -81,6 +81,72 @@ def run_config(size_bytes, per_burst, *, native, fusion):
                  ["bytes_per_us"])
 
 
+OVERLAP_WORKER = r"""
+import json, os, sys, time
+import jax
+import jax.numpy as jnp
+import horovod_tpu as hvd
+
+bursts = int(sys.argv[1])
+
+hvd.init()
+assert jax.local_device_count() == 1, "overlap A/B is a 1-device workload"
+
+@jax.jit
+def producer(x, i):
+    # a real compute chain standing in for a backward segment
+    for _ in range(8):
+        x = jnp.tanh(x @ x)
+    return x * 0 + i
+
+x = jnp.ones((512, 512), jnp.float32)
+
+# warmup: compile producer + the fused allreduce program
+for w in range(3):
+    ys = [producer(x, float(i)) for i in range(4)]
+    hs = [hvd.allreduce_async(y, average=False, name=f"w{w}.{i}")
+          for i, y in enumerate(ys)]
+    [wh.wait() for wh in hs]
+
+# async-submitter (hook-style) flow: dispatch producer, enqueue its
+# allreduce, immediately dispatch the next producer — the engine's
+# launch policy decides whether the collective waits out the producer
+# (fence on) or enqueues behind it in the device FIFO (fence off).
+t0 = time.perf_counter()
+all_hs = []
+for b in range(bursts):
+    for i in range(4):
+        y = producer(x, float(b * 4 + i))
+        all_hs.append(hvd.allreduce_async(y, average=False,
+                                          name=f"b{b}.{i}"))
+[h.wait(timeout=300.0) for h in all_hs]
+dt = time.perf_counter() - t0
+print(json.dumps({"wall_s": dt,
+                  "chains": bursts * 4,
+                  "ms_per_chain": dt * 1e3 / (bursts * 4)}))
+"""
+
+
+def run_overlap(*, fence: bool, bursts: int = 8):
+    """Async-submitter chain timing with the producer fence forced on
+    (the pre-round-4 behavior) vs off (the 1-device default): the delta
+    is the restored compute/collective overlap (VERDICT r3 #2)."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HOROVOD_TPU_PRODUCER_FENCE"] = "1" if fence else "0"
+    env["HOROVOD_CYCLE_TIME"] = "1"
+    proc = subprocess.run(
+        [sys.executable, "-c", OVERLAP_WORKER, str(bursts)],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"overlap worker failed (fence={fence}):\n"
+            f"{proc.stderr[-2000:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 def main():
     sweep = {}
     best = 0.0
@@ -96,11 +162,30 @@ def main():
         }
         sweep[f"{size}B"] = {k: round(v, 3) for k, v in row.items()}
         best = max(best, row["fused_native"])
+    # Overlap A/B (interleaved rounds, medians): hook-style async
+    # submitter on one device, producer fence forced on vs off. Guarded:
+    # a wedged/failed A/B must not discard the primary sweep above.
+    overlap_ab = None
+    try:
+        fenced_ms, unfenced_ms = [], []
+        for _ in range(3):
+            fenced_ms.append(run_overlap(fence=True)["ms_per_chain"])
+            unfenced_ms.append(run_overlap(fence=False)["ms_per_chain"])
+        med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+        overlap_ab = {
+            "fenced_ms_per_chain": round(med(fenced_ms), 3),
+            "unfenced_ms_per_chain": round(med(unfenced_ms), 3),
+            "fenced_over_unfenced": round(
+                med(fenced_ms) / med(unfenced_ms), 3),
+        }
+    except Exception as e:  # pragma: no cover - keep the primary metric
+        overlap_ab = {"error": str(e)[:200]}
     print(json.dumps({
         "metric": "engine_allreduce_bytes_per_us",
         "value": round(best, 3),
         "unit": "bytes/us",
         "sweep": sweep,
+        "overlap_ab": overlap_ab,
     }))
 
 

@@ -210,6 +210,11 @@ class CollectiveEngine:
         self._burst_depth = 0
         self._burst_owners: Dict[int, int] = {}
         self._foreign_flush = False
+        # Producer-fence decision cache (see _fence_producers): resolved
+        # once on first use — read-once env-knob semantics like every
+        # other engine knob, and no environ/device lookups on the
+        # per-group launch hot path.
+        self._fence_decision: Optional[bool] = None
         self.mp_params: Dict = {}
         # name -> (latest coordinator missing-ranks stall line, wall time)
         # in MP mode; entries expire after 2x the warning window.
@@ -1179,21 +1184,56 @@ class CollectiveEngine:
                     tl.end(r.name, getattr(out, "shape", None))
                 r.handle._fulfill(result=out)
 
+    def _fence_producers(self) -> bool:
+        """Whether collective launches must wait for input producers.
+
+        The hazard (VERDICT r2, observed 4-of-8 on the CPU mesh): this
+        engine thread launching a mesh-wide program while a user
+        thread's mesh-wide program dispatch is still fanning out across
+        the per-device queues leaves no global enqueue order — two
+        all-device programs queued in opposite orders on different
+        devices deadlock in XLA's collective rendezvous. The inversion
+        NEEDS more than one addressable device: with one device per
+        process (the real-pod shape, and the single-chip bench) every
+        launch lands in one FIFO queue and ordering is total, so the
+        fence is skipped and the collective enqueues behind the
+        still-running producer — restoring the compute/collective
+        overlap the reference gets from ready-events + NCCL streams
+        (operations.cc:816-840, 1117-1191). HOROVOD_TPU_PRODUCER_FENCE
+        forces either way.
+
+        Contract (measured, test_engine_overlap.py): the fence covers
+        PRODUCER-feeding flows — mesh programs whose outputs are the
+        collective's inputs. An unrelated mesh-wide jit stream from
+        another thread concurrent with eager collectives deadlocks on
+        a multi-device process regardless (no fence can order two
+        threads' unrelated launches); that pattern must use the jit
+        optimizer path."""
+        if self._fence_decision is None:
+            forced = _env.producer_fence()
+            self._fence_decision = (forced if forced is not None
+                                    else jax.local_device_count() > 1)
+        return self._fence_decision
+
     def _execute_group(self, ex: CollectiveExecutor,
                        group: List[_Request]) -> List:
-        # Retire every input's producer program BEFORE launching the
-        # fused collective: the collective spans the whole mesh, and
-        # this (engine) thread launching it while a user program that
-        # also spans the mesh is still in flight from the submitting
-        # thread leaves no global enqueue order across the per-device
-        # queues — XLA's collective rendezvous can then deadlock with
-        # part of the mesh inside each program (observed 4-of-8 on the
-        # CPU mesh with replicated-param jits feeding eager
-        # allreduce_gradients). Costs nothing in the synchronous
-        # pattern: the submitter is already blocked on the handles.
-        for r in group:
-            ts = r.per_rank if r.per_rank is not None else (r.tensor,)
-            jax.block_until_ready([t for t in ts if t is not None])
+        if self._fence_producers():
+            # Multi-device process: retire producers first (see
+            # _fence_producers). Tensors that are already on device and
+            # committed (is_ready) — or host arrays — skip the block,
+            # so an async submitter whose grads landed early pays
+            # nothing.
+            pending = []
+            for r in group:
+                ts = r.per_rank if r.per_rank is not None else (r.tensor,)
+                for t in ts:
+                    if t is None:
+                        continue
+                    ready = getattr(t, "is_ready", None)
+                    if ready is not None and not ready():
+                        pending.append(t)
+            if pending:
+                jax.block_until_ready(pending)
         op = group[0].op
         if op == ALLREDUCE:
             if group[0].sharded:

@@ -66,6 +66,19 @@ def shm_data_plane() -> bool:
     return os.environ.get("HOROVOD_TPU_ALL_LOCAL") == "1"
 
 
+def producer_fence() -> Optional[bool]:
+    """Force (1) or suppress (0) the eager engine's producer fence —
+    blocking on input producers before launching a fused collective.
+    Default None = automatic: fence only when this process addresses
+    more than one device (see CollectiveEngine._fence_producers — with
+    one device every launch lands in one FIFO queue and the rendezvous
+    inversion the fence prevents cannot occur)."""
+    v = _get("PRODUCER_FENCE")
+    if v in (None, ""):
+        return None
+    return v != "0"
+
+
 def hierarchical_allreduce() -> bool:
     return _get("HIERARCHICAL_ALLREDUCE") not in (None, "", "0")
 
