@@ -28,6 +28,8 @@ from .optimizer import (DistributedOptimizer, DistributedGradientTransformation,
                         broadcast_parameters, broadcast_optimizer_state,
                         broadcast_object, allreduce_gradients)
 from .utils.checkpoint import restore_checkpoint, save_checkpoint
+from .ops.timeline_jit import (step as timeline_jit_step,
+                               merge_profiler_trace)
 
 __version__ = "0.1.0"
 
@@ -42,6 +44,7 @@ __all__ = [
     "allreduce", "allreduce_async", "allgather", "allgather_async",
     "broadcast", "broadcast_async", "grouped_allreduce", "poll",
     "synchronize", "Handle", "HorovodInternalError",
+    "timeline_jit_step", "merge_profiler_trace",
     # training
     "Compression", "DistributedOptimizer",
     "DistributedGradientTransformation", "broadcast_parameters",

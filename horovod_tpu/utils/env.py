@@ -79,6 +79,16 @@ def producer_fence() -> Optional[bool]:
     return v != "0"
 
 
+def device_pack() -> Optional[bool]:
+    """Force (1) or suppress (0) device-resident MP fusion-buffer
+    packing. Default None = automatic: on for accelerator backends,
+    off on CPU (executor._device_pack)."""
+    v = _get("DEVICE_PACK")
+    if v in (None, ""):
+        return None
+    return v != "0"
+
+
 def hierarchical_allreduce() -> bool:
     return _get("HIERARCHICAL_ALLREDUCE") not in (None, "", "0")
 
