@@ -571,16 +571,13 @@ class CollectiveExecutor:
         dev = next(iter(buf.devices()))
         off = 0
         for t in ts:
-            try:
-                if t.devices() != {dev}:
-                    # Inputs committed to another local device (or
-                    # replicated across several) would make the jitted
-                    # DUS raise 'incompatible devices'; a D2D put onto
-                    # the buffer's device keeps the cascade legal — the
-                    # host pack accepted any placement, so must this.
-                    t = jax.device_put(t, dev)
-            except Exception:
-                pass  # uncommitted arrays have no fixed device set
+            if t.devices() != {dev}:
+                # Inputs committed to another local device (or
+                # replicated across several) would make the jitted
+                # DUS raise 'incompatible devices'; a D2D put onto
+                # the buffer's device keeps the cascade legal — the
+                # host pack accepted any placement, so must this.
+                t = jax.device_put(t, dev)
             key = ("pack_dus", tuple(t.shape), str(t.dtype), padded, dt_s)
             prog = self._program(key, lambda: jax.jit(
                 lambda b, v, o: jax.lax.dynamic_update_slice(

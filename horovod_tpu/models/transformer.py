@@ -64,12 +64,17 @@ class TransformerConfig:
     # (ops/flash_attention.py) instead of XLA full attention. None (the
     # default) auto-selects by sequence length: with the 512-block
     # kernel, measured on v5e (111M LM, full train step, in-process
-    # A/B, BENCH_LM.json): flash wins ~1.5x at 2048 (126.4k vs 82.1k
+    # A/B, BENCH_LM.json): flash wins ~1.5x at 2048 (137.1k vs 90.4k
     # tok/s) and 1.14x at 1024; XLA edges it at 512 (90.8k vs 86.3k)
     # — crossover ~1k.
     # (The round-2 128-block kernel crossed at ~4k; the block tuning
     # moved it.)
     use_flash: Optional[bool] = None
+    # Flash kernel block size (block_q == block_k). None = the tuned
+    # default (512 compiled / 128 interpreted, ops/flash_attention.py
+    # _default_block). Exposed for long-sequence block sweeps — the
+    # optimum can shift with seq length and head_dim.
+    flash_block: Optional[int] = None
     # MoE: when set, every other block's MLP is a top-1 MoE
     num_experts: int = 0
     capacity_factor: float = 2.0
@@ -251,8 +256,8 @@ def _block(params, x, cfg: TransformerConfig, layer_idx: int):
     elif use_flash:
         from ..ops.flash_attention import flash_attention
         # block sizes None -> tuned defaults (512 compiled / 128 interp)
-        attn = flash_attention(q, k, v, True, None, None, None,
-                               flash_interp)
+        attn = flash_attention(q, k, v, True, None, cfg.flash_block,
+                               cfg.flash_block, flash_interp)
     else:
         attn = full_attention(q, k, v, causal=True)
     attn = attn.reshape(b, s, h_local * hd)

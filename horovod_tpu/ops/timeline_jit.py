@@ -50,8 +50,14 @@ _PID_GAP = 10000  # profiler pids re-based above the engine's interned pids
 @contextlib.contextmanager
 def step(name: str = "step"):
     """Bracket a compiled-step execution on the Horovod timeline as an
-    ``XLA_STEP`` span under process ``jit::<name>``. No-op (zero
-    overhead beyond two attribute checks) when no timeline is active."""
+    ``XLA_STEP`` span under process ``jit::<name>``. When no timeline
+    path is configured this is a no-op that never touches the engine —
+    the always-on usage (bracketing every training step) must not add
+    lock traffic to the hot path."""
+    from ..utils import env as _env
+    if not _env.timeline_path():
+        yield
+        return
     from . import collective as _c
     eng = _c.engine()
     tensor = f"jit::{name}"

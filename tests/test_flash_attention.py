@@ -122,3 +122,20 @@ class TestTransformerFlash:
         logits_flash = tfm.apply(params, tokens, cfg)
         logits_full = tfm.apply(params, tokens, cfg_full)
         assert float(jnp.max(jnp.abs(logits_flash - logits_full))) < 1e-3
+
+    def test_flash_block_config_threads_through(self):
+        """cfg.flash_block reaches the kernel (round-4 long-seq sweep
+        knob): a non-default block still matches full attention."""
+        from horovod_tpu.models import transformer as tfm
+
+        base = dict(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                    d_ff=64, max_seq=64, dtype=jnp.float32, remat=False)
+        cfg_b16 = tfm.TransformerConfig(use_flash=True, flash_block=16,
+                                        **base)
+        cfg_full = tfm.TransformerConfig(use_flash=False, **base)
+        rng = jax.random.PRNGKey(1)
+        params = tfm.init_params(cfg_b16, rng)
+        tokens = jax.random.randint(rng, (2, 64), 0, 64)
+        lo_b = tfm.apply(params, tokens, cfg_b16)
+        lo_f = tfm.apply(params, tokens, cfg_full)
+        assert float(jnp.max(jnp.abs(lo_b - lo_f))) < 1e-3
