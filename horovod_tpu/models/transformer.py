@@ -73,7 +73,9 @@ class TransformerConfig:
     # Flash kernel block size (block_q == block_k). None = the tuned
     # default (512 compiled / 128 interpreted, ops/flash_attention.py
     # _default_block). Exposed for long-sequence block sweeps — the
-    # optimum can shift with seq length and head_dim.
+    # optimum can shift with seq length and head_dim. Applies to the
+    # single-shard and Ulysses paths; ring attention is its own
+    # blockwise schedule (shard-sized blocks) and takes no flash block.
     flash_block: Optional[int] = None
     # MoE: when set, every other block's MLP is a top-1 MoE
     num_experts: int = 0
@@ -248,6 +250,7 @@ def _block(params, x, cfg: TransformerConfig, layer_idx: int):
         from ..parallel.ulysses import ulysses_attention
         attn = ulysses_attention(q, k, v, axis_name=cfg.sp_axis,
                                  causal=True, use_flash=use_flash,
+                                 flash_block=cfg.flash_block,
                                  flash_interpret=flash_interp)
     elif cfg.sp_axis:
         # Ring attention is already blockwise-O(S/n); use_flash does not

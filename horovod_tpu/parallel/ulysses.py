@@ -55,6 +55,7 @@ def ulysses_attention(q, k, v, *, axis_name: str = "sp",
                       causal: bool = True,
                       scale: Optional[float] = None,
                       use_flash: bool = False,
+                      flash_block: Optional[int] = None,
                       flash_interpret: bool = False):
     """Attention over a sequence sharded on ``axis_name`` via two
     all-to-alls (DeepSpeed-Ulysses).
@@ -64,6 +65,9 @@ def ulysses_attention(q, k, v, *, axis_name: str = "sp",
       use_flash: run the local (full-sequence) attention through the
         Pallas flash kernel — O(S) memory instead of the [S, S] score
         matrix; essential at long global sequence lengths.
+      flash_block: flash kernel block size (None = tuned default) —
+        forwarded so long-sequence block sweeps reach the kernel on
+        this path too.
     Returns: [batch, seq_shard, heads, head_dim], exact (up to fp) vs
     full attention over the global sequence.
     """
@@ -82,8 +86,8 @@ def ulysses_attention(q, k, v, *, axis_name: str = "sp",
     if use_flash:
         from ..ops.flash_attention import flash_attention
         # block sizes None -> tuned defaults (512 compiled / 128 interp)
-        out = flash_attention(q, k, v, causal, scale, None, None,
-                              flash_interpret)
+        out = flash_attention(q, k, v, causal, scale, flash_block,
+                              flash_block, flash_interpret)
     else:
         out = full_attention(q, k, v, causal=causal, scale=scale)
     # Reshard back: full heads, sequence shard.

@@ -123,10 +123,25 @@ class TestTransformerFlash:
         logits_full = tfm.apply(params, tokens, cfg_full)
         assert float(jnp.max(jnp.abs(logits_flash - logits_full))) < 1e-3
 
-    def test_flash_block_config_threads_through(self):
-        """cfg.flash_block reaches the kernel (round-4 long-seq sweep
-        knob): a non-default block still matches full attention."""
+    def test_flash_block_config_threads_through(self, monkeypatch):
+        """cfg.flash_block REACHES the kernel (round-4 long-seq sweep
+        knob): capture the block args at the flash_attention call and
+        check the output still matches full attention. The capture is
+        what makes this a real thread-through test — default-block
+        flash would also match full attention numerically."""
         from horovod_tpu.models import transformer as tfm
+        from horovod_tpu.ops import flash_attention as fa
+
+        seen = []
+        real = fa.flash_attention
+
+        def spy(q, k, v, causal=True, scale=None, block_q=None,
+                block_k=None, interpret=False):
+            seen.append((block_q, block_k))
+            return real(q, k, v, causal, scale, block_q, block_k,
+                        interpret)
+
+        monkeypatch.setattr(fa, "flash_attention", spy)
 
         base = dict(vocab=64, d_model=32, n_heads=4, n_layers=2,
                     d_ff=64, max_seq=64, dtype=jnp.float32, remat=False)
@@ -137,5 +152,6 @@ class TestTransformerFlash:
         params = tfm.init_params(cfg_b16, rng)
         tokens = jax.random.randint(rng, (2, 64), 0, 64)
         lo_b = tfm.apply(params, tokens, cfg_b16)
+        assert seen and all(bq == 16 and bk == 16 for bq, bk in seen), seen
         lo_f = tfm.apply(params, tokens, cfg_full)
         assert float(jnp.max(jnp.abs(lo_b - lo_f))) < 1e-3
