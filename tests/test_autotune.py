@@ -134,17 +134,21 @@ def test_autotune_convergence_quality(tmp_path):
     assert any(r[2] == 1.0 for r in rows), "hier allreduce never explored"
     assert any(r[3] == 1.0 for r in rows), "hier allgather never explored"
     # Freeze-to-best: the frozen knobs equal the best-scoring sampled
-    # row (ties by score allowed).  The CSV logs knobs at %.3f printf
-    # precision while the frozen values come back as raw doubles, and
-    # printf rounding vs round() can disagree in the last digit
-    # (e.g. 73.9825 -> "73.983" vs round() -> 73.982), so compare with
-    # a half-ULP-of-%.3f tolerance instead of exact set membership.
+    # row (ties by score allowed). Two representation gaps separate the
+    # CSV row from the read-back frozen value and both must fit inside
+    # the tolerance: (a) the CSV logs the SAMPLED double at %.3f printf
+    # precision (half-ULP 5e-4); (b) the APPLIED value is quantized by
+    # the core's integer storage — cycle time is held in whole
+    # microseconds, so the read-back can sit a full 1e-3 ms below the
+    # sampled double (observed: sampled 77.8195 -> CSV "77.820" vs
+    # applied 77819 us -> 77.819). fusion_mb's byte quantization is
+    # ~1e-6 MB, so only the printf half-ULP applies there.
     best_score = max(r[4] for r in rows)
     best_points = {(r[0], r[1]) for r in rows
                    if abs(r[4] - best_score) < 1e-9}
     frozen = (out["fusion_mb"], out["cycle_ms"])
-    assert any(abs(frozen[0] - p[0]) <= 5e-4 and
-               abs(frozen[1] - p[1]) <= 5e-4
+    assert any(abs(frozen[0] - p[0]) <= 6e-4 and
+               abs(frozen[1] - p[1]) <= 1.6e-3
                for p in best_points), (frozen, best_points)
     # The SP tuner's execution-mode verdict is APPLIED: after the final
     # allreduce the live executor's hierarchical flags equal
