@@ -36,8 +36,9 @@ WINDOWS = int(os.environ.get("HVD_BENCH_LM_WINDOWS", 3))
 
 # (name, dict of TransformerConfig overrides + batch). The cumulative
 # tuning ladder measured on v5e (docs/benchmarks.md LM section and
-# BENCH_LM.json, round-4 K=20 methodology): 46.3k -> 137.1k tok/s
-# (18.3% -> 54.3% model MFU) in one interleaved run. Dead ends kept out: remat (full or dots policy)
+# BENCH_LM.json, round-4 K=20 methodology + flash-kernel retune):
+# 46.4k -> 145.1k tok/s (18.4% -> 57.4% model MFU) in one interleaved
+# run. Dead ends kept out: remat (full or dots policy)
 # at batch 16/32 always lost to batch-8 no-remat, and batch>=16
 # without flash OOMs (the XLA attention score tensors + fp32 logits
 # exceed the 15.75G HBM).

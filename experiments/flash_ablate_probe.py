@@ -1,0 +1,161 @@
+"""Ablate the flash forward kernel to locate the cost center.
+
+Variants at fixed shapes:
+  full      — the real fwd kernel (via flash_attention fwd-only)
+  matmul    — same grid/blockspecs, but body is just the two matmuls
+              (s = qk^T, acc += s_bf16 @ v): isolates MXU + HBM streaming
+  nosoft    — matmuls + running accumulator scale, no exp/max/sum
+  stream    — body only reads blocks and writes acc (no matmul): HBM only
+Sweeps: causal on/off, block sizes, batch scaling (fixed-overhead test).
+"""
+import time, sys, functools
+import jax, jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, "/root/repo")
+from horovod_tpu.ops.flash_attention import flash_attention
+
+PEAK = 197e12
+K = 20
+
+
+def variant_kernel(q_ref, k_ref, v_ref, o_ref, acc_sc, *, mode, causal,
+                   block_q, block_k, n_k):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    def _update():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        if mode == "stream":
+            acc_sc[:] += q.astype(jnp.float32) + k.astype(jnp.float32) \
+                + v.astype(jnp.float32)
+            return
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if mode == "matmul":
+            p = s.astype(v.dtype)
+            acc_sc[:] += jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        elif mode == "nosoft":
+            m = s.max(axis=-1)
+            p = (s - m[:, None]).astype(v.dtype)
+            acc_sc[:] = acc_sc[:] * 0.5 + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(kj * block_k <= (qi + 1) * block_q - 1)
+        def _():
+            _update()
+    else:
+        _update()
+
+    @pl.when(kj == n_k - 1)
+    def _fin():
+        o_ref[0] = acc_sc[:].astype(o_ref.dtype)
+
+
+def run_variant(q, k, v, mode, causal, bq, bk):
+    bh, s, d = q.shape
+    n_q = pl.cdiv(s, bq)
+    n_k = pl.cdiv(s, bk)
+    kern = functools.partial(variant_kernel, mode=mode, causal=causal,
+                             block_q=bq, block_k=bk, n_k=n_k)
+    call = pl.pallas_call(
+        kern,
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+    )
+
+    @jax.jit
+    def chain(c):
+        def body(_, c):
+            q, k, v = c
+            o = call(q, k, v)
+            return (o, k, v)
+        return jax.lax.fori_loop(0, K, body, c)
+
+    c = (q, k, v)
+    for _ in range(3):
+        c = chain(c)
+    float(jnp.sum(c[0][0, 0].astype(jnp.float32)))
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        c = chain(c)
+        float(jnp.sum(c[0][0, 0].astype(jnp.float32)))
+        ts.append((time.perf_counter() - t0) / K)
+    return float(np.median(ts))
+
+
+def run_full(q4, causal, bq, bk):
+    @jax.jit
+    def chain(c):
+        def body(_, c):
+            q, k, v = c
+            o = flash_attention(q, k, v, causal, None, bq, bk)
+            return (o, k, v)
+        return jax.lax.fori_loop(0, K, body, c)
+
+    c = (q4, q4, q4)
+    for _ in range(3):
+        c = chain(c)
+    float(jnp.sum(c[0][0, 0, 0].astype(jnp.float32)))
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        c = chain(c)
+        float(jnp.sum(c[0][0, 0, 0].astype(jnp.float32)))
+        ts.append((time.perf_counter() - t0) / K)
+    return float(np.median(ts))
+
+
+def main():
+    D = 128
+    for (B, H, S) in [(8, 16, 2048), (8, 16, 8192), (16, 16, 2048)]:
+        bh = B * H
+        key = jax.random.PRNGKey(0)
+        q = jax.random.normal(key, (bh, S, D), jnp.bfloat16)
+        q4 = jax.random.normal(key, (B, S, H, D), jnp.bfloat16)
+        f_causal = 4 * bh * S * S * D / 2
+
+        for causal in (True, False):
+            f = f_causal if causal else 2 * f_causal
+            rows = []
+            for mode in ("stream", "matmul", "nosoft"):
+                t = run_variant(q, q, q, mode, causal, 512, 512)
+                rows.append(f"{mode} {t*1e3:.2f}ms ({f/t/PEAK*100:.0f}%)")
+            t = run_full(q4, causal, 512, 512)
+            rows.append(f"full {t*1e3:.2f}ms ({f/t/PEAK*100:.0f}%)")
+            print(f"B{B} S{S} causal={int(causal)} b512: "
+                  + "  ".join(rows))
+
+        # block sweep, causal, full kernel
+        for (bq, bk) in [(1024, 512), (1024, 1024), (2048, 512)]:
+            try:
+                t = run_full(q4, True, bq, bk)
+                print(f"B{B} S{S} causal=1 b({bq},{bk}): full "
+                      f"{t*1e3:.2f}ms ({f_causal/t/PEAK*100:.0f}%)")
+            except Exception as e:
+                print(f"B{B} S{S} b({bq},{bk}): FAIL "
+                      f"{type(e).__name__}: {str(e)[:120]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+"""Sweep flash block sizes for fwd and fwd+bwd separately (calibrated
+against the per-call tunnel overhead). Decides the compiled defaults."""
+import time, sys
+import jax, jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+from horovod_tpu.ops.flash_attention import flash_attention
+
+PEAK = 197e12
+K = 100
+_tunnel = None
+
+
+def tunnel_overhead():
+    global _tunnel
+    if _tunnel is None:
+        x = jnp.zeros((8, 128), jnp.float32)
+
+        @jax.jit
+        def empty(c):
+            return jax.lax.fori_loop(0, K, lambda _, y: y + 1.0, c)
+
+        for _ in range(3):
+            x = empty(x)
+        float(jnp.sum(x))
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            x = empty(x)
+            float(jnp.sum(x))
+            ts.append(time.perf_counter() - t0)
+        _tunnel = float(np.median(ts))
+        print(f"tunnel overhead per call: {_tunnel*1e3:.1f} ms")
+    return _tunnel
+
+
+def timed(fn, carry, flops):
+    for _ in range(3):
+        carry = fn(carry)
+    float(jnp.sum(carry[0][0, 0, 0].astype(jnp.float32)))
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        carry = fn(carry)
+        float(jnp.sum(carry[0][0, 0, 0].astype(jnp.float32)))
+        dt = time.perf_counter() - t0 - tunnel_overhead()
+        rates.append(flops * K / dt)
+    return float(np.median(rates))
+
+
+def main():
+    B, H, D = 8, 16, 128
+    for S in (2048, 8192):
+        key = jax.random.PRNGKey(0)
+        q, k, v = (jax.random.normal(jax.random.fold_in(key, i),
+                                     (B, S, H, D), jnp.bfloat16)
+                   for i in range(3))
+        f_fwd = 4 * B * H * S * S * D / 2
+        f_bwd = 2.5 * f_fwd
+        for (bq, bk) in [(512, 512), (1024, 512), (512, 1024),
+                         (1024, 1024), (2048, 1024)]:
+            if bq > S or bk > S:
+                continue
+            try:
+                @jax.jit
+                def fwd_k(c, bq=bq, bk=bk):
+                    def body(_, c):
+                        q, k, v = c
+                        o = flash_attention(q, k, v, True, None, bq, bk)
+                        return (o, k, v)
+                    return jax.lax.fori_loop(0, K, body, c)
+
+                r_f = timed(fwd_k, (q, k, v), f_fwd)
+                msg = f"S={S} b({bq},{bk}): fwd {r_f/PEAK*100:.1f}%"
+            except Exception as e:
+                print(f"S={S} b({bq},{bk}): fwd FAIL {str(e)[:100]}")
+                continue
+            try:
+                def loss(q, k, v, bq=bq, bk=bk):
+                    return jnp.sum(
+                        flash_attention(q, k, v, True, None, bq, bk)
+                        .astype(jnp.float32))
+
+                @jax.jit
+                def fb_k(c, loss=loss):
+                    def body(_, c):
+                        q, k, v = c
+                        dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(
+                            q, k, v)
+                        eps = jnp.bfloat16(1e-4)
+                        return (q + eps * dq, k + eps * dk, v + eps * dv)
+                    return jax.lax.fori_loop(0, K, body, c)
+
+                r_fb = timed(fb_k, (q, k, v), f_fwd + f_bwd)
+                msg += f"  fwd+bwd {r_fb/PEAK*100:.1f}%"
+            except Exception as e:
+                msg += f"  bwd FAIL {str(e)[:100]}"
+            print(msg, flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""Measure the flash kernel's standalone sustained FLOP rate (fwd and
+fwd+bwd) on the real chip, to test the round-4 decomposition's ~33%
+inferred flash rate and locate where the time goes.
+
+Timing follows bench_lm.py: K chained steps inside one jitted fori_loop
+(amortizes the ~90-100 ms per-call tunnel overhead) and host readback of
+a scalar for sync (block_until_ready is unreliable through the tunnel —
+the first version of this probe "measured" 47,000% MFU without it).
+
+Useful model FLOPs (causal): fwd = 2 matmuls * 2*B*H*S^2*D, halved by
+causality; bwd = 2.5x fwd (5 useful matmuls vs fwd's 2).
+"""
+import time, sys
+from functools import partial
+
+import jax, jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+from horovod_tpu.ops.flash_attention import flash_attention
+
+PEAK = 197e12  # v5e bf16
+K = 100
+
+
+_tunnel = None
+
+
+def tunnel_overhead():
+    """Median wall time of an (almost) empty chained call + readback —
+    the per-call axon tunnel cost to subtract from every measurement."""
+    global _tunnel
+    if _tunnel is None:
+        x = jnp.zeros((8, 128), jnp.float32)
+
+        @jax.jit
+        def empty(c):
+            return jax.lax.fori_loop(0, K, lambda _, y: y + 1.0, c)
+
+        for _ in range(3):
+            x = empty(x)
+        float(jnp.sum(x))
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            x = empty(x)
+            float(jnp.sum(x))
+            ts.append(time.perf_counter() - t0)
+        _tunnel = float(np.median(ts))
+        print(f"tunnel overhead per call: {_tunnel*1e3:.1f} ms")
+    return _tunnel
+
+
+def timed(fn, carry, flops_per_step):
+    for _ in range(3):
+        carry = fn(carry)
+    float(jnp.sum(carry[0][0, 0, 0].astype(jnp.float32)))
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        carry = fn(carry)
+        float(jnp.sum(carry[0][0, 0, 0].astype(jnp.float32)))
+        dt = time.perf_counter() - t0 - tunnel_overhead()
+        rates.append(flops_per_step * K / dt)
+    return float(np.median(rates))
+
+
+def main():
+    B, H, D = 8, 16, 128
+    for S in (2048, 8192):
+        key = jax.random.PRNGKey(0)
+        q, k, v = (jax.random.normal(jax.random.fold_in(key, i),
+                                     (B, S, H, D), jnp.bfloat16)
+                   for i in range(3))
+        f_fwd = 4 * B * H * S * S * D / 2
+        f_bwd = 2.5 * f_fwd
+
+        @jax.jit
+        def fwd_k(carry):
+            def body(_, c):
+                q, k, v = c
+                o = flash_attention(q, k, v, True)
+                return (o, k, v)
+            return jax.lax.fori_loop(0, K, body, carry)
+
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, True)
+                           .astype(jnp.float32))
+
+        @jax.jit
+        def fb_k(carry):
+            def body(_, c):
+                q, k, v = c
+                dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+                eps = jnp.bfloat16(1e-4)
+                return (q + eps * dq, k + eps * dk, v + eps * dv)
+            return jax.lax.fori_loop(0, K, body, carry)
+
+        r_f = timed(fwd_k, (q, k, v), f_fwd)
+        r_fb = timed(fb_k, (q, k, v), f_fwd + f_bwd)
+        t_f = f_fwd / r_f
+        t_fb = (f_fwd + f_bwd) / r_fb
+        t_b = t_fb - t_f
+        print(f"S={S}: fwd {t_f*1e3:.2f} ms ({r_f/PEAK*100:.1f}% MFU), "
+              f"fwd+bwd {t_fb*1e3:.2f} ms ({r_fb/PEAK*100:.1f}% MFU), "
+              f"bwd-only {t_b*1e3:.2f} ms ({f_bwd/t_b/PEAK*100:.1f}% MFU)")
+
+
+if __name__ == "__main__":
+    main()

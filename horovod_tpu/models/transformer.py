@@ -70,11 +70,14 @@ class TransformerConfig:
     # (The round-2 128-block kernel crossed at ~4k; the block tuning
     # moved it.)
     use_flash: Optional[bool] = None
-    # Flash kernel block size (block_q == block_k). None = the tuned
-    # default (512 compiled / 128 interpreted, ops/flash_attention.py
-    # _default_block). Exposed for long-sequence block sweeps — the
-    # optimum can shift with seq length and head_dim. Applies to the
-    # single-shard and Ulysses paths; ring attention is its own
+    # Flash kernel block size (block_q == block_k, overriding EVERY
+    # kernel). None = the tuned per-kernel defaults (fwd 1024x1024,
+    # dkv 512x1024, dq 1024x512 compiled / 128 interpreted —
+    # ops/flash_attention.py _default_block). Exposed for
+    # long-sequence block sweeps — the optimum can shift with seq
+    # length and head_dim (1024 measured ~1% faster at seq 8192 but
+    # intermittently fails to compile at larger batch*heads). Applies
+    # to the single-shard and Ulysses paths; ring attention is its own
     # blockwise schedule (shard-sized blocks) and takes no flash block.
     flash_block: Optional[int] = None
     # MoE: when set, every other block's MLP is a top-1 MoE

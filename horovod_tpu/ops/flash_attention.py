@@ -42,16 +42,25 @@ def _row_ok(start_block: int, block: int, limit: int):
     return rows < limit
 
 
-def _masked_scores(q, k, qi, kj, *, scale, causal, block_q, block_k,
+def _scores(q, k):
+    """q k^T block scores (q pre-scaled), fp32 accumulation.
+
+    The ONE score convention, shared by the masked and unmasked paths
+    of the forward and both backward kernels so a convention change
+    (bias term, different scaling, ...) cannot desynchronize them."""
+    return jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)               # [BQ, BK]
+
+
+def _masked_scores(q, k, qi, kj, *, causal, block_q, block_k,
                    seq_q, seq_k):
-    """Scaled q k^T block scores with the bounds+causal mask applied.
+    """_scores with the bounds+causal mask applied.
 
     Shared by the forward and both backward kernels so a mask change
     (sliding window, segment ids, ...) cannot desynchronize them.
     Returns (scores, valid)."""
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale       # [BQ, BK]
+    s = _scores(q, k)
     q_pos = (qi * block_q
              + jax.lax.broadcasted_iota(jnp.int32,
                                         (block_q, block_k), 0))
@@ -66,9 +75,54 @@ def _masked_scores(q, k, qi, kj, *, scale, causal, block_q, block_k,
     return jnp.where(valid, s, _NEG_INF), valid
 
 
+def _block_dispatch(update, *, qi, kj, causal, block_q, block_k,
+                    n_q, n_k, seq_q, seq_k):
+    """Run ``update(masked)`` with per-block mask specialization.
+
+    Mask construction (two [BQ, BK] iotas + compares + wheres) costs
+    several VPU passes over the score block — comparable to the block's
+    MXU time — yet only blocks straddling the causal diagonal or a
+    cdiv-padded tail need any of it. Interior blocks (the vast majority
+    at long sequence: all-but-one block per row for causal 8k/512) take
+    the unmasked path. Both specializations are compiled; pl.when on
+    the (scalar) block coordinates picks one per grid step."""
+    tail = None
+    if seq_q % block_q != 0:
+        tail = qi == n_q - 1
+    if seq_k % block_k != 0:
+        t2 = kj == n_k - 1
+        tail = t2 if tail is None else (tail | t2)
+    if causal:
+        # active: block reaches at or below the diagonal.
+        active = kj * block_k <= (qi + 1) * block_q - 1
+        # edge: block straddles the diagonal (its top-right corner is
+        # strictly above it) — the only active blocks with invalid pairs.
+        edge = (kj + 1) * block_k - 1 > qi * block_q
+        if tail is not None:
+            edge = edge | tail
+
+        @pl.when(active & edge)
+        def _():
+            update(True)
+
+        @pl.when(active & jnp.logical_not(edge))
+        def _():
+            update(False)
+    elif tail is not None:
+        @pl.when(tail)
+        def _():
+            update(True)
+
+        @pl.when(jnp.logical_not(tail))
+        def _():
+            update(False)
+    else:
+        update(False)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc,
                 *, scale: float, causal: bool, block_q: int, block_k: int,
-                n_k: int, seq_q: int, seq_k: int):
+                n_q: int, n_k: int, seq_q: int, seq_k: int):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
 
@@ -78,20 +132,30 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc,
         l_sc[:] = jnp.zeros_like(l_sc)
         acc_sc[:] = jnp.zeros_like(acc_sc)
 
-    def _update():
-        q_ok = _row_ok(qi, block_q, seq_q)
-        k_ok = _row_ok(kj, block_k, seq_k)
-        q = jnp.where(q_ok, q_ref[0], 0)   # [BQ, D]
-        k = jnp.where(k_ok, k_ref[0], 0)   # [BK, D]
-        v = jnp.where(k_ok, v_ref[0], 0)
-        s, valid = _masked_scores(
-            q, k, qi, kj, scale=scale, causal=causal, block_q=block_q,
-            block_k=block_k, seq_q=seq_q, seq_k=seq_k)
+    def _update(masked):
+        # scale folded into q ([BQ, D] pass) instead of into the
+        # [BQ, BK] score block.
+        if masked:
+            q_ok = _row_ok(qi, block_q, seq_q)
+            k_ok = _row_ok(kj, block_k, seq_k)
+            q = jnp.where(q_ok, q_ref[0], 0) * scale   # [BQ, D]
+            k = jnp.where(k_ok, k_ref[0], 0)           # [BK, D]
+            v = jnp.where(k_ok, v_ref[0], 0)
+            s, valid = _masked_scores(
+                q, k, qi, kj, causal=causal, block_q=block_q,
+                block_k=block_k, seq_q=seq_q, seq_k=seq_k)
+        else:
+            q = q_ref[0] * scale
+            k = k_ref[0]
+            v = v_ref[0]
+            s = _scores(q, k)
 
         m_prev = m_sc[:, 0]                                # [BQ]
         m_new = jnp.maximum(m_prev, s.max(axis=-1))
         corr = jnp.exp(m_prev - m_new)
-        p = jnp.where(valid, jnp.exp(s - m_new[:, None]), 0.0)  # [BQ, BK]
+        p = jnp.exp(s - m_new[:, None])                    # [BQ, BK]
+        if masked:
+            p = jnp.where(valid, p, 0.0)
         l_sc[:, 0] = l_sc[:, 0] * corr + p.sum(axis=-1)
         acc_sc[:] = (acc_sc[:] * corr[:, None]
                      + jax.lax.dot_general(
@@ -100,13 +164,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc,
                          preferred_element_type=jnp.float32))
         m_sc[:, 0] = m_new
 
-    if causal:
-        # Blocks fully above the diagonal contribute nothing.
-        @pl.when(kj * block_k <= (qi + 1) * block_q - 1)
-        def _():
-            _update()
-    else:
-        _update()
+    _block_dispatch(_update, qi=qi, kj=kj, causal=causal,
+                    block_q=block_q, block_k=block_k, n_q=n_q, n_k=n_k,
+                    seq_q=seq_q, seq_k=seq_k)
 
     @pl.when(kj == n_k - 1)
     def _finalize():
@@ -118,7 +178,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc,
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_sc, dv_sc,
                 *, scale: float, causal: bool, block_q: int, block_k: int,
-                n_q: int, seq_q: int, seq_k: int):
+                n_q: int, n_k: int, seq_q: int, seq_k: int):
     kj = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -127,39 +187,55 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_sc[:] = jnp.zeros_like(dk_sc)
         dv_sc[:] = jnp.zeros_like(dv_sc)
 
-    def _update():
-        q_ok = _row_ok(qi, block_q, seq_q)
-        k_ok = _row_ok(kj, block_k, seq_k)
-        q = jnp.where(q_ok, q_ref[0], 0)   # [BQ, D]
-        k = jnp.where(k_ok, k_ref[0], 0)   # [BK, D]
-        v = jnp.where(k_ok, v_ref[0].astype(jnp.float32), 0)
-        do = jnp.where(q_ok, do_ref[0].astype(jnp.float32), 0)
-        lse = jnp.where(q_ok, lse_ref[0], 0)
-        delta = jnp.where(q_ok, delta_ref[0], 0)
-        s, valid = _masked_scores(
-            q, k, qi, kj, scale=scale, causal=causal, block_q=block_q,
-            block_k=block_k, seq_q=seq_q, seq_k=seq_k)
-        p = jnp.where(valid, jnp.exp(s - lse), 0.0)       # [BQ, BK]
+    def _update(masked):
+        # All matmul operands stay bf16 (fp32 accumulation via
+        # preferred_element_type) — fp32 operands run the MXU at a
+        # fraction of its bf16 rate and were the round-4 profile's
+        # single largest flash-kernel cost. q is pre-scaled, which
+        # also absorbs dK's trailing `* scale` (dK = dS^T (scale Q)).
+        if masked:
+            q_ok = _row_ok(qi, block_q, seq_q)
+            k_ok = _row_ok(kj, block_k, seq_k)
+            q = jnp.where(q_ok, q_ref[0], 0) * scale   # [BQ, D]
+            k = jnp.where(k_ok, k_ref[0], 0)           # [BK, D]
+            v = jnp.where(k_ok, v_ref[0], 0)
+            do = jnp.where(q_ok, do_ref[0], 0)
+            lse = jnp.where(q_ok, lse_ref[0], 0)
+            delta = jnp.where(q_ok, delta_ref[0], 0)
+            s, valid = _masked_scores(
+                q, k, qi, kj, causal=causal, block_q=block_q,
+                block_k=block_k, seq_q=seq_q, seq_k=seq_k)
+        else:
+            q = q_ref[0] * scale
+            k = k_ref[0]
+            v = v_ref[0]
+            do = do_ref[0]
+            lse = lse_ref[0]
+            delta = delta_ref[0]
+            s = _scores(q, k)
+        p = jnp.exp(s - lse)                              # [BQ, BK]
+        if masked:
+            p = jnp.where(valid, p, 0.0)
+        p_lo = p.astype(do.dtype)
         # dV += P^T dO
         dv_sc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p_lo, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         # dS = P * (dO V^T - delta)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # [BQ, BK]
-        ds = jnp.where(valid, p * (dp - delta), 0.0)
-        # dK += dS^T Q * scale
+        ds = p * (dp - delta)
+        if masked:
+            ds = jnp.where(valid, ds, 0.0)
+        # dK += dS^T (scale Q)
         dk_sc[:] += jax.lax.dot_general(
-            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    if causal:
-        @pl.when((qi + 1) * block_q - 1 >= kj * block_k)
-        def _():
-            _update()
-    else:
-        _update()
+    _block_dispatch(_update, qi=qi, kj=kj, causal=causal,
+                    block_q=block_q, block_k=block_k, n_q=n_q, n_k=n_k,
+                    seq_q=seq_q, seq_k=seq_k)
 
     @pl.when(qi == n_q - 1)
     def _finalize():
@@ -169,7 +245,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                dq_sc, *, scale: float, causal: bool, block_q: int,
-               block_k: int, n_k: int, seq_q: int, seq_k: int):
+               block_k: int, n_q: int, n_k: int, seq_q: int, seq_k: int):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
 
@@ -177,60 +253,76 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_sc[:] = jnp.zeros_like(dq_sc)
 
-    def _update():
-        q_ok = _row_ok(qi, block_q, seq_q)
-        k_ok = _row_ok(kj, block_k, seq_k)
-        q = jnp.where(q_ok, q_ref[0], 0)
-        k = jnp.where(k_ok, k_ref[0], 0)
-        v = jnp.where(k_ok, v_ref[0].astype(jnp.float32), 0)
-        do = jnp.where(q_ok, do_ref[0].astype(jnp.float32), 0)
-        lse = jnp.where(q_ok, lse_ref[0], 0)
-        delta = jnp.where(q_ok, delta_ref[0], 0)
-        s, valid = _masked_scores(
-            q, k, qi, kj, scale=scale, causal=causal, block_q=block_q,
-            block_k=block_k, seq_q=seq_q, seq_k=seq_k)
-        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+    def _update(masked):
+        # bf16 matmul operands, fp32 accumulation (see _dkv_kernel).
+        # The constant `* scale` on dQ moves to _finalize: one [BQ, D]
+        # pass per q-block instead of one per (q, k) block pair.
+        if masked:
+            q_ok = _row_ok(qi, block_q, seq_q)
+            k_ok = _row_ok(kj, block_k, seq_k)
+            q = jnp.where(q_ok, q_ref[0], 0) * scale
+            k = jnp.where(k_ok, k_ref[0], 0)
+            v = jnp.where(k_ok, v_ref[0], 0)
+            do = jnp.where(q_ok, do_ref[0], 0)
+            lse = jnp.where(q_ok, lse_ref[0], 0)
+            delta = jnp.where(q_ok, delta_ref[0], 0)
+            s, valid = _masked_scores(
+                q, k, qi, kj, causal=causal, block_q=block_q,
+                block_k=block_k, seq_q=seq_q, seq_k=seq_k)
+        else:
+            q = q_ref[0] * scale
+            k = k_ref[0]
+            v = v_ref[0]
+            do = do_ref[0]
+            lse = lse_ref[0]
+            delta = delta_ref[0]
+            s = _scores(q, k)
+        p = jnp.exp(s - lse)
+        if masked:
+            p = jnp.where(valid, p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = jnp.where(valid, p * (dp - delta), 0.0)
+        ds = p * (dp - delta)
+        if masked:
+            ds = jnp.where(valid, ds, 0.0)
         dq_sc[:] += jax.lax.dot_general(
-            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    if causal:
-        @pl.when(kj * block_k <= (qi + 1) * block_q - 1)
-        def _():
-            _update()
-    else:
-        _update()
+    _block_dispatch(_update, qi=qi, kj=kj, causal=causal,
+                    block_q=block_q, block_k=block_k, n_q=n_q, n_k=n_k,
+                    seq_q=seq_q, seq_k=seq_k)
 
     @pl.when(kj == n_k - 1)
     def _finalize():
-        dq_ref[0] = dq_sc[:].astype(dq_ref.dtype)
+        dq_ref[0] = (dq_sc[:] * scale).astype(dq_ref.dtype)
 
 
-def _default_block(block, interpret: bool, head_dim: int = 128) -> int:
+def _default_block(block, interpret: bool, head_dim: int = 128,
+                   cap: int = 1024) -> int:
     """Default tile size. Compiled Mosaic kernels want LARGE blocks —
-    measured on v5e at S=8192 with head_dim 128 the fwd+bwd step is 2.0x
-    faster at 512 than at 128 (fewer grid iterations re-streaming K/V
-    from HBM); 1024 exceeds the scoped VMEM budget and fails to compile.
-    The VMEM footprint scales with block*head_dim, so the compiled
-    default SHRINKS for larger head dims (256 at d=256), rounded DOWN
-    to a multiple of 128 for the TPU lane/sublane tiling and floored at
-    128 (so a huge head_dim still gets a legal — if over-budget —
-    block; pass explicit sizes there). It does NOT grow above 512 for
-    small head dims: block 1024 at head_dim 64 has the same nominal
-    footprint as 512x128 but overflows the 16M scoped-vmem stack in the
-    backward kernel (measured: 16.7M > 16M limit). The interpreter
-    keeps 128 so CPU tests stay fast. Blocks are clamped to the
-    sequence length either way."""
+    the kernels are bound by re-streaming K/V (fwd, dq) and Q/dO (dkv)
+    from HBM once per opposing block row, so doubling the block halves
+    that traffic. Measured on v5e at S=8192, head_dim 128 (calibrated
+    against the per-call tunnel overhead, experiments/flash_block_sweep
+    .py): fwd 29.2% MFU at 512x512 -> 49.9% at 1024x1024; the backward
+    kernels each cap the dimension they do NOT stream over at 512
+    (dkv 512x1024, dq 1024x512 — see _flash_bwd_rule) because
+    1024x1024 intermittently fails to compile (scoped-vmem) — hence
+    the per-kernel ``cap``. The VMEM
+    footprint scales with block*head_dim, so the compiled default
+    SHRINKS for larger head dims, rounded DOWN to a multiple of 128 for
+    the TPU lane/sublane tiling and floored at 128 (so a huge head_dim
+    still gets a legal — if over-budget — block; pass explicit sizes
+    there). The interpreter keeps 128 so CPU tests stay fast. Blocks
+    are clamped to the sequence length either way."""
     if block is not None:
         return block
     if interpret:
         return 128
-    b = 512 * 128 // max(head_dim, 1)
-    return max(128, min(512, b // 128 * 128))
+    b = cap * 128 // max(head_dim, 1)
+    return max(128, min(cap, b // 128 * 128))
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
@@ -243,7 +335,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, n_k=n_k, seq_q=s, seq_k=sk)
+        block_k=block_k, n_q=n_q, n_k=n_k, seq_q=s, seq_k=sk)
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, n_q, n_k),
@@ -280,9 +372,12 @@ def flash_attention(q, k, v, causal: bool = True,
 
     Exact (up to fp) vs full attention; O(seq) memory. ``interpret``
     routes through the Pallas interpreter (CPU tests); on TPU leave
-    False for the compiled Mosaic kernel. Block sizes default to 512
-    compiled / 128 interpreted (see _default_block — 512 measured 2x
-    faster end-to-end on v5e at long sequence).
+    False for the compiled Mosaic kernel. Compiled block sizes default
+    per kernel — forward 1024x1024, dK/dV 512x1024, dQ 1024x512 (each
+    kernel's streaming-vs-scoped-vmem optimum) — measured fastest on
+    v5e at head_dim 128 (see _default_block and _flash_bwd_rule);
+    explicit ``block_q``/``block_k`` override ALL kernels; interpreted
+    defaults stay 128.
     """
     out, _ = _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k,
                              interpret)
@@ -322,7 +417,13 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
     ob, gb = _to_bh(out), _to_bh(g)
     bh = qb.shape[0]
     sk = kb.shape[1]
-    bq = min(_default_block(block_q, interpret, d), s)
+    # The two backward kernels get opposite geometries: dkv re-streams
+    # Q/dO once per K-block row (wants LARGE block_k), dq re-streams
+    # K/V once per Q-block row (wants LARGE block_q). Both cap the
+    # other dimension at 512 — the [block_q, block_k] fp32
+    # intermediates at 1024x1024 blow the scoped-vmem budget.
+    # Explicit block_q/block_k override both kernels.
+    bq = min(_default_block(block_q, interpret, d, cap=512), s)
     bk = min(_default_block(block_k, interpret, d), sk)
     n_q = pl.cdiv(s, bq)
     n_k = pl.cdiv(sk, bk)
@@ -333,7 +434,7 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
 
     dkv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=sc, causal=causal,
-                          block_q=bq, block_k=bk, n_q=n_q,
+                          block_q=bq, block_k=bk, n_q=n_q, n_k=n_k,
                           seq_q=s, seq_k=sk),
         grid=(bh, n_k, n_q),
         in_specs=[
@@ -360,22 +461,26 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
     )(qb, kb, vb, gb, lse, delta)
     dk, dv = dkv
 
+    bq2 = min(_default_block(block_q, interpret, d), s)
+    bk2 = min(_default_block(block_k, interpret, d, cap=512), sk)
+    n_q2 = pl.cdiv(s, bq2)
+    n_k2 = pl.cdiv(sk, bk2)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=sc, causal=causal,
-                          block_q=bq, block_k=bk, n_k=n_k,
+                          block_q=bq2, block_k=bk2, n_q=n_q2, n_k=n_k2,
                           seq_q=s, seq_k=sk),
-        grid=(bh, n_q, n_k),
+        grid=(bh, n_q2, n_k2),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq2, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk2, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk2, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq2, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq2, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq2, 1), lambda b, i, j: (b, i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, bq2, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq2, d), jnp.float32)],
         interpret=interpret,
     )(qb, kb, vb, gb, lse, delta)
 
