@@ -98,6 +98,11 @@ CONFIGS.update({
                         logits_bf16=True, loss_chunk=2048),
     "long_batch4": dict(n_heads=6, batch=4, remat=False, use_flash=True,
                         logits_bf16=True, loss_chunk=512),
+    # Single row for the 16k demonstration (`--seq 16384 --configs
+    # long16k`): batch 1 is what fits; flash + chunked loss are what
+    # make it fit at all.
+    "long16k": dict(n_heads=6, batch=1, remat=False, use_flash=True,
+                    logits_bf16=True, loss_chunk=512),
 })
 
 

@@ -256,9 +256,14 @@ def _block(params, x, cfg: TransformerConfig, layer_idx: int):
                                  flash_block=cfg.flash_block,
                                  flash_interpret=flash_interp)
     elif cfg.sp_axis:
-        # Ring attention is already blockwise-O(S/n); use_flash does not
-        # apply to its inner per-block matmuls.
-        attn = ring_attention(q, k, v, axis_name=cfg.sp_axis, causal=True)
+        # Ring attention is blockwise ACROSS shards, but its plain
+        # inner op still materializes [shard, shard] scores; use_flash
+        # keys the per-shard-pair computation on this trace's SHARD
+        # length (each ring step attends q-shard x kv-shard).
+        attn = ring_attention(q, k, v, axis_name=cfg.sp_axis, causal=True,
+                              use_flash=use_flash,
+                              flash_block=cfg.flash_block,
+                              flash_interpret=flash_interp)
     elif use_flash:
         from ..ops.flash_attention import flash_attention
         # block sizes None -> tuned defaults (512 compiled / 128 interp)

@@ -325,7 +325,10 @@ def _default_block(block, interpret: bool, head_dim: int = 128,
     return max(128, min(cap, b // 128 * 128))
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+               out_dtype=None):
+    # out_dtype: ring attention requests fp32 per-block outputs so its
+    # streaming merge accumulates without an n-fold bf16 rounding.
     bh, s, d = q.shape
     sk = k.shape[1]
     block_q = min(_default_block(block_q, interpret, d), s)
@@ -349,7 +352,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), out_dtype or q.dtype),
             jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
         ],
         scratch_shapes=[
@@ -415,7 +418,27 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
     sc = _prep(q, scale)
     qb, kb, vb = _to_bh(q), _to_bh(k), _to_bh(v)
     ob, gb = _to_bh(out), _to_bh(g)
-    bh = qb.shape[0]
+
+    # delta = rowsum(dO * O) — the softmax-jacobian diagonal term.
+    delta = jnp.sum(gb.astype(jnp.float32) * ob.astype(jnp.float32),
+                    axis=-1, keepdims=True)                   # [bh, s, 1]
+
+    dq, dk, dv = _flash_bwd(qb, kb, vb, gb, lse, delta, sc, causal,
+                            block_q, block_k, interpret)
+    return (_from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h))
+
+
+def _flash_bwd(qb, kb, vb, gb, lse, delta, sc, causal, block_q, block_k,
+               interpret, out_dtype=None):
+    """Per-block backward passes on [bh, s, d] operands.
+
+    ``lse``/``delta`` are the GLOBAL per-query-row logsumexp and
+    softmax-jacobian diagonal — which is what makes these kernels
+    directly reusable by ring attention: each (q-shard, kv-block) pair's
+    gradient contribution only needs the block operands plus these two
+    global row statistics (p = exp(s - lse) is the true global softmax
+    restricted to the block)."""
+    bh, s, d = qb.shape
     sk = kb.shape[1]
     # The two backward kernels get opposite geometries: dkv re-streams
     # Q/dO once per K-block row (wants LARGE block_k), dq re-streams
@@ -427,10 +450,6 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
     bk = min(_default_block(block_k, interpret, d), sk)
     n_q = pl.cdiv(s, bq)
     n_k = pl.cdiv(sk, bk)
-
-    # delta = rowsum(dO * O) — the softmax-jacobian diagonal term.
-    delta = jnp.sum(gb.astype(jnp.float32) * ob.astype(jnp.float32),
-                    axis=-1, keepdims=True)                   # [bh, s, 1]
 
     dkv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=sc, causal=causal,
@@ -450,8 +469,8 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), out_dtype or kb.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), out_dtype or vb.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -479,12 +498,12 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
             pl.BlockSpec((1, bq2, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq2, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), out_dtype or qb.dtype),
         scratch_shapes=[pltpu.VMEM((bq2, d), jnp.float32)],
         interpret=interpret,
     )(qb, kb, vb, gb, lse, delta)
 
-    return (_from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h))
+    return dq, dk, dv
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

@@ -29,6 +29,15 @@ chip. Causal masking uses global position offsets per block.
 
 The loop is a ``lax.fori_loop`` (compiler-friendly static trip count); each
 step is two MXU matmuls over full blocks — no dynamic shapes.
+
+Two inner-op variants: the plain einsum step above materializes the
+[shard, shard] score tensor per step; :func:`ring_flash_attention`
+(``use_flash=True``) runs each (q-shard, resident-kv-block) pair through
+the Pallas flash kernels instead — O(shard) memory per device in forward
+AND backward (the custom backward re-rotates K/V with traveling fp32
+dK/dV accumulators and reuses the per-block flash backward kernels with
+the global logsumexp/delta row statistics), which is what lets the
+per-device shard itself be long.
 """
 
 from __future__ import annotations
@@ -81,14 +90,26 @@ def _ring_step(carry, _, axis_name: str, causal: bool, scale: float,
 
 
 def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None,
+                   use_flash: bool = False,
+                   flash_block: Optional[int] = None,
+                   flash_interpret: bool = False):
     """Blockwise ring attention over the ``axis_name`` mesh axis.
 
     Args (per-shard views inside shard_map):
       q, k, v: [batch, seq_shard, heads, head_dim]
+      use_flash: run each (q-shard, resident-kv-block) pair through the
+        Pallas flash kernels (:func:`ring_flash_attention`) instead of
+        materializing the [seq_shard, seq_shard] score tensor — O(shard)
+        memory per step in forward AND backward, which is what lets the
+        per-device shard itself be long.
     Returns: [batch, seq_shard, heads, head_dim] attention output for this
     device's query block, exact (up to fp) vs full attention.
     """
+    if use_flash:
+        return ring_flash_attention(
+            q, k, v, axis_name=axis_name, causal=causal, scale=scale,
+            block=flash_block, interpret=flash_interpret)
     b, sq, h, d = q.shape
     sk = k.shape[1]
     n = lax.axis_size(axis_name)
@@ -111,6 +132,171 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
     l = jnp.maximum(l, 1e-20)  # fully-masked rows (shouldn't occur causally)
     out = num / l.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
+
+
+def _ring_perm(n: int):
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def _rf_attend_cases(qb, kb, vb, sc, block, interpret, causal, branch):
+    """Per-ring-step flash forward: 0 = skip (block entirely above the
+    causal diagonal), 1 = diagonal block (intra-shard causal mask),
+    2 = past block (every pair valid, no mask). The pallas grids are
+    identical across branches, so lax.switch picks one per step without
+    shape mismatch."""
+    from ..ops.flash_attention import _flash_fwd
+
+    bh, sq, d = qb.shape
+
+    # fp32 per-block outputs: the merge accumulates across n blocks, and
+    # per-block rounding to bf16 would stack n-fold (the plain flash
+    # path rounds once over the whole sequence).
+    def skip(_):
+        return (jnp.zeros((bh, sq, d), jnp.float32),
+                jnp.full((bh, sq, 1), -jnp.inf, jnp.float32))
+
+    def diag(_):
+        return _flash_fwd(qb, kb, vb, sc, True, block, block, interpret,
+                          out_dtype=jnp.float32)
+
+    def past(_):
+        return _flash_fwd(qb, kb, vb, sc, False, block, block, interpret,
+                          out_dtype=jnp.float32)
+
+    if not causal:
+        return past(None)
+    return lax.switch(branch, (skip, diag, past), None)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def ring_flash_attention(q, k, v, axis_name: str = "sp",
+                         causal: bool = True,
+                         scale: Optional[float] = None,
+                         block: Optional[int] = None,
+                         interpret: bool = False):
+    """Ring attention with the Pallas flash kernels as the inner op.
+
+    The plain :func:`ring_attention` materializes the
+    [seq_shard, seq_shard] score tensor every ring step — O(shard²)
+    memory inside a layer whose purpose is O(shard) scaling. This
+    variant runs each (q-shard, resident-kv-block) pair through the
+    compiled flash forward (returning the per-block output and
+    logsumexp) and merges blocks with the streaming logaddexp rule; the
+    custom backward re-rotates K/V around the ring and reuses the
+    per-block flash backward kernels, which only need the block
+    operands plus the GLOBAL per-row (lse, delta) statistics
+    (ops/flash_attention._flash_bwd). Per-device memory is O(shard)
+    in forward and backward; gradients for each K/V block accumulate
+    in fp32 on the tuple that travels the ring and arrive home after
+    the full rotation.
+    """
+    out, _ = _ring_flash_fwd(q, k, v, axis_name, causal, scale, block,
+                             interpret)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale, block, interpret):
+    from ..ops.flash_attention import _from_bh, _to_bh
+
+    b, sq, h, d = q.shape
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    sc = scale if scale is not None else d ** -0.5
+    qb = _to_bh(q)
+    bh = qb.shape[0]
+
+    def step(carry, _):
+        kb_cur, vb_cur, out_r, lse_r, t = carry
+        j = (idx - t) % n
+        # branch: 0 skip (j > idx), 1 diagonal (j == idx), 2 past
+        branch = jnp.where(j > idx, 0, jnp.where(j == idx, 1, 2))
+        o_j, lse_j = _rf_attend_cases(
+            qb, kb_cur, vb_cur, sc, block, interpret, causal, branch)
+        # Streaming merge of normalized per-block outputs: weights are
+        # exp(lse_j - lse_tot). Guard the no-mass-yet rows (-inf - -inf).
+        lse_new = jnp.logaddexp(lse_r, lse_j)
+        w_r = jnp.where(jnp.isfinite(lse_r), jnp.exp(lse_r - lse_new), 0.0)
+        w_j = jnp.where(jnp.isfinite(lse_j), jnp.exp(lse_j - lse_new), 0.0)
+        out_new = out_r * w_r + o_j * w_j
+        k_nxt = lax.ppermute(kb_cur, axis_name, _ring_perm(n))
+        v_nxt = lax.ppermute(vb_cur, axis_name, _ring_perm(n))
+        return (k_nxt, v_nxt, out_new, lse_new, t + 1), None
+
+    out0 = jnp.zeros((bh, sq, d), jnp.float32)
+    lse0 = jnp.full((bh, sq, 1), -jnp.inf, jnp.float32)
+    # K/V rotate in [bh, s, d] layout: the transpose to kernel layout
+    # happens once here, not once per ring step (ppermute is
+    # layout-agnostic).
+    (k_fin, v_fin, out_r, lse_r, _), _ = lax.scan(
+        step, (_to_bh(k), _to_bh(v), out0, lse0, jnp.zeros((), jnp.int32)),
+        None, length=n)
+    del k_fin, v_fin  # back at home position after n rotations
+    out4 = _from_bh(out_r.astype(q.dtype), b, h)
+    return out4, (q, k, v, out4, lse_r)
+
+
+def _ring_flash_bwd(axis_name, causal, scale, block, interpret, res, g):
+    from ..ops.flash_attention import _flash_bwd, _from_bh, _to_bh
+
+    q, k, v, out, lse = res
+    b, sq, h, d = q.shape
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    sc = scale if scale is not None else d ** -0.5
+    qb, gb, ob = _to_bh(q), _to_bh(g), _to_bh(out)
+    bh = qb.shape[0]
+
+    # Global softmax-jacobian diagonal, same for every block pair.
+    delta = jnp.sum(gb.astype(jnp.float32) * ob.astype(jnp.float32),
+                    axis=-1, keepdims=True)                 # [bh, sq, 1]
+
+    def bwd_cases(kb, vb, branch):
+        def skip(_):
+            return (jnp.zeros((bh, sq, d), jnp.float32),
+                    jnp.zeros((bh, sq, d), jnp.float32),
+                    jnp.zeros((bh, sq, d), jnp.float32))
+
+        def run(is_diag):
+            def f(_):
+                # fp32 kernel outputs: each traveling accumulator sums n
+                # per-pair contributions, so per-block bf16 rounding
+                # would stack n-fold.
+                return _flash_bwd(qb, kb, vb, gb, lse, delta, sc,
+                                  is_diag, block, block, interpret,
+                                  out_dtype=jnp.float32)
+            return f
+
+        if not causal:
+            return run(False)(None)
+        return lax.switch(branch, (skip, run(True), run(False)), None)
+
+    def step(carry, _):
+        kb_cur, vb_cur, dk_acc, dv_acc, dq_acc, t = carry
+        j = (idx - t) % n
+        branch = jnp.where(j > idx, 0, jnp.where(j == idx, 1, 2))
+        dq_j, dk_j, dv_j = bwd_cases(kb_cur, vb_cur, branch)
+        dq_acc = dq_acc + dq_j
+        dk_acc = dk_acc + dk_j
+        dv_acc = dv_acc + dv_j
+        # dk/dv accumulators travel WITH their block around the ring.
+        perm = _ring_perm(n)
+        k_nxt = lax.ppermute(kb_cur, axis_name, perm)
+        v_nxt = lax.ppermute(vb_cur, axis_name, perm)
+        dk_nxt = lax.ppermute(dk_acc, axis_name, perm)
+        dv_nxt = lax.ppermute(dv_acc, axis_name, perm)
+        return (k_nxt, v_nxt, dk_nxt, dv_nxt, dq_acc, t + 1), None
+
+    z = jnp.zeros((bh, sq, d), jnp.float32)
+    (k_fin, v_fin, dk, dv, dq, _), _ = lax.scan(
+        step, (_to_bh(k), _to_bh(v), z, z, z, jnp.zeros((), jnp.int32)),
+        None, length=n)
+    del k_fin, v_fin  # home again; dk/dv completed the full rotation too
+    return (_from_bh(dq.astype(q.dtype), b, h),
+            _from_bh(dk.astype(k.dtype), b, h),
+            _from_bh(dv.astype(v.dtype), b, h))
+
+
+ring_flash_attention.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 def full_attention(q, k, v, *, causal: bool = True,
