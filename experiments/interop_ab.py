@@ -1,0 +1,115 @@
+"""Shim-tax A/B: DLPack zero-copy boundary vs numpy fallback.
+
+Pushes a ResNet-50-shaped gradient set (~170 tensors, ~24M params,
+~90 MB fp32) through the torch shim's async allreduce path — the
+DistributedOptimizer hook flow — with HOROVOD_TPU_DLPACK toggled
+in-process (interop reads the env per call), interleaved rounds.
+
+Isolation: on a multi-device mesh the fused collective itself costs
+1.5-5 s/step (measured) and drowns a ~90 MB boundary copy, so the
+default arm runs a 1-DEVICE CPU mesh where allreduce over one rank is
+near-identity and step time ≈ the shim boundary cost — the tax the
+VERDICT item names. AB_DEVICES=8 measures the end-to-end (diluted)
+ratio instead.
+
+  JAX_PLATFORMS=cpu python experiments/interop_ab.py            # tax
+  AB_DEVICES=8 JAX_PLATFORMS=cpu python experiments/interop_ab.py
+
+Prints one JSON line with both modes' step rates and the ratio.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+if __name__ == "__main__":
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count="
+        + os.environ.get("AB_DEVICES", "1"))
+    if os.environ.get("JAX_PLATFORMS"):
+        # The axon sitecustomize re-forces JAX_PLATFORMS=axon; config
+        # update (the conftest trick) is what actually sticks.
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import numpy as np  # noqa: E402
+import torch  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+import horovod_tpu.torch as hvd_torch  # noqa: E402
+from horovod_tpu.utils import interop  # noqa: E402
+
+ITERS = int(os.environ.get("AB_ITERS", 30))
+WARMUP = int(os.environ.get("AB_WARMUP", 5))
+ROUNDS = int(os.environ.get("AB_ROUNDS", 3))
+
+# ResNet-50 parameter-shape histogram (conv kernels + BN pairs + fc),
+# close enough for boundary-cost purposes: dominated by a few large
+# tensors with a long tail of small ones, 25.5M params total.
+SHAPES = (
+    [(2048, 512, 1, 1)] * 3 + [(512, 2048, 1, 1)] * 3
+    + [(512, 512, 3, 3)] * 3 + [(1024, 256, 1, 1)] * 6
+    + [(256, 1024, 1, 1)] * 6 + [(256, 256, 3, 3)] * 6
+    + [(512, 128, 1, 1)] * 4 + [(128, 512, 1, 1)] * 4
+    + [(128, 128, 3, 3)] * 4 + [(256, 64, 1, 1)] * 3
+    + [(64, 256, 1, 1)] * 3 + [(64, 64, 3, 3)] * 3
+    + [(1000, 2048)] + [(64, 3, 7, 7)]
+    + [(512,)] * 30 + [(256,)] * 30 + [(1024,)] * 20 + [(2048,)] * 10
+    + [(128,)] * 20 + (lambda: [(64,)] * 10)()
+)
+
+
+def step(grads):
+    handles = [hvd_torch.allreduce_async_(g, average=True,
+                                          name=f"ab.grad.{i}")
+               for i, g in enumerate(grads)]
+    for h in handles:
+        hvd_torch.synchronize(h)
+
+
+def run_mode(dlpack_on: bool, grads) -> float:
+    os.environ["HOROVOD_TPU_DLPACK"] = "1" if dlpack_on else "0"
+    for _ in range(WARMUP):
+        step(grads)
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        step(grads)
+    dt = time.perf_counter() - t0
+    return ITERS / dt
+
+
+def main():
+    hvd.init()
+    grads = [torch.randn(*s, dtype=torch.float32) for s in SHAPES]
+    nbytes = sum(g.numel() * 4 for g in grads)
+    print(f"# {len(grads)} tensors, {nbytes/2**20:.1f} MiB/step, "
+          f"size={hvd.size()}", file=sys.stderr)
+
+    on, off = [], []
+    for r in range(ROUNDS):
+        off.append(run_mode(False, grads))
+        on.append(run_mode(True, grads))
+    interop.reset_stats()
+    os.environ["HOROVOD_TPU_DLPACK"] = "1"
+    step(grads)
+    s = interop.stats()
+
+    on_m, off_m = float(np.median(on)), float(np.median(off))
+    print(json.dumps({
+        "metric": "interop_dlpack_speedup",
+        "value": round(on_m / off_m, 4),
+        "unit": "dlpack/numpy step-rate ratio",
+        "dlpack_steps_per_s": round(on_m, 3),
+        "numpy_steps_per_s": round(off_m, 3),
+        "mb_per_step": round(nbytes / 2**20, 1),
+        "rounds_on": [round(x, 3) for x in on],
+        "rounds_off": [round(x, 3) for x in off],
+        "fastpath_stats_one_step": s,
+        "platform": __import__("jax").default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+"""Compiled-TPU validation of ring_flash_attention (fwd + bwd).
+
+Multi-chip hardware isn't reachable from this box, but the full ring
+code path — lax.scan over ring steps, the branch switch, the streaming
+logaddexp merge, the custom VJP with traveling dk/dv accumulators, and
+the COMPILED Mosaic flash kernels (interpret=False) — runs on one real
+chip under ``jax.vmap`` with an ``axis_name``: vmap binds the axis so
+``ppermute``/``axis_index`` execute sequentially on-device with
+identical semantics to the multi-chip mesh. The only thing this does
+not cover is the physical ICI transfer, which is XLA's, not ours.
+
+Backward uses jax.vjp *inside* the vmap lane with the per-lane
+cotangent (2*out for a sum-of-squares loss) — grad-of-psum under vmap
+hits JAX's psum-transpose convention and is NOT the multi-chip
+semantics, so it is deliberately avoided here.
+
+Prints one JSON line; tee to ring_flash_tpu.log. Referenced from
+docs/parallelism.md (ring-flash auto-select validation).
+"""
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from horovod_tpu.parallel.ring_attention import (  # noqa: E402
+    ring_flash_attention, full_attention)
+
+B, S, H, D, N = 2, 4096, 8, 64, 4   # 1024-token shards: the auto-select
+BLOCK = None                        # regime (>=1024 attended tokens)
+DTYPE = jnp.bfloat16
+
+
+def main():
+    backend = jax.default_backend()
+    interpret = backend != "tpu"
+    key = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), DTYPE)
+               for kk in jax.random.split(key, 3))
+
+    def shard(x):
+        return x.reshape(B, N, S // N, H, D).transpose(1, 0, 2, 3, 4)
+
+    def unshard(y):
+        return y.transpose(1, 0, 2, 3, 4).reshape(B, S, H, D)
+
+    def ring(qs, ks, vs):
+        return ring_flash_attention(qs, ks, vs, "sp", True, None,
+                                    BLOCK, interpret)
+
+    @jax.jit
+    def fwd(qs, ks, vs):
+        return jax.vmap(ring, axis_name="sp")(qs, ks, vs)
+
+    @jax.jit
+    def bwd(qs, ks, vs):
+        def local(qs, ks, vs):
+            out, vjp = jax.vjp(ring, qs, ks, vs)
+            return vjp((2.0 * out.astype(jnp.float32)).astype(qs.dtype))
+        return jax.vmap(local, axis_name="sp")(qs, ks, vs)
+
+    t0 = time.time()
+    out = unshard(jax.block_until_ready(fwd(shard(q), shard(k), shard(v))))
+    dq, dk, dv = (unshard(g) for g in
+                  jax.block_until_ready(bwd(shard(q), shard(k), shard(v))))
+    elapsed = time.time() - t0
+
+    ref = full_attention(q, k, v, causal=True)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(
+            full_attention(q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+    rq, rk, rv = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+
+    def err(a, b):
+        sc = float(jnp.max(jnp.abs(b.astype(jnp.float32)))) or 1.0
+        return float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                     - b.astype(jnp.float32)))) / sc
+
+    errs = {"fwd": err(out, ref), "dq": err(dq, rq),
+            "dk": err(dk, rk), "dv": err(dv, rv)}
+    # bf16 operands: ~8 mantissa bits => relative tolerance ~2%.
+    ok = all(e < 0.05 for e in errs.values())
+    print(json.dumps({
+        "metric": "ring_flash_compiled_validation",
+        "value": max(errs.values()),
+        "unit": "max relative error (vs full attention, bf16)",
+        "ok": ok, "errors": {k2: round(e, 5) for k2, e in errs.items()},
+        "backend": backend, "interpret": interpret,
+        "shape": [B, S, H, D], "ring_shards": N,
+        "elapsed_s": round(elapsed, 1),
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,11 +8,11 @@
 
 from .collective import (Handle, allgather, allgather_async, allreduce,
                          allreduce_async, broadcast, broadcast_async,
-                         engine, grouped_allreduce, poll, reset_engine,
-                         synchronize, HorovodInternalError)
+                         engine, grouped_allreduce, launch_lock, poll,
+                         reset_engine, synchronize, HorovodInternalError)
 
 __all__ = [
     "Handle", "allreduce", "allreduce_async", "allgather", "allgather_async",
-    "broadcast", "broadcast_async", "grouped_allreduce", "poll",
-    "synchronize", "engine", "reset_engine", "HorovodInternalError",
+    "broadcast", "broadcast_async", "grouped_allreduce", "launch_lock",
+    "poll", "synchronize", "engine", "reset_engine", "HorovodInternalError",
 ]

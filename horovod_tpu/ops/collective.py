@@ -78,6 +78,34 @@ class HorovodInternalError(RuntimeError):
     pass
 
 
+# Process-global launch lock for HOROVOD_TPU_ORDERED_LAUNCH=1: the engine
+# takes it around each fused-collective enqueue, and producer streams take
+# it via launch_lock() around their mesh-wide jit calls, making the host's
+# launch order total WITHOUT waiting for producer completion (the fence's
+# cost). Measured caveat (experiments/ordered_launch_ab.py): on the CPU
+# backend PJRT fans executions out to per-device queues AFTER the Python
+# call returns, so this ordering does NOT close the rendezvous-inversion
+# window there — the completion fence stays the default.
+_LAUNCH_LOCK = threading.RLock()
+
+
+@contextlib.contextmanager
+def launch_lock():
+    """Order a producer launch against the engine's collective launches
+    (ordered-launch mode). Wrap mesh-wide jit calls whose outputs feed
+    eager collectives:
+
+        with hvd.ops.launch_lock():
+            grads = train_grads(params, batch)   # mesh-wide jit
+        handles = [hvd.allreduce_async(g) for g in grads]
+
+    A no-op contract note: taking the lock is only required when
+    HOROVOD_TPU_ORDERED_LAUNCH=1; under the default fence policy it is
+    harmless but unnecessary."""
+    with _LAUNCH_LOCK:
+        yield
+
+
 class Handle:
     """Async operation handle (torch/handle_manager.{h,cc} equivalent)."""
 
@@ -215,6 +243,7 @@ class CollectiveEngine:
         # other engine knob, and no environ/device lookups on the
         # per-group launch hot path.
         self._fence_decision: Optional[bool] = None
+        self._ordered_decision: Optional[bool] = None
         self.mp_params: Dict = {}
         # name -> (latest coordinator missing-ranks stall line, wall time)
         # in MP mode; entries expire after 2x the warning window.
@@ -1215,8 +1244,26 @@ class CollectiveEngine:
                                     else jax.local_device_count() > 1)
         return self._fence_decision
 
+    def _ordered_launch(self) -> bool:
+        """HOROVOD_TPU_ORDERED_LAUNCH=1 (read once, like every engine
+        knob): replace the completion fence with enqueue-ordering under
+        _LAUNCH_LOCK. Prototype for platforms whose per-device enqueue
+        is host-call-ordered; see utils/env.ordered_launch for the
+        measured CPU-backend caveat."""
+        if self._ordered_decision is None:
+            self._ordered_decision = _env.ordered_launch()
+        return self._ordered_decision
+
     def _execute_group(self, ex: CollectiveExecutor,
                        group: List[_Request]) -> List:
+        if self._ordered_launch():
+            # Enqueue-ordered launch: no producer completion wait; the
+            # lock only serializes the enqueue against producer streams
+            # that take launch_lock(). The XLA dispatch below returns
+            # futures, so the lock hold time is the enqueue, not the
+            # collective.
+            with _LAUNCH_LOCK:
+                return self._execute_group_ops(ex, group)
         if self._fence_producers():
             # Multi-device process: retire producers first (see
             # _fence_producers). Tensors that are already on device and
@@ -1234,6 +1281,10 @@ class CollectiveEngine:
                         pending.append(t)
             if pending:
                 jax.block_until_ready(pending)
+        return self._execute_group_ops(ex, group)
+
+    def _execute_group_ops(self, ex: CollectiveExecutor,
+                           group: List[_Request]) -> List:
         op = group[0].op
         if op == ALLREDUCE:
             if group[0].sharded:

@@ -152,6 +152,13 @@ def _rf_attend_cases(qb, kb, vb, sc, block, interpret, causal, branch):
     # per-block rounding to bf16 would stack n-fold (the plain flash
     # path rounds once over the whole sequence).
     def skip(_):
+        # Sentinel contract: skip emits lse = -inf (true "no mass"),
+        # while the flash kernels emit _NEG_INF (-1e30, finite) for
+        # massless rows. The ring merge's isfinite() guards are pinned
+        # to THIS -inf: they zero the weight of never-attended rows so
+        # (-inf) - (-inf) can't produce NaN. _NEG_INF rows pass the
+        # guard but their exp() underflows to 0 against any real mass.
+        # Keep both facts in mind before editing the merge arithmetic.
         return (jnp.zeros((bh, sq, d), jnp.float32),
                 jnp.full((bh, sq, 1), -jnp.inf, jnp.float32))
 
@@ -213,7 +220,9 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, scale, block, interpret):
         o_j, lse_j = _rf_attend_cases(
             qb, kb_cur, vb_cur, sc, block, interpret, causal, branch)
         # Streaming merge of normalized per-block outputs: weights are
-        # exp(lse_j - lse_tot). Guard the no-mass-yet rows (-inf - -inf).
+        # exp(lse_j - lse_tot). The isfinite guards are pinned to the
+        # -inf sentinel (skip branch + lse0 init); flash's finite
+        # _NEG_INF massless rows pass them and underflow to weight 0.
         lse_new = jnp.logaddexp(lse_r, lse_j)
         w_r = jnp.where(jnp.isfinite(lse_r), jnp.exp(lse_r - lse_new), 0.0)
         w_j = jnp.where(jnp.isfinite(lse_j), jnp.exp(lse_j - lse_new), 0.0)

@@ -12,7 +12,8 @@ reference's registered gradients (tensorflow/mpi_ops.py:94-183),
 Where the reference registers a TF ``AsyncOpKernel`` that enqueues into
 the MPI coordinator (mpi_ops.cc:281-303), this shim bridges with
 ``tf.py_function`` into the TPU-native XLA engine: eager tensors cross
-via numpy; inside a traced ``tf.function`` the py_function node plays the
+zero-copy via DLPack (utils/interop.py; numpy fallback for 64-bit wire);
+inside a traced ``tf.function`` the py_function node plays the
 AsyncOpKernel's role (a host callback that blocks on the engine handle).
 TF stays the autograd engine; the collectives run on the XLA data plane.
 
@@ -33,6 +34,7 @@ import tensorflow as tf
 from .. import ops as _ops
 from .. import topology as _topo
 from ..compression import Compression
+from ..utils import interop as _interop
 from ..topology import (init, shutdown, is_initialized, rank, local_rank,
                         size, local_size, mpi_threads_supported)
 
@@ -67,9 +69,27 @@ def _np(x: tf.Tensor) -> np.ndarray:
     return arr
 
 
-def _hvd_allreduce_host(x: tf.Tensor, average: bool, name: str) -> np.ndarray:
-    out = _ops.allreduce(_np(x), average=average, name=name or None)
-    return np.asarray(out)
+def _ingress(x: tf.Tensor):
+    """Eager tensor -> engine payload: DLPack zero-copy (utils/interop)
+    when the dtype/device permit, numpy otherwise."""
+    a = _interop.try_tf_to_jax(x)
+    return a if a is not None else _np(x)
+
+
+def _egress(out, want_dtype) -> tf.Tensor:
+    """Engine result -> tf.Tensor for the py_function return: zero-copy
+    DLPack when the buffer exports, else one host copy. py_function does
+    NOT cast EagerTensor returns to Tout, so cast here (the 64-bit wire
+    narrows to 32-bit in 32-bit JAX mode)."""
+    res = _interop.jax_to_tf(out)
+    if res.dtype != want_dtype:
+        res = tf.cast(res, want_dtype)
+    return res
+
+
+def _hvd_allreduce_host(x: tf.Tensor, average: bool, name: str) -> tf.Tensor:
+    out = _ops.allreduce(_ingress(x), average=average, name=name or None)
+    return _egress(out, x.dtype)
 
 
 def _py_collective(host_fn, inputs: tf.Tensor, out_dtype, out_shape):
@@ -90,8 +110,8 @@ def _grouped_bridge(submit_async, tensors):
     def host(*vs):
         _bridge_calls[0] += 1
         with _ops.engine().burst():
-            handles = [submit_async(i, _np(v)) for i, v in enumerate(vs)]
-        return [np.asarray(h.wait()) for h in handles]
+            handles = [submit_async(i, _ingress(v)) for i, v in enumerate(vs)]
+        return [_egress(h.wait(), v.dtype) for v, h in zip(vs, handles)]
 
     outs = tf.py_function(host, list(tensors),
                           Tout=[t.dtype.base_dtype if hasattr(t, "dtype")
@@ -234,7 +254,7 @@ def allgather(tensor, name: Optional[str] = None):
         dim0 = x.shape[0]
 
         def host(v):
-            return np.asarray(_ops.allgather(_np(v), name=nm))
+            return _egress(_ops.allgather(_ingress(v), name=nm), v.dtype)
 
         out_shape = tf.TensorShape(
             [None if dim0 is None else dim0 * _topo.size()]
@@ -261,7 +281,8 @@ def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
     @tf.custom_gradient
     def _op(x):
         def host(v):
-            return np.asarray(_ops.broadcast(_np(v), root_rank, name=nm))
+            return _egress(_ops.broadcast(_ingress(v), root_rank, name=nm),
+                           v.dtype)
 
         out = _py_collective(host, x, x.dtype, x.shape)
 

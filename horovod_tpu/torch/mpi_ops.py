@@ -7,10 +7,14 @@ integer-handle ``poll``/``synchronize`` semantics, and autograd Functions
 whose backward passes are themselves collectives (torch/mpi_ops.py:110-121,
 236-254, 318-332).
 
-Where the reference moves THTensor memory into the MPI/NCCL fusion buffer,
-this shim moves torch (CPU) tensors across the numpy boundary into the JAX
-collective engine (the XLA data plane) and back. bfloat16 — which numpy
-lacks — crosses as a uint16 bit-pattern reinterpreted via ml_dtypes.
+Where the reference operates on the tensor's own memory
+(torch/adapter_v2.cc:40-105), this shim hands torch (CPU) tensors to the
+JAX collective engine zero-copy via DLPack (utils/interop.py) — bf16
+crosses natively — and aliases engine output buffers on the way back.
+The numpy fallback path covers what DLPack can't carry exactly: 64-bit
+dtypes in 32-bit JAX mode (as int32 bit pairs for movement collectives,
+reinterpreted via ml_dtypes for bf16), non-contiguous tensors, and
+non-exportable output buffers (real-TPU outputs cross via one D2H copy).
 """
 
 from __future__ import annotations
@@ -24,6 +28,7 @@ import torch
 from .. import ops as _ops
 from ..ops import HorovodInternalError
 from .. import topology as _topo
+from ..utils import interop as _interop
 
 try:
     import ml_dtypes as _mld
@@ -51,6 +56,15 @@ def _to_numpy(t: torch.Tensor) -> np.ndarray:
     return t.numpy()
 
 
+def _ingress(t: torch.Tensor):
+    """Tensor -> engine payload: DLPack zero-copy when possible, numpy
+    otherwise. The payload aliases the tensor's memory either way (for a
+    contiguous CPU tensor ``.numpy()`` is also an alias); the engine's
+    device_put is the one real transfer."""
+    a = _interop.try_torch_to_jax(t)
+    return a if a is not None else _to_numpy(t)
+
+
 def _bits32(t: torch.Tensor) -> np.ndarray:
     """Reinterpret a 64-bit tensor as int32 pairs — exact transport for
     data-movement collectives (broadcast/allgather) under 32-bit JAX."""
@@ -63,7 +77,7 @@ def _bits32(t: torch.Tensor) -> np.ndarray:
 
 
 def _to_torch(a, dtype: torch.dtype, from_bits: bool = False) -> torch.Tensor:
-    arr = np.asarray(a)
+    arr = _interop.to_host(a)
     if from_bits:
         bits = torch.from_numpy(np.ascontiguousarray(arr).copy())
         return bits.view(dtype)
@@ -122,7 +136,16 @@ def synchronize(handle: int) -> torch.Tensor:
     if th is None:
         raise ValueError(f"Unknown handle {handle}")
     out = th.inner.wait()
-    result = _to_torch(out, th.dtype, from_bits=th.from_bits)
+    result = None
+    if not th.from_bits:
+        # Zero-copy egress: alias the engine's output buffer via DLPack
+        # (shard-0 of the replicated result). The handle was just popped,
+        # so nothing else references that buffer.
+        aliased = _interop.try_jax_to_torch(out)
+        if aliased is not None and aliased.dtype == th.dtype:
+            result = aliased
+    if result is None:
+        result = _to_torch(out, th.dtype, from_bits=th.from_bits)
     if th.target is not None:
         with torch.no_grad():
             th.target.copy_(result.reshape(th.target.shape))
@@ -142,7 +165,7 @@ def allreduce_async(tensor: torch.Tensor, average: bool = True,
 
     64-bit reductions without jax_enable_x64 are rejected by the engine's
     narrowing guard (ops/collective.py::_prep) at enqueue time."""
-    arr = _to_numpy(tensor)
+    arr = _ingress(tensor)
     inner = _ops.allreduce_async(arr, average=average, name=name)
     return _register(_TorchHandle(inner, tensor.dtype, tensor.shape))
 
@@ -150,18 +173,19 @@ def allreduce_async(tensor: torch.Tensor, average: bool = True,
 def allreduce_async_(tensor: torch.Tensor, average: bool = True,
                      name: Optional[str] = None) -> int:
     """In-place: the result lands in ``tensor`` (torch/mpi_ops.py:182-207)."""
-    arr = _to_numpy(tensor)
+    arr = _ingress(tensor)
     inner = _ops.allreduce_async(arr, average=average, name=name)
     return _register(
         _TorchHandle(inner, tensor.dtype, tensor.shape, target=tensor))
 
 
 def _movement_payload(tensor: torch.Tensor):
-    """(numpy array, from_bits) for data-movement collectives: 64-bit
-    dtypes travel as exact int32 bit pairs when JAX is in 32-bit mode."""
+    """(engine payload, from_bits) for data-movement collectives: 64-bit
+    dtypes travel as exact int32 bit pairs when JAX is in 32-bit mode;
+    everything else crosses via DLPack when possible."""
     if tensor.dtype in _64BIT and not _x64_enabled():
         return _bits32(tensor), True
-    return _to_numpy(tensor), False
+    return _ingress(tensor), False
 
 
 def allgather_async(tensor: torch.Tensor, name: Optional[str] = None) -> int:

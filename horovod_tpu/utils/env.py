@@ -89,6 +89,26 @@ def device_pack() -> Optional[bool]:
     return v != "0"
 
 
+def ordered_launch() -> bool:
+    """HOROVOD_TPU_ORDERED_LAUNCH=1: replace the producer completion
+    fence with enqueue-ordering under a process-global launch lock
+    (ops.collective.launch_lock()). PROTOTYPE, default off: measured on
+    the CPU backend (experiments/ordered_launch_ab.py), PJRT's
+    cross-device fan-out happens after the Python execute call returns,
+    so host-side ordering cannot prevent rendezvous inversion there —
+    the completion fence remains the safe default. The flag exists for
+    real multi-chip TPU experimentation, where per-device enqueue is
+    host-call-ordered."""
+    return _get("ORDERED_LAUNCH") == "1"
+
+
+def dlpack_boundary() -> bool:
+    """DLPack zero-copy at the framework-shim boundary (utils/interop).
+    Default on; HOROVOD_TPU_DLPACK=0 forces the numpy fallback path —
+    the A/B lever for measuring the shim tax (experiments/interop_ab)."""
+    return _get("DLPACK") not in ("0",)
+
+
 def hierarchical_allreduce() -> bool:
     return _get("HIERARCHICAL_ALLREDUCE") not in (None, "", "0")
 

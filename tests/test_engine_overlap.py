@@ -77,6 +77,72 @@ print("OK")
         assert "OK" in proc.stdout
 
 
+class TestOrderedLaunch:
+    """HOROVOD_TPU_ORDERED_LAUNCH prototype (VERDICT r4 next #4):
+    enqueue-ordering under a process-global launch lock instead of the
+    completion fence. The 4-of-8 producer-feeding rendezvous scenario
+    must pass with it on; the unrelated-stream scenario still aborts
+    even fully locked (measured, experiments/ordered_launch_hazard.log
+    — PJRT CPU fans out post-call), which is why the fence remains the
+    default."""
+
+    def test_knob_default_off(self, monkeypatch):
+        eng = collective.engine()
+        monkeypatch.delenv("HOROVOD_TPU_ORDERED_LAUNCH", raising=False)
+        monkeypatch.setattr(eng, "_ordered_decision", None)
+        assert eng._ordered_launch() is False
+
+    def test_launch_lock_reentrant_and_exported(self):
+        import horovod_tpu.ops as ops
+        with ops.launch_lock():
+            with ops.launch_lock():   # reentrant by design
+                pass
+
+    def test_rendezvous_regression_with_ordered_launch_on(self):
+        """The producer-feeding scenario under ordered-launch: producers
+        wrapped in launch_lock(), engine launching under the same lock,
+        no completion fence. Runs in a subprocess (the knob is read-once
+        engine state)."""
+        script = r"""
+import os, time
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["HOROVOD_TPU_ORDERED_LAUNCH"] = "1"
+os.environ["HOROVOD_TPU_PRODUCER_FENCE"] = "0"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+import horovod_tpu as hvd
+from horovod_tpu.ops import launch_lock
+from jax.sharding import NamedSharding, PartitionSpec as P
+hvd.init()
+mesh = hvd.mesh()
+
+@jax.jit
+def producer(x, i):
+    return jnp.tanh(x) * 0 + i
+
+x = jax.device_put(jnp.ones((256,), jnp.float32), NamedSharding(mesh, P()))
+for round_i in range(10):
+    with launch_lock():
+        ys = [producer(x, float(i)) for i in range(4)]
+    hs = [hvd.allreduce_async(y, name=f"ol.{round_i}.{i}", average=False)
+          for i, y in enumerate(ys)]
+    for i, h in enumerate(hs):
+        np.testing.assert_allclose(np.asarray(h.wait(timeout=30.0)),
+                                   float(i) * hvd.size())
+print("ORDERED_OK")
+"""
+        env = dict(os.environ)
+        proc = subprocess.run(
+            [sys.executable, "-c", script], env=env, capture_output=True,
+            text=True, timeout=300,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "ORDERED_OK" in proc.stdout
+
+
 class TestRendezvousScenario:
     def test_mesh_producers_feeding_eager_collectives(self):
         """The observed 4-of-8 deadlock scenario (VERDICT r2): a

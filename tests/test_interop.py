@@ -1,0 +1,192 @@
+"""DLPack zero-copy boundary tests (utils/interop.py).
+
+BASELINE.json's north star: framework shims hand gradients to the JAX
+collective path via DLPack. These tests prove the no-copy claim directly
+— pointer identity between the framework tensor and the jax buffer on
+ingress, buffer aliasing on egress — plus exact fallback behavior for
+everything DLPack cannot carry (64-bit truncation hazard, non-contiguous
+tensors, sharded outputs). Reference parity anchor: the torch adapter
+operates on the tensor's own memory (torch/adapter_v2.cc:40-105).
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+import horovod_tpu.torch as hvd_torch
+from horovod_tpu.utils import interop
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    hvd.init()
+    interop.reset_stats()
+    yield
+
+
+# ---------------------------------------------------------------------------
+# Ingress: torch -> jax
+# ---------------------------------------------------------------------------
+
+def test_torch_ingress_zero_copy_pointer_identity():
+    t = torch.arange(64, dtype=torch.float32)
+    a = interop.try_torch_to_jax(t)
+    assert a is not None
+    assert t.data_ptr() == a.unsafe_buffer_pointer()
+    assert interop.stats()["dlpack_in"] == 1
+
+
+@pytest.mark.parametrize("dtype", [torch.float16, torch.bfloat16,
+                                   torch.float32, torch.int32,
+                                   torch.uint8, torch.int8])
+def test_torch_ingress_dtypes_alias(dtype):
+    t = torch.ones(32, dtype=dtype)
+    a = interop.try_torch_to_jax(t)
+    assert a is not None
+    assert t.data_ptr() == a.unsafe_buffer_pointer()
+
+
+def test_torch_ingress_bf16_carried_natively():
+    t = torch.full((16,), 1.5, dtype=torch.bfloat16)
+    a = interop.try_torch_to_jax(t)
+    assert a is not None and a.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(a, dtype=np.float32), 1.5)
+
+
+def test_torch_ingress_mutation_visible_through_alias():
+    # Proof the buffer is shared, not snapshotted.
+    t = torch.zeros(8, dtype=torch.float32)
+    a = interop.try_torch_to_jax(t)
+    t[0] = 42.0
+    assert float(a[0]) == 42.0
+
+
+def test_torch_ingress_64bit_falls_back():
+    # jax.dlpack would TRUNCATE int64 (2**40 -> 0); must refuse.
+    t = torch.tensor([2**40], dtype=torch.int64)
+    assert interop.try_torch_to_jax(t) is None
+    assert interop.stats()["numpy_in"] == 1
+
+
+def test_torch_ingress_complex128_falls_back():
+    # jax.dlpack would silently narrow complex128 -> complex64.
+    t = torch.tensor([1 + 2j], dtype=torch.complex128)
+    assert interop.try_torch_to_jax(t) is None
+
+
+def test_tf_ingress_wide_dtypes_fall_back():
+    tf = pytest.importorskip("tensorflow")
+    for dt, val in [("uint64", 2**40 + 5), ("int64", 2**40),
+                    ("float64", 1.0), ("complex128", 1 + 2j)]:
+        t = tf.constant([val], dtype=getattr(tf, dt))
+        assert interop.try_tf_to_jax(t) is None, dt
+
+
+def test_torch_ingress_noncontiguous_falls_back():
+    t = torch.arange(16, dtype=torch.float32).reshape(4, 4).t()
+    assert interop.try_torch_to_jax(t) is None
+
+
+def test_torch_ingress_requires_grad_ok():
+    t = torch.ones(4, requires_grad=True)
+    a = interop.try_torch_to_jax(t)
+    assert a is not None  # detached internally
+
+
+# ---------------------------------------------------------------------------
+# Egress: jax -> torch
+# ---------------------------------------------------------------------------
+
+def test_jax_egress_unsharded_alias():
+    x = jnp.arange(32, dtype=jnp.float32) * 2
+    t = interop.try_jax_to_torch(x)
+    assert t is not None
+    assert t.data_ptr() == x.unsafe_buffer_pointer()
+
+
+def test_jax_egress_replicated_uses_shard0():
+    # Engine outputs are replicated over the mesh; egress must alias
+    # shard 0 rather than copy.
+    out = hvd.allreduce(np.arange(16, dtype=np.float32), average=False)
+    assert len(out.sharding.device_set) > 1 and \
+        out.sharding.is_fully_replicated
+    t = interop.try_jax_to_torch(out)
+    assert t is not None
+    shard0 = out.addressable_shards[0].data
+    assert t.data_ptr() == shard0.unsafe_buffer_pointer()
+    np.testing.assert_allclose(t.numpy(),
+                               np.arange(16, dtype=np.float32) * hvd.size())
+
+
+def test_jax_egress_dp_sharded_falls_back():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = hvd.topology.mesh()
+    x = jax.device_put(jnp.arange(16, dtype=jnp.float32),
+                       NamedSharding(mesh, P("dp")))
+    assert interop.try_jax_to_torch(x) is None
+
+
+def test_to_host_single_copy_on_replicated():
+    out = hvd.allreduce(np.ones(8, dtype=np.float32), average=False)
+    arr = interop.to_host(out)
+    np.testing.assert_allclose(arr, hvd.size())
+
+
+# ---------------------------------------------------------------------------
+# Shim-level: the fast path actually runs through hvd.torch
+# ---------------------------------------------------------------------------
+
+def test_torch_allreduce_uses_dlpack_both_ways():
+    t = torch.ones(128, dtype=torch.float32)
+    out = hvd_torch.allreduce(t, average=False)
+    np.testing.assert_allclose(out.numpy(), hvd.size())
+    s = interop.stats()
+    assert s["dlpack_in"] >= 1, "ingress took the numpy fallback"
+    assert s["dlpack_out"] >= 1, "egress took the numpy fallback"
+
+
+def test_torch_allreduce_bf16_dlpack():
+    t = torch.full((64,), 2.0, dtype=torch.bfloat16)
+    out = hvd_torch.allreduce(t, average=False)
+    assert out.dtype == torch.bfloat16
+    np.testing.assert_allclose(out.float().numpy(), 2.0 * hvd.size())
+    assert interop.stats()["dlpack_in"] >= 1
+
+
+def test_torch_inplace_allreduce_dlpack_source():
+    t = torch.ones(32, dtype=torch.float32)
+    hvd_torch.allreduce_(t, average=False)
+    np.testing.assert_allclose(t.numpy(), hvd.size())
+
+
+def test_torch_int64_movement_still_exact():
+    # 64-bit movement collectives keep the int32 bit-pair transport.
+    t = torch.tensor([2**40 + 7, -3], dtype=torch.int64)
+    out = hvd_torch.broadcast(t, root_rank=0)
+    assert out.tolist() == [2**40 + 7, -3]
+
+
+def test_torch_egress_result_is_private_buffer():
+    # Two successive collectives must not hand back the same buffer.
+    a = hvd_torch.allreduce(torch.ones(16), average=False)
+    b = hvd_torch.allreduce(torch.full((16,), 2.0), average=False)
+    assert a.data_ptr() != b.data_ptr()
+    np.testing.assert_allclose(a.numpy(), hvd.size())
+    np.testing.assert_allclose(b.numpy(), 2.0 * hvd.size())
+
+
+def test_torch_grouped_many_tensors_fast_path():
+    interop.reset_stats()
+    ts = [torch.full((8,), float(i)) for i in range(10)]
+    handles = [hvd_torch.allreduce_async(t, average=False) for t in ts]
+    outs = [hvd_torch.synchronize(h) for h in handles]
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(o.numpy(), float(i) * hvd.size())
+    s = interop.stats()
+    assert s["dlpack_in"] == 10
+    assert s["numpy_in"] == 0
