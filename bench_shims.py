@@ -1,0 +1,318 @@
+#!/usr/bin/env python
+"""Framework-shim throughput on a real model — the tracked config every
+published chip number so far bypassed (VERDICT r4 missing #2; BASELINE
+tracks "BERT-Large fine-tune (Keras, Tensor-Fusion bucketed grad
+allreduce)"; reference methodology docs/benchmarks.md:40-63).
+
+Four arms, each in its own subprocess (backend env isolation), all on
+whatever accelerator is attached (the real chip under axon):
+
+  jax        — pure-JAX 111M GPT train step (models/transformer), both
+               per-call (K=1, the dispatch shape every shim has) and
+               K-chained (the bench_lm headline shape). The K=1 row is
+               the honest control for the shims: through the axon
+               tunnel each host->device call carries ~100 ms, which is
+               plumbing every per-step framework loop pays.
+  keras_fit  — the SAME 111M architecture as a Keras 3 model (jax
+               backend) trained with model.fit under
+               horovod_tpu.keras.DistributedOptimizer.
+  torch      — GPT-style torch model (torch is CPU-only here) under
+               horovod_tpu.torch.DistributedOptimizer: grads cross the
+               DLPack boundary into the TPU engine each step. Control:
+               the identical model/step WITHOUT the shim — the delta is
+               the whole shim+engine+chip round trip.
+  bucketed   — BERT-Large-shaped gradient set (393 tensors, ~340M
+               params fp32) through the Keras shim's bucketed batch
+               path (_engine_allreduce_batch) on the chip: the
+               Tensor-Fusion bucketed grad-allreduce config itself.
+
+Writes BENCH_SHIMS.json and prints it.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+ITERS = int(os.environ.get("SHIM_BENCH_ITERS", 5))
+WARM = int(os.environ.get("SHIM_BENCH_WARM", 3))
+
+# The matched 111M config (bench_lm ladder shape, short-seq variant so
+# the Keras/torch python loops turn steps in seconds).
+SEQ, BATCH = 512, 8
+ARCH = dict(vocab=32000, d_model=768, n_layers=12, n_heads=12, d_ff=3072)
+
+COMMON = f"""
+import json, os, sys, time
+sys.path.insert(0, {REPO!r})
+import numpy as np
+SEQ, BATCH = {SEQ}, {BATCH}
+ARCH = {ARCH!r}
+ITERS, WARM = {ITERS}, {WARM}
+"""
+
+ARM_JAX = COMMON + """
+import jax, jax.numpy as jnp, optax
+from functools import partial
+from horovod_tpu.models import transformer as tfm
+
+cfg = tfm.TransformerConfig(vocab=ARCH["vocab"], d_model=ARCH["d_model"],
+                            n_layers=ARCH["n_layers"], d_ff=ARCH["d_ff"],
+                            max_seq=SEQ, dtype=jnp.bfloat16)
+params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+n_params = sum(int(np.prod(l.shape))
+               for l in jax.tree_util.tree_leaves(params))
+tokens = jax.random.randint(jax.random.PRNGKey(1), (BATCH, SEQ), 0,
+                            ARCH["vocab"])
+targets = jnp.roll(tokens, -1, axis=1)
+opt = optax.adamw(3e-4)
+state = opt.init(params)
+
+@partial(jax.jit, donate_argnums=(0, 1), static_argnums=(2,))
+def train_k(p, s, k):
+    def body(_, carry):
+        p, s = carry
+        _, g = jax.value_and_grad(
+            lambda p: tfm.loss_fn(p, tokens, targets, cfg))(p)
+        up, s = opt.update(g, s, p)
+        return optax.apply_updates(p, up), s
+    return jax.lax.fori_loop(0, k, body, (p, s))
+
+def run(k, iters):
+    global params, state
+    for _ in range(WARM):
+        params, state = train_k(params, state, k)
+    float(jnp.sum(params["ln_f"]))
+    rates = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        params, state = train_k(params, state, k)
+        float(jnp.sum(params["ln_f"]))
+        rates.append(BATCH * SEQ * k / (time.perf_counter() - t0))
+    return float(np.median(rates))
+
+tok_k1 = run(1, ITERS * 3)
+tok_k10 = run(10, ITERS)
+print(json.dumps({"arm": "jax", "tok_s_per_call": round(tok_k1, 0),
+                  "tok_s_chained10": round(tok_k10, 0),
+                  "params_m": round(n_params / 1e6, 1),
+                  "backend": jax.default_backend()}))
+"""
+
+ARM_KERAS = COMMON + """
+os.environ["KERAS_BACKEND"] = "jax"
+import jax
+import keras
+import horovod_tpu.keras as hvd_keras
+
+hvd_keras.init()
+
+def block(x, i):
+    h = keras.layers.MultiHeadAttention(
+        num_heads=ARCH["n_heads"], key_dim=ARCH["d_model"] // ARCH["n_heads"],
+        name=f"attn{i}")(x, x, use_causal_mask=True)
+    x = keras.layers.LayerNormalization(name=f"ln1_{i}")(x + h)
+    h = keras.layers.Dense(ARCH["d_ff"], activation="gelu",
+                           name=f"ffi{i}")(x)
+    h = keras.layers.Dense(ARCH["d_model"], name=f"ffo{i}")(h)
+    return keras.layers.LayerNormalization(name=f"ln2_{i}")(x + h)
+
+inp = keras.Input((SEQ,), dtype="int32")
+x = keras.layers.Embedding(ARCH["vocab"], ARCH["d_model"])(inp)
+for i in range(ARCH["n_layers"]):
+    x = block(x, i)
+out = keras.layers.Dense(ARCH["vocab"], name="unembed")(x)
+model = keras.Model(inp, out)
+
+opt = hvd_keras.DistributedOptimizer(keras.optimizers.AdamW(3e-4))
+model.compile(optimizer=opt,
+              loss=keras.losses.SparseCategoricalCrossentropy(
+                  from_logits=True))
+
+rng = np.random.RandomState(0)
+steps = ITERS + WARM
+xs = rng.randint(0, ARCH["vocab"], size=(BATCH * steps, SEQ)).astype("int32")
+ys = np.roll(xs, -1, axis=1)
+
+model.fit(xs[:BATCH * WARM], ys[:BATCH * WARM], batch_size=BATCH,
+          epochs=1, verbose=0)                      # compile + warm
+t0 = time.perf_counter()
+model.fit(xs[BATCH * WARM:], ys[BATCH * WARM:], batch_size=BATCH,
+          epochs=1, verbose=0)
+dt = time.perf_counter() - t0
+print(json.dumps({"arm": "keras_fit",
+                  "tok_s": round(BATCH * SEQ * ITERS / dt, 0),
+                  "params_m": round(model.count_params() / 1e6, 1),
+                  "backend": keras.backend.backend(),
+                  "wrapped": type(model.optimizer).__name__}))
+"""
+
+ARM_TORCH = COMMON + """
+if os.environ.get("FORCE_CPU") == "1":
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+import torch, torch.nn as nn, torch.nn.functional as F
+use_shim = os.environ.get("TORCH_SHIM") == "1"
+
+D, L, H, V, S, B = 512, 8, 8, ARCH["vocab"], 256, 2
+torch.manual_seed(0)
+
+class Block(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.attn = nn.MultiheadAttention(D, H, batch_first=True)
+        self.ln1, self.ln2 = nn.LayerNorm(D), nn.LayerNorm(D)
+        self.ff = nn.Sequential(nn.Linear(D, 4 * D), nn.GELU(),
+                                nn.Linear(4 * D, D))
+    def forward(self, x, mask):
+        h, _ = self.attn(x, x, x, attn_mask=mask, need_weights=False)
+        x = self.ln1(x + h)
+        return self.ln2(x + self.ff(x))
+
+class GPT(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.emb = nn.Embedding(V, D)
+        self.blocks = nn.ModuleList(Block() for _ in range(L))
+        self.out = nn.Linear(D, V)
+    def forward(self, idx):
+        mask = torch.triu(torch.full((S, S), float("-inf")), 1)
+        x = self.emb(idx)
+        for b in self.blocks:
+            x = b(x, mask)
+        return self.out(x)
+
+model = GPT()
+n_params = sum(p.numel() for p in model.parameters())
+opt = torch.optim.SGD(model.parameters(), lr=1e-3)
+if use_shim:
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+idx = torch.randint(0, V, (B, S))
+tgt = torch.roll(idx, -1, 1)
+
+def step():
+    opt.zero_grad()
+    loss = F.cross_entropy(model(idx).reshape(-1, V), tgt.reshape(-1))
+    loss.backward()
+    opt.step()
+
+for _ in range(WARM):
+    step()
+rates = []
+for _ in range(ITERS):
+    t0 = time.perf_counter()
+    step()
+    rates.append(B * S / (time.perf_counter() - t0))
+stats = {}
+backend = "none"
+if use_shim:
+    import jax
+    from horovod_tpu.utils import interop
+    backend = jax.default_backend()
+    interop.reset_stats()
+    step()
+    stats = interop.stats()
+arm = "torch_plain"
+if use_shim:
+    arm = "torch_shim_cpu" if os.environ.get("FORCE_CPU") == "1" \
+        else "torch_shim"
+print(json.dumps({"arm": arm,
+                  "tok_s": round(float(np.median(rates)), 1),
+                  "params_m": round(n_params / 1e6, 1),
+                  "grad_mb_per_step": round(n_params * 4 / 2**20, 1),
+                  "backend": backend,
+                  "interop_one_step": stats}))
+"""
+
+ARM_BUCKETED = COMMON + """
+import horovod_tpu as hvd
+from horovod_tpu.keras import _engine_allreduce_batch
+hvd.init()
+
+# BERT-Large (340M): 24 layers x (4 x 1024x1024 attn + 1024x4096 +
+# 4096x1024 ffn + biases + 2 LN pairs) + embeddings.
+shapes = [(30522, 1024), (512, 1024), (2, 1024), (1024,), (1024,)]
+for _ in range(24):
+    shapes += [(1024, 1024)] * 4 + [(1024,)] * 4
+    shapes += [(1024, 4096), (4096,), (4096, 1024), (1024,)]
+    shapes += [(1024,), (1024,)] * 2
+rng = np.random.RandomState(0)
+grads = [rng.randn(*s).astype(np.float32) for s in shapes]
+names = [f"bert.{i}" for i in range(len(grads))]
+nbytes = sum(g.nbytes for g in grads)
+
+for _ in range(WARM):
+    _engine_allreduce_batch(grads, names, None)
+rates = []
+for _ in range(ITERS):
+    t0 = time.perf_counter()
+    _engine_allreduce_batch(grads, names, None)
+    rates.append(time.perf_counter() - t0)
+import jax
+med = float(np.median(rates))
+print(json.dumps({"arm": "bucketed_bert_large",
+                  "tensors": len(grads),
+                  "params_m": round(nbytes / 4 / 1e6, 1),
+                  "step_s": round(med, 3),
+                  "gb_s": round(nbytes / 1e9 / med, 2),
+                  "backend": jax.default_backend()}))
+"""
+
+
+def run_arm(code: str, extra_env=None, timeout=3600) -> dict:
+    env = dict(os.environ)
+    env.update(extra_env or {})
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=timeout,
+                         cwd=REPO)
+    if out.returncode != 0:
+        raise RuntimeError(f"arm failed:\n{out.stderr[-3000:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main():
+    rows = {}
+    rows["jax"] = run_arm(ARM_JAX)
+    rows["keras_fit"] = run_arm(ARM_KERAS)
+    rows["torch_plain"] = run_arm(ARM_TORCH, {"TORCH_SHIM": "0"})
+    rows["torch_shim"] = run_arm(ARM_TORCH, {"TORCH_SHIM": "1"})
+    rows["torch_shim_cpu"] = run_arm(
+        ARM_TORCH, {"TORCH_SHIM": "1", "FORCE_CPU": "1"})
+    rows["bucketed"] = run_arm(ARM_BUCKETED)
+
+    j, k = rows["jax"], rows["keras_fit"]
+    tp = rows["torch_plain"]
+    result = {
+        "metric": "framework_shim_throughput",
+        "value": round(k["tok_s"] / j["tok_s_per_call"], 3),
+        "unit": "keras-fit / pure-jax-per-call tok rate",
+        "torch_shim_retention_chip": round(
+            rows["torch_shim"]["tok_s"] / tp["tok_s"], 3),
+        "torch_shim_retention_cpu": round(
+            rows["torch_shim_cpu"]["tok_s"] / tp["tok_s"], 3),
+        "rows": rows,
+        "note": ("per-call rows share the ~100 ms/step axon-tunnel "
+                 "dispatch floor; chained10 is the bench_lm headline "
+                 "shape no per-step framework loop can use. The chip "
+                 "torch row is bound by this box's D2H tunnel (~27 MB/s "
+                 "ceiling / ~70 ms floor, measured — every gradient "
+                 "must return to torch host memory each step); the cpu "
+                 "row is the same shim with a memcpy boundary and "
+                 "isolates the shim's intrinsic cost."),
+    }
+    with open(os.path.join(REPO, "BENCH_SHIMS.json"), "w") as f:
+        f.write(json.dumps(result) + "\n")
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -154,9 +154,14 @@ def _engine_allreduce_batch(arrs, names, compression):
             handles.append((_ops.allreduce_async(wire, average=True,
                                                  name=nm),
                             ctx, arr.dtype))
+    # Batched readback: one device_get for the whole group instead of a
+    # per-gradient round trip (utils/interop.to_host_many — the
+    # bridge-batching fix the BENCH_SHIMS measurement exposed).
+    from ..utils.interop import to_host_many
+    waited = to_host_many([h.wait() for h, _, _ in handles])
     outs = []
-    for h, ctx, dt in handles:
-        out = comp.decompress(h.wait(), ctx)
+    for (h, ctx, dt), out in zip(handles, waited):
+        out = comp.decompress(out, ctx)
         outs.append(np.asarray(out, dtype=dt))
     return outs
 

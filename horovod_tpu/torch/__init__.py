@@ -23,7 +23,8 @@ from .compression import Compression
 from .mpi_ops import (allreduce, allreduce_, allreduce_async,
                       allreduce_async_, allgather, allgather_async,
                       broadcast, broadcast_, broadcast_async,
-                      broadcast_async_, poll, synchronize)
+                      broadcast_async_, poll, synchronize,
+                      synchronize_many)
 
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "local_rank", "size",
@@ -124,8 +125,12 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             self._handles[id(p)] = self._allreduce_grad_async(p)
         params_by_id = {id(p): p for group in self.param_groups
                         for p in group["params"]}
-        for pid, handle in self._handles.items():
-            out = synchronize(handle)
+        # Batched synchronize: one device_get for every non-aliasable
+        # result instead of a per-parameter readback round trip
+        # (mpi_ops.synchronize_many).
+        pids = list(self._handles.keys())
+        outs = synchronize_many([self._handles[pid] for pid in pids])
+        for pid, out in zip(pids, outs):
             p = params_by_id[pid]
             ctx = self._wire_ctx.pop(pid, None)
             if out is not p.grad:

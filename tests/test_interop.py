@@ -180,6 +180,34 @@ def test_torch_egress_result_is_private_buffer():
     np.testing.assert_allclose(b.numpy(), 2.0 * hvd.size())
 
 
+def test_synchronize_many_batched_readback():
+    """Batch synchronize: mixed in-place / out-of-place / 64-bit-bits
+    handles resolve correctly through the single device_get path."""
+    t1 = torch.ones(16, dtype=torch.float32)            # in-place
+    t2 = torch.full((8,), 2.0, dtype=torch.bfloat16)    # bf16
+    t3 = torch.tensor([2**40 + 3], dtype=torch.int64)   # bits transport
+    hs = [hvd_torch.allreduce_async_(t1, average=False, name="sm.a"),
+          hvd_torch.allreduce_async(t2, average=False, name="sm.b"),
+          hvd_torch.broadcast_async(t3, 0, name="sm.c")]
+    outs = hvd_torch.synchronize_many(hs)
+    assert outs[0] is t1
+    np.testing.assert_allclose(t1.numpy(), hvd.size())
+    assert outs[1].dtype == torch.bfloat16
+    np.testing.assert_allclose(outs[1].float().numpy(), 2.0 * hvd.size())
+    assert outs[2].tolist() == [2**40 + 3]
+    with pytest.raises(ValueError):
+        hvd_torch.synchronize(hs[0])  # already cleared
+
+
+def test_to_host_many_matches_per_array():
+    import jax.numpy as jnp
+    outs = [hvd.allreduce(np.full(8, float(i), np.float32), average=False)
+            for i in range(4)]
+    hosts = interop.to_host_many(outs)
+    for i, h in enumerate(hosts):
+        np.testing.assert_allclose(h, float(i) * hvd.size())
+
+
 def test_torch_grouped_many_tensors_fast_path():
     interop.reset_stats()
     ts = [torch.full((8,), float(i)) for i in range(10)]
