@@ -303,11 +303,14 @@ def main():
         "note": ("per-call rows share the ~100 ms/step axon-tunnel "
                  "dispatch floor; chained10 is the bench_lm headline "
                  "shape no per-step framework loop can use. The chip "
-                 "torch row is bound by this box's D2H tunnel (~27 MB/s "
-                 "ceiling / ~70 ms floor, measured — every gradient "
-                 "must return to torch host memory each step); the cpu "
-                 "row is the same shim with a memcpy boundary and "
-                 "isolates the shim's intrinsic cost."),
+                 "torch row and the bucketed row are bound by this "
+                 "box's D2H tunnel, whose measured bandwidth varied "
+                 "5-27 MB/s across the session (packed single-transfer "
+                 "and per-array reads measured equally slow at the low "
+                 "end - it is the link, not the boundary code); every "
+                 "gradient must return to torch host memory each step. "
+                 "The cpu row is the same shim with a memcpy boundary "
+                 "and isolates the shim's intrinsic cost."),
     }
     with open(os.path.join(REPO, "BENCH_SHIMS.json"), "w") as f:
         f.write(json.dumps(result) + "\n")

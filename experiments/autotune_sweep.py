@@ -11,7 +11,8 @@ an HOROVOD_AUTOTUNE=1 arm on the same workload: if the grid shows a
 negative (the knobs themselves have no headroom on this plane, so no
 tuner could).
 
-Run: python experiments/autotune_sweep.py   (writes autotune_sweep.log)
+Run: python experiments/autotune_sweep.py > experiments/autotune_sweep.log
+(one JSON line on stdout; progress markers on stderr)
 """
 import json
 import os

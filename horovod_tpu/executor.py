@@ -53,19 +53,19 @@ ALLGATHER = 1
 BROADCAST = 2
 
 
-# Fusion-buffer size quantum for the host-assembled multi-process path:
-# buffers are padded up to this many elements (256 KiB at fp32) so the
-# compiled program is keyed by a handful of quantized sizes instead of
-# exact group compositions. Small buffers round to a power of two (min
-# 512) to bound tiny-size program variety. The 64-byte atomic unit of the
-# reference's fusion buffer (FUSION_BUFFER_ATOMIC_UNIT, operations.h:52-54)
-# divides both.
-_FUSION_QUANTUM = 65536
-
-
+# Fusion-buffer size quantization for the host-assembled multi-process
+# path (min 512 elements; a power of two is always a multiple of the
+# reference fusion buffer's 64-byte atomic unit,
+# FUSION_BUFFER_ATOMIC_UNIT, operations.h:52-54).
 def _fusion_padded_size(n: int) -> int:
-    if n >= _FUSION_QUANTUM:
-        return ((n + _FUSION_QUANTUM - 1) // _FUSION_QUANTUM) * _FUSION_QUANTUM
+    """Power-of-two padded size at every scale. Linear (quantum-step)
+    padding let the coordinator's timing-dependent group compositions
+    produce a fresh padded size almost every step, and padded size keys
+    BOTH the fused reduce program and the per-tensor unpack slices — a
+    120-tensor MP group measured 11 s/step of per-composition
+    recompiles. Power-of-two bounds the distinct paddeds to ~log2 of
+    the size range, so the program caches converge after warmup; the
+    cost is <=2x transient buffer memory."""
     p = 512
     while p < n:
         p *= 2
@@ -83,6 +83,16 @@ def _accum_dtype(dtype) -> Optional[np.dtype]:
     return None
 
 
+# Cached unpack programs keyed by (tensor shape/dtype, buffer
+# shape/dtype) with the OFFSET as a traced scalar — the same
+# compile-stability trick as _pack_device. An eager dynamic_slice bakes
+# the Python-int offset in as a constant, so every timing-dependent MP
+# group composition recompiled one slice program per tensor per step
+# (measured: 13 s of a 15 s step on a 120-tensor group; the round-5
+# autotune sweep's 10x "threshold pocket" was exactly this cost).
+_UNPACK_CACHE: Dict = {}
+
+
 def _unpack(out, arrs, idxs, results) -> None:
     """Device-side unpack of a fused buffer shared by every
     _run_fused_buffers branch: slice each tensor's span back out,
@@ -90,8 +100,16 @@ def _unpack(out, arrs, idxs, results) -> None:
     off = 0
     for i in idxs:
         a = arrs[i]
-        piece = jax.lax.dynamic_slice(out, (off,), (a.size,))
-        results[i] = piece.reshape(a.shape).astype(a.dtype)
+        key = (tuple(a.shape), str(a.dtype), out.shape, str(out.dtype))
+        prog = _UNPACK_CACHE.get(key)
+        if prog is None:
+            size, shape, dt = int(a.size), tuple(a.shape), a.dtype
+            prog = jax.jit(
+                lambda b, o, _s=size, _sh=shape, _dt=dt:
+                jax.lax.dynamic_slice(b, (o,), (_s,))
+                .reshape(_sh).astype(_dt))
+            _UNPACK_CACHE[key] = prog
+        results[i] = prog(out, np.int32(off))
         off += a.size
 
 
@@ -736,14 +754,27 @@ class CollectiveExecutor:
                 off += flat.size
 
             if host_op is not None:
-                # jnp.asarray ONCE on the fused buffer, then slice on
-                # device (same pattern as the XLA branch below): the XLA
-                # path fulfills handles with device-committed jax.Arrays
-                # and the two data planes must hand callers the same
-                # type — but per-tensor transfers would pay hundreds of
-                # small H2D round-trips on a parameter-broadcast burst.
-                out = jnp.asarray(np.asarray(host_op(buf)))
-                _unpack(out, arrs, idxs, results)
+                # The reduced buffer is HOST memory (the shm plane's
+                # truth). CPU backend: slice it in numpy (free views,
+                # no device programs at all). Accelerator backends: ONE
+                # whole-buffer H2D then the cached traced-offset device
+                # slices (_UNPACK_CACHE) — per-tensor jnp.asarray would
+                # pay one H2D round trip per tensor on a
+                # parameter-broadcast burst, and the compile storm the
+                # device path used to have is fixed by the offset-traced
+                # programs + power-of-two padding.
+                host_out = np.asarray(host_op(buf))
+                if jax.default_backend() == "cpu":
+                    off = 0
+                    for i in idxs:
+                        a = arrs[i]
+                        piece = host_out[off:off + a.size].reshape(a.shape)
+                        if piece.dtype != a.dtype:
+                            piece = piece.astype(a.dtype)
+                        results[i] = jnp.asarray(piece)
+                        off += a.size
+                else:
+                    _unpack(jnp.asarray(host_out), arrs, idxs, results)
                 continue
 
             key = key_fn(padded, str(buf_dt))

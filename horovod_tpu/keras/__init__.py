@@ -185,8 +185,12 @@ def _tf_graph_allreduce_batch(gs, names, compression):
                     arr = arr.astype(wire_np)
                 handles.append(_ops.allreduce_async(arr, average=True,
                                                     name=nm))
-        return [np.asarray(h.wait(), dtype=dt)
-                for h, dt in zip(handles, dts)]
+        # Batched readback (interop.to_host_many): one device_get for
+        # the group, not one round trip per gradient.
+        from ..utils.interop import to_host_many
+        waited = to_host_many([h.wait() for h in handles])
+        return [np.asarray(out, dtype=dt)
+                for out, dt in zip(waited, dts)]
 
     outs = tf.py_function(host, list(gs), Tout=[g.dtype for g in gs])
     if len(gs) == 1 and not isinstance(outs, (list, tuple)):
