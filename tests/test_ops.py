@@ -453,3 +453,61 @@ assert elapsed[0] < 0.5, (
             cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         assert proc.returncode == 0, (proc.stdout[-2000:],
                                       proc.stderr[-2000:])
+
+
+class TestFusedProgramStability:
+    """Round-5 regression guards for the MP compile storm: padded sizes
+    and unpack programs must be stable across timing-dependent group
+    compositions (a 120-tensor group measured 11 s/step of
+    per-composition recompiles before the fix)."""
+
+    def test_padded_size_power_of_two(self):
+        from horovod_tpu.executor import _fusion_padded_size
+        for n in (1, 511, 512, 513, 100_000, 15_500_000):
+            p = _fusion_padded_size(n)
+            assert p >= max(n, 512)
+            assert p & (p - 1) == 0, f"padded {p} not a power of two"
+        # Different compositions of the same total quantize together:
+        # any n in (2^k/2, 2^k] lands on 2^k.
+        assert _fusion_padded_size(9_000_000) == \
+            _fusion_padded_size(16_000_000)
+
+    def test_unpack_cache_stable_across_compositions(self):
+        """Same tensor shapes at DIFFERENT offsets (different group
+        compositions) must reuse the same compiled slice programs —
+        offsets are traced, not baked in."""
+        import jax.numpy as jnp
+        from horovod_tpu import executor as ex
+
+        ex._UNPACK_CACHE.clear()
+        buf = jnp.arange(2048, dtype=jnp.float32)
+        arrs = [np.zeros((128,), np.float32), np.zeros((64,), np.float32)]
+        res: list = [None, None]
+        ex._unpack(buf, arrs, [0, 1], res)
+        np.testing.assert_allclose(np.asarray(res[0]), np.arange(128.0))
+        keys_after_first = len(ex._UNPACK_CACHE)
+        # Second composition: same shapes, swapped order => new offsets.
+        res2: list = [None, None]
+        ex._unpack(buf, [arrs[1], arrs[0]], [0, 1], res2)
+        np.testing.assert_allclose(np.asarray(res2[0]), np.arange(64.0))
+        np.testing.assert_allclose(np.asarray(res2[1]),
+                                   np.arange(64.0, 64.0 + 128.0))
+        assert len(ex._UNPACK_CACHE) == keys_after_first, (
+            "unpack compiled new programs for a recomposition of the "
+            "same shapes - offsets are being baked in again")
+
+    def test_varying_composition_allreduce_values(self):
+        """End-to-end: the same tensors fused in different per-step
+        compositions (forced by distinct name sets) keep exact values."""
+        rng = np.random.RandomState(3)
+        tensors = [rng.randn(rng.randint(100, 5000)).astype(np.float32)
+                   for _ in range(12)]
+        for it in range(3):
+            order = rng.permutation(len(tensors))
+            hs = {i: hvd.allreduce_async(tensors[i], average=False,
+                                         name=f"comp.{it}.{i}")
+                  for i in order}
+            for i, h in hs.items():
+                np.testing.assert_allclose(
+                    np.asarray(h.wait()), tensors[i] * hvd.size(),
+                    rtol=1e-5)
