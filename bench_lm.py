@@ -118,6 +118,20 @@ CONFIGS.update({
     "wide1b": dict(d_model=2048, d_ff=8192, n_layers=20, n_heads=16,
                    batch=4, remat=True, use_flash=True,
                    logits_bf16=True, loss_chunk=512),
+    # Next-lever probes on the 1B shape (measured round 5): dots-policy
+    # remat at batch 2 wins (16.7k tok/s, 59.2% MFU — saving matmul
+    # outputs recovers ~3 MFU points over full remat at batch 4);
+    # batch 8 full-remat loses (14.6k, 51.8%); dots at batch 4 fails to
+    # compile (exceeds HBM — fp32 AdamW state 12.3 GB + dots-saved
+    # activations). The binding constraint after width is optimizer-
+    # state memory: sharding it (ZeRO-style over 'dp') or bf16 moments
+    # is what would let remat off entirely at 1B.
+    "wide1b_dots": dict(d_model=2048, d_ff=8192, n_layers=20, n_heads=16,
+                        batch=2, remat=True, remat_policy="dots",
+                        use_flash=True, logits_bf16=True, loss_chunk=512),
+    "wide1b_b8": dict(d_model=2048, d_ff=8192, n_layers=20, n_heads=16,
+                      batch=8, remat=True, use_flash=True,
+                      logits_bf16=True, loss_chunk=512),
 })
 
 

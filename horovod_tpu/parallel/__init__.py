@@ -16,16 +16,21 @@ built on the same mesh-axis collective layer, designed TPU-first:
   reshard seq->heads, local attention, reshard back)
 - :mod:`pipeline`   — GPipe-style microbatch pipeline over 'pp'
 - :mod:`expert`     — mixture-of-experts dispatch over 'ep' (all_to_all)
+- :mod:`zero`       — ZeRO-1 optimizer-state sharding over 'dp'
+  (psum_scatter grads, shard moments 1/N, all_gather updates)
 """
 
 from .mesh import MeshSpec, create_mesh
 from .collectives import (all_gather, all_to_all, axis_index, axis_size,
                           ppermute, psum, psum_scatter, ring_shift)
 from .data_parallel import shard_batch, allreduce_gradients_in_jit
+from .zero import (Zero1State, zero1_init, zero1_state_specs,
+                   zero1_update)
 
 __all__ = [
     "MeshSpec", "create_mesh",
     "psum", "all_gather", "ppermute", "all_to_all", "psum_scatter",
     "axis_index", "axis_size", "ring_shift",
     "shard_batch", "allreduce_gradients_in_jit",
+    "Zero1State", "zero1_init", "zero1_state_specs", "zero1_update",
 ]

@@ -55,9 +55,11 @@ def _spec_axes(spec) -> set:
     return out
 
 
-def reduce_gradients(grads, specs, mesh: Mesh):
-    """Apply the reduction rule leaf-by-leaf (see module docstring)."""
-    mesh_axes = [a for a in mesh.axis_names]
+def reduce_gradients(grads, specs, mesh: Mesh, skip=()):
+    """Apply the reduction rule leaf-by-leaf (see module docstring).
+    ``skip`` omits axes whose reduction happens elsewhere (ZeRO-1 sums
+    over 'dp' inside its psum_scatter)."""
+    mesh_axes = [a for a in mesh.axis_names if a not in skip]
 
     def red(g, spec):
         have = _spec_axes(spec)
@@ -83,51 +85,75 @@ def build_train_step(cfg: tfm.TransformerConfig, mesh: Mesh, optimizer):
     data_spec = P("dp" if "dp" in axis_names else None,
                   cfg.sp_axis if cfg.sp_axis else None)
 
-    def per_shard_step(params, opt_state, tokens, targets):
-        n_data = 1
-        for ax in DATA_AXES:
-            if ax in axis_names:
-                n_data *= mesh.shape[ax]
+    def _per_shard_step(zero1_mode):
+        from .zero import zero1_update
 
-        def local_loss(p):
-            loss = tfm.loss_fn(p, tokens, targets, cfg) / n_data
-            # Mask to model-rank 0 so sum-over-shards counts each data
-            # shard's loss exactly once (see module docstring).
-            for ax in MODEL_AXES:
+        def per_shard_step(params, opt_state, tokens, targets):
+            n_data = 1
+            for ax in DATA_AXES:
                 if ax in axis_names:
-                    loss = jnp.where(lax.axis_index(ax) == 0, loss, 0.0)
-            return loss
+                    n_data *= mesh.shape[ax]
 
-        loss, grads = jax.value_and_grad(local_loss)(params)
-        grads = reduce_gradients(grads, specs, mesh)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        import optax
-        params = optax.apply_updates(params, updates)
-        # Reported loss: the global mean (sum of the masked, scaled shards).
-        loss = lax.psum(loss, tuple(mesh.axis_names))
-        return params, opt_state, loss
+            def local_loss(p):
+                loss = tfm.loss_fn(p, tokens, targets, cfg) / n_data
+                # Mask to model-rank 0 so sum-over-shards counts each
+                # data shard's loss exactly once (module docstring).
+                for ax in MODEL_AXES:
+                    if ax in axis_names:
+                        loss = jnp.where(lax.axis_index(ax) == 0,
+                                         loss, 0.0)
+                return loss
+
+            loss, grads = jax.value_and_grad(local_loss)(params)
+            if zero1_mode:
+                # ZeRO-1 (parallel/zero.py): reduce over every missing
+                # axis EXCEPT 'dp' — the wrapper's psum_scatter does the
+                # dp-sum and the sharding in one collective; moments
+                # live as 1/dp flat shards.
+                grads = reduce_gradients(grads, specs, mesh,
+                                         skip=("dp",))
+                updates, opt_state = zero1_update(
+                    optimizer, grads, opt_state, params, axis="dp")
+            else:
+                grads = reduce_gradients(grads, specs, mesh)
+                updates, opt_state = optimizer.update(grads, opt_state,
+                                                      params)
+            import optax
+            params = optax.apply_updates(params, updates)
+            # Reported loss: global mean (sum of masked, scaled shards).
+            loss = lax.psum(loss, tuple(mesh.axis_names))
+            return params, opt_state, loss
+
+        return per_shard_step
 
     def make(params, opt_state):
-        # Build opt-state specs by STRUCTURE: optax moment states (mu/nu/
-        # trace) are whole subtrees with the params' treedef — give those
-        # the param specs wholesale; any other leaf (counts, scalars)
-        # replicates. Shape-based matching would be ambiguous (wq and wo
-        # share shapes with transposed specs).
-        ptreedef = jax.tree_util.tree_structure(params)
+        from .zero import Zero1State, zero1_state_specs
 
-        def is_param_like(x):
-            try:
-                return jax.tree_util.tree_structure(x) == ptreedef
-            except Exception:
-                return False
-
-        def leaf_spec(x):
-            return specs if is_param_like(x) else P()
-
-        opt_specs = jax.tree_util.tree_map(leaf_spec, opt_state,
-                                           is_leaf=is_param_like)
+        zero1_mode = isinstance(opt_state, Zero1State)
+        if zero1_mode:
+            if "dp" not in axis_names:
+                raise ValueError(
+                    "Zero1State optimizer state requires a 'dp' mesh "
+                    "axis to shard over")
+            for s in jax.tree_util.tree_leaves(
+                    specs, is_leaf=lambda x: isinstance(x, P)):
+                if "dp" in _spec_axes(s):
+                    raise ValueError(
+                        "ZeRO-1 shards moments over 'dp' and requires "
+                        f"dp-replicated parameters; spec {s} already "
+                        "uses 'dp'")
+            opt_specs = zero1_state_specs(opt_state, params, specs,
+                                          mesh, axis="dp")
+        else:
+            # Opt-state specs by STRUCTURE (shared helper — optax
+            # moment subtrees get the param specs wholesale, counts
+            # replicate; shape-based matching would be ambiguous since
+            # wq and wo share shapes with transposed specs).
+            from .zero import state_specs_by_structure
+            opt_specs = state_specs_by_structure(opt_state, params,
+                                                 specs)
         step = jax.jit(jax.shard_map(
-            per_shard_step, mesh=mesh,
+            _per_shard_step(zero1_mode), mesh=mesh,
             in_specs=(specs, opt_specs, data_spec, data_spec),
             out_specs=(specs, opt_specs, P()),
             check_vma=False))

@@ -408,3 +408,104 @@ class TestUlyssesAttention:
         _, _, loss = step(shard_p(params), state, shard_b(tokens),
                           shard_b(targets))
         assert np.isfinite(float(loss))
+
+
+class TestZero1:
+    """ZeRO-1 optimizer-state sharding (parallel/zero.py): the sharded-
+    state step must match the replicated-state step numerically, with
+    every moment leaf stored as a 1/dp flat shard over 'dp'."""
+
+    def _setup(self, opt):
+        rng = jax.random.PRNGKey(0)
+        tok = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 64)
+        tgt = jnp.roll(tok, -1, axis=1)
+        cfg = tfm.TransformerConfig(
+            vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq=32, dtype=jnp.float32, remat=False)
+        params = tfm.init_params(cfg, rng)
+        return cfg, params, tok, tgt
+
+    def _train(self, cfg, mesh, params, tok, tgt, opt, state, steps=4):
+        make, shard_p, shard_b = build_train_step(cfg, mesh, opt)
+        step, _ = make(params, state)
+        p, s = shard_p(params), state
+        tk, tg = shard_b(tok), shard_b(tgt)
+        losses = []
+        for _ in range(steps):
+            p, s, loss = step(p, s, tk, tg)
+            losses.append(float(loss))
+        leaves = [np.asarray(x, np.float32)
+                  for x in jax.tree_util.tree_leaves(p)]
+        return leaves, losses, s
+
+    def test_matches_replicated_state_adamw(self):
+        from horovod_tpu.parallel.zero import zero1_init
+        opt = optax.adamw(1e-2)
+        cfg, params, tok, tgt = self._setup(opt)
+        mesh = create_mesh(dp=8)
+        l_ref, losses_ref, _ = self._train(
+            cfg, mesh, params, tok, tgt, opt, opt.init(params))
+        zstate = zero1_init(opt, params, n_shards=8)
+        l_z, losses_z, _ = self._train(
+            cfg, mesh, params, tok, tgt, opt, zstate)
+        np.testing.assert_allclose(losses_z, losses_ref, rtol=1e-5)
+        err = max(np.max(np.abs(a - b)) for a, b in zip(l_z, l_ref))
+        assert err < 1e-5, f"param divergence {err}"
+
+    def test_moments_sharded_one_over_dp(self):
+        from horovod_tpu.parallel.zero import zero1_init
+        opt = optax.adam(1e-2)
+        cfg, params, tok, tgt = self._setup(opt)
+        mesh = create_mesh(dp=8)
+        zstate = zero1_init(opt, params, n_shards=8)
+        make, shard_p, shard_b = build_train_step(cfg, mesh, opt)
+        step, opt_specs = make(params, zstate)
+        p, s, _ = step(shard_p(params), zstate, shard_b(tok),
+                       shard_b(tgt))
+        import jax as _jax
+        from jax.sharding import PartitionSpec as P
+        # Every vector moment leaf: sharded over dp, local shard = 1/8.
+        checked = 0
+        for leaf in _jax.tree_util.tree_leaves(s):
+            if getattr(leaf, "ndim", 0) >= 1 and leaf.size >= 8:
+                assert len(leaf.sharding.device_set) == 8
+                shard = leaf.addressable_shards[0].data
+                assert shard.size == leaf.size // 8
+                checked += 1
+        assert checked >= 4  # adam mu+nu over several params
+
+    def test_zero_with_tp_combination(self):
+        """The model-axis interaction: a tp-sharded parameter's moments
+        must live as per-tp-block flat shards further split over dp —
+        AdamW (stateful) so a layout bug cannot hide in an empty state."""
+        from horovod_tpu.parallel.zero import zero1_init
+        opt = optax.adamw(1e-2)
+        rng = jax.random.PRNGKey(0)
+        tok = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 64)
+        tgt = jnp.roll(tok, -1, axis=1)
+        cfg = tfm.TransformerConfig(
+            vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq=32, dtype=jnp.float32, tp_axis="tp", remat=False)
+        params = tfm.init_params(cfg, rng)
+        mesh = create_mesh(dp=4, tp=2)
+        zstate = zero1_init(opt, params, n_shards=4,
+                            param_specs=tfm.param_specs(cfg), mesh=mesh)
+        l_z, losses_z, _ = self._train(cfg, mesh, params, tok, tgt, opt,
+                                       zstate)
+        l_ref, losses_ref, _ = self._train(cfg, mesh, params, tok, tgt,
+                                           opt, opt.init(params))
+        np.testing.assert_allclose(losses_z, losses_ref, rtol=1e-5)
+        err = max(np.max(np.abs(a - b)) for a, b in zip(l_z, l_ref))
+        assert err < 1e-5, f"param divergence {err}"
+
+    def test_requires_dp_axis(self):
+        from horovod_tpu.parallel.zero import zero1_init
+        opt = optax.sgd(0.1)
+        cfg, params, tok, tgt = self._setup(opt)
+        mesh = create_mesh(devices=jax.devices()[:2], tp=2)
+        cfg2 = tfm.TransformerConfig(
+            vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq=32, dtype=jnp.float32, tp_axis="tp", remat=False)
+        make, _, _ = build_train_step(cfg2, mesh, opt)
+        with pytest.raises(ValueError, match="dp"):
+            make(params, zero1_init(opt, params, n_shards=2))
