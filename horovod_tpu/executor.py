@@ -58,18 +58,28 @@ BROADCAST = 2
 # reference fusion buffer's 64-byte atomic unit,
 # FUSION_BUFFER_ATOMIC_UNIT, operations.h:52-54).
 def _fusion_padded_size(n: int) -> int:
-    """Power-of-two padded size at every scale. Linear (quantum-step)
-    padding let the coordinator's timing-dependent group compositions
-    produce a fresh padded size almost every step, and padded size keys
-    BOTH the fused reduce program and the per-tensor unpack slices — a
-    120-tensor MP group measured 11 s/step of per-composition
-    recompiles. Power-of-two bounds the distinct paddeds to ~log2 of
-    the size range, so the program caches converge after warmup; the
-    cost is <=2x transient buffer memory."""
-    p = 512
-    while p < n:
-        p *= 2
-    return p
+    """Padded size with at most 3 significant mantissa bits (1, 1.125,
+    ... 1.875 x 2^k; minimum 512). Two forces pull on this quantization:
+
+    - COMPILE STABILITY: linear (fine-quantum) padding let the
+      coordinator's timing-dependent group compositions produce a fresh
+      padded size almost every step, and padded size keys BOTH the
+      fused reduce program and the per-tensor unpack slices — a
+      120-tensor MP group measured 11 s/step of per-composition
+      recompiles. Few distinct sizes per octave => caches converge.
+    - TRAFFIC: the padded size is what the shm plane moves and the
+      reduce program chews; pure power-of-two padding (round-5 first
+      fix) costs up to 2x on mid-octave buffers and measurably dragged
+      the np=8 weak-scaling proxy (0.95 -> 0.80 capacity-adjusted).
+
+    Three mantissa bits bounds overhead at 12.5% with 8 sizes per
+    octave; every value stays a multiple of 64 bytes at any dtype width
+    (the reference fusion buffer's atomic unit)."""
+    if n <= 512:
+        return 512
+    k = n.bit_length() - 1          # floor(log2(n))
+    step = 1 << max(k - 3, 0)       # 1/8 of the leading power of two
+    return ((n + step - 1) // step) * step
 
 
 def _accum_dtype(dtype) -> Optional[np.dtype]:
@@ -755,26 +765,15 @@ class CollectiveExecutor:
 
             if host_op is not None:
                 # The reduced buffer is HOST memory (the shm plane's
-                # truth). CPU backend: slice it in numpy (free views,
-                # no device programs at all). Accelerator backends: ONE
-                # whole-buffer H2D then the cached traced-offset device
-                # slices (_UNPACK_CACHE) — per-tensor jnp.asarray would
-                # pay one H2D round trip per tensor on a
-                # parameter-broadcast burst, and the compile storm the
-                # device path used to have is fixed by the offset-traced
-                # programs + power-of-two padding.
-                host_out = np.asarray(host_op(buf))
-                if jax.default_backend() == "cpu":
-                    off = 0
-                    for i in idxs:
-                        a = arrs[i]
-                        piece = host_out[off:off + a.size].reshape(a.shape)
-                        if piece.dtype != a.dtype:
-                            piece = piece.astype(a.dtype)
-                        results[i] = jnp.asarray(piece)
-                        off += a.size
-                else:
-                    _unpack(jnp.asarray(host_out), arrs, idxs, results)
+                # truth): ONE whole-buffer jnp.asarray, then the cached
+                # traced-offset device slices (_UNPACK_CACHE). One
+                # transfer beats per-tensor jnp.asarray (each is its
+                # own copy+dispatch — measured as a drag on the np=8
+                # scaling proxy when tried), and the compile storm the
+                # device slicing used to have is fixed by the
+                # offset-traced programs + quantized padding.
+                _unpack(jnp.asarray(np.asarray(host_op(buf))),
+                        arrs, idxs, results)
                 continue
 
             key = key_fn(padded, str(buf_dt))
