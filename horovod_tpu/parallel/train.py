@@ -43,16 +43,8 @@ MODEL_AXES = ("tp", "ep")
 
 
 def _spec_axes(spec) -> set:
-    out = set()
-    if isinstance(spec, P):
-        for entry in spec:
-            if entry is None:
-                continue
-            if isinstance(entry, (tuple, list)):
-                out.update(entry)
-            else:
-                out.add(entry)
-    return out
+    from .zero import _spec_axes_ordered
+    return set(_spec_axes_ordered(spec))
 
 
 def reduce_gradients(grads, specs, mesh: Mesh, skip=()):

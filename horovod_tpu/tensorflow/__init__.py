@@ -112,6 +112,10 @@ def _grouped_bridge(submit_async, tensors):
         with _ops.engine().burst():
             handles = [submit_async(i, _ingress(v)) for i, v in enumerate(vs)]
         outs = [h.wait() for h in handles]
+
+        def cast(res, dt):
+            return tf.cast(res, dt) if res.dtype != dt else res
+
         # Zero-copy DLPack egress where the buffer exports (gated +
         # counted via interop.try_jax_to_tf); batched device_get for
         # the remainder (one transfer burst per group, not one round
@@ -121,18 +125,14 @@ def _grouped_bridge(submit_async, tensors):
         for i, out in enumerate(outs):
             res = _interop.try_jax_to_tf(out)
             if res is not None:
-                if res.dtype != vs[i].dtype:
-                    res = tf.cast(res, vs[i].dtype)
-                results[i] = res
+                results[i] = cast(res, vs[i].dtype)
                 continue
             rest.append(i)
         if rest:
             hosts = _interop.to_host_many([outs[i] for i in rest])
             for i, arr in zip(rest, hosts):
-                res = tf.convert_to_tensor(arr)
-                if res.dtype != vs[i].dtype:
-                    res = tf.cast(res, vs[i].dtype)
-                results[i] = res
+                results[i] = cast(tf.convert_to_tensor(arr),
+                                  vs[i].dtype)
         return results
 
     outs = tf.py_function(host, list(tensors),

@@ -135,29 +135,9 @@ def poll(handle: int) -> bool:
 def synchronize(handle: int) -> torch.Tensor:
     """Block until done; return the output tensor. In-place variants copy
     the result into the submitted tensor (WaitAndClear,
-    mpi_ops_v2.cc:228-234 + torch/mpi_ops.py:419-438)."""
-    with _lock:
-        th = _handles.pop(handle, None)
-    if th is None:
-        raise ValueError(f"Unknown handle {handle}")
-    out = th.inner.wait()
-    result = None
-    if not th.from_bits:
-        # Zero-copy egress: alias the engine's output buffer via DLPack
-        # (shard-0 of the replicated result). The handle was just popped,
-        # so nothing else references that buffer.
-        aliased = _interop.try_jax_to_torch(out)
-        if aliased is not None and aliased.dtype == th.dtype:
-            result = aliased
-    if result is None:
-        result = _to_torch(out, th.dtype, from_bits=th.from_bits)
-    if th.target is not None:
-        with torch.no_grad():
-            th.target.copy_(result.reshape(th.target.shape))
-        return th.target
-    if th.shape is not None:
-        result = result.reshape(th.shape)
-    return result
+    mpi_ops_v2.cc:228-234 + torch/mpi_ops.py:419-438). One code path
+    with the batched variant: this is synchronize_many of one."""
+    return synchronize_many([handle])[0]
 
 
 def synchronize_many(handles) -> list:
