@@ -83,6 +83,33 @@ def peak_tflops(device) -> float:
     return 0.0  # unknown (CPU run) — mfu reported as 0/None
 
 
+# Windows whose wall time exceeds the median by this factor are tunnel
+# stalls, not chip behavior (VERDICT r5 weak #3: one 16.7 s window in a
+# ~6.6 s-median run blew ci95 from ±16 to ±1118). Overridable for
+# environments with different stall shapes.
+STALL_FACTOR = float(os.environ.get("HVD_BENCH_STALL_FACTOR", 1.5))
+
+
+def annotate_stalled_windows(window_s, stall_factor=None):
+    """Detect wall-time outlier windows against the run's own median.
+
+    Returns ``(stalled_indices, ok_indices)``. The raw windows stay in
+    the JSON untouched — this only *annotates* them so round-over-round
+    ci95 comparisons can exclude stalls instead of reading a tunnel
+    hiccup as a throughput regression. If every window would be flagged
+    (degenerate tiny medians), nothing is: a uniformly slow run is slow,
+    not stalled."""
+    factor = STALL_FACTOR if stall_factor is None else stall_factor
+    if not window_s:
+        return [], []
+    med = float(np.median(window_s))
+    stalled = [i for i, w in enumerate(window_s) if w > factor * med]
+    if len(stalled) == len(window_s):
+        stalled = []
+    ok = [i for i in range(len(window_s)) if i not in set(stalled)]
+    return stalled, ok
+
+
 def build_step(model, opt):
     """One jitted k-step training program (state donated; the k optimizer
     steps run inside a single lax.fori_loop so host dispatch latency never
@@ -214,6 +241,15 @@ def run_chip_bench():
     per_chip = float(np.median(img_secs)) / n
     mean = float(np.mean(img_secs)) / n
     ci95 = float(1.96 * np.std(img_secs)) / n
+    # Stall annotation (VERDICT r5 weak #3): keep every raw window, but
+    # flag wall-time outliers and report a trimmed mean/CI over the
+    # clean windows so cross-round ci95 comparisons don't read one
+    # stalled tunnel window as a regression. The median headline is
+    # already stall-robust and unchanged.
+    stalled_idx, ok_idx = annotate_stalled_windows(window_s)
+    ok_rates = [img_secs[i] for i in ok_idx] or img_secs
+    trimmed_mean = float(np.mean(ok_rates)) / n
+    trimmed_ci95 = float(1.96 * np.std(ok_rates)) / n
     peak = peak_tflops(jax.devices()[0])
     # MFU on the same basis as the reported rate: sustained FLOP/s =
     # (reported img/sec/chip) x (FLOPs per image), so the two headline
@@ -234,6 +270,10 @@ def run_chip_bench():
         "batches_per_iter": NUM_BATCHES_PER_ITER,
         "windows_img_sec_per_chip": [round(v / n, 2) for v in img_secs],
         "windows_wall_s": window_s,
+        "stalled_windows": stalled_idx,
+        "stall_factor": STALL_FACTOR,
+        "trimmed_mean": round(trimmed_mean, 2),
+        "trimmed_ci95": round(trimmed_ci95, 2),
         "mfu": round(mfu, 4),
         "tflops_per_chip": round(tflops, 1),
         "peak_tflops": peak,

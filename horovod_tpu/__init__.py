@@ -15,8 +15,9 @@ Five-line usage, mirroring the reference README:
     ... standard JAX training loop ...
 """
 
-from .topology import (NotInitializedError, hierarchical_mesh, init,
-                       is_initialized, local_rank, local_size, mesh,
+from .utils import compat as _compat  # noqa: F401  (installs jax shims)
+from .topology import (NotInitializedError, generation, hierarchical_mesh,
+                       init, is_initialized, local_rank, local_size, mesh,
                        mpi_threads_supported, process_count, process_rank,
                        rank, shutdown, size)
 from .topology import topology as get_topology
@@ -30,6 +31,7 @@ from .optimizer import (DistributedOptimizer, DistributedGradientTransformation,
 from .utils.checkpoint import restore_checkpoint, save_checkpoint
 from .ops.timeline_jit import (step as timeline_jit_step,
                                merge_profiler_trace)
+from .elastic import ElasticState, WorkerFailure, run_elastic
 
 __version__ = "0.1.0"
 
@@ -39,7 +41,7 @@ __all__ = [
     "init", "shutdown", "is_initialized", "rank", "local_rank", "size",
     "local_size", "process_rank", "process_count", "mesh",
     "hierarchical_mesh", "get_topology", "mpi_threads_supported",
-    "NotInitializedError",
+    "NotInitializedError", "generation",
     # collectives
     "allreduce", "allreduce_async", "allgather", "allgather_async",
     "broadcast", "broadcast_async", "grouped_allreduce", "poll",
@@ -50,4 +52,6 @@ __all__ = [
     "DistributedGradientTransformation", "broadcast_parameters",
     "broadcast_optimizer_state", "broadcast_object", "allreduce_gradients",
     "save_checkpoint", "restore_checkpoint",
+    # elastic
+    "ElasticState", "WorkerFailure", "run_elastic",
 ]

@@ -1,0 +1,33 @@
+"""Elastic training subsystem — survive worker loss without restarting
+from scratch.
+
+Four pieces (see docs/elastic.md for the full contract):
+
+  - discovery (:mod:`.discovery`): the :class:`HostProvider` interface
+    with static-hostfile, ssh-probe, and GCE-metadata/TPU-pod backends
+    feeding the existing runner launch plane.
+  - failure detection (:mod:`.failure`): the typed
+    :class:`WorkerFailure` event, escalation knobs
+    (:class:`FailureConfig`), and the driver-side
+    :class:`FailureDetector`; worker-side escalation lives in the
+    engine/coordinator behind ``HOROVOD_TPU_FAILURE_TIMEOUT``.
+  - elastic state (:mod:`.state`): :class:`ElasticState` —
+    commit/rollback/restore over the checkpoint convention, with
+    broadcast-on-rejoin.
+  - driver loop (:mod:`.driver`): :func:`run_elastic` /
+    :func:`run_elastic_command` — discover, launch a generation, detect
+    failure, shrink/grow, re-rendezvous.
+"""
+
+from .discovery import (HostfileProvider, HostProvider, SSHProbeProvider,
+                        StaticProvider, TPUPodProvider, get_provider)
+from .failure import FailureConfig, FailureDetector, WorkerFailure
+from .state import ElasticState
+from .driver import generation, run_elastic, run_elastic_command
+
+__all__ = [
+    "HostProvider", "StaticProvider", "HostfileProvider",
+    "SSHProbeProvider", "TPUPodProvider", "get_provider",
+    "WorkerFailure", "FailureConfig", "FailureDetector",
+    "ElasticState", "run_elastic", "run_elastic_command", "generation",
+]

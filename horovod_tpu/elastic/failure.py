@@ -1,0 +1,136 @@
+"""Typed worker-failure events and the driver-side failure detector.
+
+The seed's only answer to a lost worker is the coordinated-shutdown path:
+stall *warnings* (ops/collective.py `_maybe_check_stalls`, the
+coordinator's `check_stalls`) followed by every handle dying with
+SHUT_DOWN_ERROR once someone notices. Elastic recovery needs the loss to
+surface as a *typed event* that names who failed and why, early enough
+to act on — so:
+
+  - :class:`WorkerFailure` is the event type. It subclasses
+    ``HorovodInternalError`` so existing ``except`` clauses keep working,
+    but carries structured ``rank``/``host``/``kind``/``detail`` fields
+    the elastic driver dispatches on (which host to penalize, whether to
+    shrink or abort).
+  - :class:`FailureConfig` holds the escalation knobs — detection
+    timeout, restart budget, backoff schedule, host blacklist window.
+  - :class:`FailureDetector` is the driver-side monitor: it polls a
+    launched job's workers and raises ``WorkerFailure`` for the first
+    dead one (a SIGKILLed worker reports a negative returncode within
+    one poll interval).
+
+Worker-side escalation lives where the signals already are: the rank-0
+coordinator tracks per-rank control-plane heartbeats and stalled-tensor
+ages and ships failure events through the fetch response
+(ops/control_plane.py), and the engine escalates its own stall detector
+past ``failure_timeout`` (ops/collective.py) — both gated on
+``HOROVOD_TPU_FAILURE_TIMEOUT`` so non-elastic jobs keep today's
+warn-only behavior.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional
+
+from ..ops.collective import HorovodInternalError
+
+
+class WorkerFailure(HorovodInternalError):
+    """A worker was lost (process death, heartbeat loss, or a stall past
+    the failure timeout). ``rank``/``host`` are -1/None when the failing
+    party cannot be attributed (e.g. a stall names missing ranks in
+    ``detail`` instead)."""
+
+    def __init__(self, rank: int = -1, host: Optional[str] = None,
+                 kind: str = "exit", detail: str = ""):
+        self.rank = int(rank)
+        self.host = host
+        self.kind = kind
+        self.detail = detail
+        self.timestamp = time.time()
+        where = f"rank {rank}" + (f" on {host}" if host else "")
+        super().__init__(
+            f"worker failure ({kind}): {where}"
+            + (f" — {detail}" if detail else ""))
+
+    def __reduce__(self):  # exceptions with kw-ish init need explicit pickle
+        return (WorkerFailure, (self.rank, self.host, self.kind,
+                                self.detail))
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """Escalation knobs for elastic runs.
+
+    ``failure_timeout_s`` is exported to workers as
+    ``HOROVOD_TPU_FAILURE_TIMEOUT`` — the window after which the
+    coordinator's heartbeat/stall tracking and the engine's stall
+    detector escalate to :class:`WorkerFailure` instead of warning.
+    ``max_restarts`` bounds relaunch attempts; the backoff fields pace
+    them; ``blacklist_s`` is how long a failed host's lost slot stays
+    excluded before the driver lets it grow back in."""
+
+    failure_timeout_s: float = 30.0
+    max_restarts: int = 3
+    backoff_s: float = 1.0
+    backoff_factor: float = 2.0
+    max_backoff_s: float = 30.0
+    blacklist_s: float = 300.0
+    poll_interval_s: float = 0.2
+
+    def next_backoff(self, current: float) -> float:
+        return min(max(current, self.backoff_s) * self.backoff_factor,
+                   self.max_backoff_s)
+
+
+class FailureDetector:
+    """Watches a launched job's workers; raises :class:`WorkerFailure`
+    for the first dead one.
+
+    Plugged into the driver's wait loops as the ``failfast`` callback
+    (the role ``LaunchedJob.failfast_check`` plays for non-elastic runs,
+    runner/launcher.py) — but instead of a generic RuntimeError it
+    produces the typed event the elastic loop dispatches on, and it
+    distinguishes signal deaths (negative returncode → ``kind='killed'``)
+    from nonzero exits (``kind='exit'``)."""
+
+    def __init__(self, job, rank_hosts: List[str],
+                 config: Optional[FailureConfig] = None):
+        self._job = job
+        self._rank_hosts = list(rank_hosts)
+        self.config = config or FailureConfig()
+        self.failures: List[WorkerFailure] = []
+
+    def check(self) -> None:
+        """Poll every worker once; raise on the first failure found.
+        All failures observed in this poll are recorded in
+        ``self.failures`` first, so the driver can penalize every lost
+        host even when several die together."""
+        found: List[WorkerFailure] = []
+        for rank, w in enumerate(self._job.workers):
+            rc = w.poll()
+            if rc is not None and rc != 0:
+                host = (self._rank_hosts[rank]
+                        if rank < len(self._rank_hosts) else None)
+                kind = "killed" if rc < 0 else "exit"
+                found.append(WorkerFailure(
+                    rank=rank, host=host, kind=kind,
+                    detail=f"worker exited with code {rc}"))
+        if found:
+            self.failures.extend(found)
+            self._job.terminate()
+            raise found[0]
+
+    def wait(self, done, timeout: Optional[float] = None) -> None:
+        """Poll ``done()`` until it returns True, checking workers at the
+        configured interval; TimeoutError past ``timeout``."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while not done():
+            self.check()
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"elastic attempt did not finish within {timeout}s")
+            time.sleep(self.config.poll_interval_s)

@@ -1,0 +1,201 @@
+"""ElasticState — commit/rollback training state that survives worker loss.
+
+Built on the repo's checkpoint convention (utils/checkpoint.py: rank-0
+atomic save, broadcast-on-restore) and extended with the elastic
+contract:
+
+  commit(step)   durably record the wrapped trees as of ``step``:
+                 rank 0 writes ``<dir>/<step>.pkl`` then atomically
+                 repoints ``<dir>/LATEST``; every rank keeps an
+                 in-memory host copy for I/O-free rollback; a barrier
+                 collective keeps ranks from racing past an unfinished
+                 commit.
+  rollback()     restore the wrapped trees from the last in-memory
+                 commit (same process — e.g. after a caught
+                 WorkerFailure, before re-entering the step loop).
+  restore()      cold-start path for a (re)joined process: load the
+                 LATEST commit from disk on rank 0 and broadcast it so
+                 every rank — old survivor or fresh replacement — resumes
+                 from identical state. With no commit on disk the
+                 *initial* trees are broadcast from rank 0 instead, which
+                 is exactly the reference's BroadcastGlobalVariablesHook
+                 restart recipe.
+
+The state directory defaults to ``HOROVOD_TPU_ELASTIC_DIR`` (exported by
+``run_elastic``); without one, commits are memory-only — rollback works,
+but a killed-and-relaunched worker starts from the initial trees (fine
+for single-process use and tests of the in-memory path).
+
+Trees are arbitrary JAX pytrees addressed by name::
+
+    state = ElasticState(params=params, opt_state=opt_state)
+    state.restore()
+    for step in range(state.step, total_steps):
+        params, opt_state, loss = train_step(...)
+        state.params, state.opt_state = params, opt_state
+        if (step + 1) % commit_every == 0:
+            state.commit(step + 1)
+
+``state.step`` is the step index training should resume from — 0 before
+any commit, the committed ``step`` argument after.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+import jax
+
+from .. import topology as _topo
+from ..utils.checkpoint import restore_checkpoint, save_checkpoint
+from ..utils.logging import get_logger
+
+_log = get_logger("elastic.state")
+
+ELASTIC_DIR_ENV = "HOROVOD_TPU_ELASTIC_DIR"
+_LATEST = "LATEST"
+
+
+class ElasticState:
+    """Named pytrees with commit/rollback/restore semantics."""
+
+    def __init__(self, directory: Optional[str] = None, **trees: Any):
+        if not trees:
+            raise ValueError(
+                "ElasticState needs at least one named tree, e.g. "
+                "ElasticState(params=params, opt_state=opt_state)")
+        # All bookkeeping attrs go through object.__setattr__ so the
+        # tree-name __setattr__ below stays unambiguous.
+        object.__setattr__(self, "_dir",
+                           directory or os.environ.get(ELASTIC_DIR_ENV))
+        object.__setattr__(self, "_trees", dict(trees))
+        object.__setattr__(self, "_committed", None)
+        object.__setattr__(self, "step", 0)
+
+    # ----------------------------------------------------- tree access
+
+    def __getattr__(self, name: str) -> Any:
+        trees = object.__getattribute__(self, "_trees")
+        if name in trees:
+            return trees[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name == "step":
+            object.__setattr__(self, name, value)
+            return
+        self._trees[name] = value
+
+    def tree_names(self):
+        return tuple(self._trees)
+
+    # ------------------------------------------------------- internals
+
+    def _latest_path(self) -> Optional[str]:
+        return os.path.join(self._dir, _LATEST) if self._dir else None
+
+    def _snapshot(self) -> Dict[str, Any]:
+        # Host copies: device buffers may be donated/overwritten by the
+        # next jitted step, so the rollback copy must not alias them.
+        return {"step": int(self.step),
+                "trees": jax.device_get(self._trees)}
+
+    def _is_rank0(self) -> bool:
+        try:
+            return _topo._get().process_index == 0
+        except Exception:
+            return True
+
+    def _adopt(self, payload: Dict[str, Any]) -> None:
+        object.__setattr__(self, "_trees", dict(payload["trees"]))
+        object.__setattr__(self, "step", int(payload["step"]))
+
+    # ------------------------------------------------------- contract
+
+    def commit(self, step: Optional[int] = None) -> "ElasticState":
+        """Durably record the current trees as of ``step``.
+
+        Ordering guarantee: the LATEST pointer is repointed only after
+        the commit file is fully on disk (two atomic renames), so a
+        crash at any instant leaves LATEST naming a complete commit.
+        The closing barrier means no rank runs past a commit its peers
+        have not durably finished — after a failure, every survivor
+        agrees on the restore point."""
+        if step is not None:
+            object.__setattr__(self, "step", int(step))
+        snap = self._snapshot()
+        object.__setattr__(self, "_committed", snap)
+        if self._dir and self._is_rank0():
+            os.makedirs(self._dir, exist_ok=True)
+            save_checkpoint(snap, self._dir, step=self.step)
+            tmp = self._latest_path() + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(self.step))
+            os.replace(tmp, self._latest_path())
+        self._barrier(f"elastic.commit.{self.step}")
+        return self
+
+    def rollback(self) -> "ElasticState":
+        """Restore trees from the last in-memory commit (no I/O). With
+        no commit yet, this is a no-op on the initial trees."""
+        if self._committed is not None:
+            self._adopt(self._committed)
+        return self
+
+    def restore(self, step: Optional[int] = None) -> "ElasticState":
+        """(Re)join path: adopt the last durable commit — or the initial
+        trees — identically on every rank.
+
+        Rank 0 resolves ``step`` (explicit, else LATEST, else none) and
+        loads the commit file; the broadcast built into
+        ``restore_checkpoint`` ships it to all ranks, so a replacement
+        worker with no shared filesystem still receives full state."""
+        resolved = step
+        if resolved is None and self._dir and self._is_rank0():
+            latest = self._latest_path()
+            if latest and os.path.exists(latest):
+                with open(latest) as f:
+                    resolved = int(f.read().strip())
+        multi = self._process_count() > 1
+        if multi:
+            # Every rank must agree whether a commit exists before anyone
+            # enters the conditional load (a split decision deadlocks the
+            # broadcast). Rank 0 announces the resolved step. Explicit
+            # names: cross-rank agreement must not depend on the engine's
+            # per-process name counters lining up.
+            from ..optimizer import broadcast_object
+            resolved = broadcast_object(resolved, root_rank=0,
+                                        name="elastic.restore.step")
+        if resolved is None:
+            if multi:
+                from ..optimizer import broadcast_object
+                self._adopt(broadcast_object(self._snapshot(), root_rank=0,
+                                             name="elastic.restore.init"))
+            object.__setattr__(self, "_committed", self._snapshot())
+            return self
+        payload = restore_checkpoint(self._dir, step=int(resolved),
+                                     broadcast=multi)
+        self._adopt(payload)
+        object.__setattr__(self, "_committed", self._snapshot())
+        _log.info("restored elastic state at step %d", self.step)
+        return self
+
+    # -------------------------------------------------------- plumbing
+
+    def _process_count(self) -> int:
+        try:
+            return _topo._get().process_count
+        except Exception:
+            return 1
+
+    def _barrier(self, name: str) -> None:
+        """Commit barrier: a tiny allreduce every rank must enter. Only
+        meaningful (and only run) across processes."""
+        if self._process_count() <= 1:
+            return
+        import jax.numpy as jnp
+        from ..ops import collective as _coll
+        _coll.allreduce(jnp.zeros((1,), jnp.float32), average=False,
+                        name=name)

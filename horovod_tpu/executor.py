@@ -35,6 +35,7 @@ fp32 accumulation is the idiomatic way to keep small-dtype reductions exact).
 from __future__ import annotations
 
 import functools
+from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -100,13 +101,28 @@ def _accum_dtype(dtype) -> Optional[np.dtype]:
 # group composition recompiled one slice program per tensor per step
 # (measured: 13 s of a 15 s step on a 120-tensor group; the round-5
 # autotune sweep's 10x "threshold pocket" was exactly this cost).
-_UNPACK_CACHE: Dict = {}
+# Bounded LRU: shape churn (ragged gathers, changing batch shapes) must
+# not grow the program table without limit over a long job — each entry
+# pins a compiled XLA executable.
+_UNPACK_CACHE: OrderedDict = OrderedDict()
+_UNPACK_CACHE_MAX = 512
+
+# The traced offset rides the wire as int32 (cheap, and a traced int64
+# would be downcast anyway without jax_enable_x64); a fused buffer big
+# enough to overflow it cannot be sliced correctly.
+_INT32_MAX = 2 ** 31 - 1
 
 
 def _unpack(out, arrs, idxs, results) -> None:
     """Device-side unpack of a fused buffer shared by every
     _run_fused_buffers branch: slice each tensor's span back out,
     reshape, restore its dtype."""
+    if int(out.size) > _INT32_MAX:
+        raise ValueError(
+            f"fused buffer has {int(out.size)} elements; unpack offsets "
+            "are traced as int32 and would overflow. Lower the fusion "
+            "threshold (HOROVOD_TPU_FUSION_THRESHOLD) below 2**31 "
+            "elements per buffer.")
     off = 0
     for i in idxs:
         a = arrs[i]
@@ -119,6 +135,10 @@ def _unpack(out, arrs, idxs, results) -> None:
                 jax.lax.dynamic_slice(b, (o,), (_s,))
                 .reshape(_sh).astype(_dt))
             _UNPACK_CACHE[key] = prog
+            while len(_UNPACK_CACHE) > _UNPACK_CACHE_MAX:
+                _UNPACK_CACHE.popitem(last=False)
+        else:
+            _UNPACK_CACHE.move_to_end(key)
         results[i] = prog(out, np.int32(off))
         off += a.size
 

@@ -258,6 +258,11 @@ class CollectiveEngine:
         self._mark_cycles = _env.timeline_mark_cycles()
         self.stall_warning_s = _env.stall_warning_secs()
         self._last_stall_check = time.monotonic()
+        # Failure escalation window (elastic recovery): > 0 turns stalls
+        # past the window — and coordinator-reported failure events —
+        # into a typed WorkerFailure on the pending handles instead of
+        # the warn-then-hang path. 0 (default) = seed behavior.
+        self.failure_timeout_s = _env.failure_timeout_secs()
         # Env-forced hierarchical modes; the SP tuner's flags OR on top
         # (_on_native_execute).
         self._env_hier_allreduce = _env.hierarchical_allreduce()
@@ -589,6 +594,22 @@ class CollectiveEngine:
             # and the cache cannot grow unboundedly.
             if name:
                 self._coord_stall_lines[name] = (line, time.monotonic())
+        failures = getattr(resp, "failures", None)
+        if failures:
+            # The coordinator escalated (heartbeat loss / stall past the
+            # failure timeout): pending quorums can never complete, so
+            # fail every in-flight handle with the TYPED event — the
+            # elastic driver (or any caller) dispatches on
+            # WorkerFailure.rank/host/kind instead of parsing log text.
+            from ..elastic.failure import WorkerFailure
+            f = failures[0]
+            err = WorkerFailure(rank=int(f.get("rank", -1)),
+                                kind=str(f.get("kind", "unknown")),
+                                detail="; ".join(
+                                    str(x.get("detail", "")) for x in failures))
+            _log.error("coordinator escalated worker failure: %s", err)
+            self._fail_native_pending(err)
+            self._fail_all(err)
         params = resp.params
         if params:
             cyc = params.get("cycle_time_ms")
@@ -1140,6 +1161,43 @@ class CollectiveEngine:
             "submitting tensors, which will cause deadlock.\n"
             "Stalled ops:\n%s",
             int(self.stall_warning_s), "\n".join(lines))
+        self._maybe_escalate_stalls(now)
+
+    def _maybe_escalate_stalls(self, now: float) -> None:
+        """Escalation past the failure timeout (elastic recovery): a
+        request stuck longer than ``failure_timeout_s`` will never
+        complete — some rank is gone — so fail its handle with a typed
+        WorkerFailure instead of warning forever. The blocked submitter
+        unblocks with an event the elastic driver can act on. Off by
+        default (``failure_timeout_s == 0`` keeps warn-only parity with
+        the reference's stall report)."""
+        if self.failure_timeout_s <= 0:
+            return
+        with self._lock:
+            overdue = [r for r in self._in_flight.values()
+                       if now - r.enqueued_at > self.failure_timeout_s]
+            for r in overdue:
+                self._in_flight.pop(r.name, None)
+                if r in self._queue:
+                    self._queue.remove(r)
+        if not overdue:
+            return
+        from ..elastic.failure import WorkerFailure
+        names = ", ".join(sorted(r.name for r in overdue))
+        for r in overdue:
+            coord = self._coord_stall_lines.get(r.name)
+            err = WorkerFailure(
+                kind="stall",
+                detail=(f"collective '{r.name}' ({_op_name(r.op)}) "
+                        f"incomplete after "
+                        f"{now - r.enqueued_at:.1f}s "
+                        f"(> failure timeout {self.failure_timeout_s:.1f}s)"
+                        + (f"; coordinator report: {coord[0]}"
+                           if coord else "")))
+            r.handle._fulfill(error=err)
+        _log.error("escalated %d stalled collectives to WorkerFailure "
+                   "after %.1fs: %s", len(overdue),
+                   self.failure_timeout_s, names)
 
     # ------------------------------------------------------------- execution
 

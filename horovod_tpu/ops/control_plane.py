@@ -134,7 +134,8 @@ class FetchResponse:
     def __init__(self, groups: List[dict], shutdown: bool,
                  payload: Optional[bytes] = None,
                  params: Optional[dict] = None,
-                 stall: Optional[List[Tuple[str, str]]] = None):
+                 stall: Optional[List[Tuple[str, str]]] = None,
+                 failures: Optional[List[dict]] = None):
         self.groups = groups      # [{seq, op, names, error, flags,
         #                            sizes: {name: [dim0 per process]}}]
         self.shutdown = shutdown
@@ -149,6 +150,12 @@ class FetchResponse:
         # (missing-ranks diagnostics, operations.cc:1625-1672), logged by
         # every process; keyed by name so no one re-parses display text.
         self.stall = stall or []
+        # Escalated failure events ({rank, kind, detail} dicts) — present
+        # only when HOROVOD_TPU_FAILURE_TIMEOUT > 0 (elastic runs):
+        # receiving engines fail their pending handles with a typed
+        # WorkerFailure instead of waiting on a quorum that can never
+        # complete.
+        self.failures = failures or []
 
 
 class _Entry:
@@ -227,6 +234,15 @@ class CoordinatorService(BasicService):
         self.stall_warning_s = (stall_warning_s if stall_warning_s is not None
                                 else _envmod.stall_warning_secs())
         self._last_stall_check = time.monotonic()
+        # Failure escalation (elastic): the fetch long-poll every worker
+        # issues each cycle doubles as its control-plane heartbeat. With
+        # HOROVOD_TPU_FAILURE_TIMEOUT > 0, a rank silent past the window
+        # — or a tensor stuck partially announced past it — becomes a
+        # typed failure event shipped to every surviving rank through
+        # the fetch response (check_failures); 0 keeps the seed's
+        # warn-only behavior.
+        self.failure_timeout_s = _envmod.failure_timeout_secs()
+        self._last_seen: Dict[int, float] = {}
         # Plan-affecting env knobs, stamped into every group so all
         # processes execute the same program shape (Response::Flags).
         self._flags = ((_wire.FLAG_HIERARCHICAL_ALLREDUCE
@@ -288,6 +304,7 @@ class CoordinatorService(BasicService):
 
     def _announce(self, req: AnnounceRequest) -> AnnounceResponse:
         with self._cv:
+            self._last_seen[req.rank] = time.monotonic()
             if req.announce_id:
                 if req.announce_id <= self._last_announce.get(req.rank, 0):
                     return AnnounceResponse()  # duplicate delivery (retry)
@@ -425,8 +442,48 @@ class CoordinatorService(BasicService):
                 "\n".join(line for _, line in lines))
         return lines
 
+    def check_failures(self) -> List[dict]:
+        """Escalated failure events (elastic recovery): ranks whose
+        control-plane heartbeat (announce/fetch) went silent past
+        ``failure_timeout_s``, and — on the fallback planner, which owns
+        the Python tensor table — tensors stuck partially announced past
+        it, attributed to their missing ranks. Empty when escalation is
+        off (the default) or nothing is overdue. Ranks that have never
+        contacted the coordinator are NOT flagged: initial rendezvous
+        may legitimately take longer than the failure window."""
+        if self.failure_timeout_s <= 0:
+            return []
+        now = time.monotonic()
+        failures: List[dict] = []
+        for rank, t in sorted(self._last_seen.items()):
+            if now - t > self.failure_timeout_s:
+                failures.append({
+                    "rank": rank, "kind": "heartbeat_timeout",
+                    "detail": (f"rank {rank} last contacted the "
+                               f"coordinator {now - t:.1f}s ago "
+                               f"(failure timeout "
+                               f"{self.failure_timeout_s:.1f}s)")})
+        if self._ctl is None:
+            with self._mu:
+                for name, e in sorted(self._table.items()):
+                    age = now - e.first_seen
+                    if age > self.failure_timeout_s:
+                        missing = sorted(set(range(self._nproc)) - e.ranks)
+                        failures.append({
+                            "rank": missing[0] if missing else -1,
+                            "kind": "stall",
+                            "detail": (f"tensor {name} waited {age:.1f}s "
+                                       f"(> failure timeout) for ranks "
+                                       f"{missing}")})
+        return failures
+
     def _fetch(self, req: FetchRequest) -> FetchResponse:
         stall = self.check_stalls()
+        # Refresh the fetching rank's heartbeat BEFORE checking: a rank
+        # returning after a long idle gap must not be handed its own
+        # obituary.
+        self._last_seen[req.rank] = time.monotonic()
+        failures = self.check_failures()
         deadline = time.monotonic() + max(0.0, req.wait_s)
         if self._ctl is not None:
             # Autotune cadence: rank 0's fetch marks one coordinator-side
@@ -465,7 +522,8 @@ class CoordinatorService(BasicService):
                 for i, g in enumerate(groups):
                     g["seq"] = req.after_seq + i
                 return FetchResponse(groups, shutdown, payload=payload,
-                                     params=self._ctl.params(), stall=stall)
+                                     params=self._ctl.params(), stall=stall,
+                                     failures=failures)
         with self._cv:
             self._acked[req.rank] = max(self._acked.get(req.rank, 0),
                                         req.after_seq)
@@ -504,7 +562,7 @@ class CoordinatorService(BasicService):
                 groups, self._shutdown,
                 payload=_wire.encode_response_list(groups, self._shutdown,
                                                    self._nproc),
-                params=params, stall=stall)
+                params=params, stall=stall, failures=failures)
 
     # ------------------------------------------------------------- planning
 

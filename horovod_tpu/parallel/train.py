@@ -127,6 +127,20 @@ def build_train_step(cfg: tfm.TransformerConfig, mesh: Mesh, optimizer):
                 raise ValueError(
                     "Zero1State optimizer state requires a 'dp' mesh "
                     "axis to shard over")
+            # The flat-shard layout (padding, per-shard sizes) is baked
+            # in at zero1_init time; a mismatched dp size would surface
+            # as an opaque jit sharding failure deep inside shard_map.
+            # Reject it here with the actual numbers instead.
+            if opt_state.n_shards is not None:
+                recorded = int(opt_state.n_shards)
+                dp = int(mesh.shape["dp"])
+                if recorded != dp:
+                    raise ValueError(
+                        f"Zero1State was built for n_shards={recorded} "
+                        f"but this mesh's 'dp' axis has {dp} shards; "
+                        "the flat-shard padding depends on the shard "
+                        "count, so rebuild the state with "
+                        f"zero1_init(..., n_shards={dp}) for this mesh")
             for s in jax.tree_util.tree_leaves(
                     specs, is_leaf=lambda x: isinstance(x, P)):
                 if "dp" in _spec_axes(s):

@@ -53,6 +53,13 @@ import optax
 
 class Zero1State(NamedTuple):
     inner: Any          # inner optimizer state over flat sharded leaves
+    # Shard count the state was built for (zero1_init's n_shards).
+    # Recorded so build_train_step.make() can reject a state whose
+    # padding/layout disagrees with the mesh's 'dp' size with a clear
+    # error instead of an opaque jit sharding failure. A pytree LEAF
+    # (NamedTuple fields always are), so it travels through jit as a
+    # replicated scalar; None only for hand-built legacy states.
+    n_shards: Any = None
 
 
 def _spec_axes_ordered(spec):
@@ -133,7 +140,8 @@ def zero1_init(inner: optax.GradientTransformation, params,
             is_leaf=lambda x: isinstance(x, P))
         # tree_map over (params, specs) keys off params' structure; the
         # result has params' treedef, which is what optax init expects.
-    return Zero1State(inner=inner.init(flat_params))
+    return Zero1State(inner=inner.init(flat_params),
+                      n_shards=int(n_shards))
 
 
 def zero1_state_specs(state: Zero1State, params, param_specs,
@@ -148,8 +156,13 @@ def zero1_state_specs(state: Zero1State, params, param_specs,
         for s in jax.tree_util.tree_flatten(
             param_specs, is_leaf=lambda x: isinstance(x, P))[0]]
     per_param_specs = jax.tree_util.tree_unflatten(ptreedef, spec_leaves)
-    return Zero1State(inner=state_specs_by_structure(
-        state.inner, params, per_param_specs))
+    # n_shards mirrors the state's structure: a replicated scalar spec
+    # when recorded, None (empty subtree) for legacy states — the spec
+    # tree must stay a structural match for shard_map's in/out_specs.
+    return Zero1State(
+        inner=state_specs_by_structure(state.inner, params,
+                                       per_param_specs),
+        n_shards=None if state.n_shards is None else P())
 
 
 def zero1_update(inner: optax.GradientTransformation, grads,
@@ -180,4 +193,4 @@ def zero1_update(inner: optax.GradientTransformation, grads,
         return full[: p.size].reshape(p.shape).astype(p.dtype)
 
     updates = jax.tree_util.tree_map(to_full, upd_shards, params)
-    return updates, Zero1State(inner=new_inner)
+    return updates, Zero1State(inner=new_inner, n_shards=state.n_shards)

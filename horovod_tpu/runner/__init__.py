@@ -12,10 +12,23 @@ Pieces:
                       (driver/driver_service.py)
   - :mod:`.api`       ``run(fn)`` (horovod.spark.run, spark/__init__.py)
   - CLI: ``python -m horovod_tpu.runner -np 4 python train.py``
+        (``--discovery {hostfile,ssh,tpu-pod}`` resolves workers through
+        the elastic subsystem's HostProvider; ``--elastic`` survives
+        worker loss — see horovod_tpu/elastic/ and docs/elastic.md)
 """
 
 from .api import run
 from .launcher import launch, parse_hosts
 from .network import find_free_port
 
-__all__ = ["run", "launch", "parse_hosts", "find_free_port"]
+__all__ = ["run", "run_elastic", "launch", "parse_hosts",
+           "find_free_port"]
+
+
+def __getattr__(name):
+    # Lazy: the elastic driver imports this package's submodules, so a
+    # top-level import here would be circular.
+    if name == "run_elastic":
+        from ..elastic.driver import run_elastic
+        return run_elastic
+    raise AttributeError(name)

@@ -3,6 +3,14 @@
 The reference has no dedicated CLI (bare ``mpirun`` per docs/running.md:
 1-45); this plays mpirun's role for the TPU-native stack. Slots follow
 mpirun's ``-H host:slots`` syntax; output is tag-prefixed per rank.
+
+Worker discovery (``--discovery {hostfile,ssh,tpu-pod}``) resolves the
+host list through the :class:`horovod_tpu.elastic.HostProvider`
+interface instead of a literal ``-H`` string — the cluster-manager
+integration the reference delegates to Spark (SURVEY M7). ``--elastic``
+additionally survives worker loss: the job shrinks to the surviving
+hosts (never below ``--min-np``), relaunches, and grows back when
+replacements appear (docs/elastic.md).
 """
 
 from __future__ import annotations
@@ -16,11 +24,43 @@ def main(argv=None) -> int:
         prog="python -m horovod_tpu.runner",
         description="Launch a distributed horovod_tpu job "
                     "(the mpirun of the TPU-native stack).")
-    parser.add_argument("-np", "--num-proc", type=int, required=True,
-                        help="total number of worker processes")
+    parser.add_argument("-np", "--num-proc", type=int, default=None,
+                        help="total number of worker processes (default "
+                             "with --discovery: every discovered slot)")
     parser.add_argument("-H", "--hosts", default=None,
                         help="host slots, mpirun syntax: host1:2,host2:2 "
-                             "(default: localhost)")
+                             "(default: localhost); with --discovery ssh "
+                             "these are the candidates to probe")
+    parser.add_argument("--discovery", default=None,
+                        choices=["hostfile", "ssh", "tpu-pod"],
+                        help="resolve workers through a HostProvider: "
+                             "a hostfile, ssh-probed -H candidates, or "
+                             "the GCE metadata server of a TPU pod")
+    parser.add_argument("--hostfile", default=None,
+                        help="hostfile path for --discovery hostfile "
+                             "(lines: 'host slots=N', 'host:N', 'host')")
+    parser.add_argument("--metadata-addr", default=None,
+                        help="metadata server base URL for --discovery "
+                             "tpu-pod (default: $HOROVOD_TPU_METADATA_ADDR "
+                             "or the real GCE endpoint)")
+    parser.add_argument("--elastic", action="store_true",
+                        help="survive worker loss: shrink to the "
+                             "remaining hosts (>= --min-np), relaunch, "
+                             "grow back when hosts return")
+    parser.add_argument("--min-np", type=int, default=1,
+                        help="elastic: smallest world size to continue "
+                             "with (default 1)")
+    parser.add_argument("--max-np", type=int, default=None,
+                        help="elastic: largest world size to grow to "
+                             "(default: -np, else all discovered slots)")
+    parser.add_argument("--max-restarts", type=int, default=3,
+                        help="elastic: relaunch budget (default 3)")
+    parser.add_argument("--failure-timeout", type=float, default=30.0,
+                        help="elastic: seconds before stalls/heartbeat "
+                             "loss escalate to WorkerFailure (default 30)")
+    parser.add_argument("--state-dir", default=None,
+                        help="elastic: ElasticState commit directory, "
+                             "exported as HOROVOD_TPU_ELASTIC_DIR")
     parser.add_argument("--timeout", type=float, default=None,
                         help="overall job timeout in seconds")
     parser.add_argument("--no-tag-output", action="store_true",
@@ -35,9 +75,47 @@ def main(argv=None) -> int:
     if command and command[0] == "--":
         command = command[1:]
 
+    provider = None
+    hosts = args.hosts
+    np = args.num_proc
+    if args.discovery:
+        from ..elastic.discovery import get_provider
+        provider = get_provider(args.discovery, hosts=args.hosts,
+                                hostfile=args.hostfile,
+                                metadata_addr=args.metadata_addr)
+        slots = provider.discover()
+        if not slots:
+            parser.error(f"--discovery {args.discovery} found no workers")
+        hosts = ",".join(f"{h}:{s}" for h, s in slots)
+        if np is None:
+            np = sum(s for _, s in slots)
+        print(f"[discovery:{args.discovery}] "
+              f"{len(slots)} host(s), {sum(s for _, s in slots)} slot(s): "
+              f"{hosts}", file=sys.stderr)
+
+    if args.elastic:
+        from ..elastic.driver import run_elastic_command
+        from ..elastic.failure import FailureConfig
+        config = FailureConfig(failure_timeout_s=args.failure_timeout,
+                               max_restarts=args.max_restarts)
+        try:
+            return run_elastic_command(
+                command, min_np=args.min_np,
+                max_np=args.max_np if args.max_np is not None else np,
+                provider=provider, hosts=hosts,
+                state_dir=args.state_dir, config=config,
+                tag_output=not args.no_tag_output,
+                run_timeout=args.timeout)
+        except KeyboardInterrupt:
+            return 130
+
+    if np is None:
+        parser.error("-np is required (or use --discovery to size the "
+                     "job from the discovered slots)")
+
     from .launcher import launch
 
-    job = launch(command, np=args.num_proc, hosts=args.hosts,
+    job = launch(command, np=np, hosts=hosts,
                  tag_output=not args.no_tag_output)
     try:
         return job.wait(timeout=args.timeout)

@@ -80,6 +80,11 @@ class Topology:
     local_rank: int           # == 0 for the leader virtual rank
     local_size: int           # local device count
     is_homogeneous: bool      # same local_size everywhere (operations.cc:1772-1790)
+    # Elastic generation: 0 for the first launch (and all non-elastic
+    # jobs); bumped by the elastic driver on every recovery relaunch
+    # (HOROVOD_TPU_ELASTIC_GENERATION). A worker function uses it to
+    # tell a cold start from a post-failure rejoin.
+    generation: int = 0
 
 
 _lock = threading.Lock()
@@ -130,6 +135,7 @@ def _build_topology(devices: Sequence, process_index: int,
         local_rank=0,
         local_size=local_size,
         is_homogeneous=is_homogeneous,
+        generation=_env_int("HOROVOD_TPU_ELASTIC_GENERATION") or 0,
     )
 
 
@@ -164,6 +170,18 @@ def init(*, coordinator_address: Optional[str] = None,
         pid = process_id if process_id is not None else _env_int(
             "HOROVOD_TPU_PROCESS_ID")
         if coord and (nproc or 0) > 1:
+            # Multi-process CPU meshes (the pod-shape test/dev harness)
+            # need a real CPU collectives implementation — without it,
+            # some jaxlib versions build a CPU client that rejects
+            # multi-process computations outright. Gloo is jaxlib's
+            # bundled TCP implementation; the knob only affects CPU
+            # client creation, so it is a no-op on TPU backends. Must
+            # run before the first backend touch.
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            except Exception:  # pragma: no cover - jax API drift
+                pass
             jax.distributed.initialize(
                 coordinator_address=coord,
                 num_processes=nproc,
@@ -274,6 +292,13 @@ def mesh() -> Mesh:
 def hierarchical_mesh() -> Mesh:
     """The ``('dcn', 'ici')`` mesh (the local/cross communicator split)."""
     return _get().hier_mesh
+
+
+def generation() -> int:
+    """Elastic generation of this job (TPU-native extra): 0 on the first
+    launch, incremented by the elastic driver on every recovery
+    relaunch. See :mod:`horovod_tpu.elastic`."""
+    return _get().generation
 
 
 def mpi_threads_supported() -> bool:

@@ -166,6 +166,15 @@ def synchronize_many(handles) -> list:
         if not th.from_bits:
             aliased = _interop.try_jax_to_torch(out)
             if aliased is not None and aliased.dtype == th.dtype:
+                if th.target is None:
+                    # Out-of-place result: the DLPack tensor ALIASES the
+                    # engine-owned XLA buffer, and torch has no read-only
+                    # tensors — handing the alias out would let ordinary
+                    # in-place math (result.add_(...)) silently mutate an
+                    # array the engine still retains. Clone before
+                    # release; in-place variants below only read the
+                    # alias as a copy_ source, so they keep zero-copy.
+                    aliased = aliased.clone()
                 results[i] = aliased
                 continue
         rest.append(i)

@@ -1,0 +1,67 @@
+"""JAX version compatibility shims.
+
+The codebase targets the stable ``jax.shard_map`` API (jax >= 0.5-era:
+top-level export, ``check_vma`` kwarg). On older installs the same
+machinery lives at ``jax.experimental.shard_map.shard_map`` with the
+replication check named ``check_rep``. Rather than scatter try/except
+over every call site (the executor alone builds a dozen shard_map
+programs), this module installs a forward-compatible ``jax.shard_map``
+once, at package import:
+
+  - same call shape as the stable API, including ``check_vma``;
+  - delegates to the experimental implementation, translating
+    ``check_vma`` -> ``check_rep`` (both gate the output-replication
+    check; the rename tracked jax's varying-manual-axes rework).
+
+Likewise ``jax.lax.axis_size`` (stable API) is backfilled from the old
+``jax.core.axis_frame`` (which returns the static size of a bound mesh
+axis on those versions).
+
+On jax versions that already export these names this module does
+nothing.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def _install_shard_map() -> None:
+    if hasattr(jax, "shard_map"):
+        return
+    try:
+        from jax.experimental.shard_map import shard_map as _shard_map
+    except ImportError:  # pragma: no cover - very old jax, nothing to do
+        return
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, **kwargs):
+        if check_vma is not None and "check_rep" not in kwargs:
+            kwargs["check_rep"] = check_vma
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kwargs)
+
+    jax.shard_map = shard_map
+
+
+def _install_axis_size() -> None:
+    if hasattr(lax, "axis_size"):
+        return
+
+    def axis_size(axis_name):
+        from jax import core
+        size = core.axis_frame(axis_name)
+        if not isinstance(size, int):  # newer frame object spelling
+            size = size.size
+        return size
+
+    lax.axis_size = axis_size
+
+
+def install() -> None:
+    _install_shard_map()
+    _install_axis_size()
+
+
+install()

@@ -46,6 +46,18 @@ def stall_warning_secs() -> float:
     return DEFAULT_STALL_WARNING_SECS
 
 
+def failure_timeout_secs() -> float:
+    """Window after which the stall detector / coordinator heartbeats
+    escalate to a typed WorkerFailure (elastic recovery) instead of the
+    warn-only behavior. 0 (the default) disables escalation — exactly
+    the seed's coordinated-shutdown-only semantics. Exported to workers
+    by the elastic driver as HOROVOD_TPU_FAILURE_TIMEOUT."""
+    v = _get("FAILURE_TIMEOUT")
+    if v in (None, ""):
+        return 0.0
+    return float(v)
+
+
 def timeline_path() -> Optional[str]:
     return _get("TIMELINE")
 

@@ -1,0 +1,566 @@
+"""Elastic subsystem tests.
+
+Fast tests cover discovery (including the TPU-pod path against a FAKE
+metadata HTTP server — no GCP anywhere), failure typing/detection, the
+ElasticState commit/rollback/restore contract, and the escalation
+plumbing in the engine and coordinator.
+
+The slow class is the acceptance scenario: a spawned multi-process
+elastic run survives a SIGKILL of a non-coordinator worker — the job
+shrinks, re-rendezvouses, resumes from the last committed ElasticState,
+and the result matches a clean run replayed from that same commit
+(rtol 1e-5).
+"""
+
+import os
+import pickle
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.elastic import (ElasticState, FailureConfig,
+                                 FailureDetector, HostfileProvider,
+                                 SSHProbeProvider, StaticProvider,
+                                 TPUPodProvider, WorkerFailure,
+                                 get_provider)
+from horovod_tpu.elastic.discovery import (WORKER_ENDPOINTS_PATH,
+                                           _parse_worker_endpoints)
+
+_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+}
+
+
+# ---------------------------------------------------------------------------
+# Discovery
+# ---------------------------------------------------------------------------
+
+class TestHostfileProvider:
+    def test_parses_all_line_forms(self, tmp_path):
+        hf = tmp_path / "hosts"
+        hf.write_text(
+            "# cluster A\n"
+            "alpha slots=4\n"
+            "beta:2\n"
+            "gamma\n"
+            "\n"
+            "delta slots=1  # trailing comment\n")
+        assert HostfileProvider(str(hf)).discover() == [
+            ("alpha", 4), ("beta", 2), ("gamma", 1), ("delta", 1)]
+
+    def test_reread_per_discover(self, tmp_path):
+        """Elastic growth: an operator editing the hostfile changes the
+        next discovery, not just the first."""
+        hf = tmp_path / "hosts"
+        hf.write_text("a:1\n")
+        p = HostfileProvider(str(hf))
+        assert p.discover() == [("a", 1)]
+        hf.write_text("a:1\nb:2\n")
+        assert p.discover() == [("a", 1), ("b", 2)]
+
+
+class TestSSHProbeProvider:
+    def test_filters_unreachable(self):
+        p = SSHProbeProvider([("up1", 2), ("down", 2), ("up2", 1)],
+                             probe=lambda h: h.startswith("up"))
+        assert p.discover() == [("up1", 2), ("up2", 1)]
+
+    def test_local_hosts_skip_probe(self):
+        p = SSHProbeProvider([("localhost", 2)],
+                             probe=lambda h: pytest.fail(
+                                 "probed a local host"))
+        assert p.discover() == [("localhost", 2)]
+
+
+class TestWorkerEndpointParsing:
+    def test_uid_ip_port_triples(self):
+        assert _parse_worker_endpoints(
+            "uid0:10.0.0.2:8470,uid1:10.0.0.3:8470") == [
+                "10.0.0.2", "10.0.0.3"]
+
+    def test_bare_and_mixed(self):
+        assert _parse_worker_endpoints(
+            "10.0.0.2, host-b:8470, uid:10.0.0.4:8470,,") == [
+                "10.0.0.2", "host-b", "10.0.0.4"]
+
+
+class _FakeMetadata(BaseHTTPRequestHandler):
+    body = b"uid0:10.128.0.2:8470,uid1:10.128.0.3:8470"
+
+    def do_GET(self):
+        if self.headers.get("Metadata-Flavor") != "Google":
+            self.send_response(403)
+            self.end_headers()
+            return
+        if self.path == WORKER_ENDPOINTS_PATH:
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(self.body)
+        else:
+            self.send_response(404)
+            self.end_headers()
+
+    def log_message(self, *a):  # keep pytest output clean
+        pass
+
+
+@pytest.fixture
+def fake_metadata_server():
+    srv = HTTPServer(("127.0.0.1", 0), _FakeMetadata)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield f"http://127.0.0.1:{srv.server_address[1]}"
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+class TestTPUPodProvider:
+    def test_discovers_through_fake_metadata_server(
+            self, fake_metadata_server):
+        p = TPUPodProvider(metadata_addr=fake_metadata_server,
+                           slots_per_host=1)
+        assert p.discover() == [("10.128.0.2", 1), ("10.128.0.3", 1)]
+
+    def test_slots_per_host(self, fake_metadata_server):
+        p = TPUPodProvider(metadata_addr=fake_metadata_server,
+                           slots_per_host=4)
+        assert p.discover() == [("10.128.0.2", 4), ("10.128.0.3", 4)]
+
+    def test_metadata_addr_env(self, fake_metadata_server, monkeypatch):
+        monkeypatch.setenv("HOROVOD_TPU_METADATA_ADDR",
+                           fake_metadata_server)
+        assert TPUPodProvider().discover() == [
+            ("10.128.0.2", 1), ("10.128.0.3", 1)]
+
+    def test_unreachable_metadata_raises_actionable_error(self):
+        p = TPUPodProvider(metadata_addr="http://127.0.0.1:1",
+                           timeout=0.5)
+        with pytest.raises(RuntimeError,
+                           match="HOROVOD_TPU_METADATA_ADDR"):
+            p.discover()
+
+
+class TestGetProvider:
+    def test_factory_shapes(self, tmp_path):
+        hf = tmp_path / "hosts"
+        hf.write_text("a:2\n")
+        assert isinstance(get_provider(None, hosts="a:2"), StaticProvider)
+        assert isinstance(get_provider("hostfile", hostfile=str(hf)),
+                          HostfileProvider)
+        assert isinstance(get_provider("ssh", hosts="a:2"),
+                          SSHProbeProvider)
+        assert isinstance(get_provider("tpu-pod", metadata_addr="http://x"),
+                          TPUPodProvider)
+        with pytest.raises(ValueError, match="hostfile"):
+            get_provider("hostfile")
+        with pytest.raises(ValueError, match="unknown discovery"):
+            get_provider("k8s")
+
+
+# ---------------------------------------------------------------------------
+# Failure typing / detection
+# ---------------------------------------------------------------------------
+
+class _FakeWorker:
+    def __init__(self, rc=None):
+        self.rc = rc
+
+    def poll(self):
+        return self.rc
+
+
+class _FakeJob:
+    def __init__(self, rcs):
+        self.workers = [_FakeWorker(rc) for rc in rcs]
+        self.terminated = False
+
+    def terminate(self):
+        self.terminated = True
+
+
+class TestWorkerFailure:
+    def test_typed_fields_and_pickle(self):
+        wf = WorkerFailure(rank=3, host="tpu-w-3", kind="killed",
+                           detail="exited with code -9")
+        assert isinstance(wf, hvd.HorovodInternalError)
+        wf2 = pickle.loads(pickle.dumps(wf))
+        assert (wf2.rank, wf2.host, wf2.kind) == (3, "tpu-w-3", "killed")
+        assert "tpu-w-3" in str(wf2)
+
+    def test_backoff_schedule(self):
+        cfg = FailureConfig(backoff_s=1.0, backoff_factor=2.0,
+                            max_backoff_s=5.0)
+        b = cfg.backoff_s
+        seq = []
+        for _ in range(4):
+            b = cfg.next_backoff(b)
+            seq.append(b)
+        assert seq == [2.0, 4.0, 5.0, 5.0]
+
+
+class TestFailureDetector:
+    def test_detects_signal_death_as_killed(self):
+        job = _FakeJob([None, -9])
+        det = FailureDetector(job, ["hostA", "hostB"])
+        with pytest.raises(WorkerFailure) as ei:
+            det.check()
+        assert ei.value.rank == 1
+        assert ei.value.host == "hostB"
+        assert ei.value.kind == "killed"
+        assert job.terminated
+
+    def test_nonzero_exit_is_exit_kind(self):
+        det = FailureDetector(_FakeJob([2, None]), ["h0", "h1"])
+        with pytest.raises(WorkerFailure) as ei:
+            det.check()
+        assert ei.value.kind == "exit"
+        assert ei.value.rank == 0
+
+    def test_healthy_job_passes(self):
+        det = FailureDetector(_FakeJob([None, 0, None]), ["a", "b", "c"])
+        det.check()  # no raise
+        assert det.failures == []
+
+
+# ---------------------------------------------------------------------------
+# ElasticState
+# ---------------------------------------------------------------------------
+
+class TestElasticState:
+    def test_commit_rollback_in_memory(self):
+        st = ElasticState(params={"w": np.ones(3)})
+        st.commit(5)
+        st.params = {"w": np.full(3, 9.0)}
+        assert st.step == 5
+        st.rollback()
+        np.testing.assert_array_equal(st.params["w"], np.ones(3))
+        assert st.step == 5
+
+    def test_commit_restore_roundtrip(self, tmp_path):
+        d = str(tmp_path / "elastic")
+        st = ElasticState(directory=d, params={"w": np.arange(4.0)},
+                          opt={"m": np.zeros(4)})
+        st.commit(5)
+        st.params = {"w": np.arange(4.0) * 10}
+        st.commit(10)
+
+        fresh = ElasticState(directory=d, params={"w": np.zeros(4)},
+                             opt={"m": np.ones(4)})
+        fresh.restore()
+        assert fresh.step == 10
+        np.testing.assert_allclose(fresh.params["w"], np.arange(4.0) * 10)
+
+        older = ElasticState(directory=d, params={"w": np.zeros(4)},
+                             opt={"m": np.ones(4)})
+        older.restore(step=5)
+        assert older.step == 5
+        np.testing.assert_allclose(older.params["w"], np.arange(4.0))
+        np.testing.assert_allclose(older.opt["m"], np.zeros(4))
+
+    def test_restore_without_commit_keeps_initial(self, tmp_path):
+        st = ElasticState(directory=str(tmp_path / "none"),
+                          params={"w": np.full(2, 7.0)})
+        st.restore()
+        assert st.step == 0
+        np.testing.assert_array_equal(st.params["w"], np.full(2, 7.0))
+
+    def test_latest_repointed_atomically(self, tmp_path):
+        d = str(tmp_path / "e2")
+        st = ElasticState(directory=d, params={"w": np.zeros(1)})
+        st.commit(3)
+        with open(os.path.join(d, "LATEST")) as f:
+            assert f.read().strip() == "3"
+        assert os.path.exists(os.path.join(d, "3.pkl"))
+
+    def test_requires_trees(self):
+        with pytest.raises(ValueError, match="named tree"):
+            ElasticState()
+
+
+# ---------------------------------------------------------------------------
+# Escalation plumbing (engine + coordinator)
+# ---------------------------------------------------------------------------
+
+class TestEngineStallEscalation:
+    def test_overdue_request_fails_with_worker_failure(self):
+        from horovod_tpu.ops import collective as coll
+
+        eng = coll.CollectiveEngine()
+        eng.stall_warning_s = 0.01
+        eng.failure_timeout_s = 0.05
+        eng._last_stall_check = time.monotonic() - 100
+        h = eng.make_handle("stall.t")
+        req = coll._Request("stall.t", coll.ALLREDUCE,
+                            np.ones(4, np.float32), h)
+        req.enqueued_at = time.monotonic() - 10
+        eng._in_flight["stall.t"] = req
+        eng._maybe_check_stalls()
+        assert h.poll()
+        with pytest.raises(WorkerFailure, match="failure timeout"):
+            h.wait()
+        assert "stall.t" not in eng._in_flight
+
+    def test_disabled_timeout_keeps_warn_only(self):
+        from horovod_tpu.ops import collective as coll
+
+        eng = coll.CollectiveEngine()
+        eng.stall_warning_s = 0.01
+        eng.failure_timeout_s = 0.0   # seed behavior
+        eng._last_stall_check = time.monotonic() - 100
+        h = eng.make_handle("warn.t")
+        req = coll._Request("warn.t", coll.ALLREDUCE,
+                            np.ones(4, np.float32), h)
+        req.enqueued_at = time.monotonic() - 10
+        eng._in_flight["warn.t"] = req
+        eng._maybe_check_stalls()
+        assert not h.poll()           # still pending, only warned
+        eng._in_flight.clear()
+
+    def test_fetch_side_channel_failures_fail_pending(self):
+        from horovod_tpu.ops import collective as coll
+        from horovod_tpu.ops.control_plane import FetchResponse
+
+        eng = coll.CollectiveEngine()
+        h = eng.make_handle("mp.t")
+        req = coll._Request("mp.t", coll.ALLREDUCE,
+                            np.ones(2, np.float32), h)
+        eng._in_flight["mp.t"] = req
+        resp = FetchResponse([], False, failures=[
+            {"rank": 1, "kind": "heartbeat_timeout",
+             "detail": "rank 1 silent for 31.0s"}])
+        eng._apply_fetch_side_channel(resp)
+        with pytest.raises(WorkerFailure) as ei:
+            h.wait()
+        assert ei.value.rank == 1
+        assert ei.value.kind == "heartbeat_timeout"
+
+
+class TestCoordinatorFailureDetection:
+    def _svc(self):
+        from horovod_tpu.ops.control_plane import CoordinatorService
+        from horovod_tpu.runner.secret import make_secret_key
+
+        svc = CoordinatorService(2, make_secret_key(), native=False)
+        svc.failure_timeout_s = 0.25
+        return svc
+
+    def _req(self, name):
+        return {"name": name, "op": 0, "dtype": "float32",
+                "shape": (4,), "root_rank": -1, "device": 0}
+
+    def test_heartbeat_and_stall_escalation(self):
+        from horovod_tpu.ops.control_plane import (AnnounceRequest,
+                                                   FetchRequest)
+
+        svc = self._svc()
+        try:
+            # Both ranks check in once; rank 0 announces a tensor rank 1
+            # never will.
+            svc._announce(AnnounceRequest(0, [self._req("e.t")],
+                                          announce_id=1))
+            svc._announce(AnnounceRequest(1, [], announce_id=1))
+            resp = svc._fetch(FetchRequest(0, 0, 0.0))
+            assert resp.failures == []          # nothing overdue yet
+            time.sleep(0.35)
+            resp = svc._fetch(FetchRequest(0, resp.groups[-1]["seq"] + 1
+                                           if resp.groups else 0, 0.0))
+            kinds = {f["kind"] for f in resp.failures}
+            assert "heartbeat_timeout" in kinds  # rank 1 went silent
+            assert "stall" in kinds              # e.t stuck partial
+            ranks = {f["rank"] for f in resp.failures}
+            assert 1 in ranks
+            assert 0 not in ranks                # the fetching rank is alive
+        finally:
+            svc.shutdown()
+
+    def test_never_seen_ranks_not_flagged(self):
+        """Initial rendezvous may be slow; a rank that has never
+        contacted the coordinator is not declared dead."""
+        from horovod_tpu.ops.control_plane import FetchRequest
+
+        svc = self._svc()
+        try:
+            time.sleep(0.3)
+            resp = svc._fetch(FetchRequest(0, 0, 0.0))
+            assert resp.failures == []
+        finally:
+            svc.shutdown()
+
+    def test_disabled_by_default(self, monkeypatch):
+        from horovod_tpu.ops.control_plane import CoordinatorService
+        from horovod_tpu.runner.secret import make_secret_key
+
+        monkeypatch.delenv("HOROVOD_TPU_FAILURE_TIMEOUT", raising=False)
+        monkeypatch.delenv("HOROVOD_FAILURE_TIMEOUT", raising=False)
+        svc = CoordinatorService(2, make_secret_key(), native=False)
+        try:
+            assert svc.failure_timeout_s == 0.0
+            assert svc.check_failures() == []
+        finally:
+            svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# CLI discovery
+# ---------------------------------------------------------------------------
+
+class TestRunnerDiscoveryCLI:
+    def test_hostfile_discovery_sizes_and_runs(self, tmp_path, capsys):
+        import sys
+        from horovod_tpu.runner.__main__ import main
+
+        hf = tmp_path / "hosts"
+        hf.write_text("localhost slots=2\n")
+        rc = main(["--discovery", "hostfile", "--hostfile", str(hf),
+                   "--no-tag-output", "--",
+                   sys.executable, "-c", "print('cli-ok')"])
+        assert rc == 0
+        err = capsys.readouterr().err
+        assert "[discovery:hostfile] 1 host(s), 2 slot(s)" in err
+
+    def test_tpu_pod_discovery_through_fake_metadata(
+            self, tmp_path, capsys, monkeypatch):
+        import sys
+        from horovod_tpu.runner.__main__ import main
+
+        srv = HTTPServer(("127.0.0.1", 0), _FakeMetadata)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            body = _FakeMetadata.body
+            _FakeMetadata.body = b"uid0:127.0.0.1:8470"
+            rc = main(["--discovery", "tpu-pod",
+                       "--metadata-addr",
+                       f"http://127.0.0.1:{srv.server_address[1]}",
+                       "--no-tag-output", "--",
+                       sys.executable, "-c", "print('pod-ok')"])
+            assert rc == 0
+            err = capsys.readouterr().err
+            assert "[discovery:tpu-pod]" in err
+            assert "127.0.0.1:1" in err
+        finally:
+            _FakeMetadata.body = body
+            srv.shutdown()
+            srv.server_close()
+
+    def test_missing_np_without_discovery_errors(self):
+        import sys
+        from horovod_tpu.runner.__main__ import main
+
+        with pytest.raises(SystemExit):
+            main(["--", sys.executable, "-c", "pass"])
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: survive SIGKILL, shrink, resume from commit (slow)
+# ---------------------------------------------------------------------------
+
+def _make_elastic_worker():
+    """Factory so cloudpickle ships the worker BY VALUE (a module-level
+    function in tests/ would pickle by reference and be unimportable in
+    the spawned workers)."""
+
+    def _elastic_worker(total_steps, commit_every, kill_at,
+                        replay_from=None):
+        """Deterministic 4-dim quadratic descent; data is a pure
+        function of (step, process_rank), gradients are averaged across
+        the world, so a run's trajectory depends only on (start state,
+        world size). Rank 1 SIGKILLs itself at ``kill_at`` in
+        generation 0 — the host-loss simulation. ``replay_from`` builds
+        the clean-replay control: restore an explicit commit, never
+        commit again."""
+        import os
+        import signal
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        import horovod_tpu as hvd
+
+        hvd.init()
+        r = hvd.process_rank()
+        gen = hvd.generation()
+
+        state = hvd.ElasticState(params={"w": jnp.zeros((4,))})
+        state.restore(step=replay_from)
+        w = jnp.asarray(state.params["w"])
+        start = int(state.step)
+
+        target = jnp.asarray([1.0, -2.0, 3.0, 0.5])
+        for step in range(start, total_steps):
+            if gen == 0 and r == 1 and step == kill_at:
+                os.kill(os.getpid(), signal.SIGKILL)
+            # Per-rank data: scale depends on (step, rank); the averaged
+            # gradient therefore depends on world size — exactly why the
+            # replay control must run at the post-shrink size.
+            scale = 1.0 + 0.1 * ((step * 7 + r * 3) % 5)
+            grad = scale * (w - target)
+            grad = hvd.allreduce(grad, average=True, name=f"g.{step}")
+            w = w - 0.1 * grad
+            state.params = {"w": w}
+            if replay_from is None and (step + 1) % commit_every == 0:
+                state.commit(step + 1)
+        return {"w": np.asarray(w).tolist(), "gen": gen,
+                "size": hvd.size(), "start": start}
+
+    return _elastic_worker
+
+
+@pytest.mark.slow
+class TestElasticRecovery:
+    def test_sigkill_shrink_resume_matches_replay(self, tmp_path):
+        from horovod_tpu.elastic import run_elastic
+        from horovod_tpu.runner.api import run as plain_run
+
+        state_dir = str(tmp_path / "estate")
+        total, commit_every, kill_at = 20, 5, 12
+
+        worker = _make_elastic_worker()
+        cfg = FailureConfig(failure_timeout_s=60.0, max_restarts=2,
+                            backoff_s=0.2, backoff_factor=1.5,
+                            blacklist_s=600.0)
+        results = run_elastic(
+            worker, args=(total, commit_every, kill_at),
+            min_np=1, max_np=2, hosts="localhost:2",
+            state_dir=state_dir, config=cfg,
+            extra_env=dict(_ENV), start_timeout=300)
+
+        # The world shrank to 1 and resumed in generation 1 from the
+        # last commit before the kill (step 10, not 12 or 0).
+        assert len(results) == 1
+        final = results[0]
+        assert final["gen"] == 1
+        assert final["size"] == 1
+        assert final["start"] == 10
+
+        # Clean control: a fresh np=1 job replaying from the same
+        # commit, never failing. Numeric equality rtol 1e-5.
+        replay = plain_run(
+            worker, args=(total, commit_every, kill_at),
+            kwargs={"replay_from": 10}, np=1,
+            extra_env=dict(_ENV, **{"HOROVOD_TPU_ELASTIC_DIR": state_dir}),
+            start_timeout=300)
+        np.testing.assert_allclose(final["w"], replay[0]["w"], rtol=1e-5)
+        assert replay[0]["start"] == 10
+
+    def test_no_failure_single_generation(self, tmp_path):
+        """Control: without a kill the elastic driver is one generation
+        of the full world."""
+        from horovod_tpu.elastic import run_elastic
+
+        results = run_elastic(
+            _make_elastic_worker(), args=(6, 3, 10 ** 9),
+            min_np=1, max_np=2, hosts="localhost:2",
+            state_dir=str(tmp_path / "estate2"),
+            config=FailureConfig(max_restarts=1, backoff_s=0.2),
+            extra_env=dict(_ENV), start_timeout=300)
+        assert len(results) == 2
+        assert all(r["gen"] == 0 and r["size"] == 2 for r in results)
+        np.testing.assert_allclose(results[0]["w"], results[1]["w"])

@@ -502,6 +502,43 @@ class TestFusedProgramStability:
             "unpack compiled new programs for a recomposition of the "
             "same shapes - offsets are being baked in again")
 
+    def test_unpack_cache_bounded_lru(self, monkeypatch):
+        """ADVICE low: the unpack-program cache must not grow without
+        bound under shape churn; eviction is LRU (a recently reused key
+        survives)."""
+        import jax.numpy as jnp
+        from horovod_tpu import executor as ex
+
+        ex._UNPACK_CACHE.clear()
+        monkeypatch.setattr(ex, "_UNPACK_CACHE_MAX", 3)
+        buf = jnp.arange(256, dtype=jnp.float32)
+
+        def one(n):
+            res = [None]
+            ex._unpack(buf, [np.zeros((n,), np.float32)], [0], res)
+            return res[0]
+
+        for n in (8, 16, 32):
+            one(n)
+        assert len(ex._UNPACK_CACHE) == 3
+        one(8)            # refresh 8 => 16 is now least-recently-used
+        one(64)           # evicts 16
+        assert len(ex._UNPACK_CACHE) == 3
+        sizes = {k[0] for k in ex._UNPACK_CACHE}
+        assert (8,) in sizes and (16,) not in sizes
+        ex._UNPACK_CACHE.clear()
+
+    def test_unpack_offset_overflow_guard(self):
+        """Offsets ride as int32; a buffer too large for that must fail
+        loudly with the knob named, not slice at a wrapped offset."""
+        from horovod_tpu import executor as ex
+
+        class Huge:
+            size = 2 ** 31
+
+        with pytest.raises(ValueError, match="int32"):
+            ex._unpack(Huge(), [], [], [])
+
     def test_varying_composition_allreduce_values(self):
         """End-to-end: the same tensors fused in different per-step
         compositions (forced by distinct name sets) keep exact values."""

@@ -509,3 +509,26 @@ class TestZero1:
         make, _, _ = build_train_step(cfg2, mesh, opt)
         with pytest.raises(ValueError, match="dp"):
             make(params, zero1_init(opt, params, n_shards=2))
+
+    def test_n_shards_recorded_and_validated(self):
+        """ADVICE low: a Zero1State built for one shard count must be
+        rejected by make() against a mesh whose 'dp' axis differs — a
+        clear ValueError naming both numbers, not an opaque jit
+        sharding failure from mismatched flat-shard padding."""
+        from horovod_tpu.parallel.zero import zero1_init
+        opt = optax.adam(1e-2)
+        cfg, params, tok, tgt = self._setup(opt)
+        zstate = zero1_init(opt, params, n_shards=4)
+        assert int(zstate.n_shards) == 4
+        mesh = create_mesh(dp=8)
+        make, _, _ = build_train_step(cfg, mesh, opt)
+        with pytest.raises(ValueError,
+                           match=r"n_shards=4.*'dp' axis has 8"):
+            make(params, zstate)
+        # The matching count passes validation and still trains.
+        good = zero1_init(opt, params, n_shards=8)
+        l_z, losses, s = self._train(cfg, mesh, params, tok, tgt, opt,
+                                     good, steps=1)
+        assert np.isfinite(losses[0])
+        # n_shards survives the jitted step round-trip.
+        assert int(np.asarray(s.n_shards)) == 8

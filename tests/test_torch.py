@@ -343,3 +343,42 @@ class TestBroadcastState:
         opt = torch.optim.LBFGS(model.parameters())
         with pytest.raises(ValueError):
             hvd_torch.broadcast_optimizer_state(opt, root_rank=0)
+
+
+class TestResultAliasing:
+    """ADVICE medium: out-of-place synchronize results must not alias
+    engine-owned XLA buffers — in-place torch math on a returned tensor
+    must never mutate an array the engine still retains."""
+
+    def test_inplace_math_on_result_cannot_mutate_engine_array(self):
+        from horovod_tpu.torch import mpi_ops
+
+        t = torch.ones(16, dtype=torch.float32)
+        h = mpi_ops.allreduce_async(t, average=False, name="alias.reg")
+        # Hold the ENGINE handle before synchronize pops the torch-level
+        # entry: its _result is exactly the engine-retained jax array the
+        # DLPack egress would alias.
+        inner = mpi_ops._handles[h].inner
+        out = mpi_ops.synchronize(h)
+        engine_arr = inner._result
+        assert engine_arr is not None
+        before = np.asarray(engine_arr).copy()
+        out.mul_(0).sub_(123)          # hostile in-place math
+        after = np.asarray(engine_arr)
+        np.testing.assert_array_equal(before, after)
+
+    def test_out_of_place_results_unshared(self):
+        """Two out-of-place results of identical collectives must not
+        share storage with each other either (distinct clones)."""
+        t = torch.full((8,), 2.0)
+        a = hvd_torch.allreduce(t, average=False)
+        b = hvd_torch.allreduce(t, average=False)
+        a.add_(7)
+        assert not torch.equal(a, b)
+        assert torch.allclose(b, torch.full((8,), 2.0 * hvd.size()))
+
+    def test_inplace_variant_still_lands_in_target(self):
+        t = torch.ones(8, dtype=torch.float32)
+        out = hvd_torch.allreduce_(t, average=False)
+        assert out is t
+        assert torch.allclose(t, torch.full((8,), float(hvd.size())))
