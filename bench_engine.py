@@ -17,6 +17,7 @@ Prints ONE JSON line:
              "unfused_native": ..., "single_native": ...}}}
 """
 
+import argparse
 import json
 import os
 import subprocess
@@ -147,6 +148,145 @@ def run_overlap(*, fence: bool, bursts: int = 8):
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+# --------------------------------------------------------------------------
+# Wire-compression bench (--compression): bytes-on-wire, roundtrip error,
+# step time, and seeded convergence per wire format. All recorded DELTAS
+# (wire-byte ratios, error, loss-vs-fp32) are deterministic — seeded data,
+# CPU backend — so BENCH_COMPRESSION.json regenerates reproducibly; only
+# the *_ms fields are wall-clock and informational.
+# --------------------------------------------------------------------------
+
+COMPRESSION_MODES = ["fp32", "bf16_cast", "fp8_cast", "int8_blockwise",
+                     "fp8_blockwise"]
+
+COMPRESSION_WORKER = r"""
+import json, os, sys, time
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import optax
+import horovod_tpu as hvd
+from horovod_tpu.compression import Compression
+from horovod_tpu.ops import collective as _coll
+
+mode = sys.argv[1]
+steps = int(sys.argv[2])
+
+COMP = {"fp32": Compression.none, "bf16_cast": Compression.bf16,
+        "fp8_cast": Compression.fp8,
+        "int8_blockwise": Compression.int8_blockwise,
+        "fp8_blockwise": Compression.fp8_blockwise}[mode]
+
+hvd.init()
+rng = np.random.RandomState(0)
+
+# Synthetic gradient pytree: mixed sizes/magnitudes like a real model's
+# layer gradients (large near-zero embedding tail, small active head).
+tree = {
+    "embed": jnp.asarray(rng.standard_normal(8192).astype(np.float32) * 1e-3),
+    "w1": jnp.asarray(rng.standard_normal(2048).astype(np.float32) * 1e-2),
+    "w2": jnp.asarray(rng.standard_normal(777).astype(np.float32) * 1e-1),
+    "b": jnp.asarray(rng.standard_normal(65).astype(np.float32)),
+}
+logical = sum(int(v.size) * 4 for v in tree.values())
+
+eng = _coll.engine()
+base = eng.wire_bytes_enqueued
+out = hvd.allreduce_gradients(tree, average=True, compression=COMP)
+wire = eng.wire_bytes_enqueued - base
+
+# Max relative error per tensor (normalized by the tensor's absmax —
+# averaging replicated copies is the identity, so the input is the
+# reference), worst tensor reported.
+max_rel = 0.0
+for k in tree:
+    ref = np.asarray(tree[k], np.float32)
+    got = np.asarray(out[k], np.float32)
+    max_rel = max(max_rel,
+                  float(np.max(np.abs(got - ref)) / np.max(np.abs(ref))))
+
+# Seeded quadratic-model convergence: `steps` eager engine steps (the
+# fused — and for blockwise, quantized — XLA collective path each step).
+X = rng.standard_normal((64, 16)).astype(np.float32)
+w_true = rng.standard_normal((16,)).astype(np.float32)
+y = X @ w_true
+Xj, yj = jnp.asarray(X), jnp.asarray(y)
+
+def loss(w):
+    return jnp.mean((Xj @ w - yj) ** 2)
+
+opt = hvd.DistributedOptimizer(optax.sgd(0.05), compression=COMP)
+w = jnp.zeros((16,))
+state = opt.init(w)
+grad = jax.grad(loss)
+t0 = time.perf_counter()
+for _ in range(steps):
+    g = grad(w)
+    u, state = opt.update(g, state, w)
+    w = optax.apply_updates(w, u)
+dt = time.perf_counter() - t0
+print(json.dumps({
+    "mode": mode,
+    "logical_bytes": logical,
+    "wire_bytes": int(wire),
+    "max_rel_err": max_rel,
+    "final_loss": float(loss(w)),
+    "steps": steps,
+    "step_time_ms": dt * 1e3 / steps,
+}))
+"""
+
+
+def run_compression_mode(mode: str, steps: int) -> dict:
+    env = dict(os.environ)
+    env["HOROVOD_CYCLE_TIME"] = "1"
+    proc = subprocess.run(
+        [sys.executable, "-c", COMPRESSION_WORKER, mode, str(steps)],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"compression bench worker failed (mode={mode}):\n"
+            f"{proc.stderr[-2000:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def main_compression(steps: int, out_path: str) -> None:
+    rows = {}
+    fp32 = None
+    for mode in COMPRESSION_MODES:
+        r = run_compression_mode(mode, steps)
+        if mode == "fp32":
+            fp32 = r
+        rows[mode] = {
+            "wire_bytes": r["wire_bytes"],
+            "wire_ratio_vs_fp32": round(
+                r["wire_bytes"] / fp32["wire_bytes"], 4),
+            "max_rel_err": round(r["max_rel_err"], 6),
+            "final_loss": r["final_loss"],
+            "loss_ratio_vs_fp32": round(
+                r["final_loss"] / fp32["final_loss"], 6)
+            if fp32["final_loss"] else None,
+            "step_time_ms": round(r["step_time_ms"], 3),
+        }
+    result = {
+        "metric": "compression_allreduce",
+        "steps": steps,
+        "logical_bytes": fp32["logical_bytes"],
+        "note": ("deltas (wire_ratio/max_rel_err/loss_ratio) are seeded "
+                 "and deterministic; step_time_ms is wall-clock and "
+                 "informational only"),
+        "rows": rows,
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(result))
+
+
 def main():
     sweep = {}
     best = 0.0
@@ -190,4 +330,18 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--compression", action="store_true",
+                    help="run the wire-compression bench and write "
+                         "BENCH_COMPRESSION.json instead of the "
+                         "throughput sweep")
+    ap.add_argument("--steps", type=int, default=50,
+                    help="convergence-run steps for --compression")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_COMPRESSION.json"))
+    args = ap.parse_args()
+    if args.compression:
+        main_compression(args.steps, args.out)
+    else:
+        main()

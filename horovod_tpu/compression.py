@@ -6,11 +6,33 @@ floating tensors to fp16 for the wire and back after
 (compression.py:33-75). On TPU we additionally provide ``Compression.bf16``
 — bfloat16 is the hardware-native 16-bit format (same exponent range as
 fp32, MXU-friendly), and is the idiomatic choice on this platform.
+
+Beyond the reference's cast compressors, ``Compression.int8_blockwise``
+and ``Compression.fp8_blockwise`` select the block-scaled quantized wire
+(quantization.py, EQuARX-style): the tensor itself is NOT transformed
+here — the quantize → reduce-scatter → fp32-accumulate → requantize →
+allgather pipeline runs inside the fused XLA collective — so these
+compressors are pass-through markers carrying the wire spec, plus
+:meth:`local_roundtrip` for error-feedback residuals (optimizer.py).
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
+
+
+def _is_floating(dtype) -> bool:
+    """Floating test covering the extended dtypes (bfloat16, fp8) whose
+    numpy identity varies across jax/ml_dtypes versions — restoring a
+    non-default floating input dtype must not silently fail."""
+    try:
+        if jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+            return True
+    except TypeError:
+        pass
+    name = str(getattr(dtype, "name", None) or
+               getattr(dtype, "__name__", None) or dtype)
+    return name.startswith(("float", "bfloat"))
 
 
 class Compressor:
@@ -44,14 +66,13 @@ class _CastCompressor(Compressor):
     @classmethod
     def compress(cls, tensor):
         ctx = tensor.dtype
-        if jnp.issubdtype(tensor.dtype, jnp.floating):
+        if _is_floating(tensor.dtype):
             tensor = tensor.astype(cls.wire_dtype)
         return tensor, ctx
 
     @classmethod
     def decompress(cls, tensor, ctx):
-        if ctx is not None and tensor.dtype != ctx and \
-                jnp.issubdtype(jnp.dtype(ctx), jnp.floating):
+        if ctx is not None and tensor.dtype != ctx and _is_floating(ctx):
             tensor = tensor.astype(ctx)
         return tensor
 
@@ -76,9 +97,53 @@ class FP8Compressor(_CastCompressor):
     wire_dtype = jnp.float8_e4m3fn
 
 
+class _BlockwiseCompressor(Compressor):
+    """Block-scaled quantized wire format (quantization.py).
+
+    ``compress``/``decompress`` only restore the logical dtype — the
+    quantization itself is executed inside the fused collective program
+    (executor._fused_reduce / quantization.allreduce_blocks), keyed off
+    ``wire_spec``. ``local_roundtrip`` reproduces this rank's phase-1
+    wire contribution for error-feedback residuals."""
+
+    wire_spec = None  # "int8x256" / "fp8x256"
+
+    @classmethod
+    def compress(cls, tensor):
+        return tensor, tensor.dtype
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        if ctx is not None and tensor.dtype != ctx and _is_floating(ctx):
+            tensor = tensor.astype(ctx)
+        return tensor
+
+    @classmethod
+    def local_roundtrip(cls, tensor):
+        from . import quantization as _q
+        return _q.local_roundtrip(tensor, cls.wire_spec)
+
+
+class Int8BlockwiseCompressor(_BlockwiseCompressor):
+    """Absmax-scaled int8 blocks (256 elements/block): ~0.25x fp32 wire
+    bytes with max error ~0.8% of each block's absmax across the dual
+    quantization — the accuracy/bandwidth workhorse."""
+    wire_spec = "int8x256"
+
+
+class FP8BlockwiseCompressor(_BlockwiseCompressor):
+    """Absmax-scaled e4m3 blocks: same wire bytes as int8_blockwise but
+    ~6% relative error near each block's absmax (3 mantissa bits) and
+    finer resolution for small elements — prefer int8_blockwise unless
+    the hardware reduces fp8 natively."""
+    wire_spec = "fp8x256"
+
+
 class Compression:
     """Option enum (compression.py:64-75)."""
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
     fp8 = FP8Compressor
+    int8_blockwise = Int8BlockwiseCompressor
+    fp8_blockwise = FP8BlockwiseCompressor

@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import quantization as _quant
 from . import topology as _topo
 
 # Ops wire-enum kept numerically aligned with the native runtime
@@ -113,10 +114,12 @@ _UNPACK_CACHE_MAX = 512
 _INT32_MAX = 2 ** 31 - 1
 
 
-def _unpack(out, arrs, idxs, results) -> None:
+def _unpack(out, arrs, idxs, results, align: int = 1) -> None:
     """Device-side unpack of a fused buffer shared by every
     _run_fused_buffers branch: slice each tensor's span back out,
-    reshape, restore its dtype."""
+    reshape, restore its dtype. ``align`` mirrors the pack-side span
+    alignment (quantized wire formats align each tensor to whole
+    blocks)."""
     if int(out.size) > _INT32_MAX:
         raise ValueError(
             f"fused buffer has {int(out.size)} elements; unpack offsets "
@@ -140,20 +143,34 @@ def _unpack(out, arrs, idxs, results) -> None:
         else:
             _UNPACK_CACHE.move_to_end(key)
         results[i] = prog(out, np.int32(off))
-        off += a.size
+        off += _quant.padded_size(int(a.size), align)
 
 
-def _fused_reduce(vals, reduce_fn, prescale: float, postscale: float):
+def _fused_reduce(vals, reduce_fn, prescale: float, postscale: float,
+                  wire=None, axis: str = "dp", world: int = 1):
     """The fusion-buffer body shared by the single- and multi-process
     allreduce programs: group per-shard values by dtype, flatten + concat
     (the "fusion buffer", operations.cc:1221-1243), reduce each buffer
     with ``reduce_fn``, split back out. One collective per dtype mirrors
-    one collective per fused response (operations.cc:2149-2265)."""
+    one collective per fused response (operations.cc:2149-2265).
+
+    With ``wire`` set (a quantization.WireSpec) floating groups run the
+    dual block-quantized allreduce over ``axis`` instead of ``reduce_fn``:
+    each tensor's flat span is padded to whole blocks (block boundaries
+    never cross tensors, so the optimizer's per-leaf error-feedback
+    residual matches the wire exactly), the buffer is padded to
+    ``world * block_size``, and quantization.allreduce_blocks moves wire
+    bytes — not fp32 bytes — through the collectives."""
     by_dtype = {}
     for i, v in enumerate(vals):
         by_dtype.setdefault(v.dtype, []).append((i, v))
     results = [None] * len(vals)
     for dt, items in by_dtype.items():
+        if (wire is not None and jnp.issubdtype(jnp.dtype(dt), jnp.floating)
+                and sum(int(v.size) for _, v in items) > 0):
+            _fused_reduce_quantized(items, wire, axis, world, prescale,
+                                    postscale, results)
+            continue
         acc = _accum_dtype(dt)
         flat = [jnp.ravel(v).astype(acc or dt) for _, v in items]
         if prescale != 1.0:
@@ -169,6 +186,38 @@ def _fused_reduce(vals, reduce_fn, prescale: float, postscale: float):
             results[i] = piece.reshape(v.shape).astype(dt)
             off += n
     return tuple(results)
+
+
+def _fused_reduce_quantized(items, wire, axis: str, world: int,
+                            prescale: float, postscale: float,
+                            results) -> None:
+    """Quantized-wire fusion-buffer body: per-tensor block padding +
+    concat, dual-quantized allreduce over ``axis``, split back out."""
+    bs = wire.block_size
+    pieces = []
+    spans = []
+    off = 0
+    for i, v in items:
+        f = jnp.ravel(v).astype(jnp.float32)
+        if prescale != 1.0:
+            f = f * prescale
+        n = int(f.size)
+        m = _quant.padded_size(max(n, 1), bs)
+        if m != n:
+            f = jnp.concatenate([f, jnp.zeros((m - n,), jnp.float32)])
+        pieces.append(f)
+        spans.append((off, n))
+        off += m
+    extra = (-off) % (world * bs)
+    if extra:
+        pieces.append(jnp.zeros((extra,), jnp.float32))
+    buf = jnp.concatenate(pieces) if len(pieces) > 1 else pieces[0]
+    red = _quant.allreduce_blocks(buf, axis, wire, world)
+    if postscale != 1.0:
+        red = red * postscale
+    for (i, v), (o, n) in zip(items, spans):
+        piece = jax.lax.dynamic_slice(red, (o,), (n,))
+        results[i] = piece.reshape(v.shape).astype(v.dtype)
 
 
 def _hier_reduce(buf, ici: int):
@@ -224,6 +273,12 @@ class CollectiveExecutor:
         self._shm_checked = False
         self._shm_transport = None
         self._device_pack_flag: Optional[bool] = None
+        # Observability counters: fused-program cache behaviour and input
+        # transfers (tests guard that replicated inputs neither recompile
+        # nor re-transfer — the hot-loop steady state).
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.device_put_count = 0
 
     @property
     def mesh(self) -> Mesh:
@@ -243,20 +298,44 @@ class CollectiveExecutor:
 
     def _replicated(self, x):
         """Device-put a host / single-device array replicated on the mesh."""
-        return jax.device_put(x, NamedSharding(self.mesh, P()))
+        return self._put_replicated([x], self.mesh)[0]
+
+    def _put_replicated(self, tensors, mesh: Mesh) -> List[jax.Array]:
+        """Replicate inputs on ``mesh``, skipping the transfer for arrays
+        that already carry the replicated sharding — in a steady-state
+        training loop the outputs of step N are the inputs of step N+1
+        and re-running device_put on them is a per-tensor dispatch for
+        nothing."""
+        sh = NamedSharding(mesh, P())
+        out = []
+        for t in tensors:
+            if isinstance(t, jax.Array):
+                try:
+                    if t.sharding.is_equivalent_to(sh, t.ndim):
+                        out.append(t)
+                        continue
+                except Exception:
+                    pass
+            self.device_put_count += 1
+            out.append(jax.device_put(t, sh))
+        return out
 
     def _program(self, key, builder):
         prog = self._cache.get(key)
         if prog is None:
+            self.cache_misses += 1
             prog = builder()
             self._cache[key] = prog
+        else:
+            self.cache_hits += 1
         return prog
 
     # -------------------------------------------------------------- allreduce
 
     def allreduce_fused(self, tensors: Sequence[jax.Array],
                         prescale: float = 1.0,
-                        postscale: float = 1.0) -> List[jax.Array]:
+                        postscale: float = 1.0,
+                        wire=None) -> List[jax.Array]:
         """Sum-allreduce a fused group of replicated tensors.
 
         Semantics: every virtual rank (device) contributes its copy, so a
@@ -266,15 +345,25 @@ class CollectiveExecutor:
 
         The whole group runs as ONE jitted program: flatten → concat (the
         "fusion buffer", operations.cc:1221-1243) → psum → split.
+
+        ``wire`` (a quantization spec, e.g. "int8x256") switches floating
+        tensors to the dual block-quantized allreduce — quantize →
+        reduce-scatter in the wire domain → fp32 dequant-accumulate →
+        requantize → allgather — inside the same fused program. The
+        quantized path always runs on the flat 'dp' mesh: its all_to_all
+        reduce-scatter is already the bandwidth-optimal single-phase
+        exchange, so the two-level hierarchy buys nothing on top.
         """
-        hier = self.hierarchical_allreduce
+        wire = _quant.parse(wire)
+        hier = self.hierarchical_allreduce and wire is None
         mesh = self.hier_mesh if hier else self.mesh
         ici = int(mesh.shape["ici"]) if hier else 1
+        world = int(mesh.devices.size)
         shapes = tuple(t.shape for t in tensors)
         dtypes = tuple(str(np.dtype(t.dtype) if t.dtype != jnp.bfloat16
                            else "bfloat16") for t in tensors)
         key = ("ar", shapes, dtypes, float(prescale), float(postscale),
-               hier, id(mesh))
+               hier, wire.encoded() if wire else None, id(mesh))
 
         def reduce_buf(buf):
             if not hier:
@@ -285,7 +374,8 @@ class CollectiveExecutor:
             def fused(*xs):
                 def shard_fn(*ys):
                     return _fused_reduce(ys, reduce_buf, prescale,
-                                         postscale)
+                                         postscale, wire=wire,
+                                         axis="dp", world=world)
 
                 return jax.shard_map(
                     shard_fn, mesh=mesh,
@@ -296,9 +386,7 @@ class CollectiveExecutor:
             return jax.jit(fused)
 
         prog = self._program(key, build)
-        ins = [jax.device_put(t, NamedSharding(mesh, P()))
-               for t in tensors]
-        outs = prog(*ins)
+        outs = prog(*self._put_replicated(tensors, mesh))
         return list(outs)
 
     # ------------------------------------------------------------- radcast &c
@@ -376,9 +464,7 @@ class CollectiveExecutor:
             return jax.jit(fused)
 
         prog = self._program(key, build)
-        ins = [jax.device_put(t, NamedSharding(mesh, P()))
-               for t in tensors]
-        return list(prog(*ins))
+        return list(prog(*self._put_replicated(tensors, mesh)))
 
     # ---------------------------------------------- per-rank (sharded) inputs
 
@@ -604,7 +690,7 @@ class CollectiveExecutor:
         return self._device_pack_flag
 
     def _pack_device(self, ts: Sequence[jax.Array], padded: int,
-                     buf_dt) -> jax.Array:
+                     buf_dt, align: int = 1) -> jax.Array:
         """Build the size-quantized fusion buffer on device: one cached
         zero-init program per (padded, dtype) plus one cached
         dynamic-update-slice program per (tensor shape/dtype, padded) —
@@ -632,7 +718,7 @@ class CollectiveExecutor:
                     b, v.ravel().astype(buf_dt), (o,)),
                 donate_argnums=(0,)))
             buf = prog(buf, t, np.int32(off))
-            off += int(t.size)
+            off += _quant.padded_size(int(t.size), align)
         return buf
 
     def _mp_stacked_device(self, buf: jax.Array, mesh: Mesh,
@@ -650,7 +736,8 @@ class CollectiveExecutor:
 
     def allreduce_fused_mp(self, tensors: Sequence[jax.Array],
                            prescale: float = 1.0,
-                           postscale: float = 1.0) -> List[jax.Array]:
+                           postscale: float = 1.0,
+                           wire=None) -> List[jax.Array]:
         """Fused sum-allreduce across processes: every virtual rank
         (device) contributes its process's copy.
 
@@ -671,12 +758,17 @@ class CollectiveExecutor:
         2-level NCCL+MPI allreduce (operations.cc:1284-1436) as XLA
         collectives; otherwise one flat psum over 'dp'.
         """
-        hier = self.hierarchical_allreduce
+        wire = _quant.parse(wire)
+        # The quantized path runs on the flat mesh (see allreduce_fused)
+        # and through XLA — the shm plane reduces host-side in full
+        # precision and would silently skip the wire format.
+        hier = self.hierarchical_allreduce and wire is None
         mesh = self.hier_mesh if hier else self.mesh
         axes = ("dcn", "ici") if hier else ("dp",)
         ici = int(mesh.shape["ici"]) if hier else 1
+        world = int(mesh.devices.size)
 
-        shm = None if hier else self._shm()
+        shm = None if (hier or wire is not None) else self._shm()
         if shm is not None:
             # Same-host fast path: reduce the host-staged fusion buffer
             # through /dev/shm instead of a socket ring. Every VIRTUAL
@@ -703,12 +795,33 @@ class CollectiveExecutor:
             return _hier_reduce(buf, ici)
 
         def build(padded, buf_dt):
+            quantize = (wire is not None and
+                        jnp.issubdtype(jnp.dtype(buf_dt), jnp.floating))
+
             def fused(x):
                 def shard_fn(y):
                     v = y[0]  # this device's block of [size, n]
                     if prescale != 1.0:
                         v = v * prescale
-                    red = reduce_buf(v)
+                    if quantize:
+                        # The packed buffer is already size-quantized
+                        # (multiples of 512 ⊇ whole 256-blocks for the
+                        # default block size); pad the tail so every
+                        # rank's shard is whole blocks. Unlike the SP
+                        # path the host pack is back-to-back, so blocks
+                        # may span tensor boundaries here — the error
+                        # stays bounded by block absmax either way.
+                        n = int(v.size)
+                        m = _quant.padded_size(
+                            max(n, 1), world * wire.block_size)
+                        b = (jnp.concatenate(
+                                [v.astype(jnp.float32),
+                                 jnp.zeros((m - n,), jnp.float32)])
+                             if m != n else v.astype(jnp.float32))
+                        red = _quant.allreduce_blocks(
+                            b, "dp", wire, world)[:n].astype(v.dtype)
+                    else:
+                        red = reduce_buf(v)
                     if postscale != 1.0:
                         red = red * postscale
                     return red
@@ -723,11 +836,13 @@ class CollectiveExecutor:
             tensors, build,
             key_fn=lambda padded, dt: ("armp_buf", padded, dt,
                                        float(prescale), float(postscale),
-                                       hier, id(mesh)),
-            mesh=mesh, axes=axes)
+                                       hier, wire.encoded() if wire
+                                       else None, id(mesh)),
+            mesh=mesh, axes=axes,
+            align=wire.block_size if wire is not None else 1)
 
     def _run_fused_buffers(self, tensors, build, key_fn, mesh, axes,
-                           host_op=None):
+                           host_op=None, align: int = 1):
         """Shared host-assembled fusion-buffer scaffolding for the MP
         collectives (the reference's memcpy into the fusion buffer,
         operations.cc:1221-1243): group by accumulation dtype (one
@@ -764,16 +879,21 @@ class CollectiveExecutor:
                                 []).append(i)
         results: List[Optional[jax.Array]] = [None] * len(arrs)
         for buf_dt, idxs in by_dtype.items():
-            n = int(sum(arrs[i].size for i in idxs))
+            # ``align`` > 1 (the quantized wire): each tensor's span is
+            # padded to whole blocks so block scales never mix tensors
+            # of different magnitudes — same layout the SP fused path
+            # and the optimizer's error-feedback residual assume.
+            n = int(sum(_quant.padded_size(int(arrs[i].size), align)
+                        for i in idxs))
             padded = _fusion_padded_size(n)
 
             if device_pack:
                 buf = self._pack_device([arrs[i] for i in idxs], padded,
-                                        buf_dt)
+                                        buf_dt, align)
                 key = key_fn(padded, str(buf_dt))
                 prog = self._program(key, lambda: build(padded, buf_dt))
                 out = prog(self._mp_stacked_device(buf, mesh, axes))
-                _unpack(out, arrs, idxs, results)
+                _unpack(out, arrs, idxs, results, align)
                 continue
 
             buf = np.zeros((padded,), dtype=buf_dt)
@@ -781,7 +901,7 @@ class CollectiveExecutor:
             for i in idxs:
                 flat = arrs[i].ravel()
                 buf[off:off + flat.size] = flat.astype(buf_dt)
-                off += flat.size
+                off += _quant.padded_size(int(flat.size), align)
 
             if host_op is not None:
                 # The reduced buffer is HOST memory (the shm plane's
@@ -793,14 +913,14 @@ class CollectiveExecutor:
                 # device slicing used to have is fixed by the
                 # offset-traced programs + quantized padding.
                 _unpack(jnp.asarray(np.asarray(host_op(buf))),
-                        arrs, idxs, results)
+                        arrs, idxs, results, align)
                 continue
 
             key = key_fn(padded, str(buf_dt))
             prog = self._program(
                 key, lambda: build(padded, buf_dt))
             out = prog(self._mp_stacked(buf, mesh=mesh, axes=axes))
-            _unpack(out, arrs, idxs, results)
+            _unpack(out, arrs, idxs, results, align)
         return [r for r in results]
 
     def broadcast_fused_mp(self, tensors: Sequence[jax.Array],

@@ -147,12 +147,15 @@ def _engine_allreduce_batch(arrs, names, compression):
     of the TF shim's grouped bridge. Sequential blocking submits would
     pay one negotiation round-trip per gradient."""
     comp = compression if compression is not None else Compression.none
+    blockwise = comp if getattr(comp, "wire_spec", None) is not None \
+        else None
     handles = []
     with _ops.engine().burst():
         for arr, nm in zip(arrs, names):
             wire, ctx = comp.compress(arr)
             handles.append((_ops.allreduce_async(wire, average=True,
-                                                 name=nm),
+                                                 name=nm,
+                                                 compression=blockwise),
                             ctx, arr.dtype))
     # Batched readback: one device_get for the whole group instead of a
     # per-gradient round trip (utils/interop.to_host_many — the
@@ -170,7 +173,10 @@ def _tf_graph_allreduce_batch(gs, names, compression):
     """One py_function crossing for the whole gradient group inside a
     traced tf.function (mirrors tensorflow._grouped_bridge)."""
     import tensorflow as tf
-    wire = getattr(compression, "wire_dtype", None)
+    blockwise = compression \
+        if getattr(compression, "wire_spec", None) is not None else None
+    wire = (None if blockwise is not None
+            else getattr(compression, "wire_dtype", None))
     wire_np = np.dtype(wire) if wire is not None else None
 
     def host(*xs):
@@ -183,8 +189,8 @@ def _tf_graph_allreduce_batch(gs, names, compression):
                 if wire_np is not None and np.issubdtype(arr.dtype,
                                                          np.floating):
                     arr = arr.astype(wire_np)
-                handles.append(_ops.allreduce_async(arr, average=True,
-                                                    name=nm))
+                handles.append(_ops.allreduce_async(
+                    arr, average=True, name=nm, compression=blockwise))
         # Batched readback (interop.to_host_many): one device_get for
         # the group, not one round trip per gradient.
         from ..utils.interop import to_host_many

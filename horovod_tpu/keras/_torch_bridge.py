@@ -17,6 +17,18 @@ def allreduce_average(g, name: Optional[str], compression):
     from .. import torch as _hvd_torch
 
     comp = _hvd_torch.Compression.none
+    wire_spec = getattr(compression, "wire_spec", None)
+    if wire_spec is not None:
+        # Blockwise wire formats cross by spec, not by cast: the torch
+        # tensor enters the engine at its logical dtype and the fused
+        # XLA program quantizes on the wire.
+        comp = (_hvd_torch.Compression.int8_blockwise
+                if wire_spec.startswith("int8")
+                else _hvd_torch.Compression.fp8_blockwise)
+        out = _hvd_torch.mpi_ops.synchronize(
+            _hvd_torch.mpi_ops.allreduce_async(
+                g, average=True, name=name, compression=comp))
+        return out
     if compression is _JaxCompression.fp16:
         comp = _hvd_torch.Compression.fp16
     elif compression is _JaxCompression.bf16:

@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 
 from . import wire_format as _wire_flags
+from .. import quantization as _quant
 from .. import topology as _topo
 from ..executor import (ALLGATHER, ALLREDUCE, BROADCAST, CollectiveExecutor,
                         default_executor)
@@ -173,18 +174,19 @@ def _semantics_fingerprint(req) -> int:
     different groups on every process identically."""
     import zlib
     key = (f"{int(req.average)}|{req.prescale!r}|{req.postscale!r}|"
-           f"{int(req.sharded)}|{int(req.per_rank is None)}")
+           f"{int(req.sharded)}|{int(req.per_rank is None)}|"
+           f"{req.wire or ''}")
     return zlib.crc32(key.encode()) & 0x7FFFFFFF
 
 
 class _Request:
     __slots__ = ("name", "op", "tensor", "per_rank", "root_rank", "average",
                  "prescale", "postscale", "handle", "nbytes", "dtype",
-                 "enqueued_at", "sharded")
+                 "enqueued_at", "sharded", "wire")
 
     def __init__(self, name, op, tensor, handle, *, per_rank=None,
                  root_rank=0, average=False, prescale=1.0, postscale=1.0,
-                 sharded=False):
+                 sharded=False, wire=None):
         self.name = name
         self.op = op
         self.tensor = tensor
@@ -195,9 +197,19 @@ class _Request:
         self.postscale = postscale
         self.handle = handle
         self.sharded = sharded
+        # Wire-format spec ("int8x256" / "fp8x256") for block-scaled
+        # quantized allreduce; None = the tensor's own dtype is the wire.
+        self.wire = wire
         if tensor is not None:
             self.dtype = _plan_dtype(tensor.dtype)
-            self.nbytes = int(np.prod(tensor.shape)) * self.dtype.itemsize
+            n_elements = int(np.prod(tensor.shape))
+            if wire is not None:
+                # What fusion planning (and the engine's wire-byte
+                # accounting) must count is bytes ON THE WIRE: quantized
+                # payload + per-block scales, not the logical fp32 bytes.
+                self.nbytes = _quant.wire_nbytes(wire, n_elements)
+            else:
+                self.nbytes = n_elements * self.dtype.itemsize
         else:
             self.dtype = _plan_dtype(per_rank[0].dtype)
             self.nbytes = sum(int(np.prod(t.shape)) for t in per_rank) * \
@@ -253,6 +265,10 @@ class CollectiveEngine:
         # no MPI round-trip to amortize on the single-controller path.
         self.fusion_threshold = _env.fusion_threshold_bytes()
         self.cycle_time_s = _env.cycle_time_ms() / 1000.0
+        # Cumulative bytes-on-wire of every enqueued request (wire bytes,
+        # i.e. quantized payload + scales for blockwise formats) — the
+        # accounting the compression bench and acceptance tests read.
+        self.wire_bytes_enqueued = 0
         self.timeline = None          # Python-mode timeline (fallback path)
         self._timeline_tried = False  # decide once, off the hot path
         self._mark_cycles = _env.timeline_mark_cycles()
@@ -471,6 +487,7 @@ class CollectiveEngine:
             # tests use reset_engine() to get a fresh one.
             raise HorovodInternalError(
                 SHUT_DOWN_ERROR.format(op=_op_name(req.op)))
+        self.wire_bytes_enqueued += req.nbytes
         core = self._ensure_native()
         if core is not None:
             return self._enqueue_native(core, req)
@@ -541,7 +558,7 @@ class CollectiveEngine:
         subgroups: Dict[tuple, List] = {}
         for i, r in pairs:
             k = (r.sharded, r.average, r.prescale, r.postscale,
-                 r.per_rank is None, r.root_rank)
+                 r.per_rank is None, r.root_rank, r.wire)
             subgroups.setdefault(k, []).append((i, r))
         ex = self.executor
         # Apply the SP tuner's execution-mode flags (hvdtpu_current_flags;
@@ -735,7 +752,7 @@ class CollectiveEngine:
         subgroups: Dict[tuple, List] = {}
         for i, r in pairs:
             k = (r.sharded, r.average, r.prescale, r.postscale,
-                 r.root_rank)
+                 r.root_rank, r.wire)
             subgroups.setdefault(k, []).append((i, r))
         tl = core.timeline_enabled()
         for sub in subgroups.values():
@@ -1028,7 +1045,8 @@ class CollectiveEngine:
         # pass identical attributes on every process.
         subgroups: Dict[tuple, List[_Request]] = {}
         for r in reqs:
-            k = (r.sharded, r.average, r.prescale, r.postscale, r.root_rank)
+            k = (r.sharded, r.average, r.prescale, r.postscale,
+                 r.root_rank, r.wire)
             subgroups.setdefault(k, []).append(r)
         topo = _topo._get()
         for sub in subgroups.values():
@@ -1076,7 +1094,7 @@ class CollectiveEngine:
                 post = post / ex.world_size
             return ex.allreduce_fused_mp(
                 [r.tensor for r in group], prescale=group[0].prescale,
-                postscale=post)
+                postscale=post, wire=group[0].wire)
         if op == BROADCAST:
             if group[0].sharded:
                 return [ex.broadcast_sharded(r.tensor, r.root_rank)
@@ -1201,37 +1219,46 @@ class CollectiveEngine:
 
     # ------------------------------------------------------------- execution
 
+    @staticmethod
+    def _fusion_key(req: _Request) -> tuple:
+        """Attributes that must agree for two requests to share one fused
+        program: op, planning dtype, WIRE format (wire bytes are what the
+        threshold counts, and a quantized program is a different program),
+        sharded-ness, root, and the execution-scaling knobs."""
+        return (req.op, str(req.dtype), req.wire, req.sharded,
+                req.root_rank, req.average, req.prescale, req.postscale)
+
     def _plan_fusion(self, batch: List[_Request]) -> List[List[_Request]]:
         """Greedy fusion with look-ahead (operations.cc:2149-2265).
 
-        Requests are fused when they share (op, dtype, root for broadcast,
-        sharded-ness) and the running byte total stays under the threshold.
-        Skipped requests remain candidates for later groups (the reference's
-        look-ahead over `skipped` responses). Delegates to the native
-        planner when attached.
+        Requests fuse when they share a fusion key and the group's wire
+        bytes stay under the threshold. Single pass over the batch:
+        requests bucket by fusion key, and within a key a request joins
+        the FIRST open group with room (first-fit) or opens a new group
+        at its submission position. This reproduces the reference's
+        round-based look-ahead exactly — in round r a request joins
+        group r iff it didn't fit groups 1..r-1, which is first-fit in
+        group-creation order — without the old O(n²) full rescan per
+        group. Groups come out ordered by their first member's
+        submission position. Per-rank (ragged allgather) requests never
+        fuse and form singleton groups in place.
         """
         groups: List[List[_Request]] = []
-        remaining = list(batch)
-        while remaining:
-            head = remaining.pop(0)
-            group = [head]
-            total = head.nbytes
-            keep = []
-            for req in remaining:
-                if (req.op == head.op and req.dtype == head.dtype
-                        and req.sharded == head.sharded
-                        and req.root_rank == head.root_rank
-                        and req.average == head.average
-                        and req.prescale == head.prescale
-                        and req.postscale == head.postscale
-                        and req.per_rank is None and head.per_rank is None
-                        and total + req.nbytes <= self.fusion_threshold):
-                    group.append(req)
-                    total += req.nbytes
-                else:
-                    keep.append(req)
-            remaining = keep
-            groups.append(group)
+        open_groups: Dict[tuple, List[List]] = {}  # key -> [group, total]s
+        for req in batch:
+            if req.per_rank is not None:
+                groups.append([req])
+                continue
+            buckets = open_groups.setdefault(self._fusion_key(req), [])
+            for entry in buckets:
+                if entry[1] + req.nbytes <= self.fusion_threshold:
+                    entry[0].append(req)
+                    entry[1] += req.nbytes
+                    break
+            else:
+                group = [req]
+                groups.append(group)
+                buckets.append([group, req.nbytes])
         return groups
 
     def _dispatch(self, batch: List[_Request]):
@@ -1355,7 +1382,8 @@ class CollectiveEngine:
             if group[0].average:
                 post = post / n
             outs = ex.allreduce_fused([r.tensor for r in group],
-                                      prescale=pre, postscale=post)
+                                      prescale=pre, postscale=post,
+                                      wire=group[0].wire)
             return outs
         if op == BROADCAST:
             if group[0].sharded:
@@ -1486,14 +1514,35 @@ def _prep(tensor):
     return arr, False
 
 
+def _wire_for(tensor, sharded: bool, compression) -> Optional[str]:
+    """Wire-format spec a blockwise compression selects for this request,
+    or None (cast compressors transform the tensor before enqueue; the
+    wire IS the tensor dtype then). Sharded per-rank arrays keep the
+    full-precision path — their reduce is per-request, not fused."""
+    spec = getattr(compression, "wire_spec", None)
+    if spec is None or sharded:
+        return None
+    if not jnp.issubdtype(tensor.dtype, jnp.floating):
+        return None
+    return _quant.parse(spec).encoded()
+
+
 def allreduce_async(tensor, average: bool = True, name: Optional[str] = None,
                     prescale_factor: float = 1.0,
-                    postscale_factor: float = 1.0) -> Handle:
+                    postscale_factor: float = 1.0,
+                    compression=None) -> Handle:
     """Asynchronous allreduce; returns a :class:`Handle`.
 
     Parity: ``hvd.allreduce_async`` (torch/mpi_ops.py:110-180). ``average``
     divides by ``size()`` after summation, as the torch binding does in its
     completion callback (torch/mpi_ops_v2.cc:62-69).
+
+    ``compression`` here only selects a blockwise WIRE format
+    (``Compression.int8_blockwise`` / ``fp8_blockwise``): the tensor is
+    submitted at its logical dtype and the quantize → reduce-scatter →
+    requantize → allgather pipeline runs inside the fused XLA program.
+    Cast compressors transform the tensor before enqueue (see
+    :func:`allreduce`) and are ignored here.
     """
     _topo._get()
     eng = engine()
@@ -1502,7 +1551,7 @@ def allreduce_async(tensor, average: bool = True, name: Optional[str] = None,
     h = eng.make_handle(nm)
     req = _Request(nm, ALLREDUCE, t, h, average=average,
                    prescale=prescale_factor, postscale=postscale_factor,
-                   sharded=sharded)
+                   sharded=sharded, wire=_wire_for(t, sharded, compression))
     return eng.enqueue(req)
 
 
@@ -1512,14 +1561,17 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None,
     """Synchronous allreduce (sum / average over all virtual ranks).
 
     ``compression`` mirrors ``hvd.Compression`` usage in
-    tensorflow/__init__.py:46-92: the tensor is compressed before the
-    collective and decompressed after.
+    tensorflow/__init__.py:46-92: a cast compressor transforms the tensor
+    before the collective and restores it after; a blockwise compressor
+    (``Compression.int8_blockwise`` / ``fp8_blockwise``) instead selects
+    the quantized wire format executed inside the fused program.
     """
     if compression is not None:
         t, ctx = compression.compress(jnp.asarray(tensor))
         out = allreduce_async(t, average=average, name=name,
                               prescale_factor=prescale_factor,
-                              postscale_factor=postscale_factor).wait()
+                              postscale_factor=postscale_factor,
+                              compression=compression).wait()
         return compression.decompress(out, ctx)
     return allreduce_async(tensor, average=average, name=name,
                            prescale_factor=prescale_factor,

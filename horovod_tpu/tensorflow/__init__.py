@@ -87,8 +87,12 @@ def _egress(out, want_dtype) -> tf.Tensor:
     return res
 
 
-def _hvd_allreduce_host(x: tf.Tensor, average: bool, name: str) -> tf.Tensor:
-    out = _ops.allreduce(_ingress(x), average=average, name=name or None)
+def _hvd_allreduce_host(x: tf.Tensor, average: bool, name: str,
+                        compression=None) -> tf.Tensor:
+    # ``compression`` only carries a blockwise wire spec down to the
+    # engine (cast compressors already transformed the tensor TF-side).
+    out = _ops.allreduce(_ingress(x), average=average, name=name or None,
+                         compression=compression)
     return _egress(out, x.dtype)
 
 
@@ -151,6 +155,11 @@ def _wire_tf_dtype(compression):
     way keras._tf_graph_allreduce_batch does, instead of assuming fp16.
     A custom compressor that is not Compression.none but declares no
     wire_dtype keeps the historical fp16 wire."""
+    if getattr(compression, "wire_spec", None) is not None:
+        # Blockwise formats: no TF-side cast — the quantization runs
+        # inside the engine's fused XLA program; the spec rides down via
+        # the ``compression`` argument of the host bridge.
+        return None
     wire = getattr(compression, "wire_dtype", None)
     if wire is None:
         if compression is not Compression.none:
@@ -196,8 +205,12 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None,
         if wire_dt is not None and x.dtype.is_floating:
             wire, ctx = tf.cast(x, wire_dt), x.dtype
 
+        blockwise = (compression
+                     if getattr(compression, "wire_spec", None) is not None
+                     else None)
+
         def host(v):
-            return _hvd_allreduce_host(v, average, nm)
+            return _hvd_allreduce_host(v, average, nm, blockwise)
 
         out = _py_collective(host, wire, wire.dtype, wire.shape)
         if ctx is not None:
@@ -247,9 +260,13 @@ def grouped_allreduce(tensors, average: bool = True,
                 wires.append(x)
                 ctxs.append(None)
 
+        blockwise = (compression
+                     if getattr(compression, "wire_spec", None) is not None
+                     else None)
         outs = _grouped_bridge(
             lambda i, arr: _ops.allreduce_async(arr, average=average,
-                                                name=f"{nm}.{i}"),
+                                                name=f"{nm}.{i}",
+                                                compression=blockwise),
             wires)
         res = [tf.cast(o, ctx) if ctx is not None else o
                for o, ctx in zip(outs, ctxs)]

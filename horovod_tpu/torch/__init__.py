@@ -105,9 +105,15 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         name = self._parameter_names.get(id(p), f"allreduce.{id(p)}")
         wire, ctx = self._compression.compress(p.grad)
         self._wire_ctx[id(p)] = ctx
+        # Blockwise formats pass through compress() unchanged; the wire
+        # spec rides the request and the engine quantizes in-program.
+        blockwise = self._compression if getattr(
+            self._compression, "wire_spec", None) is not None else None
         if wire is p.grad:
-            return allreduce_async_(p.grad, average=True, name=name)
-        return allreduce_async(wire, average=True, name=name)
+            return allreduce_async_(p.grad, average=True, name=name,
+                                    compression=blockwise)
+        return allreduce_async(wire, average=True, name=name,
+                               compression=blockwise)
 
     def synchronize(self):
         """Flush: enqueue any parameter whose hook never fired, then block

@@ -201,21 +201,26 @@ def synchronize_many(handles) -> list:
 # ---------------------------------------------------------------------------
 
 def allreduce_async(tensor: torch.Tensor, average: bool = True,
-                    name: Optional[str] = None) -> int:
+                    name: Optional[str] = None, compression=None) -> int:
     """Returns a handle; result via synchronize() (torch/mpi_ops.py:128-152).
 
     64-bit reductions without jax_enable_x64 are rejected by the engine's
-    narrowing guard (ops/collective.py::_prep) at enqueue time."""
+    narrowing guard (ops/collective.py::_prep) at enqueue time.
+    ``compression`` only forwards a blockwise wire spec
+    (Compression.int8_blockwise / fp8_blockwise) to the engine — the
+    quantization runs inside the fused XLA program."""
     arr = _ingress(tensor)
-    inner = _ops.allreduce_async(arr, average=average, name=name)
+    inner = _ops.allreduce_async(arr, average=average, name=name,
+                                 compression=compression)
     return _register(_TorchHandle(inner, tensor.dtype, tensor.shape))
 
 
 def allreduce_async_(tensor: torch.Tensor, average: bool = True,
-                     name: Optional[str] = None) -> int:
+                     name: Optional[str] = None, compression=None) -> int:
     """In-place: the result lands in ``tensor`` (torch/mpi_ops.py:182-207)."""
     arr = _ingress(tensor)
-    inner = _ops.allreduce_async(arr, average=average, name=name)
+    inner = _ops.allreduce_async(arr, average=average, name=name,
+                                 compression=compression)
     return _register(
         _TorchHandle(inner, tensor.dtype, tensor.shape, target=tensor))
 
@@ -262,15 +267,18 @@ def broadcast_async_(tensor: torch.Tensor, root_rank: int,
 
 class _HorovodAllreduce(torch.autograd.Function):
     @staticmethod
-    def forward(ctx, tensor, average, name):
+    def forward(ctx, tensor, average, name, compression=None):
         ctx.average = average
-        return synchronize(allreduce_async(tensor, average, name))
+        ctx.compression = compression
+        return synchronize(allreduce_async(tensor, average, name,
+                                           compression=compression))
 
     @staticmethod
     def backward(ctx, grad_output):
         # d(allreduce(x))/dx distributes the same allreduce over the grads.
-        return (synchronize(allreduce_async(grad_output, ctx.average)),
-                None, None)
+        return (synchronize(allreduce_async(grad_output, ctx.average,
+                                            compression=ctx.compression)),
+                None, None, None)
 
 
 class _HorovodAllgather(torch.autograd.Function):
@@ -312,7 +320,9 @@ def allreduce(tensor: torch.Tensor, average: bool = True,
     from .compression import Compression
     compression = compression or Compression.none
     wire, cctx = compression.compress(tensor)
-    out = _HorovodAllreduce.apply(wire, average, name)
+    blockwise = compression \
+        if getattr(compression, "wire_spec", None) is not None else None
+    out = _HorovodAllreduce.apply(wire, average, name, blockwise)
     return compression.decompress(out, cctx)
 
 
