@@ -32,6 +32,14 @@ from .utils.checkpoint import restore_checkpoint, save_checkpoint
 from .ops.timeline_jit import (step as timeline_jit_step,
                                merge_profiler_trace)
 from .elastic import ElasticState, WorkerFailure, run_elastic
+from .observability import (get_registry, metrics_snapshot,
+                            prometheus_text)
+
+
+def metrics_registry():
+    """The process-global metrics registry (docs/metrics.md) — for
+    registering application-level counters next to the framework's."""
+    return get_registry()
 
 __version__ = "0.1.0"
 
@@ -54,4 +62,6 @@ __all__ = [
     "save_checkpoint", "restore_checkpoint",
     # elastic
     "ElasticState", "WorkerFailure", "run_elastic",
+    # observability
+    "metrics_snapshot", "metrics_registry", "prometheus_text",
 ]

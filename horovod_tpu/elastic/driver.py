@@ -42,6 +42,7 @@ import sys
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..observability import registry as _obs
 from ..runner.driver_service import DriverService
 from ..runner.launcher import expand_slots, launch
 from ..runner.secret import SECRET_ENV, encode_key, make_secret_key
@@ -52,6 +53,48 @@ from .failure import FailureConfig, FailureDetector, WorkerFailure
 from .state import ELASTIC_DIR_ENV
 
 _log = get_logger("elastic.driver")
+
+
+class _ElasticMetrics:
+    """Driver-side health telemetry (docs/metrics.md): world size and
+    generation gauges, failure counters by kind, and re-rendezvous
+    duration — the numbers the structured ``elastic_health`` log line is
+    rendered from (one source of truth, the registry)."""
+
+    def __init__(self):
+        r = _obs.registry()
+        self.world_size = r.gauge(
+            "hvdtpu_elastic_world_size",
+            "Ranks in the current elastic generation").labels()
+        self.generation = r.gauge(
+            "hvdtpu_elastic_generation",
+            "Current elastic generation number").labels()
+        self._failures = r.counter(
+            "hvdtpu_elastic_worker_failures_total",
+            "Worker failures the elastic driver recovered from, by kind")
+        self.failures_all = self._failures.labels(kind="all")
+        self.rendezvous = r.histogram(
+            "hvdtpu_elastic_rendezvous_seconds",
+            "Discover → launch time of each generation (includes "
+            "blacklist backoff after a failure)",
+            buckets=_obs.LATENCY_BUCKETS).labels()
+        self.last_rendezvous_ms = r.gauge(
+            "hvdtpu_elastic_last_rendezvous_ms",
+            "Milliseconds the most recent re-rendezvous took").labels()
+
+    def failure(self, kind: str) -> None:
+        self.failures_all.inc()
+        self._failures.labels(kind=kind or "unknown").inc()
+
+    def health_line(self, event: str, np_now: int, generation: int,
+                    hosts_str: str) -> None:
+        """One structured, grep-able line per world-size event, rendered
+        from the registry (replaces the free-form generation prints)."""
+        _log.info(
+            "elastic_health event=%s generation=%d world_size=%d "
+            "failures_total=%d last_rendezvous_ms=%.0f hosts=%s",
+            event, generation, np_now, int(self.failures_all.value),
+            self.last_rendezvous_ms.value, hosts_str or "-")
 
 GENERATION_ENV = "HOROVOD_TPU_ELASTIC_GENERATION"
 FAILURE_TIMEOUT_ENV = "HOROVOD_TPU_FAILURE_TIMEOUT"
@@ -169,10 +212,14 @@ def _elastic_loop(provider: HostProvider, min_np: int,
     and command mode. ``attempt(np, hosts_str, rank_hosts, generation)``
     returns the job result or raises WorkerFailure."""
     penalties = _SlotPenalties(config.blacklist_s)
+    metrics = _ElasticMetrics()
     generation = 0
     restarts = 0
     backoff = config.backoff_s
     last_failure: Optional[WorkerFailure] = None
+    prev_np: Optional[int] = None
+    t_event = time.monotonic()   # loop entry / last failure — the
+    #                              re-rendezvous clock's epoch
     while True:
         slots = penalties.apply(provider.discover())
         np_now, hosts_str, rank_hosts = _clamp_world(slots, min_np, max_np)
@@ -193,12 +240,22 @@ def _elastic_loop(provider: HostProvider, min_np: int,
             time.sleep(backoff)
             backoff = config.next_backoff(backoff)
             continue
-        _log.info("elastic generation %d: np=%d over %s",
-                  generation, np_now, hosts_str)
+        rendezvous_s = time.monotonic() - t_event
+        metrics.rendezvous.observe(rendezvous_s)
+        metrics.last_rendezvous_ms.set(rendezvous_s * 1000.0)
+        metrics.world_size.set(np_now)
+        metrics.generation.set(generation)
+        event = ("launch" if prev_np is None
+                 else "grow" if np_now > prev_np
+                 else "shrink" if np_now < prev_np else "relaunch")
+        prev_np = np_now
+        metrics.health_line(event, np_now, generation, hosts_str)
         try:
             return attempt(np_now, hosts_str, rank_hosts, generation)
         except WorkerFailure as wf:
             last_failure = wf
+            metrics.failure(wf.kind)
+            t_event = time.monotonic()
             if restarts >= config.max_restarts:
                 raise
             restarts += 1

@@ -44,8 +44,42 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+import time
+
 from . import quantization as _quant
 from . import topology as _topo
+from .observability import registry as _obs
+
+
+class _ExecMetrics:
+    """Registry handles for executor counters — module-global so every
+    executor instance feeds the same process-wide totals: the snapshot
+    survives ``reset_default_executor()`` (the per-instance ints below
+    remain as deprecation aliases for existing steady-state tests)."""
+
+    _instance = None
+
+    def __init__(self):
+        r = _obs.registry()
+        self.cache_hits = r.counter(
+            "hvdtpu_executor_cache_hits_total",
+            "Fused-program cache hits").labels()
+        self.cache_misses = r.counter(
+            "hvdtpu_executor_cache_misses_total",
+            "Fused-program cache misses (program builds)").labels()
+        self.device_puts = r.counter(
+            "hvdtpu_executor_device_puts_total",
+            "Host-to-device transfers for collective inputs").labels()
+        self.compile_seconds = r.histogram(
+            "hvdtpu_executor_compile_seconds",
+            "Wall seconds building + jitting one collective program",
+            buckets=_obs.LATENCY_BUCKETS).labels()
+
+    @classmethod
+    def get(cls) -> "_ExecMetrics":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
 
 # Ops wire-enum kept numerically aligned with the native runtime
 # (runtime/src/message.h) and the reference's MPIRequest::RequestType
@@ -275,10 +309,14 @@ class CollectiveExecutor:
         self._device_pack_flag: Optional[bool] = None
         # Observability counters: fused-program cache behaviour and input
         # transfers (tests guard that replicated inputs neither recompile
-        # nor re-transfer — the hot-loop steady state).
+        # nor re-transfer — the hot-loop steady state). DEPRECATION
+        # ALIASES: per-instance views of the registry counters
+        # (hvdtpu_executor_*_total), which are the canonical series and
+        # survive reset_default_executor().
         self.cache_hits = 0
         self.cache_misses = 0
         self.device_put_count = 0
+        self._metrics = _ExecMetrics.get()
 
     @property
     def mesh(self) -> Mesh:
@@ -317,6 +355,7 @@ class CollectiveExecutor:
                 except Exception:
                     pass
             self.device_put_count += 1
+            self._metrics.device_puts.inc()
             out.append(jax.device_put(t, sh))
         return out
 
@@ -324,10 +363,26 @@ class CollectiveExecutor:
         prog = self._cache.get(key)
         if prog is None:
             self.cache_misses += 1
-            prog = builder()
-            self._cache[key] = prog
-        else:
-            self.cache_hits += 1
+            self._metrics.cache_misses.inc()
+            built = builder()
+            metrics, cache = self._metrics, self._cache
+
+            def timed_first_call(*args, **kwargs):
+                # jax.jit is lazy: trace + lower + compile all happen on
+                # the first invocation, so THAT is what the compile
+                # histogram must time (building the closure above is
+                # microseconds). After the first call the raw program
+                # replaces this shim in the cache.
+                t0 = time.perf_counter()
+                out = built(*args, **kwargs)
+                metrics.compile_seconds.observe(time.perf_counter() - t0)
+                cache[key] = built
+                return out
+
+            cache[key] = timed_first_call
+            return timed_first_call
+        self.cache_hits += 1
+        self._metrics.cache_hits.inc()
         return prog
 
     # -------------------------------------------------------------- allreduce
@@ -1069,5 +1124,12 @@ def default_executor() -> CollectiveExecutor:
 
 
 def reset_default_executor() -> None:
+    """Drop the default executor (and its jitted-program cache).
+
+    Counter state is NOT lost: the canonical cache-hit/miss/device-put
+    series live on the process-global metrics registry
+    (hvdtpu_executor_*_total) and are mirrored live, so a snapshot taken
+    after a reset still accounts for everything the dropped instance did
+    — only the per-instance deprecation aliases restart at zero."""
     global _default_executor
     _default_executor = None

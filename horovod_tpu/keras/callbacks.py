@@ -10,6 +10,10 @@ callbacks.py), rebuilt for Keras 3's multi-backend callback API.
   correction (_keras/callbacks.py:70-147).
 - ``LearningRateWarmupCallback`` — gradual 1/N → 1 warmup over the first
   epochs (_keras/callbacks.py:149-168).
+- ``MetricsCallback`` — per-step samples/sec and allreduce share of step
+  time into the horovod_tpu metrics registry (docs/metrics.md; no
+  reference equivalent — the reference's only quantitative surface is
+  the timeline file).
 """
 
 from __future__ import annotations
@@ -22,6 +26,7 @@ import keras
 
 from .. import ops as _ops
 from .. import topology as _topo
+from ..observability import StepTimer
 
 
 def _get_lr(optimizer) -> float:
@@ -82,6 +87,35 @@ class MetricAverageCallback(keras.callbacks.Callback):
 
     def on_epoch_end(self, epoch, logs=None):
         self._average_metrics_in_place(logs)
+
+
+class MetricsCallback(keras.callbacks.Callback):
+    """Report per-step training telemetry into the metrics registry
+    (``hvdtpu_step_seconds``, ``hvdtpu_samples_per_second``,
+    ``hvdtpu_allreduce_step_share`` — all labeled ``framework=keras``)
+    and optionally into the Keras logs dict.
+
+    ``batch_size`` enables the samples/sec series (Keras 3 batch logs
+    do not carry the batch size); without it only step time and
+    allreduce share are recorded. ``log_metrics=True`` additionally
+    writes ``samples_per_sec`` / ``allreduce_share`` into each batch's
+    ``logs`` so they surface in progress bars and History."""
+
+    def __init__(self, batch_size: Optional[int] = None,
+                 log_metrics: bool = False):
+        super().__init__()
+        self._timer = StepTimer("keras", batch_size=batch_size)
+        self._log_metrics = log_metrics
+
+    def on_train_batch_begin(self, batch, logs=None):
+        self._timer.begin()
+
+    def on_train_batch_end(self, batch, logs=None):
+        self._timer.end()
+        if self._log_metrics and logs is not None:
+            if self._timer.batch_size:
+                logs["samples_per_sec"] = self._timer.last_samples_per_s
+            logs["allreduce_share"] = self._timer.last_allreduce_share
 
 
 class LearningRateScheduleCallback(keras.callbacks.Callback):

@@ -1,0 +1,33 @@
+"""Unified metrics & health telemetry (docs/metrics.md).
+
+Public surface:
+
+  - :func:`get_registry` / :func:`metrics_snapshot` — the typed,
+    thread-safe metrics registry and its plain-dict snapshot.
+  - :func:`prometheus_text` — Prometheus text exposition of a snapshot.
+  - :func:`maybe_start_exporters` — env-driven JSON-file writer and
+    rank-0 HTTP endpoint (called by ``hvd.init()``).
+  - :class:`StepTimer` — per-step samples/sec + allreduce-share hook the
+    framework shims build on.
+
+NOTE: the name ``registry`` is deliberately NOT re-exported here — it
+must keep resolving to the :mod:`.registry` submodule (the engine,
+executor, control plane and elastic driver all do
+``from ..observability import registry as _obs``); the function is
+exported as :func:`get_registry`.
+"""
+
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry, enabled,
+                       set_enabled)
+from .registry import registry as get_registry
+from .registry import snapshot as metrics_snapshot
+from .export import (MetricsServer, maybe_start_exporters, prometheus_text,
+                     stop_exporters, write_json_snapshot)
+from .step_metrics import StepTimer
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "MetricsServer",
+    "StepTimer", "enabled", "get_registry", "maybe_start_exporters",
+    "metrics_snapshot", "prometheus_text", "registry", "set_enabled",
+    "stop_exporters", "write_json_snapshot",
+]

@@ -1,0 +1,255 @@
+"""Metrics export — Prometheus text exposition, JSON snapshot files, and
+the rank-0 HTTP endpoint.
+
+Three consumers, three surfaces over the ONE registry snapshot:
+
+  - ``horovod_tpu.metrics_snapshot()`` — in-process dict (tests, user
+    logging loops, the elastic driver's health line).
+  - ``HOROVOD_TPU_METRICS_FILE=/path.json`` — a daemon thread rewrites
+    the file (atomic tmp+rename) every ``HOROVOD_TPU_METRICS_INTERVAL``
+    seconds (default 15), plus one final flush at interpreter exit. In
+    multi-process jobs a ``{rank}`` placeholder in the path expands to
+    the process index; without it only process 0 writes (two writers on
+    one path would corrupt it).
+  - ``HOROVOD_TPU_METRICS_PORT=9091`` — process 0 serves Prometheus
+    text exposition (version 0.0.4) at ``/metrics`` and the raw JSON
+    snapshot at ``/metrics.json`` over stdlib ``http.server``; no new
+    dependencies. Port 0 binds an ephemeral port (tests).
+
+Everything starts from :func:`maybe_start_exporters`, called by
+``hvd.init()`` — idempotent, and a no-op when neither env var is set.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import math
+import os
+import threading
+from typing import Optional
+
+from ..utils import env as _env
+from ..utils.logging import get_logger
+from . import registry as _reg
+
+_log = get_logger("observability")
+
+METRICS_FILE_ENV = "HOROVOD_TPU_METRICS_FILE"
+METRICS_PORT_ENV = "HOROVOD_TPU_METRICS_PORT"
+METRICS_INTERVAL_ENV = "HOROVOD_TPU_METRICS_INTERVAL"
+
+
+# --------------------------------------------------------------------------
+# Prometheus text exposition
+# --------------------------------------------------------------------------
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def prometheus_text(snap: Optional[dict] = None) -> str:
+    """Render a registry snapshot as Prometheus text exposition format
+    (version 0.0.4): ``# HELP`` / ``# TYPE`` headers, ``_bucket`` series
+    with cumulative ``le`` labels ending at ``+Inf``, ``_sum`` and
+    ``_count`` per histogram."""
+    snap = snap if snap is not None else _reg.snapshot()
+    lines = []
+    for name in sorted(snap):
+        fam = snap[name]
+        if fam["help"]:
+            esc = fam["help"].replace("\\", "\\\\").replace("\n", "\\n")
+            lines.append(f"# HELP {name} {esc}")
+        lines.append(f"# TYPE {name} {fam['type']}")
+        for label_key in sorted(fam["values"]):
+            val = fam["values"][label_key]
+            if fam["type"] == "histogram":
+                for le, cum in val["buckets"]:
+                    lab = (label_key + "," if label_key else "") \
+                        + f'le="{_fmt(le)}"'
+                    lines.append(f"{name}_bucket{{{lab}}} {cum}")
+                block = f"{{{label_key}}}" if label_key else ""
+                lines.append(f"{name}_sum{block} {_fmt(val['sum'])}")
+                lines.append(f"{name}_count{block} {val['count']}")
+            else:
+                block = f"{{{label_key}}}" if label_key else ""
+                lines.append(f"{name}{block} {_fmt(val)}")
+    return "\n".join(lines) + "\n"
+
+
+# --------------------------------------------------------------------------
+# JSON snapshot file
+# --------------------------------------------------------------------------
+
+def json_safe_snapshot() -> dict:
+    """Registry snapshot with ``inf`` bucket bounds replaced by the
+    string "+Inf" — strict JSON (``json.dumps`` would emit the invalid
+    bare ``Infinity`` literal otherwise)."""
+    snap = _reg.snapshot()
+    for fam in snap.values():
+        if fam["type"] != "histogram":
+            continue
+        for val in fam["values"].values():
+            val["buckets"] = [["+Inf" if math.isinf(le) else le, c]
+                              for le, c in val["buckets"]]
+    return snap
+
+
+def write_json_snapshot(path: str) -> None:
+    """One atomic JSON snapshot write (tmp + rename — a scraper reading
+    mid-write must never see a torn file)."""
+    snap = json_safe_snapshot()
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(snap, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def _resolved_file_path() -> Optional[str]:
+    path = _env.metrics_file()
+    if not path:
+        return None
+    rank = _process_index()
+    if "{rank}" in path:
+        return path.replace("{rank}", str(rank))
+    return path if rank == 0 else None
+
+
+def _process_index() -> int:
+    try:
+        from .. import topology as _topo
+        return _topo._get().process_index
+    except Exception:
+        return 0
+
+
+class _JsonWriter:
+    def __init__(self, path: str, interval_s: float):
+        self._path = path
+        self._interval = max(0.05, interval_s)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="hvd-tpu-metrics-file",
+                                        daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            self._write()
+        self._write()  # final flush on stop
+
+    def _write(self):
+        try:
+            write_json_snapshot(self._path)
+        except OSError as e:  # never fail the job over telemetry
+            _log.warning("metrics snapshot write failed: %s", e)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+
+# --------------------------------------------------------------------------
+# HTTP endpoint (stdlib only)
+# --------------------------------------------------------------------------
+
+class MetricsServer:
+    """Prometheus + JSON endpoint over ``http.server`` (no new deps).
+
+    ``/metrics``       → text exposition (Content-Type the Prometheus
+                         scraper expects, version 0.0.4)
+    ``/metrics.json``  → the raw snapshot dict
+    """
+
+    def __init__(self, port: int):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path.split("?")[0] == "/metrics":
+                    body = prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path.split("?")[0] == "/metrics.json":
+                    body = json.dumps(json_safe_snapshot(),
+                                      sort_keys=True).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-request stderr
+                pass
+
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="hvd-tpu-metrics-http",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+
+# --------------------------------------------------------------------------
+# Lifecycle
+# --------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_json_writer: Optional[_JsonWriter] = None
+_server: Optional[MetricsServer] = None
+_started = False
+
+
+def maybe_start_exporters() -> None:
+    """Start whichever exporters the env configures (idempotent; called
+    by ``hvd.init()``). The HTTP endpoint is rank-0 only — one scrape
+    target per job, like the reference's rank-0 timeline file; JSON
+    files are per-process when the path has a ``{rank}`` placeholder."""
+    global _json_writer, _server, _started
+    if not _reg.enabled():
+        return
+    with _lock:
+        if _started:
+            return
+        _started = True
+        path = _resolved_file_path()
+        if path:
+            _json_writer = _JsonWriter(path, _env.metrics_interval_secs())
+        port = _env.metrics_port()
+        if port is not None and _process_index() == 0:
+            try:
+                _server = MetricsServer(port)
+                _log.info("metrics endpoint on :%d (/metrics, "
+                          "/metrics.json)", _server.port)
+            except OSError as e:
+                _log.warning("metrics endpoint failed to bind: %s", e)
+        if _json_writer is not None or _server is not None:
+            atexit.register(stop_exporters)
+
+
+def stop_exporters() -> None:
+    """Stop the exporters, flushing one final JSON snapshot."""
+    global _json_writer, _server, _started
+    with _lock:
+        if _json_writer is not None:
+            _json_writer.stop()
+            _json_writer = None
+        if _server is not None:
+            _server.stop()
+            _server = None
+        _started = False

@@ -1,0 +1,103 @@
+"""Per-step training telemetry shared by the framework shims.
+
+The Horovod paper's headline diagnostic is *allreduce share of step
+time* — the number that tells you whether you are compute-bound or
+communication-bound, and whether tensor fusion / compression is paying
+off (PAPERS.md, arxiv 1802.05799 §5). :class:`StepTimer` computes it
+from the registry itself: the engine accounts every fused collective's
+execution seconds into ``hvdtpu_op_execute_seconds_total``, so the
+share is (counter delta across the step) / (step wall time) — no
+framework-specific hooks into the collective path needed.
+
+One class serves all three shims:
+
+  - Keras: :class:`horovod_tpu.keras.callbacks.MetricsCallback` wraps it
+    in the callback API.
+  - torch / TF: exported as ``horovod_tpu.torch.StepMetrics`` /
+    ``horovod_tpu.tensorflow.StepMetrics`` — use as a context manager
+    around each step::
+
+        metrics = hvd.torch.StepMetrics(batch_size=64)
+        for batch in loader:
+            with metrics:
+                train_step(batch)
+
+Recorded metrics (all labeled ``framework=...``):
+  - ``hvdtpu_step_seconds`` (histogram)
+  - ``hvdtpu_samples_total`` (counter)
+  - ``hvdtpu_samples_per_second`` (gauge, last step)
+  - ``hvdtpu_allreduce_step_share`` (gauge in [0, 1], last step)
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from . import registry as _reg
+
+
+def _allreduce_execute_seconds() -> float:
+    fam = _reg.registry().counter(
+        "hvdtpu_op_execute_seconds_total",
+        "Cumulative wall seconds executing fused collective groups")
+    return fam.labels(op="allreduce").value
+
+
+class StepTimer:
+    """Brackets one training step; records step time, samples/sec and
+    the allreduce share of step time. Cheap enough to leave on: two
+    ``time.perf_counter`` calls and four registry writes per step."""
+
+    def __init__(self, framework: str, batch_size: Optional[int] = None):
+        self.framework = framework
+        self.batch_size = batch_size
+        r = _reg.registry()
+        labels = {"framework": framework}
+        self._h_step = r.histogram(
+            "hvdtpu_step_seconds", "Training step wall time",
+            buckets=_reg.LATENCY_BUCKETS).labels(**labels)
+        self._c_samples = r.counter(
+            "hvdtpu_samples_total", "Training samples processed"
+        ).labels(**labels)
+        self._g_rate = r.gauge(
+            "hvdtpu_samples_per_second",
+            "Samples/sec of the most recent step").labels(**labels)
+        self._g_share = r.gauge(
+            "hvdtpu_allreduce_step_share",
+            "Fraction of the last step's wall time spent executing "
+            "allreduce groups").labels(**labels)
+        self._t0: Optional[float] = None
+        self._ar0 = 0.0
+        self.last_step_s = 0.0
+        self.last_samples_per_s = 0.0
+        self.last_allreduce_share = 0.0
+
+    def begin(self) -> None:
+        self._ar0 = _allreduce_execute_seconds()
+        self._t0 = time.perf_counter()
+
+    def end(self, samples: Optional[int] = None) -> None:
+        if self._t0 is None:
+            return
+        dt = max(time.perf_counter() - self._t0, 1e-9)
+        self._t0 = None
+        n = samples if samples is not None else self.batch_size
+        self.last_step_s = dt
+        self._h_step.observe(dt)
+        if n:
+            self.last_samples_per_s = n / dt
+            self._c_samples.inc(n)
+            self._g_rate.set(self.last_samples_per_s)
+        share = min((_allreduce_execute_seconds() - self._ar0) / dt, 1.0)
+        self.last_allreduce_share = max(share, 0.0)
+        self._g_share.set(self.last_allreduce_share)
+
+    # Context-manager sugar for the torch/TF step loop.
+
+    def __enter__(self) -> "StepTimer":
+        self.begin()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.end()

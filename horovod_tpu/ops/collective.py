@@ -46,10 +46,109 @@ from .. import quantization as _quant
 from .. import topology as _topo
 from ..executor import (ALLGATHER, ALLREDUCE, BROADCAST, CollectiveExecutor,
                         default_executor)
+from ..observability import registry as _obs
 from ..utils import env as _env
 from ..utils.logging import get_logger
 
 _log = get_logger("ops")
+
+
+class _EngineMetrics:
+    """Registry handles for the engine's hot paths, resolved ONCE at
+    engine construction (docs/metrics.md): the per-op/per-phase child
+    lookup must never sit inside the enqueue or dispatch loop. All
+    counters are process-global registry state — they deliberately
+    survive ``reset_engine()`` (the satellite fix: telemetry must not
+    vanish with the instance that recorded it)."""
+
+    _OPS = (ALLREDUCE, ALLGATHER, BROADCAST)
+
+    def __init__(self):
+        r = _obs.registry()
+        phase = r.histogram(
+            "hvdtpu_op_phase_seconds",
+            "Per-collective latency by lifecycle phase (negotiate = "
+            "enqueue until the group is agreed/delivered; queue = "
+            "delivery until XLA dispatch; execute = fused program wall "
+            "time)", buckets=_obs.LATENCY_BUCKETS)
+        ops = r.counter("hvdtpu_ops_total", "Collective requests enqueued")
+        exec_total = r.counter(
+            "hvdtpu_op_execute_seconds_total",
+            "Cumulative wall seconds executing fused collective groups")
+        self.phase = {
+            (op, ph): phase.labels(op=_op_name(op), phase=ph)
+            for op in self._OPS
+            for ph in ("negotiate", "queue", "execute")}
+        self.ops = {op: ops.labels(op=_op_name(op)) for op in self._OPS}
+        self.exec_total = {op: exec_total.labels(op=_op_name(op))
+                           for op in self._OPS}
+        self.group_size = r.histogram(
+            "hvdtpu_fused_group_size",
+            "Tensors per executed fusion group",
+            buckets=_obs.SIZE_BUCKETS).labels()
+        self.group_bytes = r.histogram(
+            "hvdtpu_fused_group_bytes",
+            "Wire bytes per executed fusion group",
+            buckets=_obs.BYTE_BUCKETS).labels()
+        self._wire = r.counter(
+            "hvdtpu_wire_bytes_enqueued_total",
+            "Bytes-on-wire enqueued, by compression wire spec ('raw' = "
+            "the tensor's own dtype); matches _Request accounting")
+        self._wire_children = {None: self._wire.labels(spec="raw")}
+        self.cycles = r.counter(
+            "hvdtpu_cycles_total",
+            "Background dispatcher cycles (Python fallback loop)").labels()
+        self.cycle_busy = r.counter(
+            "hvdtpu_cycle_busy_seconds_total",
+            "Dispatcher seconds spent draining/planning/executing").labels()
+        self.cycle_idle = r.counter(
+            "hvdtpu_cycle_idle_seconds_total",
+            "Dispatcher seconds spent waiting for work").labels()
+        self.stalled_count = r.gauge(
+            "hvdtpu_engine_stalled_tensors",
+            "In-flight collectives currently past the stall warning "
+            "window (engine view)").labels()
+        self.stalled_info = r.gauge(
+            "hvdtpu_engine_stalled_tensor_seconds",
+            "Seconds each stalled tensor has waited, labeled with the "
+            "coordinator's missing-ranks report when available")
+
+    def wire_bytes(self, spec, nbytes: int) -> None:
+        child = self._wire_children.get(spec)
+        if child is None:
+            child = self._wire.labels(spec=spec)
+            self._wire_children[spec] = child
+        child.inc(nbytes)
+
+    def group_delivered(self, op: int, reqs, t_deliver: float) -> None:
+        """Close the negotiate phase for every request in a delivered
+        group and record the group's shape."""
+        ph = self.phase.get((op, "negotiate"))
+        if ph is None:
+            return
+        for r in reqs:
+            ph.observe(t_deliver - r.enqueued_at)
+        self.group_size.observe(len(reqs))
+        self.group_bytes.observe(sum(r.nbytes for r in reqs))
+
+    def group_executed(self, op: int, n: int, t_deliver: float,
+                       t_start: float, t_end: float) -> None:
+        key = (op, "queue")
+        if key not in self.phase:
+            return
+        self.phase[key].observe(t_start - t_deliver)
+        self.phase[(op, "execute")].observe(t_end - t_start)
+        self.exec_total[op].inc(t_end - t_start)
+
+    def set_stalls(self, entries) -> None:
+        """Replace the stalled-tensor gauges with the current episode:
+        ``entries`` is [(tensor, age_s, missing_ranks_str)]. Clearing
+        first keeps resolved stalls from lingering in the export."""
+        self.stalled_info.clear()
+        self.stalled_count.set(len(entries))
+        for tensor, age, missing in entries:
+            self.stalled_info.labels(
+                tensor=tensor, missing_ranks=missing).set(age)
 
 DUPLICATE_NAME_ERROR = (
     "Requested to {op} a tensor with the same name as another tensor that is "
@@ -268,7 +367,15 @@ class CollectiveEngine:
         # Cumulative bytes-on-wire of every enqueued request (wire bytes,
         # i.e. quantized payload + scales for blockwise formats) — the
         # accounting the compression bench and acceptance tests read.
+        # DEPRECATION ALIAS: the canonical series is the registry's
+        # hvdtpu_wire_bytes_enqueued_total (labeled by wire spec, and it
+        # survives reset_engine()); this attribute stays for existing
+        # delta-based callers.
         self.wire_bytes_enqueued = 0
+        # Registry handles (docs/metrics.md), resolved once — the
+        # registry itself is process-global, so totals accumulate across
+        # engine instances.
+        self._metrics = _EngineMetrics()
         self.timeline = None          # Python-mode timeline (fallback path)
         self._timeline_tried = False  # decide once, off the hot path
         self._mark_cycles = _env.timeline_mark_cycles()
@@ -488,6 +595,8 @@ class CollectiveEngine:
             raise HorovodInternalError(
                 SHUT_DOWN_ERROR.format(op=_op_name(req.op)))
         self.wire_bytes_enqueued += req.nbytes
+        self._metrics.wire_bytes(req.wire, req.nbytes)
+        self._metrics.ops[req.op].inc()
         core = self._ensure_native()
         if core is not None:
             return self._enqueue_native(core, req)
@@ -541,11 +650,13 @@ class CollectiveEngine:
         negotiated + fusion-planned in C++ (the PerformOperation dispatch
         point, operations.cc:768-791); run it as XLA programs."""
         core = self._native_core
+        t_deliver = time.monotonic()
         with self._lock:
             pairs = [(i, self._native_pending.pop(i))
                      for i in native_ids if i in self._native_pending]
         if not pairs:
             return
+        self._metrics.group_delivered(op, [r for _, r in pairs], t_deliver)
         if err:
             core.complete([i for i, _ in pairs], 2, err)
             for i, r in pairs:
@@ -578,6 +689,7 @@ class CollectiveEngine:
                 for r in reqs:
                     core.timeline_activity_end(r.name)       # close QUEUE
                     core.timeline_activity_start(r.name, _xla_activity(op))
+            t_start = time.monotonic()
             try:
                 results = self._execute_group(ex, reqs)
             except BaseException as e:
@@ -587,6 +699,8 @@ class CollectiveEngine:
                     core.release(i)
                     r.handle._fulfill(error=_as_error(e))
                 continue
+            self._metrics.group_executed(op, len(reqs), t_deliver,
+                                         t_start, time.monotonic())
             core.complete(ids, 0, "")
             for (i, r), out in zip(sub, results):
                 core.release(i)
@@ -705,6 +819,7 @@ class CollectiveEngine:
         core = self._native_core
         if core is None:
             return
+        t_deliver = time.monotonic()
         with self._lock:
             pairs = [(i, self._native_pending.pop(i))
                      for i in native_ids if i in self._native_pending]
@@ -727,6 +842,7 @@ class CollectiveEngine:
                 core.release(i)
                 r.handle._fulfill(error=desync)
             return
+        self._metrics.group_delivered(op, [r for _, r in pairs], t_deliver)
         if err:
             ids = [i for i, _ in pairs]
             core.complete(ids, 2, err)
@@ -762,6 +878,7 @@ class CollectiveEngine:
                 for r in reqs:
                     core.timeline_activity_end(r.name)       # close QUEUE
                     core.timeline_activity_start(r.name, _xla_activity(op))
+            t_start = time.monotonic()
             try:
                 results = self._execute_group_mp(ex, reqs, meta, topo, op)
             except BaseException as e:
@@ -771,6 +888,8 @@ class CollectiveEngine:
                     core.release(i)
                     r.handle._fulfill(error=_as_error(e))
                 continue
+            self._metrics.group_executed(op, len(reqs), t_deliver,
+                                         t_start, time.monotonic())
             core.complete(ids, 0, "")
             for (i, r), out in zip(sub, results):
                 core.release(i)
@@ -861,11 +980,19 @@ class CollectiveEngine:
         drain queue, plan fusion, execute. In multi-process mode the plan
         comes from the rank-0 coordinator instead of local fusion."""
         mp = self._is_multiprocess()
+        m = self._metrics
+        prev_cycle_end = time.monotonic()
         while not self._shutdown:
             self._wake.wait(timeout=self.cycle_time_s)
             self._wake.clear()
             if self._shutdown:
                 return
+            # Cycle utilization (docs/metrics.md): busy = this
+            # iteration's drain/plan/execute work, idle = the wait
+            # above. utilization = busy / (busy + idle).
+            t_wake = time.monotonic()
+            m.cycles.inc()
+            m.cycle_idle.inc(t_wake - prev_cycle_end)
             if self._mark_cycles and self.timeline is not None:
                 self.timeline.mark_cycle()  # HOROVOD_TIMELINE_MARK_CYCLES
             with self._lock:
@@ -931,6 +1058,8 @@ class CollectiveEngine:
                 # Also skip the MP fetch: a long-poll here would hold the
                 # rest of the burst back past the coordinator's quiet
                 # window.
+                prev_cycle_end = time.monotonic()
+                m.cycle_busy.inc(prev_cycle_end - t_wake)
                 continue
             if mp:
                 try:
@@ -944,6 +1073,8 @@ class CollectiveEngine:
                 except BaseException as e:   # pragma: no cover - safety net
                     _log.error("background dispatch failed: %s", e)
             self._maybe_check_stalls()
+            prev_cycle_end = time.monotonic()
+            m.cycle_busy.inc(prev_cycle_end - t_wake)
 
     def _fail_all(self, err: BaseException):
         with self._lock:
@@ -1003,6 +1134,7 @@ class CollectiveEngine:
         after a cycle exception while announcements remained registered).
         Skipping the collective while peers execute it would deadlock the
         SPMD program, so desync is fatal for the engine instead."""
+        t_deliver = time.monotonic()
         with self._lock:
             reqs = [self._in_flight.pop(n) for n in group["names"]
                     if n in self._in_flight]
@@ -1021,6 +1153,8 @@ class CollectiveEngine:
             # request, so the job dies with a diagnostic instead of
             # hanging all ranks.
             raise err
+        if reqs:
+            self._metrics.group_delivered(reqs[0].op, reqs, t_deliver)
         tl = self.timeline
         if tl is not None:
             for r in reqs:
@@ -1058,6 +1192,7 @@ class CollectiveEngine:
                     tl.activity_end_all(sub_names)
                 tl.activity_start_all(sub_names,
                                       _xla_activity(sub[0].op))
+            t_start = time.monotonic()
             try:
                 results = self._execute_group_mp(ex, sub, group, topo)
             except BaseException as e:
@@ -1069,6 +1204,8 @@ class CollectiveEngine:
                 for r in sub:
                     r.handle._fulfill(error=err)
                 continue
+            self._metrics.group_executed(sub[0].op, len(sub), t_deliver,
+                                         t_start, time.monotonic())
             if tl is not None:
                 tl.activity_end_all(sub_names)
             for r, out in zip(sub, results):
@@ -1146,6 +1283,9 @@ class CollectiveEngine:
                        for r in self._in_flight.values()
                        if now - r.enqueued_at > self.stall_warning_s]
         if not stalled:
+            # The previous episode resolved: zero the gauges so the
+            # export stops naming tensors that completed.
+            self._metrics.set_stalls([])
             return
         mp = self._is_multiprocess()
         # Expire coordinator lines from a PREVIOUS stall episode: a line
@@ -1156,20 +1296,26 @@ class CollectiveEngine:
             n: (ln, ts) for n, (ln, ts) in self._coord_stall_lines.items()
             if ts >= cutoff}
         lines = []
+        gauge_entries = []
         for name, op, age in sorted(stalled):
             coord = self._coord_stall_lines.get(name)
             if coord is not None:
                 lines.append(f"{coord[0]} [{op}, waiting {int(age)}s]")
+                gauge_entries.append(
+                    (name, age, _missing_ranks_of(coord[0])))
             elif mp:
                 lines.append(
                     f"{name} [{op}, waiting {int(age)}s; announced, "
                     "awaiting coordinator grouping — see coordinator "
                     "report for missing ranks]")
+                gauge_entries.append((name, age, "unknown"))
             else:
                 lines.append(
                     f"{name} [{op}, waiting {int(age)}s; single-process: "
                     "all virtual ranks are local, so no rank is missing — "
                     "likely a wedged dispatcher or an unawaited handle]")
+                gauge_entries.append((name, age, "none(single-process)"))
+        self._metrics.set_stalls(gauge_entries)
         _log.warning(
             "One or more tensors were submitted to be reduced, gathered "
             "or broadcasted by subset of ranks and are waiting for "
@@ -1264,9 +1410,11 @@ class CollectiveEngine:
     def _dispatch(self, batch: List[_Request]):
         ex = self.executor
         tl = self.timeline
+        t_drain = time.monotonic()
         for group in self._plan_fusion(batch):
             names = [r.name for r in group]
             op = group[0].op
+            self._metrics.group_delivered(op, group, t_drain)
             if tl is not None:
                 for n in names:
                     tl.negotiate_end(n)
@@ -1275,6 +1423,7 @@ class CollectiveEngine:
                     tl.activity_start_all(names, "MEMCPY_IN_FUSION_BUFFER")
                     tl.activity_end_all(names)
                 tl.activity_start_all(names, _xla_activity(op))
+            t_start = time.monotonic()
             try:
                 results = self._execute_group(ex, group)
             except BaseException as e:
@@ -1288,6 +1437,8 @@ class CollectiveEngine:
                     for n in names:
                         tl.end(n, None)
                 continue
+            self._metrics.group_executed(op, len(group), t_drain,
+                                         t_start, time.monotonic())
             if tl is not None:
                 tl.activity_end_all(names)
             with self._lock:
@@ -1412,6 +1563,28 @@ class CollectiveEngine:
 def _op_name(op: int) -> str:
     return {ALLREDUCE: "allreduce", ALLGATHER: "allgather",
             BROADCAST: "broadcast"}[op]
+
+
+def _missing_ranks_of(display_line: str) -> str:
+    """Best-effort extraction of the missing-rank list from a
+    coordinator stall display line ("name [missing ranks: 1, 3]") for
+    the gauge label. The structured source is the coordinator's own
+    metrics (control_plane.check_stalls); this is the worker-side echo,
+    parsed from OUR controller's stable wording — worst case the label
+    degrades to 'unknown', never to a wrong rank."""
+    marker = "missing ranks:"
+    i = display_line.find(marker)
+    if i < 0:
+        return "unknown"
+    tail = display_line[i + len(marker):]
+    ranks = []
+    for tok in tail.replace("]", " ").split(","):
+        tok = tok.strip()
+        if tok.isdigit():
+            ranks.append(tok)
+        elif ranks:
+            break
+    return ",".join(ranks) if ranks else "unknown"
 
 
 def _xla_activity(op: int) -> str:

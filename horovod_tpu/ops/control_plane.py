@@ -41,6 +41,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from . import wire_format as _wire
+from ..observability import registry as _obs
 from ..runner.network import BasicClient, BasicService
 from ..runner.secret import SECRET_ENV, decode_key, make_secret_key
 from ..utils.logging import get_logger
@@ -250,6 +251,30 @@ class CoordinatorService(BasicService):
                        | (_wire.FLAG_HIERARCHICAL_ALLGATHER
                           if _envmod.hierarchical_allgather() else 0))
         self.cycle_time_ms = _envmod.cycle_time_ms()
+        # Registry metrics (docs/metrics.md): the coordinator is the ONE
+        # place that knows which ranks are missing per stalled tensor,
+        # so its stall report is exported as gauges here — closing the
+        # gap where multi-process stalls were visible only as log lines.
+        r = _obs.registry()
+        self._m_stalled_count = r.gauge(
+            "hvdtpu_coordinator_stalled_tensors",
+            "Tensors announced by only a subset of ranks past the stall "
+            "warning window (rank-0 coordinator view)").labels()
+        self._m_stalled_info = r.gauge(
+            "hvdtpu_coordinator_stalled_tensor_seconds",
+            "Per stalled tensor: seconds since first announce, labeled "
+            "with the ranks that have not announced it")
+        self._m_failures = r.counter(
+            "hvdtpu_coordinator_failure_events_total",
+            "Escalated worker-failure events, by kind")
+        self._m_groups = r.counter(
+            "hvdtpu_coordinator_groups_planned_total",
+            "Fusion groups cut by the coordinator planner").labels()
+        self._m_announces = r.counter(
+            "hvdtpu_coordinator_announces_total",
+            "Announce RPCs processed").labels()
+        self._groups_seen = 0
+        self._failures_reported: set = set()
         self._ctl = None
         if native is not False:
             try:
@@ -303,6 +328,7 @@ class CoordinatorService(BasicService):
         return super()._handle(req, client_address)
 
     def _announce(self, req: AnnounceRequest) -> AnnounceResponse:
+        self._m_announces.inc()
         with self._cv:
             self._last_seen[req.rank] = time.monotonic()
             if req.announce_id:
@@ -414,6 +440,7 @@ class CoordinatorService(BasicService):
         structured name instead of re-parsing the display text."""
         now = time.monotonic()
         lines: List[Tuple[str, str]] = []
+        entries: List[Tuple[str, float, str]] = []
         with self._mu:
             if (self.stall_warning_s <= 0
                     or now - self._last_stall_check < self.stall_warning_s):
@@ -421,6 +448,13 @@ class CoordinatorService(BasicService):
             self._last_stall_check = now
             if self._ctl is not None:
                 lines = self._ctl.stalled()
+                from .collective import _missing_ranks_of
+                # The native wire carries no age; the stall window is a
+                # guaranteed lower bound (a tensor only appears once it
+                # has waited at least that long).
+                entries = [(name, self.stall_warning_s,
+                            _missing_ranks_of(line))
+                           for name, line in lines]
             else:
                 for name, e in sorted(self._table.items()):
                     if now - e.first_seen > self.stall_warning_s:
@@ -429,6 +463,17 @@ class CoordinatorService(BasicService):
                             (name,
                              f"{name} [missing ranks: "
                              f"{', '.join(map(str, missing))}]"))
+                        entries.append(
+                            (name, now - e.first_seen,
+                             ",".join(map(str, missing))))
+        # Gauge export of the authoritative report: cleared and re-set
+        # each completed check, so a resolved episode zeroes out instead
+        # of naming completed tensors forever.
+        self._m_stalled_info.clear()
+        self._m_stalled_count.set(len(entries))
+        for name, age, missing in entries:
+            self._m_stalled_info.labels(
+                tensor=name, missing_ranks=missing).set(age)
         if lines:
             _log.warning(
                 "One or more tensors were submitted to be reduced, "
@@ -475,6 +520,13 @@ class CoordinatorService(BasicService):
                             "detail": (f"tensor {name} waited {age:.1f}s "
                                        f"(> failure timeout) for ranks "
                                        f"{missing}")})
+        for f in failures:
+            # check_failures recomputes on every fetch; count each
+            # distinct (rank, kind) event once.
+            key = (f["rank"], f["kind"])
+            if key not in self._failures_reported:
+                self._failures_reported.add(key)
+                self._m_failures.labels(kind=f["kind"]).inc()
         return failures
 
     def _fetch(self, req: FetchRequest) -> FetchResponse:
@@ -516,6 +568,10 @@ class CoordinatorService(BasicService):
                     # burst into a timing-dependent group.
                     if self._ctl.plan() > req.after_seq:
                         self._cv.notify_all()
+                total = self._ctl.group_count()
+                if total > self._groups_seen:
+                    self._m_groups.inc(total - self._groups_seen)
+                    self._groups_seen = total
                 payload = self._ctl.fetch(req.rank, req.after_seq)
                 groups, shutdown = _wire.decode_response_list(payload,
                                                               self._nproc)
@@ -618,6 +674,7 @@ class CoordinatorService(BasicService):
         remaining = self._ready
         self._ready = []
         self._oldest_ready_t = None
+        n_before = len(self._groups)
         while remaining:
             name, e = remaining.pop(0)
             err = self._validate(name, e)
@@ -655,6 +712,7 @@ class CoordinatorService(BasicService):
                 "names": group_names, "error": "",
                 "root_rank": next(iter(e.root_by_rank.values()), -1),
                 "sizes": sizes, "flags": self._flags})
+        self._m_groups.inc(len(self._groups) - n_before)
 
 
     def shutdown(self) -> None:

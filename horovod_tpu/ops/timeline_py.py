@@ -57,13 +57,15 @@ class PyTimeline:
         return pid
 
     def _emit(self, tensor: str, ph: str, name: Optional[str] = None,
-              args: Optional[dict] = None):
+              args: Optional[dict] = None, scope: Optional[str] = None):
         ev = {"ph": ph, "ts": self._ts(), "pid": self._pid(tensor),
               "tid": 0}
         if name is not None:
             ev["name"] = name
         if args:
             ev["args"] = args
+        if scope is not None:
+            ev["s"] = scope
         self._queue.append(ev)
         self._wake.set()
 
@@ -91,7 +93,12 @@ class PyTimeline:
         self._emit(tensor, "E", args=args)
 
     def mark_cycle(self):
-        self._emit("_cycles", "i", "CYCLE_START")
+        # Instant events need an explicit scope: without "s" Perfetto
+        # and Chrome render a thread-scoped tick on tid 0 only; "g"
+        # (global) draws the cycle marker across the whole trace, which
+        # is what a background-cycle boundary means (Trace Event Format
+        # §Instant Events).
+        self._emit("_cycles", "i", "CYCLE_START", scope="g")
 
     # ------------------------------------------------------------- writer
 

@@ -34,6 +34,7 @@ import tensorflow as tf
 from .. import ops as _ops
 from .. import topology as _topo
 from ..compression import Compression
+from ..observability import StepTimer as _StepTimer
 from ..utils import interop as _interop
 from ..topology import (init, shutdown, is_initialized, rank, local_rank,
                         size, local_size, mpi_threads_supported)
@@ -45,7 +46,20 @@ __all__ = [
     "broadcast_variables", "broadcast_global_variables",
     "DistributedOptimizer", "DistributedGradientTape",
     "BroadcastGlobalVariablesCallback", "BroadcastGlobalVariablesHook",
+    "StepMetrics",
 ]
+
+
+class StepMetrics(_StepTimer):
+    """Per-step telemetry hook for TF training loops (docs/metrics.md):
+    ``hvdtpu_step_seconds`` / ``hvdtpu_samples_per_second`` /
+    ``hvdtpu_allreduce_step_share``, labeled ``framework=tensorflow``.
+    Use as a context manager around each train step; the allreduce share
+    comes from the engine's execute-time accounting, so it covers the
+    collectives issued through DistributedGradientTape/Optimizer."""
+
+    def __init__(self, batch_size: Optional[int] = None):
+        super().__init__("tensorflow", batch_size=batch_size)
 
 # Host-bridge call counter (observability/tests): index 0 counts how many
 # py_function/host crossings carried a GROUP of tensors — the fusion-

@@ -223,7 +223,17 @@ def init(*, coordinator_address: Optional[str] = None,
         devs = tuple(devices) if devices is not None else tuple(jax.devices())
         _topology = _build_topology(
             devs, jax.process_index(), jax.process_count())
-        return _topology
+    # Telemetry exporters (docs/metrics.md): env-driven, idempotent,
+    # no-op unless HOROVOD_TPU_METRICS_FILE / _PORT is set. Outside the
+    # lock — the exporter reads topology through the public path.
+    try:
+        from .observability import maybe_start_exporters
+        maybe_start_exporters()
+    except Exception as e:  # never fail init over telemetry
+        from .utils.logging import get_logger
+        get_logger("topology").warning("metrics exporters not started: %s",
+                                       e)
+    return _topology
 
 
 def shutdown() -> None:

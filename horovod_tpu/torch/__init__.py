@@ -19,6 +19,7 @@ import torch
 
 from ..topology import (init, shutdown, is_initialized, rank, local_rank,
                         size, local_size, mpi_threads_supported)
+from ..observability import StepTimer as _StepTimer
 from .compression import Compression
 from .mpi_ops import (allreduce, allreduce_, allreduce_async,
                       allreduce_async_, allgather, allgather_async,
@@ -33,8 +34,28 @@ __all__ = [
     "allgather", "allgather_async", "broadcast", "broadcast_",
     "broadcast_async", "broadcast_async_", "poll", "synchronize",
     "DistributedOptimizer", "broadcast_parameters",
-    "broadcast_optimizer_state",
+    "broadcast_optimizer_state", "StepMetrics",
 ]
+
+
+class StepMetrics(_StepTimer):
+    """Per-step telemetry hook for the torch training loop
+    (docs/metrics.md): records ``hvdtpu_step_seconds``,
+    ``hvdtpu_samples_per_second`` and ``hvdtpu_allreduce_step_share``
+    (all labeled ``framework=torch``) into the metrics registry. Use as
+    a context manager around each step::
+
+        metrics = hvd.torch.StepMetrics(batch_size=64)
+        for batch in loader:
+            with metrics:
+                loss = train_step(batch)   # backward + optimizer.step()
+
+    The allreduce share is computed from the engine's own execute-time
+    accounting, so it covers the DistributedOptimizer's async allreduces
+    wherever they overlap the step."""
+
+    def __init__(self, batch_size: Optional[int] = None):
+        super().__init__("torch", batch_size=batch_size)
 
 
 class _DistributedOptimizer(torch.optim.Optimizer):

@@ -1,0 +1,114 @@
+"""Metrics overhead guard (slow tier) — the registry's contract is
+"near-zero cost": run the fused-allreduce hot loop with metrics enabled
+vs. disabled and hold the wall-clock overhead under 3%, writing
+``BENCH_METRICS.json`` (seeded tensor contents; same artifact shape as
+``BENCH_COMPRESSION.json``).
+
+Methodology: the two modes run INTERLEAVED repeats with ALTERNATING
+order (A/B, B/A, ...) so machine drift and cache-warming hit both modes
+equally; each step is timed individually and the per-mode estimate is
+the 25th percentile of the pooled per-step times — multi-millisecond
+scheduler/XLA-dispatch hiccups land in the upper tail, while the
+metrics cost, being systematic, shifts the whole distribution. One
+re-measure is allowed before failing (a shared CI box can stay
+saturated through one window). Measured mutator costs are ~0.3 µs per
+counter inc / ~0.5 µs per histogram observe against a multi-millisecond
+fused step, so a persistent >3% reading indicates a real hot-path
+regression, not noise."""
+
+import json
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.observability import enabled, set_enabled
+
+pytestmark = pytest.mark.slow
+
+REPEATS = 8
+STEPS = 80
+N_TENSORS = 8
+N_ELEMENTS = 1024
+
+
+def _hot_loop(tensors, steps: int) -> list:
+    """The eager engine's fused-allreduce hot path: burst-enqueue the
+    group, wait all — the per-step pattern of a synchronous training
+    loop (and the path every metric hook sits on: enqueue accounting,
+    drain, phase histograms, group execution). Returns per-step wall
+    times."""
+    from horovod_tpu.ops import collective as _coll
+    eng = _coll.engine()
+    out = []
+    for step in range(steps):
+        t0 = time.perf_counter()
+        with eng.burst():
+            handles = [
+                hvd.allreduce_async(t, average=True,
+                                    name=f"bench.metrics.{step}.{i}")
+                for i, t in enumerate(tensors)]
+        for h in handles:
+            h.wait()
+        out.append(time.perf_counter() - t0)
+    return out
+
+
+def _measure(tensors):
+    times = {"enabled": [], "disabled": []}
+    try:
+        for rep in range(REPEATS):
+            order = (True, False) if rep % 2 == 0 else (False, True)
+            for mode in order:
+                set_enabled(mode)
+                times["enabled" if mode else "disabled"].extend(
+                    _hot_loop(tensors, STEPS))
+    finally:
+        set_enabled(True)
+    t_on = float(np.percentile(times["enabled"], 25))
+    t_off = float(np.percentile(times["disabled"], 25))
+    return t_on, t_off
+
+
+def test_metrics_overhead_under_3_percent():
+    rng = np.random.RandomState(0)
+    tensors = [jnp.asarray(rng.standard_normal(N_ELEMENTS)
+                           .astype(np.float32))
+               for _ in range(N_TENSORS)]
+    assert enabled(), "guard must A/B from the enabled default"
+
+    _hot_loop(tensors, 10)         # warmup: compile + caches
+    t_on, t_off = _measure(tensors)
+    overhead = t_on / t_off - 1.0
+    if overhead >= 0.03:           # one re-measure before failing
+        t_on, t_off = _measure(tensors)
+        overhead = t_on / t_off - 1.0
+
+    out = {
+        "metric": "metrics_overhead",
+        "note": ("fused-allreduce hot loop, metrics enabled vs disabled; "
+                 "p25 of pooled per-step wall times over interleaved "
+                 "alternating repeats (wall-clock, informational); guard "
+                 "asserts enabled/disabled < 1.03"),
+        "steps": STEPS,
+        "tensors_per_step": N_TENSORS,
+        "elements_per_tensor": N_ELEMENTS,
+        "repeats": REPEATS,
+        "rows": {
+            "enabled": {"step_time_ms": round(t_on * 1000.0, 4)},
+            "disabled": {"step_time_ms": round(t_off * 1000.0, 4)},
+        },
+        "overhead_frac": round(overhead, 6),
+    }
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "BENCH_METRICS.json"), "w") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+    assert overhead < 0.03, (
+        f"metrics recording cost {overhead:.2%} of the hot loop "
+        f"(p25 step time enabled {t_on * 1e3:.3f} ms vs disabled "
+        f"{t_off * 1e3:.3f} ms; budget 3%)")

@@ -213,3 +213,106 @@ class TestJitPathTimeline:
                   and e.get("pid", 0) >= timeline_jit._PID_GAP]
         assert prof_x, "no duration events merged"
         assert min(e["ts"] for e in prof_x) >= anchor - 1
+
+
+class TestCycleMarkerScope:
+    def test_mark_cycle_emits_global_scope_instant(self, tmp_path):
+        """Chrome/Perfetto render "ph": "i" instant events thread-scoped
+        unless "s" says otherwise; cycle markers are trace-wide
+        boundaries, so they must carry "s": "g" (Trace Event Format
+        §Instant Events). Asserts the emitted JSON directly."""
+        from horovod_tpu.ops.timeline_py import PyTimeline
+
+        path = tmp_path / "cycles.json"
+        tl = PyTimeline(str(path))
+        tl.mark_cycle()
+        tl.mark_cycle()
+        tl.close()
+        events = json.loads(path.read_text())
+        cycles = [e for e in events
+                  if e.get("name") == "CYCLE_START" and e.get("ph") == "i"]
+        assert len(cycles) == 2
+        for e in cycles:
+            assert e.get("s") == "g", e
+        # The _cycles pseudo-process is still named for the viewer.
+        assert any(e.get("ph") == "M"
+                   and e.get("args", {}).get("name") == "_cycles"
+                   for e in events)
+
+
+class TestMergeCli:
+    """The timeline_jit merge CLI on SYNTHETIC inputs: no profiler run,
+    no engine — just a timeline file and a fake jax.profiler capture
+    directory, exercising exactly what the CLI does."""
+
+    def _make_inputs(self, tmp_path):
+        import gzip
+
+        tl = tmp_path / "timeline.json"
+        # An unterminated file (PyTimeline.close's slow-writer escape
+        # hatch) — _load_timeline must tolerate the missing bracket.
+        tl.write_text(
+            '[\n'
+            '{"name": "process_name", "ph": "M", "pid": 0,'
+            ' "args": {"name": "jit::train"}},\n'
+            '{"ph": "B", "ts": 1000, "pid": 0, "tid": 0,'
+            ' "name": "XLA_STEP"},\n'
+            '{"ph": "E", "ts": 5000, "pid": 0, "tid": 0},\n')
+        profdir = tmp_path / "profile" / "plugins" / "profile" / "run1"
+        profdir.mkdir(parents=True)
+        capture = {
+            "traceEvents": [
+                {"name": "process_name", "ph": "M", "pid": 3,
+                 "args": {"name": "/device:TPU:0"}},
+                {"ph": "X", "ts": 777000, "dur": 300, "pid": 3,
+                 "tid": 1, "name": "fusion.1"},
+                {"ph": "X", "ts": 777400, "dur": 200, "pid": 3,
+                 "tid": 1, "name": "all-reduce.2"},
+            ]}
+        with gzip.open(profdir / "host.trace.json.gz", "wt") as f:
+            json.dump(capture, f)
+        return tl, tmp_path / "profile"
+
+    def test_cli_merges_and_interleaves(self, tmp_path, capsys):
+        from horovod_tpu.ops import timeline_jit
+
+        tl, profdir = self._make_inputs(tmp_path)
+        out = tmp_path / "merged.json"
+        timeline_jit._main([str(tl), str(profdir), "-o", str(out)])
+        assert capsys.readouterr().out.strip() == str(out)
+
+        merged = json.loads(out.read_text())
+        # Both streams present: the timeline's own events...
+        assert any(e.get("name") == "XLA_STEP" for e in merged)
+        # ...and the capture's device lanes, pid-rebased above the gap.
+        prof = [e for e in merged
+                if e.get("pid", 0) >= timeline_jit._PID_GAP]
+        assert any(e.get("name") == "all-reduce.2" for e in prof)
+        assert any(e.get("args", {}).get("name") == "/device:TPU:0"
+                   for e in prof if e.get("ph") == "M")
+        # Interleaved on ONE clock: the capture's earliest event is
+        # anchored at the first XLA_STEP bracket (ts 1000), so its
+        # duration events sit inside the step span, not at ts 777000.
+        prof_x = [e for e in prof if e.get("ph") == "X"]
+        assert prof_x
+        assert min(e["ts"] for e in prof_x) == 1000
+        assert max(e["ts"] for e in prof_x) <= 5000
+
+    def test_cli_default_output_path(self, tmp_path, capsys):
+        from horovod_tpu.ops import timeline_jit
+
+        tl, profdir = self._make_inputs(tmp_path)
+        timeline_jit._main([str(tl), str(profdir)])
+        printed = capsys.readouterr().out.strip()
+        assert printed == str(tl) + ".merged.json"
+        json.loads(open(printed).read())
+
+    def test_cli_missing_capture_errors(self, tmp_path):
+        from horovod_tpu.ops import timeline_jit
+
+        tl = tmp_path / "t.json"
+        tl.write_text("[\n]")
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        with pytest.raises(FileNotFoundError):
+            timeline_jit._main([str(tl), str(empty)])
