@@ -287,6 +287,144 @@ def main_compression(steps: int, out_path: str) -> None:
     print(json.dumps(result))
 
 
+# --------------------------------------------------------------------------
+# Checkpoint bench (--checkpoint): rank-0 pickle vs sharded-async engine on
+# a ZeRO-like seeded state. Deterministic fields: logical bytes, per-rank
+# bytes written, shard counts (seeded data, fixed layouts). Wall-clock
+# fields (*_ms) are informational except the headline claim they support:
+# the sharded-async save blocks the training loop for less time than the
+# rank-0 pickle (the *_ratio row; guarded by the slow-tier bench test).
+# --------------------------------------------------------------------------
+
+CHECKPOINT_WORKER = r"""
+import json, os, sys, time
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+import horovod_tpu as hvd
+from horovod_tpu.checkpoint import CheckpointEngine, read_manifest, tree_layout
+from horovod_tpu.utils.checkpoint import save_checkpoint
+
+commits = int(sys.argv[1])
+world = 4                                  # simulated hosts (8 devs / 2)
+
+hvd.init()
+rng = np.random.RandomState(0)
+mesh = Mesh(np.asarray(jax.devices(), dtype=object).reshape(8), ("dp",))
+shard = NamedSharding(mesh, P("dp"))
+
+# ZeRO-1-shaped state: two dp-sharded flat moment vectors (the state that
+# is ALREADY sharded across ranks and should never transit one host) plus
+# a replicated parameter block. ~48 MB fp32 total.
+state = {
+    "mu": jax.device_put(
+        jnp.asarray(rng.standard_normal(8 * 1024 * 1024), jnp.float32),
+        shard),
+    "nu": jax.device_put(
+        jnp.asarray(rng.standard_normal(2 * 1024 * 1024), jnp.float32),
+        shard),
+    "params": jnp.asarray(rng.standard_normal(2 * 1024 * 1024),
+                          jnp.float32),
+}
+logical = sum(int(np.shape(v)[0]) * 4 for v in state.values())
+
+outdir = sys.argv[2]
+
+# --- rank-0 pickle convention: the loop blocks for the whole device_get
+# + serialize + fsync of the full state.
+pk_dir = os.path.join(outdir, "pickle")
+pk_blocked = []
+for c in range(commits):
+    t0 = time.perf_counter()
+    save_checkpoint(state, pk_dir, step=c)
+    pk_blocked.append(time.perf_counter() - t0)
+pk_bytes = os.path.getsize(os.path.join(pk_dir, "0.pkl"))
+
+# --- sharded-async engine, simulated 4-host layout: each rank's save()
+# returns after snapshotting ITS shards; writes/commit run in background.
+proc_fn = lambda d: d.id // 2
+sh_dir = os.path.join(outdir, "sharded")
+engines = [CheckpointEngine(sh_dir, process_index=p, process_count=world,
+                            process_fn=proc_fn, barrier=lambda n: None)
+           for p in range(world)]
+sh_blocked = []
+for c in range(commits):
+    per_rank = []
+    for p in list(range(1, world)) + [0]:
+        t0 = time.perf_counter()
+        engines[p].save(state, c)
+        per_rank.append(time.perf_counter() - t0)
+    # the loop blocks on the slowest rank's snapshot
+    sh_blocked.append(max(per_rank))
+    for p in range(world):
+        engines[p].wait()
+
+man = read_manifest(sh_dir, commits - 1)
+rank_bytes = {p: 0 for p in range(world)}
+rank_shards = {p: 0 for p in range(world)}
+for entry in man["leaves"]:
+    for s in entry["shards"]:
+        rank_bytes[s["process"]] += s["nbytes"]
+        rank_shards[s["process"]] += 1
+
+med = lambda xs: sorted(xs)[len(xs) // 2]
+print(json.dumps({
+    "logical_bytes": logical,
+    "commits": commits,
+    "pickle": {"bytes_rank0": pk_bytes,
+               "bytes_other_ranks": 0,
+               "blocked_ms_per_commit": round(med(pk_blocked) * 1e3, 3)},
+    "sharded": {"bytes_per_rank": {str(p): rank_bytes[p]
+                                   for p in range(world)},
+                "shards_per_rank": {str(p): rank_shards[p]
+                                    for p in range(world)},
+                "process_count": man["process_count"],
+                "blocked_ms_per_commit": round(med(sh_blocked) * 1e3, 3)},
+}))
+"""
+
+
+def run_checkpoint_bench(commits: int, workdir: str) -> dict:
+    env = dict(os.environ)
+    proc = subprocess.run(
+        [sys.executable, "-c", CHECKPOINT_WORKER, str(commits), workdir],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"checkpoint bench worker failed:\n{proc.stderr[-2000:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def main_checkpoint(commits: int, out_path: str) -> None:
+    import tempfile
+    with tempfile.TemporaryDirectory() as workdir:
+        r = run_checkpoint_bench(commits, workdir)
+    pk_ms = r["pickle"]["blocked_ms_per_commit"]
+    sh_ms = r["sharded"]["blocked_ms_per_commit"]
+    result = {
+        "metric": "checkpoint_blocked_seconds",
+        "commits": r["commits"],
+        "logical_bytes": r["logical_bytes"],
+        "note": ("byte/shard counts are seeded and deterministic; "
+                 "*_ms are wall-clock. The headline delta — sharded-"
+                 "async blocks the loop less than the rank-0 pickle — "
+                 "is blocked_ratio_sharded_vs_pickle < 1."),
+        "pickle": r["pickle"],
+        "sharded": r["sharded"],
+        "blocked_ratio_sharded_vs_pickle": round(sh_ms / pk_ms, 4)
+        if pk_ms else None,
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(result))
+
+
 def main():
     sweep = {}
     best = 0.0
@@ -335,13 +473,22 @@ if __name__ == "__main__":
                     help="run the wire-compression bench and write "
                          "BENCH_COMPRESSION.json instead of the "
                          "throughput sweep")
+    ap.add_argument("--checkpoint", action="store_true",
+                    help="run the rank-0-pickle vs sharded-async "
+                         "checkpoint bench and write "
+                         "BENCH_CHECKPOINT.json")
     ap.add_argument("--steps", type=int, default=50,
                     help="convergence-run steps for --compression")
-    ap.add_argument("--out", default=os.path.join(
-        os.path.dirname(os.path.abspath(__file__)),
-        "BENCH_COMPRESSION.json"))
+    ap.add_argument("--commits", type=int, default=5,
+                    help="checkpoint commits per mode for --checkpoint")
+    ap.add_argument("--out", default=None)
     args = ap.parse_args()
+    here = os.path.dirname(os.path.abspath(__file__))
     if args.compression:
-        main_compression(args.steps, args.out)
+        main_compression(args.steps, args.out or os.path.join(
+            here, "BENCH_COMPRESSION.json"))
+    elif args.checkpoint:
+        main_checkpoint(args.commits, args.out or os.path.join(
+            here, "BENCH_CHECKPOINT.json"))
     else:
         main()

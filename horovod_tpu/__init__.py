@@ -29,6 +29,7 @@ from .optimizer import (DistributedOptimizer, DistributedGradientTransformation,
                         broadcast_parameters, broadcast_optimizer_state,
                         broadcast_object, allreduce_gradients)
 from .utils.checkpoint import restore_checkpoint, save_checkpoint
+from .checkpoint import CheckpointEngine, CorruptShardError
 from .ops.timeline_jit import (step as timeline_jit_step,
                                merge_profiler_trace)
 from .elastic import ElasticState, WorkerFailure, run_elastic
@@ -60,6 +61,7 @@ __all__ = [
     "DistributedGradientTransformation", "broadcast_parameters",
     "broadcast_optimizer_state", "broadcast_object", "allreduce_gradients",
     "save_checkpoint", "restore_checkpoint",
+    "CheckpointEngine", "CorruptShardError",
     # elastic
     "ElasticState", "WorkerFailure", "run_elastic",
     # observability

@@ -1,0 +1,27 @@
+"""Sharded async checkpoint engine (ISSUE 4; docs/checkpoint.md).
+
+The reference delegates checkpointing to the host framework and
+standardizes only rank-0-save / broadcast-on-restore (SURVEY.md §5.4) —
+``utils/checkpoint.py`` keeps that convention. This subsystem is the
+pod-scale replacement: per-host sharded save (ZeRO-sharded state never
+transits one host), async background writes, two-phase crash-atomic
+commit, and manifest-driven resharded restore for elastic grow/shrink.
+
+    engine = CheckpointEngine("/nfs/job/ckpt")
+    engine.save(state, step)          # returns after the host snapshot
+    ...
+    state = engine.restore(template=state)
+"""
+
+from .engine import CheckpointEngine, SaveHandle
+from .layout import LeafLayout, Shard, leaf_layout, tree_layout
+from .manifest import list_steps, read_latest, read_manifest
+from .reader import CorruptShardError, read_block, read_tree
+from .writer import AsyncWriter, atomic_write_bytes, fsync_dir
+
+__all__ = [
+    "AsyncWriter", "CheckpointEngine", "CorruptShardError", "LeafLayout",
+    "SaveHandle", "Shard", "atomic_write_bytes", "fsync_dir",
+    "leaf_layout", "list_steps", "read_block", "read_latest",
+    "read_manifest", "read_tree", "tree_layout",
+]

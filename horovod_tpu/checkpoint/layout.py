@@ -1,0 +1,178 @@
+"""Leaf→shard layout derivation — who writes which spans of which leaf.
+
+The reference's checkpoint convention funnels the whole model through
+rank 0 (SURVEY.md §5.4); the sharded engine instead derives, per pytree
+leaf, the set of index blocks and the process that owns each, straight
+from the leaf's ``jax.sharding``:
+
+  - a sharded ``jax.Array`` contributes one :class:`Shard` per distinct
+    index block of ``sharding.devices_indices_map`` — replicas dedupe to
+    the lowest-process owner, so every block is written exactly once;
+  - a fully replicated array (or a plain host ``numpy`` array — the
+    ``ElasticState`` host-snapshot case) is a single full-extent shard
+    owned by process 0, reproducing the rank-0-save convention for the
+    state that really is replicated.
+
+``process_fn`` overrides the device→process attribution. Its production
+value is the default (``device.process_index``); tests and the
+resharding bench use it to *simulate* a multi-host layout on the 8-device
+single-process CPU mesh (e.g. ``lambda d: d.id // 2`` acts like 4 hosts
+of 2 chips), which is what lets the world-size-4 → 2 → 1 restore matrix
+run in one process.
+
+Index blocks are half-open per-dimension spans ``((start, stop), ...)``
+— the normalized form of the slice tuples JAX hands out — and
+:func:`intersect_spans` is the one piece of geometry the resharded
+restore needs: a rank restoring into a new layout reads exactly the
+source shards whose spans overlap its new addressable blocks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+
+Span = Tuple[int, int]
+Index = Tuple[Span, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Shard:
+    """One index block of a leaf and the process that writes it."""
+
+    index: Index
+    process: int
+
+    @property
+    def slices(self) -> Tuple[slice, ...]:
+        return tuple(slice(a, b) for a, b in self.index)
+
+    def nelems(self) -> int:
+        n = 1
+        for a, b in self.index:
+            n *= b - a
+        return n
+
+
+@dataclasses.dataclass(frozen=True)
+class LeafLayout:
+    """Global shape/dtype of a leaf plus its deduped shard map."""
+
+    shape: Tuple[int, ...]
+    dtype: str
+    shards: Tuple[Shard, ...]
+    replicated: bool
+
+    def shards_of(self, process: int) -> Tuple[Shard, ...]:
+        return tuple(s for s in self.shards if s.process == process)
+
+
+def normalize_index(slices: Sequence[slice], shape: Sequence[int]) -> Index:
+    """Half-open per-dim spans from a slice tuple (fills None bounds)."""
+    out: List[Span] = []
+    for sl, dim in zip(slices, shape):
+        start, stop, step = sl.indices(int(dim))
+        if step != 1:
+            raise ValueError(f"non-unit-stride shard slice {sl!r}")
+        out.append((start, stop))
+    # 0-d leaves (optax count scalars) get an empty index — one block.
+    return tuple(out)
+
+
+def full_index(shape: Sequence[int]) -> Index:
+    return tuple((0, int(d)) for d in shape)
+
+
+def intersect_spans(a: Index, b: Index) -> Optional[Index]:
+    """Per-dim intersection of two blocks; None when they are disjoint."""
+    out: List[Span] = []
+    for (a0, a1), (b0, b1) in zip(a, b):
+        lo, hi = max(a0, b0), min(a1, b1)
+        if lo >= hi:
+            return None
+        out.append((lo, hi))
+    return tuple(out)
+
+
+def relative_slices(outer: Index, inner: Index) -> Tuple[slice, ...]:
+    """``inner`` re-based into the coordinates of the ``outer`` block."""
+    return tuple(slice(i0 - o0, i1 - o0)
+                 for (o0, _), (i0, i1) in zip(outer, inner))
+
+
+def _is_sharded_jax_array(x: Any) -> bool:
+    return (isinstance(x, jax.Array) and hasattr(x, "sharding")
+            and not x.sharding.is_fully_replicated)
+
+
+def leaf_layout(x: Any,
+                process_fn: Optional[Callable[[Any], int]] = None
+                ) -> LeafLayout:
+    """Derive a leaf's layout from its value (see module docstring)."""
+    arr_shape = tuple(int(d) for d in np.shape(x))
+    dtype = str(np.asarray(x).dtype) if not isinstance(x, jax.Array) \
+        else str(x.dtype)
+    if _is_sharded_jax_array(x):
+        idx_map = x.sharding.devices_indices_map(x.shape)
+        owners: Dict[Index, int] = {}
+        for dev, slices in idx_map.items():
+            idx = normalize_index(slices, arr_shape)
+            proc = int(process_fn(dev)) if process_fn is not None \
+                else int(dev.process_index)
+            prev = owners.get(idx)
+            if prev is None or proc < prev:
+                owners[idx] = proc
+        shards = tuple(Shard(index=idx, process=proc)
+                       for idx, proc in sorted(owners.items()))
+        return LeafLayout(shape=arr_shape, dtype=dtype, shards=shards,
+                          replicated=False)
+    return LeafLayout(
+        shape=arr_shape, dtype=dtype,
+        shards=(Shard(index=full_index(arr_shape), process=0),),
+        replicated=True)
+
+
+def tree_keys(tree: Any) -> Tuple[Tuple[str, Any], ...]:
+    """Stable ``(keystr, leaf)`` pairs in flatten order — the leaf
+    addressing scheme shared by layouts, shard file names and the
+    manifest."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return tuple((jax.tree_util.keystr(path), leaf) for path, leaf in flat)
+
+
+def tree_layout(tree: Any,
+                process_fn: Optional[Callable[[Any], int]] = None
+                ) -> Dict[str, LeafLayout]:
+    """``{leaf keystr: LeafLayout}`` for every leaf of ``tree``."""
+    return {key: leaf_layout(leaf, process_fn)
+            for key, leaf in tree_keys(tree)}
+
+
+def process_count(layouts: Dict[str, LeafLayout]) -> int:
+    """Number of distinct writing processes a layout set implies."""
+    procs = {s.process for ll in layouts.values() for s in ll.shards}
+    return max(procs) + 1 if procs else 1
+
+
+def shard_data(x: Any, shard: Shard) -> np.ndarray:
+    """Host copy of one shard's block (the device→host snapshot unit).
+
+    For a sharded ``jax.Array`` the block is fetched from the matching
+    addressable shard — local data only, no cross-host gather. Falls
+    back to slicing the (addressable) global value, which also covers
+    replicated leaves and plain host arrays.
+    """
+    # Always a real copy: on the CPU backend np.asarray of a jax buffer
+    # may alias device memory, and the next (donating) jitted step would
+    # overwrite the snapshot under the async writer.
+    if _is_sharded_jax_array(x):
+        for s in x.addressable_shards:
+            if normalize_index(s.index, x.shape) == shard.index:
+                return np.array(s.data, copy=True)
+    arr = np.asarray(jax.device_get(x)) if isinstance(x, jax.Array) \
+        else np.asarray(x)
+    return np.array(arr[shard.slices], copy=True)

@@ -231,3 +231,40 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
         if epoch == self.end_epoch - 1 and self.verbose > 0:
             print(f"\nEpoch {epoch + 1}: finished gradual learning rate "
                   f"warmup to {_get_lr(self.model.optimizer):g}.")
+
+
+class CheckpointCallback(keras.callbacks.Callback):
+    """Async checkpoint save hook on the sharded engine
+    (docs/checkpoint.md).
+
+    Every ``every_epochs`` epoch end, the model's weights (a list of
+    host arrays — replicated state, so rank 0 writes under the engine's
+    layout rules) are handed to a
+    :class:`horovod_tpu.checkpoint.CheckpointEngine`; serialization and
+    the atomic commit run on the engine's background thread, so
+    ``model.fit`` is blocked only for the snapshot. The in-flight write
+    is joined at train end (and by the next save). ``step`` in the
+    checkpoint is the epoch number; restore with
+    ``weights = engine.restore(template=model.get_weights())`` followed
+    by ``model.set_weights(weights)``.
+    """
+
+    def __init__(self, directory=None, *, engine=None,
+                 every_epochs: int = 1):
+        super().__init__()
+        if (directory is None) == (engine is None):
+            raise ValueError(
+                "pass exactly one of directory= or engine=")
+        if engine is None:
+            from ..checkpoint import CheckpointEngine
+            engine = CheckpointEngine(directory)
+        self.engine = engine
+        self.every_epochs = max(1, int(every_epochs))
+
+    def on_epoch_end(self, epoch, logs=None):
+        if (epoch + 1) % self.every_epochs == 0:
+            self.engine.save(list(self.model.get_weights()),
+                             step=epoch + 1)
+
+    def on_train_end(self, logs=None):
+        self.engine.wait()

@@ -34,7 +34,7 @@ __all__ = [
     "allgather", "allgather_async", "broadcast", "broadcast_",
     "broadcast_async", "broadcast_async_", "poll", "synchronize",
     "DistributedOptimizer", "broadcast_parameters",
-    "broadcast_optimizer_state", "StepMetrics",
+    "broadcast_optimizer_state", "StepMetrics", "checkpoint_hook",
 ]
 
 
@@ -326,3 +326,59 @@ def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
             t, typ = scalars[skey]
             sd["state"][pid][key] = typ(t.item())
         optimizer.load_state_dict(sd)
+
+
+def checkpoint_hook(directory=None, *, engine=None, model=None,
+                    optimizer=None, every: int = 100):
+    """Async save hook for the torch training loop on the sharded
+    checkpoint engine (docs/checkpoint.md).
+
+    Returns ``save(step)``: call it once per step; every ``every`` steps
+    it snapshots ``model.state_dict()`` / ``optimizer.state_dict()``
+    tensors to host numpy (a replicated tree — rank 0 writes under the
+    engine's layout rules) and hands them to the engine, which
+    serializes and commits atomically in the background. The returned
+    callable exposes ``save.engine`` (e.g. for ``engine.wait()`` at
+    train end) and forces a blocking commit with ``save(step,
+    block=True)``. Restore via ``engine.restore()`` — the tree is plain
+    nested dicts, so no template is needed — then
+    ``model.load_state_dict``/``optimizer.load_state_dict`` with
+    re-tensorized leaves.
+    """
+    if (directory is None) == (engine is None):
+        raise ValueError("pass exactly one of directory= or engine=")
+    if engine is None:
+        from ..checkpoint import CheckpointEngine
+        engine = CheckpointEngine(directory)
+
+    def _host_tree(sd):
+        out = {}
+        for key, value in sd.items():
+            if isinstance(value, torch.Tensor):
+                out[key] = value.detach().cpu().numpy()
+            elif isinstance(value, dict):
+                out[key] = _host_tree(value)
+            elif isinstance(value, (list, tuple)):
+                out[key] = [_host_tree(v) if isinstance(v, dict)
+                            else (v.detach().cpu().numpy()
+                                  if isinstance(v, torch.Tensor) else v)
+                            for v in value]
+            else:
+                out[key] = value
+        return out
+
+    def save(step: int, block: bool = False):
+        if step % every:
+            return None
+        tree = {}
+        if model is not None:
+            tree["model"] = _host_tree(model.state_dict())
+        if optimizer is not None:
+            tree["optimizer"] = _host_tree(optimizer.state_dict())
+        if not tree:
+            raise ValueError("checkpoint_hook needs model= and/or "
+                             "optimizer=")
+        return engine.save(tree, step=step, block=block)
+
+    save.engine = engine
+    return save

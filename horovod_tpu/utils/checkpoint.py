@@ -60,13 +60,36 @@ def save_checkpoint(state: Any, path: str,
     parent = os.path.dirname(os.path.abspath(target))
     if parent:
         os.makedirs(parent, exist_ok=True)
-    # Atomic: a crash mid-write (spot/preemptible restarts are the whole
-    # point of checkpointing) must never truncate the previous copy.
+    # Atomic AND durable: a crash mid-write (spot/preemptible restarts
+    # are the whole point of checkpointing) must never truncate the
+    # previous copy — and the rename alone is not enough: without
+    # fsyncing the data before the replace (and the directory entry
+    # after), power loss can keep the rename while dropping the data
+    # blocks, leaving a complete-looking but empty/truncated target.
     tmp = target + ".tmp"
     with open(tmp, "wb") as f:
         pickle.dump(jax.device_get(state), f)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, target)
+    _fsync_dir(parent)
     return target
+
+
+def _fsync_dir(path: str) -> None:
+    """Durable directory entry after a rename (best-effort: platforms
+    that refuse O_RDONLY directory fds also do not need it). The sharded
+    engine's writer (checkpoint/writer.py) applies the same discipline."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def restore_checkpoint(path: str, *, step: Optional[int] = None,

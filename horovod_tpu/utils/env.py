@@ -58,6 +58,17 @@ def failure_timeout_secs() -> float:
     return float(v)
 
 
+def checkpoint_keep() -> int:
+    """Keep-last-N retention for committed checkpoints (both the elastic
+    pickle backend and the sharded engine, docs/checkpoint.md). 0 means
+    unlimited — the seed's keep-everything behavior. Default 10: spot
+    jobs commit often and nothing ever deleted old steps before."""
+    v = _get("CHECKPOINT_KEEP")
+    if v in (None, ""):
+        return 10
+    return int(v)
+
+
 def timeline_path() -> Optional[str]:
     return _get("TIMELINE")
 

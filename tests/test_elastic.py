@@ -283,6 +283,112 @@ class TestElasticState:
         with pytest.raises(ValueError, match="named tree"):
             ElasticState()
 
+    def test_pickle_commits_are_garbage_collected(self, tmp_path):
+        """Disk commits are no longer unbounded: keep-last-N retention
+        prunes old <step>.pkl files and never touches the one LATEST
+        names."""
+        d = str(tmp_path / "gc")
+        st = ElasticState(directory=d, keep_last=3,
+                          params={"w": np.ones(2)})
+        for step in range(1, 9):
+            st.commit(step)
+        pkls = sorted(int(f[:-4]) for f in os.listdir(d)
+                      if f.endswith(".pkl"))
+        assert pkls == [6, 7, 8]
+        with open(os.path.join(d, "LATEST")) as f:
+            assert int(f.read().strip()) == 8
+        # the retained window still restores
+        older = ElasticState(directory=d, params={"w": np.zeros(2)})
+        older.restore(step=6)
+        assert older.step == 6
+
+    def test_keep_env_knob(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HOROVOD_TPU_CHECKPOINT_KEEP", "2")
+        d = str(tmp_path / "gcenv")
+        st = ElasticState(directory=d, params={"w": np.ones(2)})
+        for step in range(1, 6):
+            st.commit(step)
+        pkls = sorted(int(f[:-4]) for f in os.listdir(d)
+                      if f.endswith(".pkl"))
+        assert pkls == [4, 5]
+
+    def test_unknown_backend_rejected(self):
+        with pytest.raises(ValueError, match="backend"):
+            ElasticState(backend="orbax", params={"w": np.ones(1)})
+        with pytest.raises(ValueError, match="shared filesystem"):
+            ElasticState(backend="sharded", params={"w": np.ones(1)})
+
+
+class TestElasticStateShardedBackend:
+    """backend='sharded': elastic commit/restore riding the checkpoint
+    engine (docs/checkpoint.md) — async commits, manifest LATEST,
+    engine retention, restore-from-shared-dir."""
+
+    def _state(self, d, scale=1.0, **kw):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mesh = Mesh(np.asarray(jax.devices(), dtype=object).reshape(8),
+                    ("dp",))
+        sharded = jax.device_put(
+            jnp.arange(32.0) * scale, NamedSharding(mesh, P("dp")))
+        return ElasticState(directory=d, backend="sharded",
+                            params={"w": np.arange(4.0) * scale},
+                            opt={"m": sharded}, **kw)
+
+    def test_commit_restore_roundtrip(self, tmp_path):
+        d = str(tmp_path / "sharded")
+        st = self._state(d)
+        st.commit(5)
+        st.params = {"w": np.arange(4.0) * 10}
+        st.commit(10, block=True)
+        assert os.path.exists(os.path.join(d, "step-10",
+                                           "manifest.json"))
+
+        fresh = self._state(d, scale=0.0)
+        fresh.restore()
+        assert fresh.step == 10
+        np.testing.assert_allclose(fresh.params["w"],
+                                   np.arange(4.0) * 10)
+        np.testing.assert_allclose(np.asarray(fresh.opt["m"]),
+                                   np.arange(32.0))
+
+        older = self._state(d, scale=0.0)
+        older.restore(step=5)
+        assert older.step == 5
+        np.testing.assert_allclose(older.params["w"], np.arange(4.0))
+
+    def test_async_commit_joined_by_next(self, tmp_path):
+        d = str(tmp_path / "sharded2")
+        st = self._state(d)
+        st.commit(1)               # returns before the write finishes
+        st.commit(2)               # joins 1, enqueues 2
+        st.wait()
+        from horovod_tpu.checkpoint import read_latest
+        assert read_latest(d) == 2
+
+    def test_rollback_and_restore_without_commit(self, tmp_path):
+        st = self._state(str(tmp_path / "sharded3"))
+        st.commit(3, block=True)
+        st.params = {"w": np.full(4, 99.0)}
+        st.rollback()
+        np.testing.assert_allclose(st.params["w"], np.arange(4.0))
+        assert st.step == 3
+
+        st2 = self._state(str(tmp_path / "sharded4"))
+        st2.restore()              # no commit on disk: initial trees
+        assert st2.step == 0
+        np.testing.assert_allclose(st2.params["w"], np.arange(4.0))
+
+    def test_engine_retention_applies(self, tmp_path):
+        d = str(tmp_path / "sharded5")
+        st = self._state(d, keep_last=2)
+        for step in range(1, 6):
+            st.commit(step)
+        st.wait()
+        from horovod_tpu.checkpoint import list_steps
+        assert list_steps(d) == [4, 5]
+
 
 # ---------------------------------------------------------------------------
 # Escalation plumbing (engine + coordinator)

@@ -146,6 +146,28 @@ class TestCallbacks:
         # momentum restored after correction batches
         assert float(model.optimizer.momentum) == pytest.approx(0.9)
 
+    def test_checkpoint_callback_commits_epochs(self, tmp_path):
+        """CheckpointCallback hands weights to the sharded engine every
+        N epochs; commits are atomic manifests and restore round-trips
+        into model.set_weights."""
+        from horovod_tpu.checkpoint import list_steps
+
+        model = _model()
+        model.compile(optimizer=keras.optimizers.SGD(0.1), loss="mse",
+                      jit_compile=False)
+        cb = hvd_keras.callbacks.CheckpointCallback(
+            str(tmp_path / "kck"), every_epochs=2)
+        x = np.random.rand(16, 8).astype("float32")
+        y = np.random.rand(16, 2).astype("float32")
+        model.fit(x, y, batch_size=8, epochs=4, callbacks=[cb], verbose=0)
+        assert list_steps(str(tmp_path / "kck")) == [2, 4]
+        weights = cb.engine.restore(
+            template=list(model.get_weights()))
+        for got, want in zip(weights, model.get_weights()):
+            np.testing.assert_allclose(got, np.asarray(want), rtol=1e-6)
+        with pytest.raises(ValueError, match="exactly one"):
+            hvd_keras.callbacks.CheckpointCallback()
+
     def test_lr_warmup_reaches_initial(self):
         model = _model()
         model.compile(optimizer=keras.optimizers.SGD(learning_rate=0.8),
