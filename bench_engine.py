@@ -425,6 +425,119 @@ def main_checkpoint(commits: int, out_path: str) -> None:
     print(json.dumps(result))
 
 
+# --------------------------------------------------------------------------
+# Trace overhead bench (--trace): all-ranks tracing (HOROVOD_TPU_TIMELINE
+# with a {rank} placeholder, docs/tracing.md) on vs off inside ONE
+# 2-process control-plane job — the same p25-of-per-step A/B method as
+# BENCH_METRICS: interleaved repeats with ALTERNATING order toggled
+# in-process (the writer is detached between bursts, so both modes share
+# one process, one warmup, one socket set — separate jobs were measured
+# to differ by ±5% job-to-job, swamping a 3% budget), each step timed
+# individually, per-mode estimate = 25th percentile of the pooled
+# per-step times (hiccups land in the upper tail; a systematic writer
+# cost shifts the whole distribution). Writes BENCH_TRACE.json; the
+# slow-tier guard (tests/test_trace_overhead.py) asserts < 3%.
+# --------------------------------------------------------------------------
+
+TRACE_STEPS = 40           # steps per mode per round
+TRACE_ROUNDS = 6           # alternating-order on/off rounds
+TRACE_WARMUP = 8
+
+
+def run_trace_job(steps: int, warmup: int, rounds: int,
+                  tmpdir: str) -> dict:
+    """One 2-process job with per-rank tracing configured; returns
+    {"on": [...], "off": [...]} per-step wall times pooled over both
+    ranks."""
+    from horovod_tpu.runner.api import run as hvd_run
+
+    def worker(steps, warmup, rounds):
+        import time
+
+        import jax.numpy as jnp
+
+        import horovod_tpu as hvd
+        from horovod_tpu.ops import collective as _coll
+
+        hvd.init()
+        eng = _coll.engine()
+        xs = [jnp.ones((256,), jnp.float32) for _ in range(8)]
+
+        def hot(tag, n):
+            out = []
+            for step in range(n):
+                t0 = time.perf_counter()
+                with eng.burst():
+                    hs = [hvd.allreduce_async(x, average=False,
+                                              name=f"tr.{tag}.{step}.{i}")
+                          for i, x in enumerate(xs)]
+                for h in hs:
+                    h.wait()
+                out.append(time.perf_counter() - t0)
+            return out
+
+        hot("w", warmup)               # compile + engine + trace bring-up
+        tl = eng.timeline              # created during warmup (per-rank)
+        times = {"on": [], "off": []}
+        for rep in range(rounds):
+            order = (("on", "off") if rep % 2 == 0 else ("off", "on"))
+            for mode in order:
+                # Toggle BETWEEN bursts only: every handle is waited, so
+                # no span is torn. The off mode still pays the
+                # `timeline is None` checks — that IS the disabled cost.
+                eng.timeline = tl if mode == "on" else None
+                times[mode].extend(hot(f"{rep}.{mode}", steps))
+        eng.timeline = tl
+        eng.shutdown()
+        return times
+
+    env = {"JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+           "HOROVOD_TPU_DISABLE_NATIVE": "1",
+           "HOROVOD_CYCLE_TIME": "1",
+           "HOROVOD_TPU_TIMELINE": os.path.join(tmpdir,
+                                                "bench.{rank}.json")}
+    results = hvd_run(worker, args=(steps, warmup, rounds), np=2,
+                      extra_env=env, start_timeout=300)
+    pooled = {"on": [], "off": []}
+    for r in results:
+        pooled["on"].extend(r["on"])
+        pooled["off"].extend(r["off"])
+    return pooled
+
+
+def main_trace(out_path: str, rounds: int = TRACE_ROUNDS) -> dict:
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmpdir:
+        times = run_trace_job(TRACE_STEPS, TRACE_WARMUP, rounds, tmpdir)
+    p25 = lambda xs: sorted(xs)[len(xs) // 4]  # noqa: E731
+    t_on, t_off = p25(times["on"]), p25(times["off"])
+    overhead = t_on / t_off - 1.0
+    result = {
+        "metric": "trace_overhead",
+        "note": ("2-process fused-allreduce loop, all-ranks tracing "
+                 "({rank} placeholder) on vs off, toggled in-process "
+                 "with alternating order per round (the BENCH_METRICS "
+                 "method); p25 of pooled per-step wall times "
+                 "(wall-clock, informational); the slow-tier guard "
+                 "asserts on < 1.03 * off"),
+        "steps_per_mode_per_round": TRACE_STEPS,
+        "rounds": rounds,
+        "tensors_per_step": 8,
+        "rows": {
+            "tracing_on": {"step_time_ms": round(t_on * 1e3, 4)},
+            "tracing_off": {"step_time_ms": round(t_off * 1e3, 4)},
+        },
+        "overhead_frac": round(overhead, 6),
+        "budget_frac": 0.03,
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(result))
+    return result
+
+
 def main():
     sweep = {}
     best = 0.0
@@ -477,6 +590,11 @@ if __name__ == "__main__":
                     help="run the rank-0-pickle vs sharded-async "
                          "checkpoint bench and write "
                          "BENCH_CHECKPOINT.json")
+    ap.add_argument("--trace", action="store_true",
+                    help="run the all-ranks-tracing overhead A/B and "
+                         "write BENCH_TRACE.json")
+    ap.add_argument("--trace-rounds", type=int, default=TRACE_ROUNDS,
+                    help="alternating on/off rounds for --trace")
     ap.add_argument("--steps", type=int, default=50,
                     help="convergence-run steps for --compression")
     ap.add_argument("--commits", type=int, default=5,
@@ -490,5 +608,8 @@ if __name__ == "__main__":
     elif args.checkpoint:
         main_checkpoint(args.commits, args.out or os.path.join(
             here, "BENCH_CHECKPOINT.json"))
+    elif args.trace:
+        main_trace(args.out or os.path.join(here, "BENCH_TRACE.json"),
+                   rounds=args.trace_rounds)
     else:
         main()

@@ -21,13 +21,15 @@ from .registry import (Counter, Gauge, Histogram, MetricsRegistry, enabled,
                        set_enabled)
 from .registry import registry as get_registry
 from .registry import snapshot as metrics_snapshot
-from .export import (MetricsServer, maybe_start_exporters, prometheus_text,
-                     stop_exporters, write_json_snapshot)
+from .export import (MetricsServer, histogram_percentiles,
+                     maybe_start_exporters, prometheus_text, stop_exporters,
+                     with_percentiles, write_json_snapshot)
 from .step_metrics import StepTimer
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "MetricsServer",
-    "StepTimer", "enabled", "get_registry", "maybe_start_exporters",
-    "metrics_snapshot", "prometheus_text", "registry", "set_enabled",
-    "stop_exporters", "write_json_snapshot",
+    "StepTimer", "enabled", "get_registry", "histogram_percentiles",
+    "maybe_start_exporters", "metrics_snapshot", "prometheus_text",
+    "registry", "set_enabled", "stop_exporters", "with_percentiles",
+    "write_json_snapshot",
 ]

@@ -85,6 +85,70 @@ def prometheus_text(snap: Optional[dict] = None) -> str:
 
 
 # --------------------------------------------------------------------------
+# Percentile estimation from log-bucketed histogram snapshots
+# --------------------------------------------------------------------------
+
+def histogram_percentiles(hist: dict, qs=(0.5, 0.9, 0.99)) -> dict:
+    """Estimate percentiles from a histogram snapshot
+    (``{"buckets": [[le, cumulative], ...], "count", ...}`` — the
+    registry's format, with ``le`` possibly the string "+Inf" when the
+    snapshot came through strict JSON).
+
+    Prometheus-style linear interpolation within the containing bucket:
+    exact to within one bucket width — the resolution the log-bucketed
+    layout was chosen for. The +Inf bucket has no upper bound, so a
+    percentile landing there returns the largest finite bound (a known
+    underestimate; the export cannot do better without raw samples).
+    Returns ``{"p50": v, ...}`` keyed by percentile name, or {} for an
+    empty histogram.
+
+    Used by both the trace report (tools/trace.py routes its lateness
+    samples through the same bucket layout) and the HTTP endpoint's
+    ``/metrics.json`` view, so offline and live numbers come from one
+    estimator."""
+    count = hist.get("count", 0)
+    buckets = hist.get("buckets") or []
+    if not count or not buckets:
+        return {}
+    bounds = [math.inf if isinstance(le, str) else float(le)
+              for le, _ in buckets]
+    cums = [c for _, c in buckets]
+    finite = [b for b in bounds if not math.isinf(b)]
+    top = finite[-1] if finite else 0.0
+    out = {}
+    for q in qs:
+        target = q * count
+        v = top
+        for i, cum in enumerate(cums):
+            if cum >= target:
+                hi = bounds[i]
+                lo = bounds[i - 1] if i > 0 else 0.0
+                prev = cums[i - 1] if i > 0 else 0
+                if math.isinf(hi):
+                    v = top
+                elif cum == prev:
+                    v = hi
+                else:
+                    v = lo + (hi - lo) * (target - prev) / (cum - prev)
+                break
+        name = f"p{q * 100:g}".replace(".", "_")
+        out[name] = v
+    return out
+
+
+def with_percentiles(snap: dict, qs=(0.5, 0.9, 0.99)) -> dict:
+    """Add a ``"percentiles"`` dict to every histogram value of a
+    (json-safe) snapshot — the endpoint's JSON view, so dashboards get
+    p50/p90/p99 without re-implementing bucket math."""
+    for fam in snap.values():
+        if fam["type"] != "histogram":
+            continue
+        for val in fam["values"].values():
+            val["percentiles"] = histogram_percentiles(val, qs)
+    return snap
+
+
+# --------------------------------------------------------------------------
 # JSON snapshot file
 # --------------------------------------------------------------------------
 
@@ -177,7 +241,7 @@ class MetricsServer:
                     body = prometheus_text().encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                 elif self.path.split("?")[0] == "/metrics.json":
-                    body = json.dumps(json_safe_snapshot(),
+                    body = json.dumps(with_percentiles(json_safe_snapshot()),
                                       sort_keys=True).encode()
                     ctype = "application/json"
                 else:
@@ -217,9 +281,12 @@ _started = False
 
 def maybe_start_exporters() -> None:
     """Start whichever exporters the env configures (idempotent; called
-    by ``hvd.init()``). The HTTP endpoint is rank-0 only — one scrape
-    target per job, like the reference's rank-0 timeline file; JSON
-    files are per-process when the path has a ``{rank}`` placeholder."""
+    by ``hvd.init()``). A plain HTTP port is rank-0 only — one scrape
+    target per job, like the reference's rank-0 timeline file; the
+    per-rank port forms (``{rank}`` placeholder / ``base+rank``) bind an
+    endpoint on EVERY process so multi-process jobs are scrapeable per
+    rank. JSON files are per-process when the path has a ``{rank}``
+    placeholder."""
     global _json_writer, _server, _started
     if not _reg.enabled():
         return
@@ -230,8 +297,9 @@ def maybe_start_exporters() -> None:
         path = _resolved_file_path()
         if path:
             _json_writer = _JsonWriter(path, _env.metrics_interval_secs())
-        port = _env.metrics_port()
-        if port is not None and _process_index() == 0:
+        rank = _process_index()
+        port = _env.metrics_port(rank)
+        if port is not None and (rank == 0 or _env.metrics_port_per_rank()):
             try:
                 _server = MetricsServer(port)
                 _log.info("metrics endpoint on :%d (/metrics, "

@@ -379,6 +379,17 @@ class CollectiveEngine:
         self.timeline = None          # Python-mode timeline (fallback path)
         self._timeline_tried = False  # decide once, off the hot path
         self._mark_cycles = _env.timeline_mark_cycles()
+        # Cross-rank trace clock state (docs/tracing.md): the resolved
+        # per-rank trace path, its monotonic epoch, and whether the
+        # clock-alignment handshake still has to run (nonzero MP ranks
+        # sync on their first control-plane cycle).
+        self._trace_path: Optional[str] = None
+        self._trace_start_mono_us = 0
+        self._trace_clock_pending = False
+        # Local fused-group counter for the single-process dispatch path
+        # (MP groups carry the coordinator's seq instead) — keys the
+        # merge tool's per-group attribution.
+        self._local_group_seq = 0
         self.stall_warning_s = _env.stall_warning_secs()
         self._last_stall_check = time.monotonic()
         # Failure escalation window (elastic recovery): > 0 turns stalls
@@ -452,8 +463,35 @@ class CollectiveEngine:
                 if core is None:
                     return None
                 topo = _topo._get()
+                # Per-rank trace capture (docs/tracing.md): the native
+                # timeline reads HOROVOD_TPU_TIMELINE in C++ at init, so
+                # expand the {rank} placeholder here — and drop the env
+                # for nonzero ranks when there is NO placeholder, or
+                # every process's native writer would open (and
+                # truncate) the one shared file.
+                tl_raw = _env.timeline_path()
+                tl_resolved = (_env.resolved_timeline_path(
+                    topo.process_index) if tl_raw else None)
+                if tl_raw and tl_resolved is None:
+                    os.environ.pop("HOROVOD_TPU_TIMELINE", None)
+                    os.environ.pop("HOROVOD_TIMELINE", None)
+                elif tl_resolved is not None and tl_resolved != tl_raw:
+                    os.environ["HOROVOD_TPU_TIMELINE"] = tl_resolved
+                t_before = time.monotonic()
                 core.init(topo.process_index, topo.process_count,
                           topo.local_size, topo.size)
+                if tl_resolved is not None and core.timeline_enabled():
+                    # The native writer's epoch is steady_clock at its
+                    # Initialize, somewhere inside core.init — the
+                    # bracket midpoint approximates it to well under the
+                    # init duration (same CLOCK_MONOTONIC domain).
+                    self._trace_path = tl_resolved
+                    self._trace_start_mono_us = int(
+                        (t_before + time.monotonic()) / 2.0 * 1e6)
+                    if topo.process_index == 0 or topo.process_count == 1:
+                        self._write_clock_meta(0.0, 0.0, synced=True)
+                    else:
+                        self._trace_clock_pending = True
                 core.set_execute_callback(self._on_native_execute)
                 if topo.process_count > 1:
                     core.set_group_callback(self._on_native_group)
@@ -468,35 +506,103 @@ class CollectiveEngine:
 
     def _ensure_timeline(self):
         """Create the Python timeline writer for paths the native core
-        does not cover (Python fallback, multi-process). Rank 0 writes,
-        like the reference (operations.cc:1824-1829); an undeterminable
-        rank does NOT write (a second writer would truncate rank 0's
-        file). Decision is made once; the monotonic flag makes the
-        unlocked fast-path read safe."""
+        does not cover (Python fallback, multi-process). Without a
+        ``{rank}`` placeholder in the path, rank 0 writes like the
+        reference (operations.cc:1824-1829) and an undeterminable rank
+        does NOT write (a second writer would truncate rank 0's file);
+        WITH the placeholder every rank writes its own file — the
+        cross-rank capture mode (docs/tracing.md). Decision is made
+        once; the monotonic flag makes the unlocked fast-path read
+        safe."""
         if self._timeline_tried:
             return self.timeline
         with self._lock:
             if self._timeline_tried:
                 return self.timeline
             self._timeline_tried = True
-            path = _env.timeline_path()
-            if not path or self._shutdown:
+            if not _env.timeline_path() or self._shutdown:
                 return None
             try:
-                if _topo._get().process_index != 0:
-                    return None
+                topo = _topo._get()
+                rank, world = topo.process_index, topo.process_count
             except Exception:
+                return None
+            path = _env.resolved_timeline_path(rank)
+            if not path:
                 return None
             try:
                 from .timeline_py import PyTimeline
-                self.timeline = PyTimeline(path)
+                self.timeline = PyTimeline(path, rank=rank, world=world)
             except OSError as e:
                 # Unwritable path disables the timeline, as the native
                 # writer does (runtime/src/timeline.cc) — never fail the
                 # user's collective over tracing.
                 _log.warning("timeline disabled: cannot open %s: %s",
                              path, e)
+                return None
+            self._trace_path = path
+            self._trace_start_mono_us = self.timeline.start_monotonic_us
+            # Rank 0 (and single-process jobs) ARE the reference clock:
+            # offset 0 by definition, sidecar written now. Other ranks
+            # sync against the coordinator on their first MP cycle
+            # (_maybe_sync_trace_clock).
+            if rank == 0 or world == 1:
+                self._write_clock_meta(0.0, 0.0, synced=True)
+            else:
+                self._trace_clock_pending = True
             return self.timeline
+
+    def _write_clock_meta(self, offset_s: float, rtt_s: float,
+                          synced: bool) -> None:
+        """Record this rank's trace clock header: in-band metadata when
+        the Python writer owns the file, plus the sidecar either way
+        (the native writer's file is owned by C++ — the sidecar is the
+        only channel there). ``offset_s`` is the estimated rank-0
+        monotonic clock minus ours."""
+        path = self._trace_path
+        if not path:
+            return
+        try:
+            topo = _topo._get()
+            rank, world = topo.process_index, topo.process_count
+        except Exception:
+            rank, world = 0, 1
+        if self.timeline is not None and synced:
+            self.timeline.set_clock_meta(offset_s, rtt_s)
+        from . import timeline_py as _tlpy
+        try:
+            _tlpy.write_clock_sidecar(path, {
+                "rank": rank, "world": world,
+                "start_mono_us": self._trace_start_mono_us,
+                "offset_to_rank0_us": offset_s * 1e6,
+                "rtt_us": rtt_s * 1e6,
+                "clock_synced": bool(synced)})
+        except OSError as e:
+            _log.warning("trace clock sidecar write failed: %s", e)
+
+    def _maybe_sync_trace_clock(self, client) -> None:
+        """Run the clock-alignment handshake once (nonzero MP ranks
+        only; rank 0 is the reference clock): K NTP-style pings over the
+        coordinator channel, min-RTT sample wins
+        (CoordinatorClient.clock_sync), result recorded in the trace
+        clock header. Runs on the background cycle thread right after
+        the control plane comes up — a one-time cost of K tiny RPCs,
+        never on the enqueue path."""
+        if not self._trace_clock_pending:
+            return
+        self._trace_clock_pending = False
+        probes = _env.trace_clock_probes()
+        if probes <= 0:
+            self._write_clock_meta(0.0, 0.0, synced=False)
+            return
+        try:
+            res = client.clock_sync(probes=probes)
+        except Exception as e:
+            _log.warning("trace clock sync failed; offset recorded as "
+                         "unsynced: %s", e)
+            self._write_clock_meta(0.0, 0.0, synced=False)
+            return
+        self._write_clock_meta(res["offset_s"], res["rtt_s"], synced=True)
 
     def _is_multiprocess(self) -> bool:
         if self._mp is None:
@@ -613,8 +719,10 @@ class CollectiveEngine:
                 self._oldest_enqueue_t = time.monotonic()
             self._queue.append(req)
             self._last_enqueue_t = time.monotonic()
-            if self.timeline is not None:
-                self.timeline.negotiate_start(req.name, _op_name(req.op))
+            # No timeline event here: the NEGOTIATE span is emitted as
+            # one complete "X" event at group delivery, anchored at
+            # req.enqueued_at — nothing on the user's enqueue path
+            # (PyTimeline.negotiate_span).
         self._ensure_thread()
         self._wake.set()
         return req.handle
@@ -791,6 +899,7 @@ class CollectiveEngine:
         instead of hanging the fleet."""
         try:
             client = self._ensure_mp()
+            self._maybe_sync_trace_clock(client)
             if pending <= 0 and nreq <= 0:
                 return b""
             wait = (self.cycle_time_s if (nreq > 0 and not complete)
@@ -1093,6 +1202,7 @@ class CollectiveEngine:
         plans eagerly on the last rank's complete announce); an
         incomplete one short-polls to announce the remainder quickly."""
         client = self._ensure_mp()
+        self._maybe_sync_trace_clock(client)
         requests = [{
             "name": r.name, "op": r.op,
             "dtype": str((r.tensor if r.tensor is not None
@@ -1158,12 +1268,14 @@ class CollectiveEngine:
         tl = self.timeline
         if tl is not None:
             for r in reqs:
-                tl.negotiate_end(r.name)
-                tl.start(r.name, _op_name(r.op).upper())
+                # One complete NEGOTIATE span per tensor, anchored at
+                # its true enqueue tick, carrying the coordinator seq —
+                # identical on every rank for this group, the merge
+                # tool's cross-rank group key (docs/tracing.md).
+                tl.negotiate_span(r.name, _op_name(r.op), r.enqueued_at,
+                                  t_deliver, group=group.get("seq"))
         if group["error"]:
             for r in reqs:
-                if tl is not None:
-                    tl.end(r.name, None)
                 r.handle._fulfill(error=HorovodInternalError(group["error"]))
             return
         ex = self.executor
@@ -1184,33 +1296,29 @@ class CollectiveEngine:
             subgroups.setdefault(k, []).append(r)
         topo = _topo._get()
         for sub in subgroups.values():
-            sub_names = [r.name for r in sub]
-            if tl is not None:
-                if len(sub) > 1:
-                    tl.activity_start_all(sub_names,
-                                          "MEMCPY_IN_FUSION_BUFFER")
-                    tl.activity_end_all(sub_names)
-                tl.activity_start_all(sub_names,
-                                      _xla_activity(sub[0].op))
             t_start = time.monotonic()
             try:
                 results = self._execute_group_mp(ex, sub, group, topo)
             except BaseException as e:
                 if tl is not None:
-                    tl.activity_end_all(sub_names)
-                    for n in sub_names:
-                        tl.end(n, None)
+                    t_end = time.monotonic()
+                    for r in sub:
+                        tl.execute_span(r.name, _xla_activity(sub[0].op),
+                                        t_start, t_end)
                 err = _as_error(e)
                 for r in sub:
                     r.handle._fulfill(error=err)
                 continue
+            t_end = time.monotonic()
             self._metrics.group_executed(sub[0].op, len(sub), t_deliver,
-                                         t_start, time.monotonic())
-            if tl is not None:
-                tl.activity_end_all(sub_names)
+                                         t_start, t_end)
             for r, out in zip(sub, results):
                 if tl is not None:
-                    tl.end(r.name, getattr(out, "shape", None))
+                    # One complete XLA span per tensor, shape riding
+                    # along (the reference's activity + shape-on-end).
+                    tl.execute_span(r.name, _xla_activity(sub[0].op),
+                                    t_start, t_end,
+                                    getattr(out, "shape", None))
                 r.handle._fulfill(result=out)
 
     def _execute_group_mp(self, ex: CollectiveExecutor,
@@ -1416,13 +1524,14 @@ class CollectiveEngine:
             op = group[0].op
             self._metrics.group_delivered(op, group, t_drain)
             if tl is not None:
-                for n in names:
-                    tl.negotiate_end(n)
-                    tl.start(n, _op_name(op).upper())
-                if len(group) > 1:
-                    tl.activity_start_all(names, "MEMCPY_IN_FUSION_BUFFER")
-                    tl.activity_end_all(names)
-                tl.activity_start_all(names, _xla_activity(op))
+                seq = self._local_group_seq
+                self._local_group_seq += 1
+                for r in group:
+                    # Same span diet as the MP path: one complete
+                    # NEGOTIATE span anchored at the enqueue tick, one
+                    # XLA span after execution.
+                    tl.negotiate_span(r.name, _op_name(op),
+                                      r.enqueued_at, t_drain, group=seq)
             t_start = time.monotonic()
             try:
                 results = self._execute_group(ex, group)
@@ -1430,23 +1539,25 @@ class CollectiveEngine:
                 with self._lock:
                     for r in group:
                         self._in_flight.pop(r.name, None)
+                if tl is not None:
+                    t_end = time.monotonic()
+                    for n in names:
+                        tl.execute_span(n, _xla_activity(op), t_start,
+                                        t_end)
                 for r in group:
                     r.handle._fulfill(error=_as_error(e))
-                if tl is not None:
-                    tl.activity_end_all(names)
-                    for n in names:
-                        tl.end(n, None)
                 continue
+            t_end = time.monotonic()
             self._metrics.group_executed(op, len(group), t_drain,
-                                         t_start, time.monotonic())
-            if tl is not None:
-                tl.activity_end_all(names)
+                                         t_start, t_end)
             with self._lock:
                 for r in group:
                     self._in_flight.pop(r.name, None)
             for r, out in zip(group, results):
                 if tl is not None:
-                    tl.end(r.name, getattr(out, "shape", None))
+                    # One complete XLA span per tensor, shape attached.
+                    tl.execute_span(r.name, _xla_activity(op), t_start,
+                                    t_end, getattr(out, "shape", None))
                 r.handle._fulfill(result=out)
 
     def _fence_producers(self) -> bool:

@@ -121,6 +121,24 @@ class AnnounceResponse:
         self.ok = ok
 
 
+class ClockProbeRequest:
+    """One ping of the clock-alignment handshake (docs/tracing.md): the
+    worker samples its monotonic clock around the round trip and the
+    coordinator answers with its own monotonic reading. NTP-style
+    round-trip halving — offset = t_coord + rtt/2 - t_recv — repeated K
+    times with the minimum-RTT sample winning gives each rank its
+    estimated offset to rank 0's clock, recorded in the per-rank trace
+    header so the offline merger can realign N traces onto one clock."""
+
+    def __init__(self, rank: int):
+        self.rank = rank
+
+
+class ClockProbeResponse:
+    def __init__(self, t_mono_us: int):
+        self.t_mono_us = t_mono_us
+
+
 class FetchRequest:
     """Long-poll for response groups after ``after_seq`` — the response
     list Bcast of the reference (operations.cc:2282-2287)."""
@@ -186,6 +204,97 @@ class _Entry:
         return next(iter(self.dtype_by_rank.values()))
 
 
+class _SkewTracker:
+    """Per-rank negotiate-lateness accounting from the coordinator's
+    announce ticks (the live half of the cross-rank tracing subsystem,
+    docs/tracing.md): the coordinator is the one place that sees WHEN
+    each rank announced each tensor, so it can quantify skew without any
+    trace files. For every tensor that reaches quorum, each rank's
+    lateness is its announce tick minus the first rank's; the per-rank
+    distribution goes to ``hvdtpu_negotiate_lateness_seconds{rank=}``
+    and an exponentially-decayed accumulator elects the current
+    straggler (``hvdtpu_straggler_rank``). The MLPerf pod study (arxiv
+    1909.09756) attributes most scaling loss to exactly this skew; this
+    makes it a scrapeable number instead of a "ranks N,M not ready"
+    log line."""
+
+    # Decay per completed tensor: ~0.99^400 ≈ 0.02, so the straggler
+    # election follows the last few hundred collectives (a few training
+    # steps), not the whole job history.
+    DECAY = 0.99
+
+    def __init__(self, nproc: int):
+        self._nproc = nproc
+        self._pending: Dict[str, Dict[int, float]] = {}
+        r = _obs.registry()
+        self._m_lateness = r.histogram(
+            "hvdtpu_negotiate_lateness_seconds",
+            "Per-rank announce lateness behind the first-announcing rank, "
+            "per fully-announced tensor (rank-0 coordinator view)",
+            buckets=_obs.LATENCY_BUCKETS)
+        self._m_lateness_total = r.counter(
+            "hvdtpu_negotiate_lateness_seconds_total",
+            "Cumulative announce-lateness seconds by rank")
+        self._m_straggler = r.gauge(
+            "hvdtpu_straggler_rank",
+            "Rank with the highest recent negotiate lateness "
+            "(exponentially decayed; -1 until any skew is observed)"
+        ).labels()
+        self._m_straggler_lateness = r.gauge(
+            "hvdtpu_straggler_lateness_seconds",
+            "Decay-weighted mean negotiate lateness of the current "
+            "straggler rank").labels()
+        self._hist_children = {
+            rk: self._m_lateness.labels(rank=str(rk))
+            for rk in range(nproc)}
+        self._total_children = {
+            rk: self._m_lateness_total.labels(rank=str(rk))
+            for rk in range(nproc)}
+        self._acc = [0.0] * nproc
+        self._weight = [0.0] * nproc
+        self._m_straggler.set(-1)
+
+    def note(self, rank: int, names, now: float) -> None:
+        """Record ``rank``'s announce tick for each tensor name; on
+        quorum, fold the per-rank lateness into the metrics."""
+        for name in names:
+            entry = self._pending.setdefault(name, {})
+            if rank in entry:
+                continue  # duplicate announce (client retry)
+            entry[rank] = now
+            if len(entry) < self._nproc:
+                continue
+            del self._pending[name]
+            t0 = min(entry.values())
+            for rk, t in entry.items():
+                late = t - t0
+                self._hist_children[rk].observe(late)
+                self._total_children[rk].inc(late)
+                self._acc[rk] = self._acc[rk] * self.DECAY + late
+                self._weight[rk] = self._weight[rk] * self.DECAY + 1.0
+            worst = max(range(self._nproc), key=lambda rk: self._acc[rk])
+            if self._acc[worst] > 0.0:
+                self._m_straggler.set(worst)
+                self._m_straggler_lateness.set(
+                    self._acc[worst] / self._weight[worst])
+
+    def recent_lateness_by_rank(self) -> Dict[int, float]:
+        """Decay-weighted mean lateness per rank — the quantitative tail
+        for the stall warning."""
+        return {rk: self._acc[rk] / self._weight[rk]
+                for rk in range(self._nproc) if self._weight[rk] > 0.0}
+
+    def prune(self, older_than: float) -> None:
+        """Drop partially-announced entries whose newest tick is older
+        than ``older_than`` (monotonic seconds): tensors stuck past the
+        stall window are the stall detector's story; keeping their ticks
+        forever would grow coordinator memory on misbehaving jobs."""
+        stale = [n for n, e in self._pending.items()
+                 if max(e.values()) < older_than]
+        for n in stale:
+            del self._pending[n]
+
+
 class CoordinatorService(BasicService):
     """Rank-0 coordinator: counts announcements, validates, plans fusion,
     serves the ordered group sequence.
@@ -201,7 +310,14 @@ class CoordinatorService(BasicService):
                  port: int = 0, native: object = "auto",
                  virtual_size: int = 0,
                  stall_warning_s: Optional[float] = None):
-        super().__init__("horovod-tpu-coordinator", key, port=port)
+        # NOTE: the TCP service (super().__init__) is brought up at the
+        # very END of this constructor. Workers connect-poll the
+        # launcher-published control port, so the instant it binds,
+        # announce RPCs arrive — binding first (the old order) let a
+        # handler thread read half-initialized coordinator state and die
+        # with an AttributeError, stranding that rank's announce
+        # (observed as a "missing ranks" stall on an otherwise healthy
+        # job).
         self.key = key
         self._nproc = nproc
         self.fusion_threshold = fusion_threshold
@@ -275,6 +391,10 @@ class CoordinatorService(BasicService):
             "Announce RPCs processed").labels()
         self._groups_seen = 0
         self._failures_reported: set = set()
+        # Live skew telemetry (docs/tracing.md): per-rank announce
+        # lateness histograms + straggler election from the announce
+        # ticks this service already observes.
+        self._skew = _SkewTracker(nproc)
         self._ctl = None
         if native is not False:
             try:
@@ -295,6 +415,9 @@ class CoordinatorService(BasicService):
                     raise
                 _log.warning("native controller unavailable, using Python "
                              "fallback planner: %s", e)
+        # Fully initialized — NOW answer the phone (see the note at the
+        # top of this constructor).
+        super().__init__("horovod-tpu-coordinator", key, port=port)
 
     @property
     def native_active(self) -> bool:
@@ -325,6 +448,11 @@ class CoordinatorService(BasicService):
             return self._announce(req)
         if isinstance(req, FetchRequest):
             return self._fetch(req)
+        if isinstance(req, ClockProbeRequest):
+            # Answer with the coordinator's monotonic clock, sampled as
+            # close to the reply as possible — the worker halves the
+            # round trip around this reading (min-RTT sample wins).
+            return ClockProbeResponse(int(time.monotonic() * 1e6))
         return super()._handle(req, client_address)
 
     def _announce(self, req: AnnounceRequest) -> AnnounceResponse:
@@ -352,6 +480,20 @@ class CoordinatorService(BasicService):
                 if payload is None:
                     payload = _wire.encode_request_list(req.rank,
                                                         req.requests)
+                if _obs.enabled():
+                    # Skew telemetry needs the tensor names; native-engine
+                    # announces carry them only in the codec bytes, so
+                    # decode (pure-python struct parse) — gated on the
+                    # metrics flag to keep the disabled path free.
+                    if req.requests:
+                        names = [r["name"] for r in req.requests]
+                    else:
+                        try:
+                            names = [r["name"] for r in
+                                     _wire.decode_request_list(payload)[0]]
+                        except Exception:
+                            names = []
+                    self._skew.note(req.rank, names, time.monotonic())
                 self._ctl.announce(payload)
                 if req.complete:
                     # Burst-complete announce: plan NOW if no tensor is
@@ -369,6 +511,8 @@ class CoordinatorService(BasicService):
                     self._cv.notify_all()
                     return AnnounceResponse()
                 requests = decoded
+            self._skew.note(req.rank, [r["name"] for r in requests],
+                            time.monotonic())
             for r in requests:
                 e = self._table.get(r["name"])
                 if e is None:
@@ -446,6 +590,9 @@ class CoordinatorService(BasicService):
                     or now - self._last_stall_check < self.stall_warning_s):
                 return lines
             self._last_stall_check = now
+            # Ticks of tensors stuck partially announced are the stall
+            # detector's story from here on; cap tracker memory.
+            self._skew.prune(now - 2.0 * self.stall_warning_s)
             if self._ctl is not None:
                 lines = self._ctl.stalled()
                 from .collective import _missing_ranks_of
@@ -475,6 +622,18 @@ class CoordinatorService(BasicService):
             self._m_stalled_info.labels(
                 tensor=name, missing_ranks=missing).set(age)
         if lines:
+            # Quantitative tail (docs/tracing.md): the warning names the
+            # missing ranks; the skew tracker says HOW LATE those ranks
+            # have recently been, so a straggler is diagnosable from the
+            # log alone — no trace collection required.
+            report = "\n".join(line for _, line in lines)
+            late = self._skew.recent_lateness_by_rank()
+            if late:
+                report += (
+                    "\nRecent negotiate lateness by rank "
+                    "(decay-weighted mean): "
+                    + ", ".join(f"rank {rk}: {v * 1e3:.1f} ms"
+                                for rk, v in sorted(late.items())))
             _log.warning(
                 "One or more tensors were submitted to be reduced, "
                 "gathered or broadcasted by subset of ranks and are "
@@ -483,8 +642,7 @@ class CoordinatorService(BasicService):
                 "trying to submit different tensors or that only subset "
                 "of ranks is submitting tensors, which will cause "
                 "deadlock.\nStalled ops:\n%s",
-                int(self.stall_warning_s),
-                "\n".join(line for _, line in lines))
+                int(self.stall_warning_s), report)
         return lines
 
     def check_failures(self) -> List[dict]:
@@ -785,6 +943,30 @@ class CoordinatorClient:
         if resp.groups:
             self.last_seq = resp.groups[-1]["seq"] + 1
         return resp
+
+    def clock_sync(self, probes: int = 8) -> dict:
+        """NTP-style clock-alignment handshake against the rank-0
+        coordinator (docs/tracing.md): ``probes`` round trips, each
+        estimating ``offset = t_coord + rtt/2 - t_recv`` (the coordinator
+        clock's lead over ours, assuming symmetric paths); the
+        minimum-RTT sample wins — it bounds the asymmetry error by
+        rtt/2, so the cleanest round trip gives the tightest estimate.
+
+        Returns ``{"offset_s", "rtt_s", "probes"}`` where ``offset_s``
+        is the estimated rank-0-monotonic minus local-monotonic, for the
+        per-rank trace clock header."""
+        best_rtt = None
+        best_offset = 0.0
+        for _ in range(max(1, probes)):
+            t0 = time.monotonic()
+            resp = self._client.request(ClockProbeRequest(self._rank))
+            t1 = time.monotonic()
+            rtt = t1 - t0
+            offset = resp.t_mono_us / 1e6 + rtt / 2.0 - t1
+            if best_rtt is None or rtt < best_rtt:
+                best_rtt, best_offset = rtt, offset
+        return {"offset_s": best_offset, "rtt_s": best_rtt,
+                "probes": int(probes)}
 
     def announce_shutdown(self) -> None:
         try:

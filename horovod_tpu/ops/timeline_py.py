@@ -13,25 +13,61 @@ NEGOTIATE_* / op / activity phases the reference writes
 Writer thread + queue mirror the native design at Python scale: events
 append to a deque; a daemon thread drains it so the enqueue path never
 blocks on file IO.
+
+Cross-rank additions (docs/tracing.md): every rank may write its own
+trace (``HOROVOD_TPU_TIMELINE`` with a ``{rank}`` placeholder), so each
+file carries clock metadata — the writer's monotonic start and the
+rank's estimated offset to rank 0 from the control-plane handshake
+(``set_clock_meta``) — letting ``python -m horovod_tpu.tools.trace``
+realign N per-rank files onto one clock. ``negotiate_end`` records the
+coordinator's group sequence number so the merger can attribute
+per-fused-group critical paths across ranks without guessing from
+timestamps.
 """
 
 from __future__ import annotations
 
+import atexit
 import collections
 import json
 import threading
 import time
 from typing import Optional
 
+# Trace-metadata event name shared with the merge tool
+# (horovod_tpu/tools/trace.py) and the sidecar writer (ops/collective.py).
+TRACE_META_EVENT = "horovod_tpu_trace_meta"
+
+
+def clock_sidecar_path(trace_path: str) -> str:
+    """Path of the clock-metadata sidecar written next to a per-rank
+    trace. The sidecar exists because the NATIVE timeline writer
+    (runtime/src/timeline.cc) owns its file in C++ and cannot carry the
+    Python-measured clock offset in-band; the Python writer embeds the
+    same fields as a metadata event AND gets the sidecar, so the merge
+    tool reads whichever is present."""
+    return trace_path + ".clock.json"
+
+
+def write_clock_sidecar(trace_path: str, meta: dict) -> None:
+    with open(clock_sidecar_path(trace_path), "w") as f:
+        json.dump(meta, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
 class PyTimeline:
     """Chrome-trace writer with the reference's phase vocabulary."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, rank: int = 0, world: int = 1):
         self._path = path
         self._f = open(path, "w")
         self._f.write("[\n")
         self._start = time.monotonic()
+        self.rank = rank
+        self.world = world
         self._pids = {}
+        self._name_json = {}   # event name -> pre-escaped JSON string
+        self._neg_cache = {}   # op name -> "NEGOTIATE_<OP>"
         self._queue = collections.deque()
         self._wake = threading.Event()
         self._stop = False
@@ -40,6 +76,41 @@ class PyTimeline:
                                         name="hvd-tpu-timeline",
                                         daemon=True)
         self._thread.start()
+        # Flush-on-exit (crash/SIGTERM paths of the elastic driver reach
+        # interpreter exit without engine.shutdown()): close() drains the
+        # deque and terminates the JSON array so buffered events are not
+        # lost. close() is idempotent — a later explicit shutdown() is a
+        # no-op on the already-closed file.
+        atexit.register(self.close)
+        # Clock metadata header: written immediately with offset unknown;
+        # set_clock_meta() re-emits it once the control-plane handshake
+        # measured the offset to rank 0.
+        self._emit_clock_meta(offset_us=0.0, rtt_us=0.0, synced=False)
+
+    @property
+    def start_monotonic_us(self) -> int:
+        """This trace's epoch on the local monotonic clock — event ts are
+        microseconds since this instant."""
+        return int(self._start * 1e6)
+
+    def _emit_clock_meta(self, offset_us: float, rtt_us: float,
+                         synced: bool) -> None:
+        self._queue.append({
+            "name": TRACE_META_EVENT, "ph": "M", "pid": 0, "tid": 0,
+            "args": {"rank": self.rank, "world": self.world,
+                     "start_mono_us": self.start_monotonic_us,
+                     "offset_to_rank0_us": float(offset_us),
+                     "rtt_us": float(rtt_us),
+                     "clock_synced": bool(synced)}})
+        self._wake.set()
+
+    def set_clock_meta(self, offset_s: float, rtt_s: float) -> None:
+        """Record the measured offset-to-rank-0 (seconds; positive means
+        rank 0's monotonic clock reads ahead of ours) from the NTP-style
+        control-plane handshake. The merge tool uses the LAST meta event
+        in the file, so re-emitting supersedes the unsynced header."""
+        self._emit_clock_meta(offset_us=offset_s * 1e6,
+                              rtt_us=rtt_s * 1e6, synced=True)
 
     # ------------------------------------------------------------- events
 
@@ -58,39 +129,91 @@ class PyTimeline:
 
     def _emit(self, tensor: str, ph: str, name: Optional[str] = None,
               args: Optional[dict] = None, scope: Optional[str] = None):
-        ev = {"ph": ph, "ts": self._ts(), "pid": self._pid(tensor),
-              "tid": 0}
-        if name is not None:
-            ev["name"] = name
-        if args:
-            ev["args"] = args
-        if scope is not None:
-            ev["s"] = scope
-        self._queue.append(ev)
-        self._wake.set()
+        # The emitting thread is the engine's dispatch thread — this
+        # call sits between group delivery and handle fulfillment, i.e.
+        # on the step's critical path. Append the raw fields only; the
+        # drain thread builds the dict/JSON (BENCH_TRACE holds the
+        # step-time cost of all-ranks tracing under 3%).
+        self._queue.append((ph, self._ts(), self._pid(tensor), name,
+                            args, scope))
+        # Deliberately NO wake: the drain thread polls DRAIN_POLL_S.
+        # Waking per event made every enqueue a context-switch
+        # invitation — on a saturated host the writer preempted the step
+        # loop it was observing (measured ~38% step overhead on a 1-core
+        # box). Worst case DRAIN_POLL_S of events sit buffered; close()
+        # still drains everything.
 
-    # Phase API — mirrors the native Timeline's surface used by the engine.
+    # Phase API — mirrors the native Timeline's surface used by the
+    # engine. These sit on the enqueue/dispatch threads' critical path,
+    # so they append raw tuples directly (no _emit indirection, cached
+    # phase-name strings); the drain thread does all formatting.
 
     def negotiate_start(self, tensor: str, op_name: str):
-        self._emit(tensor, "B", f"NEGOTIATE_{op_name.upper()}")
+        nm = self._neg_cache.get(op_name)
+        if nm is None:
+            nm = self._neg_cache[op_name] = "NEGOTIATE_" + op_name.upper()
+        self._queue.append(("B", self._ts(), self._pid(tensor), nm,
+                            None, None))
 
-    def negotiate_end(self, tensor: str):
-        self._emit(tensor, "E")
+    def negotiate_end(self, tensor: str, group: Optional[int] = None):
+        # The group sequence number (coordinator-agreed in MP mode, a
+        # local counter otherwise) keys cross-rank critical-path
+        # attribution in the merge tool: the same group seq names the
+        # same fused collective on every rank. Shipped as a raw tagged
+        # value — the drain thread formats it; no dict on this path.
+        self._queue.append(
+            ("E", self._ts(), self._pid(tensor), None,
+             ("group", int(group)) if group is not None else None, None))
 
     def start(self, tensor: str, op_name: str):
-        self._emit(tensor, "B", op_name)
+        self._queue.append(("B", self._ts(), self._pid(tensor), op_name,
+                            None, None))
 
     def activity_start_all(self, tensors, activity: str):
         for t in tensors:
-            self._emit(t, "B", activity)
+            self._queue.append(("B", self._ts(), self._pid(t), activity,
+                                None, None))
 
     def activity_end_all(self, tensors):
         for t in tensors:
-            self._emit(t, "E")
+            self._queue.append(("E", self._ts(), self._pid(t), None,
+                                None, None))
 
     def end(self, tensor: str, shape=None):
-        args = {"shape": list(shape)} if shape is not None else None
-        self._emit(tensor, "E", args=args)
+        args = (("shape", tuple(int(d) for d in shape))
+                if shape is not None else None)
+        self._queue.append(("E", self._ts(), self._pid(tensor), None,
+                            args, None))
+
+    # Complete-span fast path ("X" events): the engine's dispatch loop
+    # already holds both endpoints of every phase (enqueued_at,
+    # delivery, execute start/end on its own monotonic clock), so one
+    # event carries what a B/E pair would — half the event volume, and
+    # nothing emitted from the user's enqueue thread at all. Trade-off
+    # vs. the native writer's live B/E stream: a tensor stuck IN a phase
+    # has no open span in the file; the stall detector and the
+    # coordinator's lateness metrics cover that case (docs/tracing.md).
+
+    def negotiate_span(self, tensor: str, op_name: str, t0: float,
+                       t1: float, group: Optional[int] = None):
+        """One NEGOTIATE_<OP> complete span from monotonic seconds
+        ``t0`` (enqueue) to ``t1`` (group delivery)."""
+        nm = self._neg_cache.get(op_name)
+        if nm is None:
+            nm = self._neg_cache[op_name] = "NEGOTIATE_" + op_name.upper()
+        self._queue.append(
+            ("X", int((t0 - self._start) * 1e6), self._pid(tensor), nm,
+             ("group", int(group)) if group is not None else None,
+             max(0, int((t1 - t0) * 1e6))))
+
+    def execute_span(self, tensor: str, activity: str, t0: float,
+                     t1: float, shape=None):
+        """One XLA_* complete span over the fused program execution."""
+        args = (("shape", tuple(int(d) for d in shape))
+                if shape is not None else None)
+        self._queue.append(
+            ("X", int((t0 - self._start) * 1e6), self._pid(tensor),
+             activity, args, max(0, int((t1 - t0) * 1e6))))
 
     def mark_cycle(self):
         # Instant events need an explicit scope: without "s" Perfetto
@@ -102,23 +225,68 @@ class PyTimeline:
 
     # ------------------------------------------------------------- writer
 
+    # Drain cadence: long enough to batch hundreds of events per write
+    # (one json+IO burst instead of a wakeup per event), short enough
+    # that a SIGKILL loses at most a blink of trace.
+    DRAIN_POLL_S = 0.05
+
     def _drain(self):
+        # Event records are serialized HERE, not at emit time, with the
+        # few variable pieces (event names, args) going through cached /
+        # per-occurrence json.dumps for correct escaping; ph and scope
+        # are single-character constants from this module. One write +
+        # flush per poll turns ~DRAIN_POLL_S of events into a single IO
+        # burst.
+        dumps = json.dumps
+        name_json = self._name_json
         while True:
-            self._wake.wait(timeout=0.2)
+            self._wake.wait(timeout=self.DRAIN_POLL_S)
             self._wake.clear()
-            wrote = False
+            parts = []
             while self._queue:
-                ev = self._queue.popleft()
+                item = self._queue.popleft()
+                if isinstance(item, dict):   # metadata events
+                    parts.append(dumps(item))
+                    continue
+                # extra = dur for "X" complete events, scope for "i"
+                # instants, None otherwise.
+                ph, ts, pid, name, args, extra = item
+                s = f'{{"ph":"{ph}","ts":{ts},"pid":{pid},"tid":0'
+                if name is not None:
+                    e = name_json.get(name)
+                    if e is None:
+                        e = name_json[name] = dumps(name)
+                    s += f',"name":{e}'
+                if args is not None:
+                    # ("group", int) / ("shape", (ints,)) fast paths —
+                    # integer-only payloads need no escaping; anything
+                    # else goes through json.dumps.
+                    if type(args) is tuple:
+                        k, v = args
+                        if k == "shape":
+                            v = f'[{",".join(map(str, v))}]'
+                        s += f',"args":{{"{k}":{v}}}'
+                    else:
+                        s += f',"args":{dumps(args)}'
+                if extra is not None:
+                    if ph == "X":
+                        s += f',"dur":{extra}'
+                    else:
+                        s += f',"s":"{extra}"'
+                parts.append(s + "}")
+            if parts:
                 prefix = "" if self._first else ",\n"
                 self._first = False
-                self._f.write(prefix + json.dumps(ev))
-                wrote = True
-            if wrote:
+                self._f.write(prefix + ",\n".join(parts))
                 self._f.flush()
             if self._stop and not self._queue:
                 return
 
     def close(self):
+        try:
+            atexit.unregister(self.close)
+        except Exception:  # pragma: no cover - interpreter teardown
+            pass
         self._stop = True
         self._wake.set()
         self._thread.join(timeout=5.0)

@@ -61,6 +61,15 @@ def main(argv=None) -> int:
     parser.add_argument("--state-dir", default=None,
                         help="elastic: ElasticState commit directory, "
                              "exported as HOROVOD_TPU_ELASTIC_DIR")
+    parser.add_argument("--timeline", default=None,
+                        help="write collective timelines: a plain path "
+                             "traces rank 0 only; a path with a {rank} "
+                             "placeholder (e.g. /tmp/trace.{rank}.json) "
+                             "traces EVERY rank with clock-alignment "
+                             "headers for `python -m "
+                             "horovod_tpu.tools.trace merge` "
+                             "(docs/tracing.md); exported as "
+                             "HOROVOD_TPU_TIMELINE")
     parser.add_argument("--timeout", type=float, default=None,
                         help="overall job timeout in seconds")
     parser.add_argument("--no-tag-output", action="store_true",
@@ -74,6 +83,14 @@ def main(argv=None) -> int:
     command = args.command
     if command and command[0] == "--":
         command = command[1:]
+
+    extra_env = {}
+    if args.timeline:
+        # Propagated UNEXPANDED: each worker resolves its own {rank}
+        # (utils/env.resolved_timeline_path), so the same value serves
+        # the single-writer and all-ranks capture modes — and elastic
+        # relaunches keep rank-correct paths across generations.
+        extra_env["HOROVOD_TPU_TIMELINE"] = args.timeline
 
     provider = None
     hosts = args.hosts
@@ -104,6 +121,7 @@ def main(argv=None) -> int:
                 max_np=args.max_np if args.max_np is not None else np,
                 provider=provider, hosts=hosts,
                 state_dir=args.state_dir, config=config,
+                extra_env=extra_env or None,
                 tag_output=not args.no_tag_output,
                 run_timeout=args.timeout)
         except KeyboardInterrupt:
@@ -116,6 +134,7 @@ def main(argv=None) -> int:
     from .launcher import launch
 
     job = launch(command, np=np, hosts=hosts,
+                 extra_env=extra_env or None,
                  tag_output=not args.no_tag_output)
     try:
         return job.wait(timeout=args.timeout)

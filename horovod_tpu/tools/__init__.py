@@ -1,0 +1,6 @@
+"""Offline analysis tools (``python -m horovod_tpu.tools.<name>``).
+
+Currently: :mod:`.trace` — merge N per-rank timeline captures into one
+clock-aligned Perfetto trace and compute the per-fused-group critical
+path / straggler attribution (docs/tracing.md).
+"""

@@ -1,0 +1,447 @@
+"""Cross-rank trace merge + critical-path analysis CLI (docs/tracing.md).
+
+The capture side (``HOROVOD_TPU_TIMELINE`` with a ``{rank}``
+placeholder) leaves one catapult JSON per rank, each on its OWN clock:
+event ``ts`` are microseconds since that rank's writer start, and the
+trace clock header records the writer's monotonic epoch plus the rank's
+estimated offset to rank 0 (the NTP-style control-plane handshake,
+ops/control_plane.ClockProbeRequest). This tool puts the N files back
+on one clock and answers the question the single-rank timeline cannot:
+*which rank is slowing the job down, and in which phase?*
+
+  merge   — one Perfetto/catapult trace: one trace "process" per rank,
+            tensors as named threads, timestamps realigned through the
+            recorded offsets.
+  report  — per-fused-group critical path: for every coordinator group,
+            which rank's NEGOTIATE tick arrived last; per-rank lateness
+            distributions (p50/p90/p99 through the same log-bucket
+            estimator as the live Prometheus plane,
+            observability.histogram_percentiles); a ranked straggler
+            table with the phase each rank loses time in
+            (negotiate/queue/h2d/execute — or "upstream" when the skew
+            originates before the collective path, i.e. compute/input).
+
+Usage::
+
+    python -m horovod_tpu.tools.trace merge /tmp/trace.{rank}.json \
+        -o merged.json --report report.json
+    python -m horovod_tpu.tools.trace report /tmp/trace.*.json
+
+Groups are keyed by the coordinator sequence number the Python writer
+records on each NEGOTIATE span — identical on every rank for the
+same fused collective. Traces without group ids (the native C++ writer)
+fall back to per-tensor occurrence pairing, which holds as long as
+every rank executed the same collectives in the same order (the SPMD
+contract the coordinator enforces anyway).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+import bisect
+import math
+
+from ..observability.export import histogram_percentiles
+from ..observability.registry import LATENCY_BUCKETS
+from ..ops.timeline_jit import _load_timeline
+from ..ops.timeline_py import TRACE_META_EVENT, clock_sidecar_path
+
+PHASES = ("negotiate", "queue", "h2d", "execute")
+
+_PHASE_OF = {"QUEUE": "queue", "MEMCPY_IN_FUSION_BUFFER": "h2d"}
+
+
+def _phase_of(name: str) -> Optional[str]:
+    if name.startswith("NEGOTIATE_"):
+        return "negotiate"
+    if name.startswith("XLA_") and name != "XLA_STEP":
+        return "execute"
+    return _PHASE_OF.get(name)
+
+
+# --------------------------------------------------------------------------
+# Loading
+# --------------------------------------------------------------------------
+
+class RankTrace:
+    """One rank's capture: raw events, pid→tensor names, clock meta."""
+
+    def __init__(self, path: str, events: List[dict], meta: dict):
+        self.path = path
+        self.events = events
+        self.meta = meta
+        self.rank = meta.get("rank")
+        self.tensor_of: Dict[int, str] = {
+            e["pid"]: str(e["args"]["name"]) for e in events
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+            and "pid" in e and e.get("args", {}).get("name") is not None}
+
+    @property
+    def shift_us(self) -> float:
+        """Local trace ts → rank-0 monotonic microseconds."""
+        return (float(self.meta.get("start_mono_us", 0))
+                + float(self.meta.get("offset_to_rank0_us", 0.0)))
+
+
+def _read_meta(path: str, events: List[dict]) -> dict:
+    """Clock metadata: the LAST in-trace meta event (a sync supersedes
+    the unsynced header) or the sidecar; empty dict when neither exists
+    (offset 0 — single-host captures still merge correctly since all
+    writers share one monotonic clock only if starts are recorded, so a
+    missing header degrades alignment to per-file-relative time)."""
+    meta: dict = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == TRACE_META_EVENT:
+            meta = dict(e.get("args") or {})
+    if not meta:
+        sidecar = clock_sidecar_path(path)
+        if os.path.exists(sidecar):
+            with open(sidecar) as f:
+                meta = json.load(f)
+    return meta
+
+
+def load_rank_trace(path: str) -> RankTrace:
+    events = _load_timeline(path)
+    return RankTrace(path, events, _read_meta(path, events))
+
+
+def expand_inputs(paths: List[str]) -> List[str]:
+    """A single ``{rank}`` template expands to consecutive existing
+    files starting at rank 0."""
+    if len(paths) == 1 and "{rank}" in paths[0]:
+        out = []
+        rank = 0
+        while True:
+            p = paths[0].replace("{rank}", str(rank))
+            if not os.path.exists(p):
+                break
+            out.append(p)
+            rank += 1
+        if not out:
+            raise FileNotFoundError(
+                f"no trace files match template {paths[0]}")
+        return out
+    return list(paths)
+
+
+def load_traces(paths: List[str]) -> List[RankTrace]:
+    traces = [load_rank_trace(p) for p in expand_inputs(paths)]
+    # Rank identity from the clock header; positional fallback for
+    # headerless files, collision = operator error (two copies of one
+    # rank's file would silently halve every lateness).
+    seen = set()
+    for i, t in enumerate(traces):
+        if t.rank is None:
+            t.rank = i
+        if t.rank in seen:
+            raise ValueError(
+                f"duplicate rank {t.rank} among inputs ({t.path})")
+        seen.add(t.rank)
+    traces.sort(key=lambda t: t.rank)
+    return traces
+
+
+# --------------------------------------------------------------------------
+# Merge
+# --------------------------------------------------------------------------
+
+def merge_traces(traces: List[RankTrace], out_path: str) -> str:
+    """One Perfetto/catapult file: pid = rank, tid = interned tensor,
+    timestamps realigned via each trace's recorded clock shift and
+    rebased so the earliest event sits at 0."""
+    shifts = {t.rank: t.shift_us for t in traces}
+    base = None
+    for t in traces:
+        for e in t.events:
+            if e.get("ph") in ("M", None) or "ts" not in e:
+                continue
+            at = e["ts"] + shifts[t.rank]
+            base = at if base is None else min(base, at)
+    base = base or 0.0
+    merged: List[dict] = []
+    for t in traces:
+        merged.append({"name": "process_name", "ph": "M", "pid": t.rank,
+                       "args": {"name": f"rank {t.rank}"}})
+        merged.append({"name": "process_sort_index", "ph": "M",
+                       "pid": t.rank, "args": {"sort_index": t.rank}})
+        tids: Dict[int, int] = {}
+        for pid, tensor in t.tensor_of.items():
+            tids[pid] = len(tids)
+            merged.append({"name": "thread_name", "ph": "M", "pid": t.rank,
+                           "tid": tids[pid], "args": {"name": tensor}})
+        for e in t.events:
+            if e.get("ph") == "M":
+                continue  # re-interned above (incl. the clock header)
+            ev = dict(e)
+            ev["pid"] = t.rank
+            ev["tid"] = tids.setdefault(e.get("pid", 0),
+                                        len(tids))
+            if "ts" in ev:
+                ev["ts"] = int(ev["ts"] + shifts[t.rank] - base)
+            if ev.get("s") == "g":
+                # A cycle marker is per-rank state; in the merged view a
+                # global-scope instant would draw across ALL ranks.
+                ev["s"] = "p"
+            merged.append(ev)
+    with open(out_path, "w") as f:
+        json.dump(merged, f)
+    return out_path
+
+
+# --------------------------------------------------------------------------
+# Analysis
+# --------------------------------------------------------------------------
+
+def _spans(events: List[dict]) -> List[dict]:
+    """Pair B/E (and accept X) into spans per (pid, tid). Tolerates
+    truncated captures: unmatched closers are dropped, unclosed openers
+    are skipped — exactly what a killed writer leaves behind."""
+    stacks: Dict[Tuple, List[dict]] = {}
+    spans: List[dict] = []
+    for e in events:
+        ph = e.get("ph")
+        key = (e.get("pid"), e.get("tid", 0))
+        if ph == "B":
+            stacks.setdefault(key, []).append(e)
+        elif ph == "E":
+            st = stacks.get(key)
+            if not st:
+                continue
+            b = st.pop()
+            args = dict(b.get("args") or {})
+            args.update(e.get("args") or {})
+            spans.append({"pid": key[0], "name": b.get("name", ""),
+                          "ts": b.get("ts", 0),
+                          "dur": max(0, e.get("ts", 0) - b.get("ts", 0)),
+                          "args": args})
+        elif ph == "X":
+            spans.append({"pid": key[0], "name": e.get("name", ""),
+                          "ts": e.get("ts", 0),
+                          "dur": int(e.get("dur", 0)),
+                          "args": dict(e.get("args") or {})})
+    return spans
+
+
+def _arrivals(trace: RankTrace) -> Dict[str, float]:
+    """Per-group arrival microseconds in the rank-0 clock domain.
+    Arrival of a group on a rank = the LAST member tensor's NEGOTIATE
+    start (the group cannot be agreed before the rank's final
+    announce); groups keyed by the recorded coordinator seq, falling
+    back to per-tensor occurrence index."""
+    shift = trace.shift_us
+    occurrence: Dict[str, int] = {}
+    arrivals: Dict[str, float] = {}
+    for s in _spans(trace.events):
+        if not s["name"].startswith("NEGOTIATE_"):
+            continue
+        tensor = trace.tensor_of.get(s["pid"], str(s["pid"]))
+        if tensor.startswith(("jit::", "_cycles")):
+            continue
+        group = s["args"].get("group")
+        if group is not None:
+            key = f"g{int(group)}"
+        else:
+            k = occurrence.get(tensor, 0)
+            occurrence[tensor] = k + 1
+            key = f"{tensor}#{k}"
+        at = s["ts"] + shift
+        arrivals[key] = max(arrivals.get(key, at), at)
+    return arrivals
+
+
+def _phase_means(trace: RankTrace) -> Dict[str, float]:
+    """Mean span duration (seconds) per lifecycle phase on this rank."""
+    sums = {p: 0.0 for p in PHASES}
+    counts = {p: 0 for p in PHASES}
+    for s in _spans(trace.events):
+        phase = _phase_of(s["name"])
+        if phase is None:
+            continue
+        sums[phase] += s["dur"] / 1e6
+        counts[phase] += 1
+    return {p: (sums[p] / counts[p] if counts[p] else 0.0)
+            for p in PHASES}
+
+
+def _hist_snapshot(samples: List[float]) -> dict:
+    """Registry-format histogram snapshot of raw samples over the live
+    plane's LATENCY_BUCKETS — feeds the same percentile estimator, so
+    offline and Prometheus numbers agree to within one bucket width."""
+    counts = [0] * (len(LATENCY_BUCKETS) + 1)
+    for v in samples:
+        counts[bisect.bisect_left(LATENCY_BUCKETS, v)] += 1
+    out = []
+    cum = 0
+    for le, c in zip(LATENCY_BUCKETS, counts[:-1]):
+        cum += c
+        out.append([le, cum])
+    out.append([math.inf, cum + counts[-1]])
+    return {"buckets": out, "sum": float(sum(samples)),
+            "count": len(samples)}
+
+
+def analyze(traces: List[RankTrace], top: int = 0) -> dict:
+    """The straggler report (see module docstring). ``top`` limits the
+    per-group critical-path listing (0 = omit raw groups)."""
+    ranks = [t.rank for t in traces]
+    arrivals_by_rank = {t.rank: _arrivals(t) for t in traces}
+    common = set.intersection(*(set(a) for a in arrivals_by_rank.values())) \
+        if traces else set()
+    lateness: Dict[int, List[float]] = {r: [] for r in ranks}
+    last_count: Dict[int, int] = {r: 0 for r in ranks}
+    group_rows = []
+    for key in sorted(common,
+                      key=lambda k: min(arrivals_by_rank[r][k]
+                                        for r in ranks)):
+        arr = {r: arrivals_by_rank[r][key] for r in ranks}
+        t0 = min(arr.values())
+        critical = max(arr, key=lambda r: arr[r])
+        last_count[critical] += 1
+        for r in ranks:
+            lateness[r].append((arr[r] - t0) / 1e6)
+        group_rows.append({
+            "group": key, "critical_rank": critical,
+            "lateness_s": round((arr[critical] - t0) / 1e6, 6)})
+    phase_means = {t.rank: _phase_means(t) for t in traces}
+    # Lower median: with an even rank count the upper median would let a
+    # single slow rank set its own baseline and mask itself.
+    fleet_median = {
+        p: sorted(phase_means[r][p] for r in ranks)[(len(ranks) - 1) // 2]
+        for p in PHASES}
+    per_rank = {}
+    for r in ranks:
+        samples = lateness[r]
+        # Same estimator as the live hvdtpu_negotiate_lateness_seconds
+        # plane: route samples through the identical log-bucket layout
+        # (independent of the runtime metrics flag — this is an offline
+        # tool).
+        pct = histogram_percentiles(_hist_snapshot(samples),
+                                    qs=(0.5, 0.9, 0.99))
+        mean = sum(samples) / len(samples) if samples else 0.0
+        dev = {p: phase_means[r][p] - fleet_median[p] for p in PHASES}
+        worst_phase = max(PHASES, key=lambda p: dev[p])
+        # When no collective-path phase explains the skew, the rank is
+        # late ARRIVING — the time is lost upstream (compute, input
+        # pipeline, host scheduling), not inside the engine.
+        loses_in = (worst_phase
+                    if dev[worst_phase] > max(1e-6, 0.1 * mean)
+                    else "upstream(compute/input)")
+        per_rank[str(r)] = {
+            "groups": len(samples),
+            "groups_last": last_count[r],
+            "lateness": {
+                "p50_s": round(pct.get("p50", 0.0), 6),
+                "p90_s": round(pct.get("p90", 0.0), 6),
+                "p99_s": round(pct.get("p99", 0.0), 6),
+                "mean_s": round(mean, 6),
+                "max_s": round(max(samples), 6) if samples else 0.0,
+            },
+            "phase_mean_s": {p: round(phase_means[r][p], 6)
+                             for p in PHASES},
+            "loses_most_in": loses_in,
+        }
+    order = sorted(ranks,
+                   key=lambda r: (per_rank[str(r)]["lateness"]["p50_s"],
+                                  per_rank[str(r)]["lateness"]["mean_s"],
+                                  per_rank[str(r)]["groups_last"]),
+                   reverse=True)
+    stragglers = [{"rank": r, **per_rank[str(r)]["lateness"],
+                   "groups_last": per_rank[str(r)]["groups_last"],
+                   "loses_most_in": per_rank[str(r)]["loses_most_in"]}
+                  for r in order]
+    report = {
+        "ranks": ranks,
+        "groups_scored": len(common),
+        "clock": {str(t.rank): {
+            "offset_to_rank0_us": float(
+                t.meta.get("offset_to_rank0_us", 0.0)),
+            "rtt_us": float(t.meta.get("rtt_us", 0.0)),
+            "synced": bool(t.meta.get("clock_synced", False)),
+        } for t in traces},
+        "per_rank": per_rank,
+        "stragglers": stragglers,
+        "top_straggler": stragglers[0] if stragglers else None,
+    }
+    if top:
+        worst = sorted(group_rows, key=lambda g: -g["lateness_s"])[:top]
+        report["worst_groups"] = worst
+    return report
+
+
+def format_report(report: dict) -> str:
+    """Human-readable rendering of :func:`analyze`'s JSON."""
+    lines = [
+        f"Cross-rank trace report — {len(report['ranks'])} ranks, "
+        f"{report['groups_scored']} fused groups scored",
+        "",
+        f"{'rank':>4}  {'p50 late':>10}  {'p99 late':>10}  "
+        f"{'mean':>10}  {'last-in':>8}  loses most in",
+    ]
+    for s in report["stragglers"]:
+        lines.append(
+            f"{s['rank']:>4}  {s['p50_s'] * 1e3:>8.2f}ms  "
+            f"{s['p99_s'] * 1e3:>8.2f}ms  {s['mean_s'] * 1e3:>8.2f}ms  "
+            f"{s['groups_last']:>8}  {s['loses_most_in']}")
+    top = report.get("top_straggler")
+    if top and top["mean_s"] > 0:
+        lines += ["", f"Top straggler: rank {top['rank']} "
+                      f"(p50 lateness {top['p50_s'] * 1e3:.2f} ms, "
+                      f"last to arrive in {top['groups_last']} of "
+                      f"{report['groups_scored']} groups; "
+                      f"loses time in: {top['loses_most_in']})"]
+    unsynced = [r for r, c in report["clock"].items()
+                if not c["synced"] and r != "0"]
+    if unsynced:
+        lines += ["", "WARNING: clock offset unsynced for ranks "
+                      f"{', '.join(unsynced)} — lateness numbers for "
+                      "them carry the raw inter-host clock skew."]
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+
+def _main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.tools.trace",
+        description="Merge per-rank horovod_tpu timeline captures into "
+                    "one clock-aligned Perfetto trace and report the "
+                    "per-group critical path / straggler attribution")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_merge = sub.add_parser(
+        "merge", help="merge + analyze (writes the merged trace)")
+    p_report = sub.add_parser("report", help="analyze only")
+    for p in (p_merge, p_report):
+        p.add_argument("traces", nargs="+",
+                       help="per-rank trace files, or ONE path template "
+                            "containing {rank}")
+        p.add_argument("--report", default=None,
+                       help="also write the report JSON here")
+        p.add_argument("--top", type=int, default=10,
+                       help="include the N worst groups in the JSON")
+    p_merge.add_argument("-o", "--out", default=None,
+                         help="merged trace path (default: "
+                              "<first input>.merged.json)")
+    args = ap.parse_args(argv)
+
+    traces = load_traces(args.traces)
+    if args.cmd == "merge":
+        out = args.out or expand_inputs(args.traces)[0] + ".merged.json"
+        merge_traces(traces, out)
+        print(f"merged trace: {out}")
+    report = analyze(traces, top=args.top)
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+            f.write("\n")
+    print(format_report(report))
+
+
+if __name__ == "__main__":  # pragma: no cover - thin CLI
+    _main()

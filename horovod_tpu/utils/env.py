@@ -73,6 +73,34 @@ def timeline_path() -> Optional[str]:
     return _get("TIMELINE")
 
 
+def resolved_timeline_path(rank: int) -> Optional[str]:
+    """Timeline file this process should write, or None.
+
+    A ``{rank}`` placeholder in HOROVOD_TPU_TIMELINE expands to the
+    process index and EVERY rank writes its own trace (the cross-rank
+    capture mode, docs/tracing.md — mirroring the
+    HOROVOD_TPU_METRICS_FILE convention). Without a placeholder only
+    process 0 writes, the reference's single-viewpoint behavior
+    (operations.cc:1824-1829): a second writer on one path would
+    truncate rank 0's file."""
+    path = timeline_path()
+    if not path:
+        return None
+    if "{rank}" in path:
+        return path.replace("{rank}", str(rank))
+    return path if rank == 0 else None
+
+
+def trace_clock_probes() -> int:
+    """Clock-alignment handshake pings per rank (NTP-style, min-RTT
+    sample wins) recorded in each per-rank trace's clock metadata;
+    0 disables the handshake (offset recorded as unsynced)."""
+    v = _get("TRACE_CLOCK_PROBES")
+    if v in (None, ""):
+        return 8
+    return int(v)
+
+
 def metrics_enabled() -> bool:
     """Metrics registry recording (docs/metrics.md). Default ON — a
     guarded counter add is nanoseconds (the BENCH_METRICS overhead test
@@ -89,13 +117,38 @@ def metrics_file() -> Optional[str]:
     return _get("METRICS_FILE")
 
 
-def metrics_port() -> Optional[int]:
-    """Rank-0 Prometheus/JSON HTTP endpoint port (0 = ephemeral);
-    None disables the endpoint."""
+def metrics_port(rank: int = 0) -> Optional[int]:
+    """Prometheus/JSON HTTP endpoint port for ``rank`` (0 = ephemeral);
+    None disables the endpoint.
+
+    Three forms (docs/metrics.md):
+      - ``9091``        — plain port, served by process 0 only.
+      - ``909{rank}``   — ``{rank}`` placeholder, every rank serves its
+                          substituted port.
+      - ``9091+rank``   — base + process index, every rank serves
+                          ``base + rank``.
+    The per-rank forms make every process scrapeable in multi-process
+    mode instead of aggregates-through-rank-0 only."""
     v = _get("METRICS_PORT")
     if v in (None, ""):
         return None
+    v = v.strip()
+    if "{rank}" in v:
+        return int(v.replace("{rank}", str(rank)))
+    if v.endswith("+rank"):
+        return int(v[: -len("+rank")]) + rank
     return int(v)
+
+
+def metrics_port_per_rank() -> bool:
+    """True when HOROVOD_TPU_METRICS_PORT uses a per-rank form
+    (``{rank}`` placeholder or ``base+rank``), i.e. every process — not
+    just 0 — should bind its endpoint."""
+    v = _get("METRICS_PORT")
+    if v in (None, ""):
+        return False
+    v = v.strip()
+    return "{rank}" in v or v.endswith("+rank")
 
 
 def metrics_interval_secs() -> float:

@@ -261,3 +261,164 @@ class TestBoundedPlanDefer:
             assert elapsed < 1.0, f"valve fired too late: {elapsed:.3f}s"
         finally:
             svc.shutdown()
+
+
+class TestClockSync:
+    """Clock-alignment handshake (docs/tracing.md): NTP-style pings with
+    round-trip halving over the coordinator channel."""
+
+    def test_clock_sync_local_offset_near_zero(self, svc):
+        c1 = _client(svc, 1)
+        res = c1.clock_sync(probes=6)
+        # Same host, same monotonic clock: the measured offset must be
+        # tiny (bounded by scheduling noise) and the RTT positive.
+        assert res["rtt_s"] > 0.0
+        assert abs(res["offset_s"]) < 0.05
+        assert res["probes"] == 6
+
+    def test_min_rtt_sample_wins(self, svc, monkeypatch):
+        """The kept offset is the one measured on the cleanest round
+        trip, not the last or the mean."""
+        from horovod_tpu.ops import control_plane as cp
+
+        c1 = _client(svc, 1)
+        rtts = iter([0.010, 0.002, 0.030])
+        real_request = c1._client.request
+
+        def jittered(req):
+            import time as _t
+            resp = real_request(req)
+            if isinstance(req, cp.ClockProbeRequest):
+                _t.sleep(next(rtts))   # inflate this probe's RTT
+            return resp
+
+        monkeypatch.setattr(c1._client, "request", jittered)
+        res = c1.clock_sync(probes=3)
+        # The winning sample is the middle one (min inflated RTT).
+        assert 0.002 <= res["rtt_s"] < 0.010
+
+
+class TestSkewTelemetry:
+    """Live straggler metrics (docs/tracing.md): the coordinator turns
+    its announce ticks into per-rank lateness histograms and a
+    straggler gauge — visible on the Prometheus plane without traces."""
+
+    def _lateness(self, snap, rank):
+        fam = snap.get("hvdtpu_negotiate_lateness_seconds",
+                       {"values": {}})["values"]
+        return fam.get(f'rank="{rank}"')
+
+    def test_late_rank_measured_and_elected(self, svc):
+        import time
+
+        from horovod_tpu.observability import metrics_snapshot
+
+        before = self._lateness(metrics_snapshot(), 1)
+        n0 = before["count"] if before else 0
+        s0 = before["sum"] if before else 0.0
+        c0, c1 = _client(svc, 0), _client(svc, 1)
+        for step in range(3):
+            c0.announce([_req(f"skew.{step}")])
+            time.sleep(0.06)
+            c1.announce([_req(f"skew.{step}")])
+            assert c0.fetch(wait_s=2.0).groups
+        snap = metrics_snapshot()
+        h1 = self._lateness(snap, 1)
+        assert h1["count"] - n0 == 3
+        # Each quorum saw rank 1 ~60 ms behind rank 0.
+        mean = (h1["sum"] - s0) / 3
+        assert 0.03 <= mean <= 0.3
+        assert snap["hvdtpu_straggler_rank"]["values"][""] == 1.0
+        assert snap["hvdtpu_straggler_lateness_seconds"]["values"][""] \
+            > 0.01
+
+    def test_native_coordinator_decodes_payload_announces(self):
+        """Skew telemetry must also work when announces arrive as
+        pre-serialized RequestList bytes (native-engine workers)."""
+        import time
+
+        from horovod_tpu.observability import metrics_snapshot
+        from horovod_tpu.ops import wire_format as wire
+
+        svc = CoordinatorService(nproc=2, key=make_secret_key(),
+                                 fusion_threshold=1024, native=True)
+        if not svc.native_active:
+            svc.shutdown()
+            pytest.skip("native controller unavailable")
+        try:
+            before = self._lateness(metrics_snapshot(), 1)
+            n0 = before["count"] if before else 0
+            c0, c1 = _client(svc, 0), _client(svc, 1)
+            payload = wire.encode_request_list(
+                0, [dict(_req("native.skew"), device=0, nbytes=16)])
+            c0.announce_bytes(payload)
+            time.sleep(0.05)
+            payload1 = wire.encode_request_list(
+                1, [dict(_req("native.skew"), device=0, nbytes=16)])
+            c1.announce_bytes(payload1)
+            assert c0.fetch(wait_s=2.0).groups
+            h1 = self._lateness(metrics_snapshot(), 1)
+            assert h1 is not None and h1["count"] - n0 == 1
+        finally:
+            svc.shutdown()
+
+    def test_stall_warning_includes_measured_lateness(self):
+        """The upgraded stall report carries the per-rank lateness tail
+        next to the missing-ranks line. (The horovod_tpu logger does not
+        propagate to root — caplog misses it — so attach a handler
+        directly.)"""
+        import logging
+        import time
+
+        records = []
+
+        class _Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record)
+
+        handler = _Capture(level=logging.WARNING)
+        logging.getLogger("horovod_tpu.control_plane").addHandler(handler)
+        svc = CoordinatorService(nproc=2, key=make_secret_key(),
+                                 fusion_threshold=1024, native=False,
+                                 stall_warning_s=0.05)
+        try:
+            c0, c1 = _client(svc, 0), _client(svc, 1)
+            # One completed tensor establishes rank 1's lateness...
+            c0.announce([_req("warm")])
+            time.sleep(0.08)
+            c1.announce([_req("warm")])
+            assert c0.fetch(wait_s=2.0).groups
+            # ...then a stuck one triggers the stall report.
+            c0.announce([_req("stuck")])
+            time.sleep(0.1)
+            svc._last_stall_check = 0.0
+            lines = svc.check_stalls()
+            assert lines and lines[0][0] == "stuck"
+            text = "\n".join(r.getMessage() for r in records)
+            assert "Recent negotiate lateness by rank" in text
+            assert "rank 1:" in text
+        finally:
+            svc.shutdown()
+            logging.getLogger(
+                "horovod_tpu.control_plane").removeHandler(handler)
+
+    def test_partial_entries_pruned(self):
+        """Ticks of tensors that never reach quorum are dropped after
+        the stall window — coordinator memory must not grow with a
+        misbehaving job."""
+        import time
+
+        svc = CoordinatorService(nproc=2, key=make_secret_key(),
+                                 fusion_threshold=1024, native=False,
+                                 stall_warning_s=0.05)
+        try:
+            c0 = _client(svc, 0)
+            for i in range(5):
+                c0.announce([_req(f"orphan.{i}")])
+            assert len(svc._skew._pending) == 5
+            time.sleep(0.15)
+            svc._last_stall_check = 0.0
+            svc.check_stalls()
+            assert len(svc._skew._pending) == 0
+        finally:
+            svc.shutdown()

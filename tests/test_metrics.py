@@ -470,3 +470,97 @@ class TestElasticMetrics:
         fails = snap["hvdtpu_elastic_worker_failures_total"]["values"]
         assert fails['kind="sigkill"'] >= 1
         assert fails['kind="all"'] >= 1
+
+
+class TestPerRankMetricsPort:
+    """Satellite: HOROVOD_TPU_METRICS_PORT {rank}/base+rank forms make
+    every rank scrapeable in multi-process mode (docs/metrics.md)."""
+
+    def test_plain_port_rank0_only(self, monkeypatch):
+        from horovod_tpu.utils import env as _env
+        monkeypatch.setenv("HOROVOD_TPU_METRICS_PORT", "9091")
+        assert _env.metrics_port(0) == 9091
+        assert _env.metrics_port(3) == 9091
+        assert _env.metrics_port_per_rank() is False
+
+    def test_placeholder_form(self, monkeypatch):
+        from horovod_tpu.utils import env as _env
+        monkeypatch.setenv("HOROVOD_TPU_METRICS_PORT", "909{rank}")
+        assert _env.metrics_port(0) == 9090
+        assert _env.metrics_port(7) == 9097
+        assert _env.metrics_port_per_rank() is True
+
+    def test_base_plus_rank_form(self, monkeypatch):
+        from horovod_tpu.utils import env as _env
+        monkeypatch.setenv("HOROVOD_TPU_METRICS_PORT", "9091+rank")
+        assert _env.metrics_port(0) == 9091
+        assert _env.metrics_port(5) == 9096
+        assert _env.metrics_port_per_rank() is True
+
+    def test_two_ranks_bind_distinct_ports(self, monkeypatch):
+        """Two ranks' resolved ports bind two live endpoints, each
+        serving the exposition."""
+        import socket
+
+        from horovod_tpu.utils import env as _env
+
+        hvd.allreduce(jnp.ones((4,)), name="metrics.perrank.ar")
+        for _ in range(5):   # free-port race: retry with a fresh base
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            base = s.getsockname()[1]
+            s.close()
+            monkeypatch.setenv("HOROVOD_TPU_METRICS_PORT",
+                               f"{base}+rank")
+            ports = [_env.metrics_port(r) for r in (0, 1)]
+            assert ports == [base, base + 1]
+            try:
+                servers = [MetricsServer(p) for p in ports]
+            except OSError:
+                continue
+            try:
+                assert sorted(s.port for s in servers) == ports
+                for p in ports:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{p}/metrics",
+                            timeout=10) as resp:
+                        assert b"hvdtpu_ops_total" in resp.read()
+            finally:
+                for srv in servers:
+                    srv.stop()
+            return
+        pytest.skip("could not find two adjacent free ports")
+
+
+class TestJsonPercentiles:
+    """Satellite: the endpoint's JSON view carries p50/p90/p99 estimated
+    from the log buckets (shared estimator with the trace report)."""
+
+    def test_metrics_json_includes_percentiles(self):
+        from horovod_tpu.observability import with_percentiles
+        from horovod_tpu.observability.export import json_safe_snapshot
+
+        hvd.allreduce(jnp.ones((16,)), name="metrics.pct.ar")
+        snap = with_percentiles(json_safe_snapshot())
+        fam = snap["hvdtpu_op_phase_seconds"]["values"]
+        key = 'op="allreduce",phase="execute"'
+        assert key in fam
+        pct = fam[key]["percentiles"]
+        assert set(pct) == {"p50", "p90", "p99"}
+        assert 0 < pct["p50"] <= pct["p90"] <= pct["p99"]
+
+    def test_http_json_view_serves_percentiles(self):
+        hvd.allreduce(jnp.ones((16,)), name="metrics.pct.http")
+        srv = MetricsServer(0)
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/metrics.json",
+                    timeout=10) as resp:
+                snap = json.loads(resp.read().decode())
+            hists = [v for fam in snap.values()
+                     if fam["type"] == "histogram"
+                     for v in fam["values"].values() if v["count"]]
+            assert hists
+            assert all("percentiles" in v for v in hists)
+        finally:
+            srv.stop()

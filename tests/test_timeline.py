@@ -240,6 +240,127 @@ class TestCycleMarkerScope:
                    for e in events)
 
 
+class TestWriterExitSafety:
+    """Satellite: events buffered in the writer deque must not be lost
+    when a rank exits without a clean shutdown() (crash/SIGTERM paths of
+    the elastic driver)."""
+
+    def test_atexit_flushes_unclosed_writer(self, tmp_path):
+        """Interpreter exit without close(): the atexit hook drains the
+        deque and terminates the JSON array."""
+        path = tmp_path / "atexit.json"
+        script = (
+            "import sys\n"
+            "sys.path.insert(0, sys.argv[2])\n"
+            "from horovod_tpu.ops.timeline_py import PyTimeline\n"
+            "tl = PyTimeline(sys.argv[1])\n"
+            "for i in range(200):\n"
+            "    tl.negotiate_start(f'exit.t{i}', 'allreduce')\n"
+            "    tl.negotiate_end(f'exit.t{i}', group=i)\n"
+            "sys.exit(0)\n")   # NO close() — atexit must flush
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run([sys.executable, "-c", script, str(path),
+                               root],
+                              capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        events = json.loads(path.read_text())   # strict parse: complete
+        assert sum(e.get("ph") == "B" for e in events) == 200
+        assert any(e.get("args", {}).get("group") == 199 for e in events)
+
+    def test_killed_writer_leaves_valid_prefix(self, tmp_path):
+        """SIGKILL mid-stream: the file must be valid JSON up to the
+        last drained event (the tolerant loader the merge tool uses),
+        with every drained record intact — no torn lines."""
+        import signal
+        import time as _time
+
+        path = tmp_path / "killed.json"
+        script = (
+            "import sys, time\n"
+            "sys.path.insert(0, sys.argv[2])\n"
+            "from horovod_tpu.ops.timeline_py import PyTimeline\n"
+            "tl = PyTimeline(sys.argv[1])\n"
+            "for i in range(500):\n"
+            "    tl.negotiate_start(f'kill.t{i}', 'allreduce')\n"
+            "    tl.negotiate_end(f'kill.t{i}', group=i)\n"
+            "time.sleep(0.5)\n"           # let the drain thread flush
+            "print('DRAINED', flush=True)\n"
+            "time.sleep(60)\n")
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.Popen([sys.executable, "-c", script, str(path),
+                                 root],
+                                stdout=subprocess.PIPE, text=True)
+        try:
+            assert proc.stdout.readline().strip() == "DRAINED"
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        _time.sleep(0.1)
+        from horovod_tpu.ops import timeline_jit
+        events = timeline_jit._load_timeline(str(path))  # tolerant parse
+        bs = [e for e in events if e.get("ph") == "B"]
+        assert len(bs) == 500   # everything drained before the kill
+        for e in events[:50]:
+            assert "ph" in e or e.get("name") in ("process_name",
+                                                  "horovod_tpu_trace_meta")
+
+
+class TestPerRankCapture:
+    """Tentpole: HOROVOD_TPU_TIMELINE with a {rank} placeholder makes
+    EVERY rank write a trace, each carrying a clock header + sidecar for
+    the offline merger (docs/tracing.md)."""
+
+    def test_placeholder_resolution(self, monkeypatch):
+        from horovod_tpu.utils import env as _env
+        monkeypatch.setenv("HOROVOD_TPU_TIMELINE", "/tmp/t.{rank}.json")
+        assert _env.resolved_timeline_path(0) == "/tmp/t.0.json"
+        assert _env.resolved_timeline_path(3) == "/tmp/t.3.json"
+        monkeypatch.setenv("HOROVOD_TPU_TIMELINE", "/tmp/t.json")
+        assert _env.resolved_timeline_path(0) == "/tmp/t.json"
+        assert _env.resolved_timeline_path(1) is None   # rank-0-only mode
+
+    def test_single_process_placeholder_writes_rank0_with_meta(
+            self, tmp_path):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "HOROVOD_TPU_DISABLE_NATIVE": "1",
+            "HOROVOD_TPU_TIMELINE": str(tmp_path / "t.{rank}.json"),
+            "PYTHONPATH": os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+        })
+        script = (
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "import jax.numpy as jnp\n"
+            "import horovod_tpu as hvd\n"
+            "from horovod_tpu.ops import collective\n"
+            "hvd.init()\n"
+            "hvd.allreduce(jnp.ones((8,)), name='prk.allreduce')\n"
+            "collective.engine().shutdown()\n")
+        proc = subprocess.run([sys.executable, "-c", script],
+                              capture_output=True, text=True, timeout=300,
+                              env=env)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        path = tmp_path / "t.0.json"
+        events = json.loads(path.read_text())
+        meta = [e for e in events
+                if e.get("name") == "horovod_tpu_trace_meta"]
+        assert meta, "no clock header in the per-rank trace"
+        args = meta[-1]["args"]
+        assert args["rank"] == 0 and args["clock_synced"] is True
+        assert args["start_mono_us"] > 0
+        # Sidecar for the merge tool (and for native-writer parity).
+        sidecar = json.loads((tmp_path / "t.0.json.clock.json")
+                             .read_text())
+        assert sidecar["rank"] == 0
+        # Fused-group ids recorded on the NEGOTIATE spans.
+        assert any("group" in (e.get("args") or {}) for e in events
+                   if e.get("ph") in ("E", "X"))
+
+
 class TestMergeCli:
     """The timeline_jit merge CLI on SYNTHETIC inputs: no profiler run,
     no engine — just a timeline file and a fake jax.profiler capture

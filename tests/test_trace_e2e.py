@@ -1,0 +1,135 @@
+"""End-to-end cross-rank tracing acceptance (docs/tracing.md).
+
+ISSUE 5 acceptance: a 4-process run with one artificially delayed rank
+produces per-rank trace files; ``python -m horovod_tpu.tools.trace
+merge`` emits a single valid Perfetto/catapult JSON whose straggler
+report names the delayed rank as top straggler with lateness within 2x
+of the injected delay; and the live
+``hvdtpu_negotiate_lateness_seconds`` metric for that rank shows the
+same signal WITHOUT traces enabled.
+
+Marked slow: two real 4-process jobs over the TCP control plane.
+"""
+
+import json
+import os
+
+import pytest
+
+from horovod_tpu.runner.api import run
+
+pytestmark = pytest.mark.slow
+
+_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    # The Python writer records fused-group seqs + in-band clock meta;
+    # forcing the fallback keeps the trace format deterministic here.
+    "HOROVOD_TPU_DISABLE_NATIVE": "1",
+}
+
+NP = 4
+DELAYED_RANK = 2
+DELAY_S = 0.15
+STEPS = 5
+
+
+def _make_worker():
+    """Nested so cloudpickle ships it by value (module-level test
+    functions are not importable in the workers)."""
+
+    def worker(trace_dir, steps, delay_s, delayed_rank):
+        import os
+        import time
+
+        import jax.numpy as jnp
+
+        import horovod_tpu as hvd
+        from horovod_tpu.ops import collective
+
+        if trace_dir:
+            os.environ["HOROVOD_TPU_TIMELINE"] = os.path.join(
+                trace_dir, "trace.{rank}.json")
+        hvd.init()
+        r = hvd.process_rank()
+        for step in range(steps):
+            if r == delayed_rank:
+                time.sleep(delay_s)
+            hvd.allreduce(jnp.full((16,), float(r)), average=False,
+                          name=f"e2e.step{step}")
+        snap = hvd.metrics_snapshot()
+        collective.engine().shutdown()
+        lat = snap.get("hvdtpu_negotiate_lateness_seconds", {}).get(
+            "values", {})
+        return {
+            "rank": r,
+            "lateness": {k: {"count": v["count"], "sum": v["sum"]}
+                         for k, v in lat.items()},
+            "straggler": snap.get("hvdtpu_straggler_rank", {}).get(
+                "values", {}).get(""),
+        }
+
+    return worker
+
+
+class TestCrossRankTraceAcceptance:
+    def test_delayed_rank_diagnosed_from_traces(self, tmp_path):
+        results = run(_make_worker(),
+                      args=(str(tmp_path), STEPS, DELAY_S, DELAYED_RANK),
+                      np=NP, extra_env=dict(_ENV), start_timeout=300)
+        assert sorted(r["rank"] for r in results) == list(range(NP))
+
+        # Every rank wrote a trace + clock sidecar.
+        for r in range(NP):
+            assert (tmp_path / f"trace.{r}.json").exists()
+            sc = json.loads(
+                (tmp_path / f"trace.{r}.json.clock.json").read_text())
+            assert sc["rank"] == r and sc["world"] == NP
+            assert sc["clock_synced"] is True
+
+        # Merge CLI: one valid catapult JSON + straggler report.
+        from horovod_tpu.tools import trace as trace_tool
+        merged_path = tmp_path / "merged.json"
+        report_path = tmp_path / "report.json"
+        trace_tool._main(["merge", str(tmp_path / "trace.{rank}.json"),
+                          "-o", str(merged_path),
+                          "--report", str(report_path)])
+        merged = json.loads(merged_path.read_text())
+        assert {e["args"]["name"] for e in merged
+                if e.get("name") == "process_name"} \
+            == {f"rank {r}" for r in range(NP)}
+        assert any(e.get("name") == "NEGOTIATE_ALLREDUCE" for e in merged)
+
+        report = json.loads(report_path.read_text())
+        top = report["top_straggler"]
+        assert top["rank"] == DELAYED_RANK
+        # Lateness within 2x of the injected delay.
+        assert DELAY_S / 2 <= top["p50_s"] <= DELAY_S * 2
+        assert top["groups_last"] >= STEPS - 1
+        # Punctual ranks are near zero.
+        for r in range(NP):
+            if r != DELAYED_RANK:
+                assert report["per_rank"][str(r)]["lateness"]["p50_s"] \
+                    <= DELAY_S / 2
+
+    def test_live_metric_shows_same_signal_without_traces(self, tmp_path):
+        """Same job shape, NO timeline env: the coordinator's registry
+        alone names the straggler with the right magnitude."""
+        results = run(_make_worker(),
+                      args=("", STEPS, DELAY_S, DELAYED_RANK),
+                      np=NP, extra_env=dict(_ENV), start_timeout=300)
+        for r in range(NP):
+            assert not (tmp_path / f"trace.{r}.json").exists()
+        rank0 = next(r for r in results if r["rank"] == 0)
+        h = rank0["lateness"].get(f'rank="{DELAYED_RANK}"')
+        assert h is not None and h["count"] >= STEPS - 1
+        mean = h["sum"] / h["count"]
+        assert DELAY_S / 2 <= mean <= DELAY_S * 2
+        assert rank0["straggler"] == DELAYED_RANK
+        # Punctual ranks' mean lateness stays well below the delay.
+        for r in range(NP):
+            if r == DELAYED_RANK:
+                continue
+            hr = rank0["lateness"].get(f'rank="{r}"')
+            if hr and hr["count"]:
+                assert hr["sum"] / hr["count"] <= DELAY_S / 2

@@ -1,0 +1,53 @@
+"""All-ranks tracing overhead guard (slow tier) — the cross-rank trace
+capture must stay out of the hot path: ``bench_engine.py --trace`` A/Bs
+a 2-process fused-allreduce loop with per-rank tracing on vs off (the
+same p25-of-per-step method as BENCH_METRICS: interleaved
+alternating-order repeats toggled IN-process — separate jobs differ by
+±5% job-to-job, swamping the budget — pooled per-step times, 25th
+percentile) and this guard holds the step-time overhead under 3%,
+regenerating ``BENCH_TRACE.json``.
+
+One re-measure is allowed before failing — a shared CI box can stay
+saturated through one window (the BENCH_METRICS precedent)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+BUDGET = 0.03
+
+
+def _run_bench(out_path: str, rounds: int) -> dict:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "bench_engine.py"), "--trace",
+         "--trace-rounds", str(rounds), "--out", out_path],
+        capture_output=True, text=True, timeout=600, cwd=root)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return json.loads(open(out_path).read())
+
+
+def test_trace_overhead_under_3_percent(tmp_path):
+    out = tmp_path / "bench_trace.json"
+    result = _run_bench(str(out), rounds=6)
+    if result["overhead_frac"] >= BUDGET:   # one re-measure
+        result = _run_bench(str(out), rounds=6)
+
+    # Regenerate the committed artifact from the accepted run.
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "BENCH_TRACE.json"), "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+    assert result["rows"]["tracing_on"]["step_time_ms"] > 0
+    assert result["overhead_frac"] < BUDGET, (
+        f"all-ranks tracing cost {result['overhead_frac']:.2%} of the "
+        f"2-process step time (on "
+        f"{result['rows']['tracing_on']['step_time_ms']} ms vs off "
+        f"{result['rows']['tracing_off']['step_time_ms']} ms; "
+        f"budget {BUDGET:.0%})")
