@@ -538,6 +538,227 @@ def main_trace(out_path: str, rounds: int = TRACE_ROUNDS) -> dict:
     return result
 
 
+# --------------------------------------------------------------------------
+# Straggler A/B (--straggler): a 4-process job with one rank delayed via
+# HOROVOD_TPU_FAULT_SPEC, run WITHOUT adaptation (every fused collective
+# stalls behind the slow rank for the whole job) and WITH the adaptation
+# policy + elastic eviction (docs/adaptation.md): the policy escalates
+# degradation tiers, evicts the slow rank, and the job re-rendezvouses at
+# np=3 and recovers. Writes BENCH_STRAGGLER.json: the per-step step-time
+# timeline, the recovered-throughput ratio (unmitigated stalled step time
+# over post-recovery step time), time-to-recover, and the adaptation
+# events from the hvdtpu_adaptation_* metrics. Deterministic fields:
+# world sizes, generations, tier/transition names, eviction target, step
+# counts (seeded faults, fixed spec); *_ms / *_s fields are wall-clock —
+# the slow-tier reproducibility test asserts only their sign-stable
+# headline, recovered_throughput_ratio > 1.
+# --------------------------------------------------------------------------
+
+STRAGGLER_NP = 4
+STRAGGLER_RANK = 2
+STRAGGLER_DELAY_MS = 100
+STRAGGLER_STEPS = 24
+STRAGGLER_COMMIT_EVERY = 2
+
+
+def _make_straggler_worker():
+    """Nested so cloudpickle ships it by value (see tests/test_elastic)."""
+
+    def worker(outdir, total_steps, commit_every):
+        import json
+        import os
+        import time
+
+        import jax.numpy as jnp
+
+        import horovod_tpu as hvd
+
+        hvd.init()
+        r = hvd.process_rank()
+        gen = hvd.generation()
+        state = hvd.ElasticState(params={"w": jnp.zeros((64,))})
+        state.restore()
+        w = jnp.asarray(state.params["w"])
+
+        def dump_adapt():
+            if r != 0:
+                return
+            snap = hvd.metrics_snapshot()
+            keep = {k: v for k, v in snap.items()
+                    if k.startswith("hvdtpu_adaptation")
+                    or k.startswith("hvdtpu_fault")}
+            tmp = os.path.join(outdir, f"adapt.g{gen}.json.tmp")
+            with open(tmp, "w") as af:
+                json.dump(keep, af)
+            os.replace(tmp, os.path.join(outdir, f"adapt.g{gen}.json"))
+
+        path = os.path.join(outdir, f"steps.g{gen}.r{r}.jsonl")
+        try:
+            with open(path, "a") as f:
+                for step in range(int(state.step), total_steps):
+                    t0 = time.perf_counter()
+                    g = hvd.allreduce(w * 0 + (r + 1.0), average=True,
+                                      name=f"g.{step}")
+                    w = w - 0.01 * g
+                    f.write(json.dumps(
+                        {"step": step, "gen": gen,
+                         "t_ms": (time.perf_counter() - t0) * 1e3,
+                         "ts": time.time()}) + "\n")
+                    f.flush()
+                    state.params = {"w": w}
+                    if (step + 1) % commit_every == 0:
+                        state.commit(step + 1)
+        except BaseException:
+            # Eviction path: persist the adaptation metrics BEFORE the
+            # typed failure propagates (the post-eviction snapshot is
+            # the one that records the eviction counter).
+            dump_adapt()
+            raise
+        dump_adapt()
+        return {"rank": r, "gen": gen, "size": hvd.size(),
+                "w0": float(w[0])}
+
+    return worker
+
+
+def _straggler_env(adaptation: bool) -> dict:
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "HOROVOD_TPU_DISABLE_NATIVE": "1",
+        "HOROVOD_CYCLE_TIME": "1",
+        "HOROVOD_TPU_STALL_CHECK_DISABLE": "1",
+        "HOROVOD_TPU_FAULT_SPEC": (
+            f"rank={STRAGGLER_RANK}:delay={STRAGGLER_DELAY_MS}ms:gen=0"),
+    }
+    if adaptation:
+        env.update({
+            "HOROVOD_TPU_ADAPTATION": "1",
+            "HOROVOD_TPU_ADAPT_THRESHOLD": "0.03",
+            "HOROVOD_TPU_ADAPT_SUSTAIN": "0.4",
+            "HOROVOD_TPU_ADAPT_COOLDOWN": "10",
+            "HOROVOD_TPU_ADAPT_INTERVAL": "0.1",
+        })
+    return env
+
+
+def _read_steps(outdir: str, gen: int, rank: int = 0):
+    path = os.path.join(outdir, f"steps.g{gen}.r{rank}.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def run_straggler_pair(workdir: str, steps: int, commit_every: int) -> dict:
+    """Both arms of the A/B; returns the raw per-arm data."""
+    from horovod_tpu.elastic import FailureConfig, run_elastic
+    from horovod_tpu.runner.api import run as hvd_run
+
+    un_dir = os.path.join(workdir, "unmitigated")
+    ad_dir = os.path.join(workdir, "adaptive")
+    os.makedirs(un_dir)
+    os.makedirs(ad_dir)
+
+    hvd_run(_make_straggler_worker(), args=(un_dir, steps, commit_every),
+            np=STRAGGLER_NP, extra_env=_straggler_env(adaptation=False),
+            start_timeout=300)
+
+    cfg = FailureConfig(failure_timeout_s=60.0, max_restarts=2,
+                        backoff_s=0.2, slow_blacklist_s=600.0)
+    results = run_elastic(
+        _make_straggler_worker(), args=(ad_dir, steps, commit_every),
+        min_np=1, max_np=STRAGGLER_NP, hosts=f"localhost:{STRAGGLER_NP}",
+        state_dir=os.path.join(ad_dir, "estate"), config=cfg,
+        extra_env=_straggler_env(adaptation=True), start_timeout=300)
+
+    # Merged adaptive timeline: per-step rows keyed by step index, the
+    # highest generation's execution winning (a resumed step replays
+    # from the last commit).
+    merged = {}
+    for gen in range(4):
+        for row in _read_steps(ad_dir, gen):
+            prev = merged.get(row["step"])
+            if prev is None or row["gen"] >= prev["gen"]:
+                merged[row["step"]] = row
+    adapt = {}
+    for gen in range(4):
+        p = os.path.join(ad_dir, f"adapt.g{gen}.json")
+        if os.path.exists(p):
+            adapt[f"g{gen}"] = json.load(open(p))
+    return {
+        "unmitigated_steps": _read_steps(un_dir, 0),
+        "adaptive_timeline": [merged[s] for s in sorted(merged)],
+        "adaptation_metrics": adapt,
+        "final_world_size": results[0]["size"] if results else None,
+        "final_generation": results[0]["gen"] if results else None,
+    }
+
+
+def main_straggler(out_path: str, steps: int = STRAGGLER_STEPS) -> dict:
+    import tempfile
+    with tempfile.TemporaryDirectory() as workdir:
+        raw = run_straggler_pair(workdir, steps, STRAGGLER_COMMIT_EVERY)
+    med = lambda xs: sorted(xs)[len(xs) // 2] if xs else None  # noqa: E731
+    un = raw["unmitigated_steps"]
+    tl = raw["adaptive_timeline"]
+    un_steady = med([r["t_ms"] for r in un[len(un) // 2:]])
+    tail = [r["t_ms"] for r in tl if r["gen"] > 0] or [r["t_ms"] for r in tl]
+    rec_steady = med(tail[len(tail) // 2:])
+    # Time-to-recover: first step at least 2x faster than the stalled
+    # steady state, measured from the adaptive run's first step.
+    t_rec = None
+    for r in tl:
+        if un_steady and r["t_ms"] < un_steady / 2.0:
+            t_rec = r["ts"] - tl[0]["ts"]
+            break
+    g0 = raw["adaptation_metrics"].get("g0", {})
+    transitions = g0.get("hvdtpu_adaptation_transitions_total",
+                         {}).get("values", {})
+    evictions = g0.get("hvdtpu_adaptation_evictions_total",
+                       {}).get("values", {})
+    result = {
+        "metric": "straggler_recovery",
+        "np": STRAGGLER_NP,
+        "straggler_rank": STRAGGLER_RANK,
+        "injected_delay_ms": STRAGGLER_DELAY_MS,
+        "steps": steps,
+        "note": ("4-proc fused-allreduce loop, rank "
+                 f"{STRAGGLER_RANK} delayed {STRAGGLER_DELAY_MS}ms/step "
+                 "via HOROVOD_TPU_FAULT_SPEC. Unmitigated: the whole "
+                 "fleet runs at the straggler's pace forever. Adaptive: "
+                 "the policy escalates degradation tiers then evicts the "
+                 "rank; the elastic driver re-rendezvouses at np=3 and "
+                 "resumes from the last commit. World sizes / "
+                 "generations / transition names / eviction target are "
+                 "deterministic; *_ms and *_s are wall-clock — the "
+                 "slow-tier guard asserts recovered_throughput_ratio "
+                 "> 1."),
+        "rows": {
+            "unmitigated": {"steady_step_ms": round(un_steady, 3),
+                            "steps_completed": len(un)},
+            "adaptive": {
+                "recovered_steady_step_ms": round(rec_steady, 3),
+                "steps_completed": len(tl),
+                "final_world_size": raw["final_world_size"],
+                "final_generation": raw["final_generation"],
+            },
+        },
+        "recovered_throughput_ratio": round(un_steady / rec_steady, 3)
+        if un_steady and rec_steady else None,
+        "time_to_recover_s": round(t_rec, 3) if t_rec is not None else None,
+        "adaptation_events": {"transitions": transitions,
+                              "evictions": evictions},
+        "step_timeline": [{"step": r["step"], "gen": r["gen"],
+                           "t_ms": round(r["t_ms"], 3)} for r in tl],
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(result))
+    return result
+
+
 def main():
     sweep = {}
     best = 0.0
@@ -593,6 +814,12 @@ if __name__ == "__main__":
     ap.add_argument("--trace", action="store_true",
                     help="run the all-ranks-tracing overhead A/B and "
                          "write BENCH_TRACE.json")
+    ap.add_argument("--straggler", action="store_true",
+                    help="run the injected-slow-rank A/B (no adaptation "
+                         "vs adaptation + eviction) and write "
+                         "BENCH_STRAGGLER.json")
+    ap.add_argument("--straggler-steps", type=int, default=STRAGGLER_STEPS,
+                    help="training steps per arm for --straggler")
     ap.add_argument("--trace-rounds", type=int, default=TRACE_ROUNDS,
                     help="alternating on/off rounds for --trace")
     ap.add_argument("--steps", type=int, default=50,
@@ -611,5 +838,9 @@ if __name__ == "__main__":
     elif args.trace:
         main_trace(args.out or os.path.join(here, "BENCH_TRACE.json"),
                    rounds=args.trace_rounds)
+    elif args.straggler:
+        main_straggler(args.out or os.path.join(here,
+                                                "BENCH_STRAGGLER.json"),
+                       steps=args.straggler_steps)
     else:
         main()

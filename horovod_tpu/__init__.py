@@ -32,7 +32,8 @@ from .utils.checkpoint import restore_checkpoint, save_checkpoint
 from .checkpoint import CheckpointEngine, CorruptShardError
 from .ops.timeline_jit import (step as timeline_jit_step,
                                merge_profiler_trace)
-from .elastic import ElasticState, WorkerFailure, run_elastic
+from .elastic import (ElasticState, SlowRankFailure, WorkerFailure,
+                      run_elastic)
 from .observability import (get_registry, metrics_snapshot,
                             prometheus_text)
 
@@ -62,8 +63,8 @@ __all__ = [
     "broadcast_optimizer_state", "broadcast_object", "allreduce_gradients",
     "save_checkpoint", "restore_checkpoint",
     "CheckpointEngine", "CorruptShardError",
-    # elastic
-    "ElasticState", "WorkerFailure", "run_elastic",
+    # elastic / adaptation
+    "ElasticState", "WorkerFailure", "SlowRankFailure", "run_elastic",
     # observability
     "metrics_snapshot", "metrics_registry", "prometheus_text",
 ]

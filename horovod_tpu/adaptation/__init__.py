@@ -1,0 +1,26 @@
+"""Self-healing collective plane (docs/adaptation.md).
+
+Two halves of one loop:
+
+  - :mod:`.faults` — deterministic, declarative fault injection
+    (``HOROVOD_TPU_FAULT_SPEC``): slow ranks, mute announces, crashes —
+    the scenarios the adaptation machinery is proven against.
+  - :mod:`.policy` — the rank-0 control loop that escalates
+    graceful-degradation tiers (shrink fused groups → bf16 → int8 →
+    fp8 wire → evict the straggler) on sustained
+    ``hvdtpu_straggler_lateness``, hysteresis-guarded and exported as
+    ``hvdtpu_adaptation_*`` metrics.
+
+The coordinator (ops/control_plane.py) hosts the policy; the eviction
+tier hands off to the elastic driver (elastic/driver.py) through a
+typed :class:`~horovod_tpu.elastic.failure.SlowRankFailure`.
+"""
+
+from .faults import (FAULT_SPEC_ENV, FaultClause, FaultInjector, injector,
+                     parse_spec)
+from .policy import DEFAULT_TIERS, AdaptationConfig, AdaptationPolicy
+
+__all__ = [
+    "FAULT_SPEC_ENV", "FaultClause", "FaultInjector", "injector",
+    "parse_spec", "AdaptationConfig", "AdaptationPolicy", "DEFAULT_TIERS",
+]

@@ -1,0 +1,284 @@
+"""Declarative fault injection — the chaos half of the self-healing loop.
+
+A robustness claim is only as good as the faults it was proven against,
+and real fleets misbehave in ways unit mocks don't: a rank that is
+*slow* rather than dead, a worker whose control-plane announces stop
+arriving while its heartbeat stays alive, a process that dies mid-step.
+This module makes those scenarios first-class and **deterministic**: a
+declarative per-rank spec (``HOROVOD_TPU_FAULT_SPEC``) is parsed once,
+resolved against this process's rank and elastic generation, and hooked
+into exactly three places — the engine enqueue path
+(``CollectiveEngine.enqueue``), the coordinator announce path
+(``CoordinatorClient``), and — through the env contract the elastic
+driver already propagates — every relaunched worker generation.
+
+Grammar (clauses separated by ``;``, fields by ``:``)::
+
+    spec   := clause (';' clause)*
+    clause := field (':' field)*
+    field  := key ['=' value]
+
+    rank=N | rank=*        which process rank the clause targets (required)
+    gen=N                  only active in elastic generation N (default: all)
+    from_step=N            first active tick (default 0)
+    until_step=N           first tick past the window (default: unbounded)
+    delay=80ms             sleep per enqueued collective (the slow rank)
+    slow_h2d=2ms           extra sleep modeling a slow host→device path
+    crash_at=N             SIGKILL self at tick N (the host-loss fault)
+    drop_announce          suppress coordinator announces while active
+                           (mute worker: fetch heartbeat stays alive, so
+                           only the stall detector can name it)
+
+A *tick* is one enqueued collective on this rank — for the common
+one-fused-allreduce-per-step training loop, tick == training step.
+
+Examples::
+
+    HOROVOD_TPU_FAULT_SPEC="rank=2:delay=80ms:from_step=50"
+    HOROVOD_TPU_FAULT_SPEC="rank=1:crash_at=30:gen=0"
+    HOROVOD_TPU_FAULT_SPEC="rank=3:drop_announce:from_step=5; rank=0:slow_h2d=2ms"
+
+Design constraints:
+
+  - OFF BY DEFAULT, ZERO HOT-PATH COST WHEN UNSET: with no spec the
+    process-global injector resolves to ``None`` once and the engine's
+    enqueue path carries a single ``is None`` check.
+  - DETERMINISTIC: ticks count enqueues (not wall time), windows are
+    half-open integer ranges, and the spec is resolved once per process
+    — two runs with the same spec and program inject identically.
+  - OBSERVABLE: every injected fault increments
+    ``hvdtpu_fault_injections_total{kind=}`` so traces/benches can
+    correlate anomalies with injections.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import List, Optional
+
+from ..utils.logging import get_logger
+
+_log = get_logger("adaptation.faults")
+
+FAULT_SPEC_ENV = "HOROVOD_TPU_FAULT_SPEC"
+
+_DURATION_UNITS = (("ms", 1e-3), ("us", 1e-6), ("s", 1.0))
+
+
+def _parse_duration(value: str) -> float:
+    v = value.strip().lower()
+    for suffix, mult in _DURATION_UNITS:
+        if v.endswith(suffix):
+            return float(v[: -len(suffix)]) * mult
+    return float(v)  # bare number = seconds
+
+
+class FaultClause:
+    """One parsed clause of the spec — a set of faults targeted at one
+    rank (or ``*``) over one tick window (and optionally one elastic
+    generation)."""
+
+    __slots__ = ("rank", "gen", "from_step", "until_step", "delay_s",
+                 "slow_h2d_s", "crash_at", "drop_announce")
+
+    def __init__(self):
+        self.rank: Optional[int] = None        # None == '*'
+        self.gen: Optional[int] = None
+        self.from_step = 0
+        self.until_step: Optional[int] = None
+        self.delay_s = 0.0
+        self.slow_h2d_s = 0.0
+        self.crash_at: Optional[int] = None
+        self.drop_announce = False
+
+    def matches(self, rank: int, generation: int) -> bool:
+        if self.rank is not None and self.rank != rank:
+            return False
+        if self.gen is not None and self.gen != generation:
+            return False
+        return True
+
+    def in_window(self, tick: int) -> bool:
+        if tick < self.from_step:
+            return False
+        return self.until_step is None or tick < self.until_step
+
+    def __repr__(self):  # readable in logs/tests
+        parts = [f"rank={'*' if self.rank is None else self.rank}"]
+        if self.gen is not None:
+            parts.append(f"gen={self.gen}")
+        if self.delay_s:
+            parts.append(f"delay={self.delay_s * 1e3:g}ms")
+        if self.slow_h2d_s:
+            parts.append(f"slow_h2d={self.slow_h2d_s * 1e3:g}ms")
+        if self.crash_at is not None:
+            parts.append(f"crash_at={self.crash_at}")
+        if self.drop_announce:
+            parts.append("drop_announce")
+        if self.from_step:
+            parts.append(f"from_step={self.from_step}")
+        if self.until_step is not None:
+            parts.append(f"until_step={self.until_step}")
+        return ":".join(parts)
+
+
+def parse_spec(text: str) -> List[FaultClause]:
+    """Parse a full ``HOROVOD_TPU_FAULT_SPEC`` value. Malformed specs
+    raise ``ValueError`` naming the offending field — a typo'd fault
+    harness must fail loudly at startup, not silently inject nothing."""
+    clauses: List[FaultClause] = []
+    for raw in text.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        c = FaultClause()
+        saw_rank = False
+        for field in raw.split(":"):
+            field = field.strip()
+            if not field:
+                continue
+            key, sep, value = field.partition("=")
+            key = key.strip()
+            value = value.strip()
+            if key == "rank":
+                saw_rank = True
+                c.rank = None if value == "*" else int(value)
+            elif key == "gen":
+                c.gen = int(value)
+            elif key == "from_step":
+                c.from_step = int(value)
+            elif key == "until_step":
+                c.until_step = int(value)
+            elif key == "delay":
+                c.delay_s = _parse_duration(value)
+            elif key == "slow_h2d":
+                c.slow_h2d_s = _parse_duration(value)
+            elif key == "crash_at":
+                c.crash_at = int(value)
+            elif key == "drop_announce":
+                if sep and value not in ("", "1", "true"):
+                    raise ValueError(
+                        f"drop_announce takes no value, got {value!r}")
+                c.drop_announce = True
+            else:
+                raise ValueError(
+                    f"unknown fault-spec field {key!r} in clause {raw!r} "
+                    "(expected rank/gen/from_step/until_step/delay/"
+                    "slow_h2d/crash_at/drop_announce)")
+        if not saw_rank:
+            raise ValueError(
+                f"fault-spec clause {raw!r} is missing the required "
+                "rank= field (use rank=* to target every rank)")
+        clauses.append(c)
+    return clauses
+
+
+class FaultInjector:
+    """Per-process injector: the clauses of the spec that target this
+    (rank, generation), plus the tick counter the windows are evaluated
+    against. Hook points:
+
+      - :meth:`on_enqueue` — the engine calls this once per enqueued
+        collective (delay / slow_h2d / crash_at).
+      - :meth:`drop_announce_active` — the coordinator client consults
+        this before each announce leg (mute-worker fault).
+    """
+
+    def __init__(self, clauses: List[FaultClause], rank: int,
+                 generation: int = 0):
+        self.rank = int(rank)
+        self.generation = int(generation)
+        self.clauses = [c for c in clauses
+                        if c.matches(self.rank, self.generation)]
+        self._tick = 0
+        # Metric handles resolved once (docs/metrics.md); label children
+        # cached since the kinds are a tiny fixed set.
+        from ..observability import registry as _obs
+        fam = _obs.registry().counter(
+            "hvdtpu_fault_injections_total",
+            "Faults injected by the HOROVOD_TPU_FAULT_SPEC harness, "
+            "by kind")
+        self._m = {k: fam.labels(kind=k)
+                   for k in ("delay", "slow_h2d", "crash", "drop_announce")}
+        if self.clauses:
+            _log.warning("fault injection ARMED for rank %d gen %d: %s",
+                         self.rank, self.generation,
+                         "; ".join(map(repr, self.clauses)))
+
+    @property
+    def tick(self) -> int:
+        return self._tick
+
+    def on_enqueue(self) -> None:
+        """One collective enqueued: advance the tick and apply any
+        active delay/slow_h2d/crash faults."""
+        t = self._tick
+        self._tick = t + 1
+        for c in self.clauses:
+            if c.crash_at is not None and t == c.crash_at:
+                self._m["crash"].inc()
+                _log.error("fault injection: crash_at=%d reached on "
+                           "rank %d — SIGKILL self", t, self.rank)
+                os.kill(os.getpid(), signal.SIGKILL)
+            if not c.in_window(t):
+                continue
+            if c.delay_s > 0.0:
+                self._m["delay"].inc()
+                time.sleep(c.delay_s)
+            if c.slow_h2d_s > 0.0:
+                self._m["slow_h2d"].inc()
+                time.sleep(c.slow_h2d_s)
+
+    def drop_announce_active(self) -> bool:
+        """True while a drop_announce clause's window covers the current
+        tick — the coordinator client then suppresses the announce leg
+        (the fetch heartbeat deliberately stays alive: only the stall
+        detector can catch a mute-but-breathing worker)."""
+        for c in self.clauses:
+            if c.drop_announce and c.in_window(self._tick):
+                self._m["drop_announce"].inc()
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Process-global resolution — once, lazily, off by default.
+# ---------------------------------------------------------------------------
+
+_resolved = False
+_injector: Optional[FaultInjector] = None
+
+
+def injector() -> Optional[FaultInjector]:
+    """The process's injector, or None when HOROVOD_TPU_FAULT_SPEC is
+    unset / targets other ranks. Resolved once; callers cache the result
+    so the disabled path is a single ``is None`` check."""
+    global _resolved, _injector
+    if _resolved:
+        return _injector
+    from ..utils import env as _env
+    text = _env.fault_spec()
+    if not text:
+        _resolved = True
+        return None
+    clauses = parse_spec(text)
+    try:
+        from .. import topology as _topo
+        rank = _topo._get().process_index
+    except Exception:
+        rank = int(os.environ.get("HOROVOD_TPU_PROCESS_ID", "0") or 0)
+    gen = int(os.environ.get("HOROVOD_TPU_ELASTIC_GENERATION", "0") or 0)
+    inj = FaultInjector(clauses, rank=rank, generation=gen)
+    _injector = inj if inj.clauses else None
+    _resolved = True
+    return _injector
+
+
+def reset() -> None:
+    """Test hook: forget the resolved injector so the next ``injector()``
+    call re-reads the env (mirrors reset_engine())."""
+    global _resolved, _injector
+    _resolved = False
+    _injector = None

@@ -1,0 +1,241 @@
+"""Closed-loop adaptation policy — the rank-0 control loop that ACTS on
+the straggler telemetry PR 5 only measured.
+
+The coordinator's :class:`~horovod_tpu.ops.control_plane._SkewTracker`
+already elects a straggler and quantifies its decay-weighted negotiate
+lateness. This module turns that signal into graceful degradation: on
+sustained lateness above a threshold the policy climbs a ladder of
+tiers, each trading a little fidelity or fusion efficiency for less
+time spent waiting on the slowest rank —
+
+  ``shrink``      cut the fusion threshold (smaller fused groups →
+                  shorter quanta → less head-of-line blocking behind a
+                  late announce),
+  ``bf16``        transport allreduce groups as bf16 casts,
+  ``int8x256``    block-scaled int8 quantized wire (EQuARX-style,
+                  riding the existing ``wire=`` fused path),
+  ``fp8x256``     block-scaled fp8 wire — the most aggressive format,
+  ``evict``       mark the straggler unhealthy: a ``slow_rank`` failure
+                  event ships through the fetch side-channel, every
+                  engine fails its pending handles with a typed
+                  :class:`~horovod_tpu.elastic.failure.SlowRankFailure`,
+                  and the elastic driver re-rendezvouses without the
+                  host — a fleet-wide stall becomes a bounded
+                  throughput dip.
+
+Every transition is hysteresis-guarded: escalation requires the
+lateness to stay above ``threshold_s`` for ``sustain_s`` (per step of
+the ladder), de-escalation requires it below ``threshold_s *
+deescalate_ratio`` for ``cooldown_s`` (per step, reverse order — the
+ladder unwinds monotonically). Between the two bands the clocks reset,
+so a borderline-slow rank produces NO flapping. Transitions are logged
+as structured ``adaptation_event`` lines and exported as
+``hvdtpu_adaptation_*`` metrics so the trace CLI and dashboards can
+show *when* the system adapted.
+
+The policy itself is a pure, deterministically-testable state machine
+(:meth:`AdaptationPolicy.observe` takes the lateness map and a
+timestamp and returns transition events); the coordinator glue that
+applies the events lives in ops/control_plane.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from ..observability import registry as _obs
+from ..utils import env as _env
+from ..utils.logging import get_logger
+
+_log = get_logger("adaptation.policy")
+
+# Ladder entries that select a wire transport (vs structural actions).
+_WIRE_TIERS = ("bf16", "int8x256", "fp8x256")
+DEFAULT_TIERS = ("shrink", "bf16", "int8x256", "fp8x256", "evict")
+
+
+@dataclasses.dataclass
+class AdaptationConfig:
+    """Knobs of the degradation ladder (env: HOROVOD_TPU_ADAPT_*)."""
+
+    threshold_s: float = 0.1       # lateness that starts the sustain clock
+    sustain_s: float = 5.0         # above threshold this long per escalation
+    cooldown_s: float = 30.0       # below the low band this long per de-esc
+    interval_s: float = 1.0        # evaluation cadence
+    deescalate_ratio: float = 0.5  # low band = threshold * ratio
+    shrink_factor: int = 4         # fusion-threshold divisor for 'shrink'
+    tiers: Tuple[str, ...] = DEFAULT_TIERS
+
+    @classmethod
+    def from_env(cls) -> "AdaptationConfig":
+        tiers = _env.adapt_tiers()
+        return cls(
+            threshold_s=_env.adapt_threshold_s(),
+            sustain_s=_env.adapt_sustain_s(),
+            cooldown_s=_env.adapt_cooldown_s(),
+            interval_s=_env.adapt_interval_s(),
+            tiers=tuple(t.strip() for t in tiers.split(",") if t.strip())
+            if tiers else DEFAULT_TIERS)
+
+
+class AdaptationPolicy:
+    """Hysteresis-guarded tier ladder over the straggler-lateness signal.
+
+    ``tier`` is 0 (baseline) .. len(tiers); tier k means tiers[:k] are
+    active. ``observe(lateness_by_rank, now)`` advances the state
+    machine and returns the transitions taken this call as event dicts
+    (``{"action", "tier", "name", "rank", "lateness_s"}``) — the
+    coordinator applies them; tests drive it with synthetic clocks."""
+
+    def __init__(self, config: Optional[AdaptationConfig] = None,
+                 allow_evict: bool = True):
+        self.config = config or AdaptationConfig()
+        # Eviction needs the elastic failure plane (it kills the job on
+        # a fixed-world run); the coordinator passes allow_evict=False
+        # when HOROVOD_TPU_FAILURE_TIMEOUT is not armed.
+        self.allow_evict = allow_evict
+        self.tier = 0
+        self.evicted: set = set()
+        self._above_since: Optional[float] = None
+        self._below_since: Optional[float] = None
+        r = _obs.registry()
+        self._m_tier = r.gauge(
+            "hvdtpu_adaptation_tier",
+            "Active degradation tier (0 = baseline; N = the first N "
+            "ladder entries are active)").labels()
+        self._m_transitions = r.counter(
+            "hvdtpu_adaptation_transitions_total",
+            "Degradation-ladder transitions, by direction and tier name")
+        self._m_lateness = r.gauge(
+            "hvdtpu_adaptation_lateness_seconds",
+            "Worst-rank decayed lateness at the last policy "
+            "evaluation").labels()
+        self._m_straggler = r.gauge(
+            "hvdtpu_adaptation_straggler_rank",
+            "Rank the policy currently considers the straggler "
+            "(-1: none)").labels()
+        self._m_wire = r.gauge(
+            "hvdtpu_adaptation_wire_active",
+            "1 for the wire spec the policy currently imposes on fused "
+            "allreduce groups (raw = no override)")
+        self._m_evictions = r.counter(
+            "hvdtpu_adaptation_evictions_total",
+            "Slow-rank evictions requested by the policy, by rank")
+        self._m_tier.set(0)
+        self._m_straggler.set(-1)
+        self._set_wire_gauge()
+
+    # ----------------------------------------------------------- derived
+
+    def active_tiers(self) -> Tuple[str, ...]:
+        return self.config.tiers[: self.tier]
+
+    def wire_spec(self) -> Optional[str]:
+        """Wire transport the current tier imposes (the STRONGEST active
+        wire entry), or None for raw."""
+        spec = None
+        for t in self.active_tiers():
+            if t in _WIRE_TIERS:
+                spec = t
+        return spec
+
+    def shrink_active(self) -> bool:
+        return "shrink" in self.active_tiers()
+
+    def _set_wire_gauge(self) -> None:
+        self._m_wire.clear()
+        self._m_wire.labels(spec=self.wire_spec() or "raw").set(1)
+
+    # ------------------------------------------------------------- clock
+
+    def observe(self, lateness_by_rank: Dict[int, float],
+                now: float) -> List[dict]:
+        """Advance the ladder given the current per-rank decayed
+        lateness; returns the transition events taken (possibly empty,
+        never more than one per call — one hysteresis window per
+        step keeps the escalation rate bounded and observable)."""
+        cfg = self.config
+        live = {r: v for r, v in lateness_by_rank.items()
+                if r not in self.evicted}
+        worst_rank = max(live, key=live.get) if live else -1
+        lateness = live.get(worst_rank, 0.0)
+        self._m_lateness.set(lateness)
+        self._m_straggler.set(worst_rank if lateness > 0 else -1)
+
+        events: List[dict] = []
+        if lateness >= cfg.threshold_s:
+            self._below_since = None
+            if self._above_since is None:
+                self._above_since = now
+            elif now - self._above_since >= cfg.sustain_s:
+                ev = self._escalate(worst_rank, lateness, now)
+                if ev is not None:
+                    events.append(ev)
+                # Each further step needs its own full sustain window.
+                self._above_since = now
+        elif lateness < cfg.threshold_s * cfg.deescalate_ratio:
+            self._above_since = None
+            if self._below_since is None:
+                self._below_since = now
+            elif now - self._below_since >= cfg.cooldown_s:
+                ev = self._deescalate(lateness, now)
+                if ev is not None:
+                    events.append(ev)
+                self._below_since = now
+        else:
+            # Hysteresis band: hold state, restart both clocks — a
+            # borderline-slow rank neither escalates nor unwinds.
+            self._above_since = None
+            self._below_since = None
+        return events
+
+    def _escalate(self, rank: int, lateness: float, now: float
+                  ) -> Optional[dict]:
+        if self.tier >= len(self.config.tiers):
+            return None
+        name = self.config.tiers[self.tier]
+        if name == "evict":
+            if not self.allow_evict or rank < 0:
+                return None   # ladder capped below eviction
+            # Edge-triggered, NOT a persistent tier: the straggler is
+            # removed from the signal, the degradation tiers below stay
+            # until the cooldown unwinds them, and a SECOND straggler
+            # sustaining lateness earns its own eviction after its own
+            # sustain window.
+            self.evicted.add(rank)
+            self._m_evictions.labels(rank=str(rank)).inc()
+            self._m_transitions.labels(action="escalate", tier=name).inc()
+            _log.warning(
+                "adaptation_event action=evict rank=%d lateness_ms=%.1f",
+                rank, lateness * 1e3)
+            return {"action": "escalate", "tier": self.tier,
+                    "name": name, "rank": rank, "lateness_s": lateness}
+        self.tier += 1
+        self._m_tier.set(self.tier)
+        self._m_transitions.labels(action="escalate", tier=name).inc()
+        ev = {"action": "escalate", "tier": self.tier, "name": name,
+              "rank": rank, "lateness_s": lateness}
+        self._set_wire_gauge()
+        _log.warning(
+            "adaptation_event action=escalate tier=%d name=%s rank=%d "
+            "lateness_ms=%.1f", self.tier, name, rank, lateness * 1e3)
+        return ev
+
+    def _deescalate(self, lateness: float, now: float) -> Optional[dict]:
+        if self.tier <= 0:
+            return None
+        name = self.config.tiers[self.tier - 1]
+        if name == "evict":
+            # Eviction is not unwound by the ladder — readmission is the
+            # elastic driver's probe/backoff story (docs/elastic.md).
+            return None
+        self.tier -= 1
+        self._m_tier.set(self.tier)
+        self._m_transitions.labels(action="deescalate", tier=name).inc()
+        self._set_wire_gauge()
+        _log.warning(
+            "adaptation_event action=deescalate tier=%d dropped=%s "
+            "lateness_ms=%.1f", self.tier, name, lateness * 1e3)
+        return {"action": "deescalate", "tier": self.tier, "name": name,
+                "rank": -1, "lateness_s": lateness}

@@ -20,14 +20,17 @@ Four pieces (see docs/elastic.md for the full contract):
 """
 
 from .discovery import (HostfileProvider, HostProvider, SSHProbeProvider,
-                        StaticProvider, TPUPodProvider, get_provider)
-from .failure import FailureConfig, FailureDetector, WorkerFailure
+                        StaticProvider, TPUPodProvider, get_provider,
+                        host_alive)
+from .failure import (FailureConfig, FailureDetector, SlowRankFailure,
+                      WorkerFailure, failure_from_event)
 from .state import ElasticState
 from .driver import generation, run_elastic, run_elastic_command
 
 __all__ = [
     "HostProvider", "StaticProvider", "HostfileProvider",
-    "SSHProbeProvider", "TPUPodProvider", "get_provider",
-    "WorkerFailure", "FailureConfig", "FailureDetector",
+    "SSHProbeProvider", "TPUPodProvider", "get_provider", "host_alive",
+    "WorkerFailure", "SlowRankFailure", "failure_from_event",
+    "FailureConfig", "FailureDetector",
     "ElasticState", "run_elastic", "run_elastic_command", "generation",
 ]

@@ -134,6 +134,17 @@ def _ssh_alive(host: str, connect_timeout: float = 5.0) -> bool:
         return False
 
 
+def host_alive(host: str, connect_timeout: float = 5.0) -> bool:
+    """Readmission probe (docs/adaptation.md): is ``host`` worth
+    offering slots on again? Local names are trivially alive (the
+    launcher spawns plain subprocesses there); remote ones get the ssh
+    reachability probe. Used by the elastic driver's blacklist expiry
+    so an evicted-then-recovered host grows back in, while a
+    still-dead one has its penalty renewed with backoff."""
+    from ..runner.launcher import is_local_host
+    return is_local_host(host) or _ssh_alive(host, connect_timeout)
+
+
 class SSHProbeProvider(HostProvider):
     """Candidate hosts filtered to the ssh-reachable subset.
 

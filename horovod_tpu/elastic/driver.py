@@ -49,7 +49,8 @@ from ..runner.secret import SECRET_ENV, encode_key, make_secret_key
 from ..runner.timeout import Timeout
 from ..utils.logging import get_logger
 from .discovery import HostProvider, HostSlots, get_provider
-from .failure import FailureConfig, FailureDetector, WorkerFailure
+from .failure import (FailureConfig, FailureDetector, SlowRankFailure,
+                      WorkerFailure)
 from .state import ELASTIC_DIR_ENV
 
 _log = get_logger("elastic.driver")
@@ -101,28 +102,56 @@ FAILURE_TIMEOUT_ENV = "HOROVOD_TPU_FAILURE_TIMEOUT"
 
 
 class _SlotPenalties:
-    """Per-host lost-slot ledger with expiry.
+    """Per-host lost-slot ledger with expiry and readmission probing.
 
     A failure on ``host`` removes ONE slot there (not the whole host:
     a single-host job that loses one of two local workers must shrink
-    to one, not to zero) until ``blacklist_s`` passes — at which point
-    the slot is offered again and the world can grow back."""
+    to one, not to zero) until its window passes — at which point the
+    slot is offered again and the world can grow back. Each penalty
+    carries its own window (crash vs slow-rank blacklists differ).
 
-    def __init__(self, blacklist_s: float):
+    With a ``probe`` (host -> bool), an EXPIRED penalty is only lifted
+    once the probe passes; a failing probe renews it with the window
+    scaled by ``backoff_factor`` (capped at ``max_blacklist_s``), so a
+    host that stays sick is re-checked ever more lazily instead of
+    flapping in and out of the membership."""
+
+    def __init__(self, blacklist_s: float, probe=None,
+                 backoff_factor: float = 2.0,
+                 max_blacklist_s: float = 1800.0):
         self._blacklist_s = blacklist_s
-        self._until: Dict[str, List[float]] = {}
+        self._probe = probe
+        self._backoff_factor = backoff_factor
+        self._max_blacklist_s = max_blacklist_s
+        # host -> [[expiry, window_s], ...]
+        self._until: Dict[str, List[List[float]]] = {}
 
-    def penalize(self, host: Optional[str]) -> None:
+    def penalize(self, host: Optional[str],
+                 window_s: Optional[float] = None) -> None:
         if host is None:
             return
-        self._until.setdefault(host, []).append(
-            time.monotonic() + self._blacklist_s)
+        w = self._blacklist_s if window_s is None else window_s
+        self._until.setdefault(host, []).append([time.monotonic() + w, w])
 
     def apply(self, slots: HostSlots) -> HostSlots:
         now = time.monotonic()
         out: HostSlots = []
         for host, n in slots:
-            pend = [t for t in self._until.get(host, []) if t > now]
+            pend: List[List[float]] = []
+            for expiry, window in self._until.get(host, []):
+                if expiry > now:
+                    pend.append([expiry, window])
+                    continue
+                if self._probe is not None and not self._probe(host):
+                    # Still sick: renew with backoff instead of
+                    # readmitting a host that would fail again.
+                    window = min(window * self._backoff_factor,
+                                 self._max_blacklist_s)
+                    _log.warning(
+                        "readmission probe failed for %s; re-penalizing "
+                        "for %.0fs", host, window)
+                    pend.append([now + window, window])
+                # probe passed (or no probe): penalty lifted
             self._until[host] = pend
             n = max(0, n - len(pend))
             if n > 0:
@@ -193,8 +222,16 @@ def _run_generation(fn_bytes: bytes, np_now: int, hosts_str: str,
             total = Timeout(
                 run_timeout if run_timeout is not None else 10 ** 9,
                 "Timed out after {timeout} s waiting for results.")
-            results = driver.wait_for_results(total,
-                                              failfast=detector.check)
+            try:
+                results = driver.wait_for_results(total,
+                                                  failfast=detector.check)
+            except WorkerFailure as wf:
+                # Typed failure registered by a worker (e.g. a
+                # slow_rank eviction): attribute the host so the loop
+                # can penalize the right slot.
+                if wf.host is None and 0 <= wf.rank < len(rank_hosts):
+                    wf.host = rank_hosts[wf.rank]
+                raise
             with contextlib.suppress(TimeoutError):
                 job.wait(timeout=60)
             return results
@@ -211,7 +248,10 @@ def _elastic_loop(provider: HostProvider, min_np: int,
     """Shared discover → attempt → penalize/backoff loop for function
     and command mode. ``attempt(np, hosts_str, rank_hosts, generation)``
     returns the job result or raises WorkerFailure."""
-    penalties = _SlotPenalties(config.blacklist_s)
+    penalties = _SlotPenalties(
+        config.blacklist_s, probe=config.readmit_probe,
+        backoff_factor=config.readmit_backoff_factor,
+        max_blacklist_s=config.max_blacklist_s)
     metrics = _ElasticMetrics()
     generation = 0
     restarts = 0
@@ -259,7 +299,13 @@ def _elastic_loop(provider: HostProvider, min_np: int,
             if restarts >= config.max_restarts:
                 raise
             restarts += 1
-            penalties.penalize(wf.host)
+            # Slow-rank evictions (docs/adaptation.md) get the SHORT
+            # blacklist window: the host is alive, just late — the
+            # readmission probe decides when it grows back in.
+            penalties.penalize(
+                wf.host,
+                window_s=(config.slow_blacklist_s
+                          if isinstance(wf, SlowRankFailure) else None))
             _log.warning(
                 "%s; shrinking and relaunching in %.1fs "
                 "(restart %d/%d)", wf, backoff, restarts,

@@ -32,7 +32,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 from ..ops.collective import HorovodInternalError
 
@@ -56,8 +56,31 @@ class WorkerFailure(HorovodInternalError):
             + (f" — {detail}" if detail else ""))
 
     def __reduce__(self):  # exceptions with kw-ish init need explicit pickle
-        return (WorkerFailure, (self.rank, self.host, self.kind,
-                                self.detail))
+        return (type(self), (self.rank, self.host, self.kind,
+                             self.detail))
+
+
+class SlowRankFailure(WorkerFailure):
+    """A rank evicted by the adaptation policy (docs/adaptation.md):
+    alive but persistently too late for every fused collective, after
+    the graceful-degradation ladder failed to absorb it. The elastic
+    driver dispatches on the type — the host gets the SHORT slow-rank
+    blacklist window and a readmission probe instead of the crash
+    blacklist, because a slow host (thermal throttle, noisy neighbor,
+    flaky NIC) often recovers and should grow back in."""
+
+    def __init__(self, rank: int = -1, host: Optional[str] = None,
+                 kind: str = "slow_rank", detail: str = ""):
+        super().__init__(rank=rank, host=host, kind=kind, detail=detail)
+
+
+def failure_from_event(event: dict) -> WorkerFailure:
+    """Typed WorkerFailure from a coordinator failure event dict
+    (``{rank, kind, detail}`` — the fetch side-channel's shape)."""
+    kind = str(event.get("kind", "unknown"))
+    cls = SlowRankFailure if kind == "slow_rank" else WorkerFailure
+    return cls(rank=int(event.get("rank", -1)), kind=kind,
+               detail=str(event.get("detail", "")))
 
 
 @dataclasses.dataclass
@@ -70,7 +93,17 @@ class FailureConfig:
     detector escalate to :class:`WorkerFailure` instead of warning.
     ``max_restarts`` bounds relaunch attempts; the backoff fields pace
     them; ``blacklist_s`` is how long a failed host's lost slot stays
-    excluded before the driver lets it grow back in."""
+    excluded before the driver lets it grow back in.
+
+    Slow-rank eviction (docs/adaptation.md): a
+    :class:`SlowRankFailure` penalizes its host for the shorter
+    ``slow_blacklist_s`` window. When a penalty expires and
+    ``readmit_probe`` is set (a ``host -> bool`` callable, e.g.
+    :func:`horovod_tpu.elastic.discovery.host_alive`), the slot only
+    returns if the probe passes; a failed probe renews the penalty with
+    the window scaled by ``readmit_backoff_factor`` (capped at
+    ``max_blacklist_s``) — a still-sick host is re-probed ever more
+    lazily instead of flapping in and out of the world."""
 
     failure_timeout_s: float = 30.0
     max_restarts: int = 3
@@ -79,6 +112,10 @@ class FailureConfig:
     max_backoff_s: float = 30.0
     blacklist_s: float = 300.0
     poll_interval_s: float = 0.2
+    slow_blacklist_s: float = 60.0
+    readmit_probe: Optional[Callable[[str], bool]] = None
+    readmit_backoff_factor: float = 2.0
+    max_blacklist_s: float = 1800.0
 
     def next_backoff(self, current: float) -> float:
         return min(max(current, self.backoff_s) * self.backoff_factor,

@@ -112,6 +112,18 @@ class _EngineMetrics:
             "hvdtpu_engine_stalled_tensor_seconds",
             "Seconds each stalled tensor has waited, labeled with the "
             "coordinator's missing-ranks report when available")
+        self._adapted = r.counter(
+            "hvdtpu_adaptation_applied_groups_total",
+            "Fused allreduce groups executed under a policy wire "
+            "override, by spec (docs/adaptation.md)")
+        self._adapted_children: Dict[str, object] = {}
+
+    def adapted_group(self, spec: str) -> None:
+        child = self._adapted_children.get(spec)
+        if child is None:
+            child = self._adapted.labels(spec=spec)
+            self._adapted_children[spec] = child
+        child.inc()
 
     def wire_bytes(self, spec, nbytes: int) -> None:
         child = self._wire_children.get(spec)
@@ -416,6 +428,20 @@ class CollectiveEngine:
         self._mp_client = None
         self._mp_service = None
         self._announced: set = set()
+        # Fault harness (docs/adaptation.md): resolved once on first
+        # enqueue; None (the default, no HOROVOD_TPU_FAULT_SPEC) keeps
+        # the hot path at a single attribute check.
+        self._faults = None
+        self._faults_tried = False
+        # Policy wire-override epochs from the coordinator's params
+        # side-channel: [(from_seq, spec)] — groups with seq >= from_seq
+        # execute with spec ('' = raw). Seq-keyed so every process flips
+        # at the same group boundary (docs/adaptation.md).
+        self._wire_epochs: List = []
+        # Delivered-group counter for the native MP path (group
+        # callbacks arrive in coordinator-seq order but carry no seq on
+        # the wire) — mirrors the fallback path's group['seq'].
+        self._mp_group_seq = 0
 
     # ------------------------------------------------------------- lifecycle
 
@@ -700,6 +726,15 @@ class CollectiveEngine:
             # tests use reset_engine() to get a fresh one.
             raise HorovodInternalError(
                 SHUT_DOWN_ERROR.format(op=_op_name(req.op)))
+        if not self._faults_tried:
+            # Fault harness (docs/adaptation.md), resolved once: with no
+            # HOROVOD_TPU_FAULT_SPEC the enqueue path keeps exactly one
+            # attribute check.
+            self._faults_tried = True
+            from ..adaptation import faults as _faults_mod
+            self._faults = _faults_mod.injector()
+        if self._faults is not None:
+            self._faults.on_enqueue()
         self.wire_bytes_enqueued += req.nbytes
         self._metrics.wire_bytes(req.wire, req.nbytes)
         self._metrics.ops[req.op].inc()
@@ -840,17 +875,26 @@ class CollectiveEngine:
             # fail every in-flight handle with the TYPED event — the
             # elastic driver (or any caller) dispatches on
             # WorkerFailure.rank/host/kind instead of parsing log text.
-            from ..elastic.failure import WorkerFailure
-            f = failures[0]
-            err = WorkerFailure(rank=int(f.get("rank", -1)),
-                                kind=str(f.get("kind", "unknown")),
-                                detail="; ".join(
-                                    str(x.get("detail", "")) for x in failures))
+            from ..elastic.failure import failure_from_event
+            f = dict(failures[0])
+            f["detail"] = "; ".join(
+                str(x.get("detail", "")) for x in failures)
+            # Typed construction: a slow_rank event becomes a
+            # SlowRankFailure so the elastic driver can apply the
+            # slow-rank blacklist window instead of the crash one.
+            err = failure_from_event(f)
             _log.error("coordinator escalated worker failure: %s", err)
             self._fail_native_pending(err)
             self._fail_all(err)
         params = resp.params
         if params:
+            we = params.get("wire_epochs")
+            if we:
+                # Policy wire-override epochs (docs/adaptation.md):
+                # replace wholesale — the coordinator ships the full
+                # (small) list every fetch, so a late joiner catches up
+                # in one response.
+                self._wire_epochs = [(int(s), str(sp)) for s, sp in we]
             cyc = params.get("cycle_time_ms")
             if cyc and abs(cyc - self.cycle_time_s * 1000.0) > 1e-9:
                 self.cycle_time_s = cyc / 1000.0
@@ -914,8 +958,7 @@ class CollectiveEngine:
                 resp = client.fetch(wait_s=wait)
         except BaseException as e:
             _log.error("multi-process control plane failed: %s", e)
-            self._fail_native_pending(HorovodInternalError(
-                f"multi-process control plane failed: {e}"))
+            self._fail_native_pending(_as_error(e))
             return b""
         self._apply_fetch_side_channel(resp)
         return resp.payload or b""
@@ -929,6 +972,12 @@ class CollectiveEngine:
         if core is None:
             return
         t_deliver = time.monotonic()
+        # Coordinator seq of this group: callbacks fire in seq order and
+        # exactly once per group, so a local counter mirrors it (the
+        # native wire carries no seq field) — keys the policy's
+        # wire-override epochs identically to the fallback path.
+        group_seq = self._mp_group_seq
+        self._mp_group_seq += 1
         with self._lock:
             pairs = [(i, self._native_pending.pop(i))
                      for i in native_ids if i in self._native_pending]
@@ -966,7 +1015,7 @@ class CollectiveEngine:
         if op == ALLGATHER and len(sizes) == nnames * nproc:
             for j, (_, r) in enumerate(pairs):
                 sizes_of[r.name] = sizes[j * nproc:(j + 1) * nproc]
-        meta = {"sizes": sizes_of}
+        meta = {"sizes": sizes_of, "seq": group_seq}
         ex = self.executor
         # Plan-time flags rule execution for THIS group on every process —
         # the engine thread is the only executor user, so the flip is safe.
@@ -1337,9 +1386,27 @@ class CollectiveEngine:
             post = group[0].postscale
             if group[0].average:
                 post = post / ex.world_size
-            return ex.allreduce_fused_mp(
-                [r.tensor for r in group], prescale=group[0].prescale,
-                postscale=post, wire=group[0].wire)
+            wire = group[0].wire
+            tensors = [r.tensor for r in group]
+            restore = None
+            if wire is None:
+                # Policy wire override (docs/adaptation.md), keyed on
+                # the coordinator seq so every process flips at the
+                # same group boundary. 'bf16' is a cast transport (the
+                # fused program moves bf16); the blockwise specs ride
+                # the executor's quantized wire path.
+                ov = self._wire_override_for(meta.get("seq"), group)
+                if ov == "bf16":
+                    restore = [t.dtype for t in tensors]
+                    tensors = [t.astype(jnp.bfloat16) for t in tensors]
+                elif ov:
+                    wire = ov
+            outs = ex.allreduce_fused_mp(
+                tensors, prescale=group[0].prescale,
+                postscale=post, wire=wire)
+            if restore is not None:
+                outs = [o.astype(dt) for o, dt in zip(outs, restore)]
+            return outs
         if op == BROADCAST:
             if group[0].sharded:
                 return [ex.broadcast_sharded(r.tensor, r.root_rank)
@@ -1370,6 +1437,32 @@ class CollectiveEngine:
                     outs.append(ex.allgather_ragged_mp(r.tensor, dev_dims))
             return outs
         raise ValueError(f"unknown op {op}")
+
+    def _wire_override_for(self, seq, group) -> Optional[str]:
+        """Wire spec the policy's epoch list imposes on this fused
+        allreduce group, or None. Epochs are [(from_seq, spec)] in
+        ascending from_seq order; the last epoch at or below ``seq``
+        wins ('' = back to raw). Only clean floating full-precision
+        groups are eligible — an explicit user wire spec, sharded
+        arrays, and non-float dtypes are left untouched."""
+        epochs = self._wire_epochs
+        if not epochs or seq is None:
+            return None
+        spec = None
+        for fs, sp in epochs:
+            if seq >= fs:
+                spec = sp
+            else:
+                break
+        if not spec:
+            return None
+        for r in group:
+            t = r.tensor
+            if (t is None or r.sharded or r.wire is not None
+                    or not jnp.issubdtype(t.dtype, jnp.floating)):
+                return None
+        self._metrics.adapted_group(spec)
+        return spec
 
     def _maybe_check_stalls(self):
         """Stall detector (CheckForStalledTensors, operations.cc:1625-1672):
@@ -1708,6 +1801,14 @@ def _xla_activity(op: int) -> str:
 def _as_error(e: BaseException) -> BaseException:
     if isinstance(e, (ValueError, TypeError, HorovodInternalError)):
         return e
+    from .control_plane import CoordinatorUnreachableError
+    if isinstance(e, CoordinatorUnreachableError):
+        # Typed for the elastic plane: a dead rank-0 process is a
+        # recoverable worker loss (the driver re-rendezvouses), not an
+        # anonymous internal error.
+        from ..elastic.failure import WorkerFailure
+        return WorkerFailure(rank=0, kind="coordinator_unreachable",
+                             detail=str(e))
     return HorovodInternalError(str(e))
 
 

@@ -65,6 +65,16 @@ PLAN_MAX_DEFER_FACTOR = 10.0
 
 CONTROL_ENV = "HOROVOD_TPU_CONTROL"
 
+
+class CoordinatorUnreachableError(ConnectionError):
+    """The rank-0 coordinator could not be reached after the bounded
+    retry/backoff schedule. Subclasses ConnectionError so existing
+    transport-failure handlers keep working, while giving callers (the
+    engine, the elastic plane) a typed event to dispatch on instead of
+    a generic socket error — a worker polling a dead or restarting
+    coordinator surfaces this in seconds with an actionable message,
+    rather than hanging or dying with a bare ECONNREFUSED."""
+
 # Wire op enums shared with the engine (executor.ALLREDUCE etc.).
 _OP_NAMES = {0: "allreduce", 1: "allgather", 2: "broadcast"}
 
@@ -235,6 +245,15 @@ class _SkewTracker:
         self._m_lateness_total = r.counter(
             "hvdtpu_negotiate_lateness_seconds_total",
             "Cumulative announce-lateness seconds by rank")
+        # Re-key on (re-)rendezvous: these families are labeled by rank
+        # under the CURRENT world size. A new tracker means a new world
+        # (elastic shrink/grow, or a reset coordinator in tests) — an
+        # evicted rank's per-rank children lingering in the export would
+        # keep naming it as the straggler forever, so the rank-keyed
+        # series are dropped and rebuilt rather than accumulated across
+        # worlds (unlike the world-agnostic totals elsewhere).
+        self._m_lateness.clear()
+        self._m_lateness_total.clear()
         self._m_straggler = r.gauge(
             "hvdtpu_straggler_rank",
             "Rank with the highest recent negotiate lateness "
@@ -244,6 +263,7 @@ class _SkewTracker:
             "hvdtpu_straggler_lateness_seconds",
             "Decay-weighted mean negotiate lateness of the current "
             "straggler rank").labels()
+        self._m_straggler_lateness.set(0.0)
         self._hist_children = {
             rk: self._m_lateness.labels(rank=str(rk))
             for rk in range(nproc)}
@@ -395,6 +415,37 @@ class CoordinatorService(BasicService):
         # lateness histograms + straggler election from the announce
         # ticks this service already observes.
         self._skew = _SkewTracker(nproc)
+        # Stall→failure blame ledger (docs/adaptation.md): ranks named
+        # missing by CONSECUTIVE stall reports, with the tick they were
+        # first blamed — past failure_timeout_s the repeat offender is
+        # escalated to a typed failure event instead of warned forever.
+        # Works for BOTH planners (the fallback's table-based escalation
+        # in check_failures never covered the native controller).
+        self._stall_blame: Dict[int, float] = {}
+        self._escalated_stalls: Dict[int, str] = {}
+        # Closed-loop adaptation (docs/adaptation.md): the rank-0 policy
+        # ladder over the skew tracker's signal. Off unless
+        # HOROVOD_TPU_ADAPTATION=1; eviction additionally requires the
+        # elastic failure plane (failure_timeout_s > 0) — on a fixed
+        # world an eviction is just a job kill.
+        self._base_fusion_threshold = fusion_threshold
+        self._policy = None
+        self._policy_failures: List[dict] = []
+        # Wire-override epochs: [(from_seq, spec)] — groups with seq >=
+        # from_seq execute with `spec` ("" = back to raw). Published
+        # under _mu BEFORE any group at from_seq can be planned, and
+        # shipped whole in every fetch's params, so every process maps
+        # seq → spec identically (the agreement that makes a mid-run
+        # wire switch safe: a group quantized on one rank and raw on
+        # another would be two different SPMD programs).
+        self._wire_epochs: List[Tuple[int, str]] = []
+        if _envmod.adaptation_enabled():
+            from ..adaptation.policy import (AdaptationConfig,
+                                             AdaptationPolicy)
+            self._policy = AdaptationPolicy(
+                AdaptationConfig.from_env(),
+                allow_evict=self.failure_timeout_s > 0)
+            self._last_policy_tick = time.monotonic()
         self._ctl = None
         if native is not False:
             try:
@@ -613,6 +664,31 @@ class CoordinatorService(BasicService):
                         entries.append(
                             (name, now - e.first_seen,
                              ",".join(map(str, missing))))
+        # Repeat-offender escalation (docs/adaptation.md): a rank named
+        # missing by stall reports spanning more than failure_timeout_s
+        # becomes a typed failure event (check_failures) instead of a
+        # warning loop — the drop_announce fault is exactly this shape
+        # (its fetch heartbeat stays alive, so only the stall report
+        # ever names it). Blame entries for ranks a report no longer
+        # names are cleared: the episode resolved.
+        if self.failure_timeout_s > 0:
+            named: set = set()
+            for name, age, missing in entries:
+                for tok in missing.split(","):
+                    tok = tok.strip()
+                    if tok.isdigit():
+                        named.add(int(tok))
+            for rk in named:
+                first = self._stall_blame.setdefault(rk, now)
+                if now - first > self.failure_timeout_s \
+                        and rk not in self._escalated_stalls:
+                    self._escalated_stalls[rk] = (
+                        f"rank {rk} named missing by stall reports for "
+                        f"{now - first:.1f}s (> failure timeout "
+                        f"{self.failure_timeout_s:.1f}s)")
+            for rk in list(self._stall_blame):
+                if rk not in named:
+                    del self._stall_blame[rk]
         # Gauge export of the authoritative report: cleared and re-set
         # each completed check, so a resolved episode zeroes out instead
         # of naming completed tensors forever.
@@ -658,6 +734,14 @@ class CoordinatorService(BasicService):
             return []
         now = time.monotonic()
         failures: List[dict] = []
+        # Policy evictions (docs/adaptation.md) persist until the world
+        # re-forms: a rank idling in user code at escalation time must
+        # still receive its obituary on its NEXT fetch, or it would hang
+        # in a quorum its evicted peer can never complete.
+        failures.extend(self._policy_failures)
+        for rank, detail in sorted(self._escalated_stalls.items()):
+            failures.append({"rank": rank, "kind": "stall",
+                             "detail": detail})
         for rank, t in sorted(self._last_seen.items()):
             if now - t > self.failure_timeout_s:
                 failures.append({
@@ -687,8 +771,76 @@ class CoordinatorService(BasicService):
                 self._m_failures.labels(kind=f["kind"]).inc()
         return failures
 
+    # ----------------------------------------------------------- adaptation
+
+    def _publish_wire_epoch(self, spec: Optional[str]) -> None:
+        """Record that groups planned from NOW on use ``spec`` ("" =
+        raw). Taken under ``_mu`` so the epoch boundary is ordered
+        against planning: any group with seq >= from_seq is planned
+        after the epoch exists, hence every fetch serving it also
+        carries the epoch in params — all processes agree."""
+        with self._mu:
+            if self._ctl is not None:
+                from_seq = self._ctl.group_count()
+            else:
+                from_seq = len(self._groups) + self._base_seq
+            self._wire_epochs.append((from_seq, spec or ""))
+
+    def _maybe_adapt(self) -> None:
+        """One policy evaluation (time-gated to interval_s), applied to
+        the coordinator's authoritative knobs: the fusion threshold the
+        planner cuts groups with, the wire-override epoch list, and —
+        at the top of the ladder — a ``slow_rank`` failure event for
+        the elastic driver."""
+        if self._policy is None:
+            return
+        now = time.monotonic()
+        if now - self._last_policy_tick < self._policy.config.interval_s:
+            return
+        self._last_policy_tick = now
+        prev_wire = self._policy.wire_spec()
+        events = self._policy.observe(
+            self._skew.recent_lateness_by_rank(), now)
+        if not events:
+            return
+        shrink = self._policy.shrink_active()
+        self.fusion_threshold = (
+            self._base_fusion_threshold // self._policy.config.shrink_factor
+            if shrink else self._base_fusion_threshold)
+        wire = self._policy.wire_spec()
+        if wire != prev_wire:
+            self._publish_wire_epoch(wire)
+        for ev in events:
+            if ev["name"] == "evict" and ev["action"] == "escalate":
+                self._policy_failures.append({
+                    "rank": ev["rank"], "kind": "slow_rank",
+                    "detail": (
+                        f"rank {ev['rank']} evicted by the adaptation "
+                        f"policy: negotiate lateness "
+                        f"{ev['lateness_s'] * 1e3:.1f} ms sustained above "
+                        f"{self._policy.config.threshold_s * 1e3:.1f} ms "
+                        "through every degradation tier "
+                        f"({', '.join(self._policy.config.tiers[:-1])})")})
+
+    def _adapted_params(self, params: dict) -> dict:
+        """Overlay the policy's knobs on a params dict (either planner's):
+        the shrunk fusion threshold and the wire-epoch list every engine
+        needs to map group seq → wire spec."""
+        if self._policy is None and not self._wire_epochs:
+            return params
+        params = dict(params)
+        params["fusion_threshold"] = self.fusion_threshold
+        if self._wire_epochs:
+            # No lock (the fallback fetch path already holds _mu via its
+            # condition when building params): list appends are atomic,
+            # and any epoch relevant to a served group was fully
+            # appended — under _mu — before that group was planned.
+            params["wire_epochs"] = [list(e) for e in self._wire_epochs]
+        return params
+
     def _fetch(self, req: FetchRequest) -> FetchResponse:
         stall = self.check_stalls()
+        self._maybe_adapt()
         # Refresh the fetching rank's heartbeat BEFORE checking: a rank
         # returning after a long idle gap must not be handed its own
         # obituary.
@@ -735,9 +887,10 @@ class CoordinatorService(BasicService):
                                                               self._nproc)
                 for i, g in enumerate(groups):
                     g["seq"] = req.after_seq + i
-                return FetchResponse(groups, shutdown, payload=payload,
-                                     params=self._ctl.params(), stall=stall,
-                                     failures=failures)
+                return FetchResponse(
+                    groups, shutdown, payload=payload,
+                    params=self._adapted_params(self._ctl.params()),
+                    stall=stall, failures=failures)
         with self._cv:
             self._acked[req.rank] = max(self._acked.get(req.rank, 0),
                                         req.after_seq)
@@ -768,10 +921,11 @@ class CoordinatorService(BasicService):
                     self._cv.notify_all()
             start = max(0, req.after_seq - self._base_seq)
             groups = self._groups[start:]
-            params = {"fusion_threshold": self.fusion_threshold,
-                      "cycle_time_ms": self.cycle_time_ms,
-                      "flags": self._flags, "autotune_active": False,
-                      "autotune_done": False}
+            params = self._adapted_params(
+                {"fusion_threshold": self.fusion_threshold,
+                 "cycle_time_ms": self.cycle_time_ms,
+                 "flags": self._flags, "autotune_active": False,
+                 "autotune_done": False})
             return FetchResponse(
                 groups, self._shutdown,
                 payload=_wire.encode_response_list(groups, self._shutdown,
@@ -885,41 +1039,102 @@ class CoordinatorService(BasicService):
 
 class CoordinatorClient:
     """Per-process client — the worker half of RunLoopOnce
-    (operations.cc:2323-2377)."""
+    (operations.cc:2323-2377).
+
+    Post-rendezvous RPC failures are retried with BOUNDED exponential
+    backoff plus deterministic per-rank jitter (every worker polls the
+    coordinator each cycle; on a coordinator restart, synchronized
+    retries would stampede the fresh socket — decorrelating them is the
+    standard thundering-herd fix), then surface as a typed
+    :class:`CoordinatorUnreachableError` naming the endpoint and budget
+    — previously a worker polling a dead/restarting coordinator hung in
+    the transport or died with an uninformative socket error."""
 
     def __init__(self, addresses: List[Tuple[str, int]], key: bytes,
-                 rank: int):
+                 rank: int, retries: Optional[int] = None,
+                 backoff_s: Optional[float] = None):
         # Patient FIRST connection only: rank 0 binds the coordinator
         # lazily on its first collective, which may come seconds after
         # the other ranks' (e.g. rank 0 reads a checkpoint first) — the
         # reference's workers block in MPI_Gather until rank 0 arrives.
-        # After rendezvous, failures retry briefly so a dead coordinator
-        # surfaces in seconds, not hours.
-        self._client = BasicClient(addresses, key, attempts=3,
+        # After rendezvous this layer owns the retry schedule, so the
+        # inner client attempts each request once.
+        from ..utils import env as _envmod
+        self._client = BasicClient(addresses, key, attempts=1,
                                    connect_attempts=300)
+        self._addresses = list(self._client._addresses)
         self._rank = rank
+        self._retries = (retries if retries is not None
+                         else _envmod.coord_retries())
+        self._backoff_s = (backoff_s if backoff_s is not None
+                           else _envmod.coord_backoff_s())
+        self._backoff_max_s = 2.0
+        # Deterministic per-rank jitter stream: reproducible runs, and
+        # distinct ranks decorrelate without sharing a seed.
+        import random
+        self._jitter = random.Random(0x9E3779B1 * (rank + 1))
+        self._ever_ok = False
         self.last_seq = 0
         self._announce_seq = 0
+        # Fault harness (docs/adaptation.md): the drop_announce fault
+        # suppresses this client's announce legs. Resolved once —
+        # without a spec this is a None attribute check per announce.
+        from ..adaptation import faults as _faults
+        self._faults = _faults.injector()
+
+    def _rpc(self, req):
+        """One coordinator RPC with the bounded retry/backoff/jitter
+        schedule; raises CoordinatorUnreachableError when the budget is
+        spent (or immediately when rendezvous itself — which has its own
+        patience window inside BasicClient — never succeeded)."""
+        delay = self._backoff_s
+        last: Optional[Exception] = None
+        for attempt in range(max(1, self._retries)):
+            try:
+                resp = self._client.request(req)
+                self._ever_ok = True
+                return resp
+            except (ConnectionError, OSError) as e:
+                last = e
+                if not self._ever_ok or attempt >= self._retries - 1:
+                    break
+                time.sleep(delay * (0.5 + self._jitter.random()))
+                delay = min(delay * 2.0, self._backoff_max_s)
+        raise CoordinatorUnreachableError(
+            f"rank {self._rank}: coordinator at {self._addresses} "
+            f"unreachable after {self._retries} attempts with "
+            f"exponential backoff (base {self._backoff_s:.2f}s): {last}. "
+            "The rank-0 process is dead or partitioned; in elastic runs "
+            "the driver will re-rendezvous the surviving world."
+        ) from last
+
+    def _drop_announce(self) -> bool:
+        return (self._faults is not None
+                and self._faults.drop_announce_active())
 
     def announce(self, requests: List[dict],
                  complete: bool = False) -> None:
+        if self._drop_announce():
+            return
         self._announce_seq += 1
-        self._client.request(AnnounceRequest(self._rank, requests,
-                                             announce_id=self._announce_seq,
-                                             complete=complete))
+        self._rpc(AnnounceRequest(self._rank, requests,
+                                  announce_id=self._announce_seq,
+                                  complete=complete))
 
     def announce_bytes(self, payload: bytes,
                        complete: bool = False) -> None:
         """Announce a pre-serialized RequestList (message.cc codec) — the
         native engine's path: the bytes the C++ core serialized travel
         verbatim to the controller's C++ parser."""
+        if self._drop_announce():
+            return
         self._announce_seq += 1
-        self._client.request(AnnounceRequest(
+        self._rpc(AnnounceRequest(
             self._rank, [], announce_id=self._announce_seq,
             payload=payload, complete=complete))
 
     def fetch(self, wait_s: float = 0.0) -> FetchResponse:
-        resp = self._client.request(
+        resp = self._rpc(
             FetchRequest(self._rank, self.last_seq, wait_s))
         if resp.groups:
             self.last_seq = resp.groups[-1]["seq"] + 1
@@ -933,12 +1148,12 @@ class CoordinatorClient:
         announce newly-ready requests (dicts or pre-serialized bytes),
         then long-poll the agreed group sequence."""
         ann = None
-        if requests or payload is not None:
+        if (requests or payload is not None) and not self._drop_announce():
             self._announce_seq += 1
             ann = AnnounceRequest(self._rank, requests or [],
                                   announce_id=self._announce_seq,
                                   payload=payload, complete=complete)
-        resp = self._client.request(AnnounceFetchRequest(
+        resp = self._rpc(AnnounceFetchRequest(
             ann, FetchRequest(self._rank, self.last_seq, wait_s)))
         if resp.groups:
             self.last_seq = resp.groups[-1]["seq"] + 1
@@ -959,7 +1174,7 @@ class CoordinatorClient:
         best_offset = 0.0
         for _ in range(max(1, probes)):
             t0 = time.monotonic()
-            resp = self._client.request(ClockProbeRequest(self._rank))
+            resp = self._rpc(ClockProbeRequest(self._rank))
             t1 = time.monotonic()
             rtt = t1 - t0
             offset = resp.t_mono_us / 1e6 + rtt / 2.0 - t1

@@ -161,6 +161,7 @@ class DistributedGradientTransformation:
         self.backward_passes_per_step = int(backward_passes_per_step)
         self.average = average if op_average is None else op_average
         self.axis_name = axis_name
+        self._ef_explicit = error_feedback is not None
         if error_feedback is None:
             # Blockwise formats are lossy on the wire; cast/none formats
             # keep EF off by default (fp16/bf16 roundtrip error is noise
@@ -168,6 +169,27 @@ class DistributedGradientTransformation:
             error_feedback = getattr(compression, "wire_spec", None) \
                 is not None
         self.error_feedback = bool(error_feedback)
+        self._reset_residual = False
+
+    def set_compression(self, compression) -> None:
+        """Switch wire compression mid-run — the optimizer-level hook of
+        the adaptation ladder (docs/adaptation.md).
+
+        The error-feedback residual is RESET on the next ``update``: it
+        measures ``delta - roundtrip(delta)`` against the OLD spec's
+        quantizer, and carrying it across a spec switch would inject a
+        correction the new wire never dropped (measured as a one-step
+        numerics glitch on every escalation). Unless the caller pinned
+        ``error_feedback`` explicitly, its default is re-derived for the
+        new spec (blockwise on, cast/none off). Under jit the switch
+        takes effect on the next trace (the compression is baked into
+        the compiled update); the eager engine path switches
+        immediately."""
+        self.compression = compression
+        if not self._ef_explicit:
+            self.error_feedback = getattr(
+                compression, "wire_spec", None) is not None
+        self._reset_residual = True
 
     def _roundtrip(self, g):
         """This rank's transmitted value for gradient ``g`` — what the
@@ -201,6 +223,12 @@ class DistributedGradientTransformation:
 
     def update(self, grads, state: _DistOptState, params=None):
         residual = getattr(state, "residual", None)
+        if self._reset_residual:
+            # set_compression: the carried residual belongs to the OLD
+            # wire's quantizer — zero it rather than double-correct.
+            self._reset_residual = False
+            if residual is not None:
+                residual = jax.tree_util.tree_map(jnp.zeros_like, residual)
         if self.error_feedback and residual is None:
             # State from a pre-EF checkpoint (or init with EF toggled on
             # later): start the residual at zero.

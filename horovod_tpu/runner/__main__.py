@@ -70,6 +70,20 @@ def main(argv=None) -> int:
                              "horovod_tpu.tools.trace merge` "
                              "(docs/tracing.md); exported as "
                              "HOROVOD_TPU_TIMELINE")
+    parser.add_argument("--fault-spec", default=None,
+                        help="deterministic fault injection "
+                             "(docs/adaptation.md), e.g. "
+                             "'rank=2:delay=80ms:from_step=50; "
+                             "rank=1:crash_at=30'; exported as "
+                             "HOROVOD_TPU_FAULT_SPEC to every worker "
+                             "generation")
+    parser.add_argument("--adaptation", action="store_true",
+                        help="arm the rank-0 adaptation policy "
+                             "(docs/adaptation.md): on sustained "
+                             "straggler lateness, shrink fused groups, "
+                             "escalate wire compression, and (with "
+                             "--elastic) evict the slow rank; exported "
+                             "as HOROVOD_TPU_ADAPTATION=1")
     parser.add_argument("--timeout", type=float, default=None,
                         help="overall job timeout in seconds")
     parser.add_argument("--no-tag-output", action="store_true",
@@ -85,6 +99,14 @@ def main(argv=None) -> int:
         command = command[1:]
 
     extra_env = {}
+    if args.fault_spec:
+        # Validate at launch: a typo'd fault harness must fail here, not
+        # silently inject nothing in the workers.
+        from ..adaptation.faults import parse_spec
+        parse_spec(args.fault_spec)
+        extra_env["HOROVOD_TPU_FAULT_SPEC"] = args.fault_spec
+    if args.adaptation:
+        extra_env["HOROVOD_TPU_ADAPTATION"] = "1"
     if args.timeline:
         # Propagated UNEXPANDED: each worker resolves its own {rank}
         # (utils/env.resolved_timeline_path), so the same value serves

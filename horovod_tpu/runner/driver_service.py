@@ -117,11 +117,20 @@ class DriverService(BasicService):
                 failfast()
         out: List[Any] = []
         errors = []
+        typed = None
         for r in range(self._num_proc):
             result, error = self._results[r]
             if error is not None:
                 errors.append(f"rank {r}: {error}")
+                if typed is None and isinstance(error, BaseException):
+                    typed = error
             out.append(result)
+        if typed is not None:
+            # A worker registered a typed failure object (WorkerFailure
+            # from a slow-rank eviction / escalated stall): re-raise IT
+            # so the elastic driver can dispatch on rank/host/kind and
+            # recover, instead of burying it in a generic RuntimeError.
+            raise typed
         if errors:
             raise RuntimeError("worker function failed on "
                                + "; ".join(errors))

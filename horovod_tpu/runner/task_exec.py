@@ -54,11 +54,22 @@ def main() -> int:
         result = fn(*args, **kwargs)
         client.register_result(info.rank, result, None)
         return 0
-    except BaseException:
+    except BaseException as e:
         # Exit 0 once the traceback is registered: the driver raises the
         # real exception from wait_for_results; a nonzero exit here would
         # race failfast into masking it with a generic "exited with code 1".
-        client.register_result(info.rank, None, traceback.format_exc())
+        error = traceback.format_exc()
+        try:
+            # A typed WorkerFailure (e.g. a slow_rank eviction from the
+            # adaptation policy) travels as the OBJECT, not flattened
+            # text: the elastic driver dispatches on its class/fields to
+            # recover instead of aborting (docs/adaptation.md).
+            from ..elastic.failure import WorkerFailure
+            if isinstance(e, WorkerFailure):
+                error = e
+        except Exception:
+            pass
+        client.register_result(info.rank, None, error)
         return 0
 
 

@@ -43,6 +43,12 @@ def cycle_time_ms() -> float:
 def stall_warning_secs() -> float:
     if _get("STALL_CHECK_DISABLE") not in (None, "", "0"):
         return 0.0
+    # HOROVOD_TPU_STALL_WARNING overrides the 60 s default — short
+    # windows let the stall→failure escalation (docs/adaptation.md)
+    # react in seconds on jobs whose steps are subsecond.
+    v = _get("STALL_WARNING")
+    if v not in (None, ""):
+        return float(v)
     return DEFAULT_STALL_WARNING_SECS
 
 
@@ -56,6 +62,71 @@ def failure_timeout_secs() -> float:
     if v in (None, ""):
         return 0.0
     return float(v)
+
+
+def fault_spec() -> Optional[str]:
+    """Declarative per-rank fault-injection spec (docs/adaptation.md):
+    ``rank=2:delay=80ms:from_step=50; rank=1:crash_at=30``. None/empty
+    disables injection entirely — the engine then carries a single
+    ``is None`` check on the enqueue path and nothing else."""
+    v = _get("FAULT_SPEC")
+    return v or None
+
+
+def adaptation_enabled() -> bool:
+    """Rank-0 closed-loop adaptation policy (docs/adaptation.md):
+    HOROVOD_TPU_ADAPTATION=1 arms the coordinator-side control loop that
+    escalates graceful-degradation tiers on sustained straggler
+    lateness. Default off — observability stays passive."""
+    return _get("ADAPTATION") in ("1",)
+
+
+def adapt_threshold_s() -> float:
+    """Straggler lateness (decay-weighted mean seconds) above which the
+    adaptation policy starts its sustain clock."""
+    v = _get("ADAPT_THRESHOLD")
+    return float(v) if v not in (None, "") else 0.1
+
+
+def adapt_sustain_s() -> float:
+    """Seconds the lateness must stay above threshold before EACH
+    escalation step (hysteresis against transient spikes)."""
+    v = _get("ADAPT_SUSTAIN")
+    return float(v) if v not in (None, "") else 5.0
+
+
+def adapt_cooldown_s() -> float:
+    """Seconds the lateness must stay below threshold *
+    deescalate-ratio before each de-escalation step."""
+    v = _get("ADAPT_COOLDOWN")
+    return float(v) if v not in (None, "") else 30.0
+
+
+def adapt_interval_s() -> float:
+    """Policy evaluation cadence (piggybacked on coordinator fetches)."""
+    v = _get("ADAPT_INTERVAL")
+    return float(v) if v not in (None, "") else 1.0
+
+
+def adapt_tiers() -> Optional[str]:
+    """Comma-separated degradation ladder override
+    (HOROVOD_TPU_ADAPT_TIERS, e.g. "shrink,int8x256,evict"); None keeps
+    the default shrink → bf16 → int8x256 → fp8x256 → evict ladder."""
+    return _get("ADAPT_TIERS")
+
+
+def coord_retries() -> int:
+    """Post-rendezvous coordinator RPC retry budget (each retried with
+    exponential backoff + jitter before CoordinatorUnreachableError)."""
+    v = _get("COORD_RETRIES")
+    return int(v) if v not in (None, "") else 6
+
+
+def coord_backoff_s() -> float:
+    """Base backoff between coordinator RPC retries (doubles per
+    attempt, capped at ~2 s, ±50% deterministic per-rank jitter)."""
+    v = _get("COORD_BACKOFF")
+    return float(v) if v not in (None, "") else 0.1
 
 
 def checkpoint_keep() -> int:

@@ -1,0 +1,654 @@
+"""Self-healing collective plane — fast unit tests (docs/adaptation.md).
+
+Covers the fault-spec grammar and injector windows, the policy ladder
+(monotonic escalation/de-escalation, hysteresis on a borderline-slow
+rank, edge-triggered eviction), the coordinator glue (fusion-threshold
+shrink, seq-keyed wire epochs in params, slow_rank failure events, the
+stall-blame escalation driven by a drop_announce fault), the engine's
+wire-epoch selection, the hardened coordinator client
+(retry/backoff/jitter + CoordinatorUnreachableError on a flapping
+server), straggler-telemetry re-keying across world-size changes, the
+typed WorkerFailure propagation through the driver service, slot-penalty
+readmission probing, and error-feedback residual reset on a mid-run wire
+spec switch.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+from horovod_tpu.adaptation import (AdaptationConfig, AdaptationPolicy,
+                                    FaultInjector, parse_spec)
+from horovod_tpu.adaptation import faults as faults_mod
+from horovod_tpu.elastic import SlowRankFailure, WorkerFailure
+from horovod_tpu.elastic.failure import failure_from_event
+from horovod_tpu.ops.control_plane import (AnnounceRequest,
+                                           CoordinatorClient,
+                                           CoordinatorService,
+                                           CoordinatorUnreachableError,
+                                           FetchRequest)
+from horovod_tpu.runner.secret import make_secret_key
+
+
+# ---------------------------------------------------------------------------
+# Fault-spec grammar + injector
+# ---------------------------------------------------------------------------
+
+class TestFaultSpec:
+    def test_full_grammar(self):
+        cl = parse_spec("rank=2:delay=80ms:from_step=50; "
+                        "rank=1:crash_at=30:gen=0; "
+                        "rank=*:slow_h2d=2ms; "
+                        "rank=3:drop_announce:from_step=5:until_step=9")
+        assert len(cl) == 4
+        assert cl[0].rank == 2 and cl[0].delay_s == pytest.approx(0.08)
+        assert cl[0].from_step == 50
+        assert cl[1].crash_at == 30 and cl[1].gen == 0
+        assert cl[2].rank is None and cl[2].slow_h2d_s == pytest.approx(2e-3)
+        assert cl[3].drop_announce and cl[3].until_step == 9
+
+    def test_duration_units(self):
+        assert parse_spec("rank=0:delay=1.5s")[0].delay_s == 1.5
+        assert parse_spec("rank=0:delay=500us")[0].delay_s == \
+            pytest.approx(5e-4)
+        assert parse_spec("rank=0:delay=0.25")[0].delay_s == 0.25
+
+    def test_missing_rank_rejected(self):
+        with pytest.raises(ValueError, match="rank"):
+            parse_spec("delay=80ms")
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault-spec field"):
+            parse_spec("rank=0:dealy=80ms")
+
+    def test_injector_filters_rank_and_gen(self):
+        cl = parse_spec("rank=2:delay=10ms; rank=1:delay=5ms:gen=1")
+        assert len(FaultInjector(cl, rank=2, generation=0).clauses) == 1
+        assert FaultInjector(cl, rank=1, generation=0).clauses == []
+        assert len(FaultInjector(cl, rank=1, generation=1).clauses) == 1
+        assert FaultInjector(cl, rank=0, generation=0).clauses == []
+
+    def test_window_and_tick(self):
+        inj = FaultInjector(
+            parse_spec("rank=0:drop_announce:from_step=2:until_step=4"),
+            rank=0)
+        active = []
+        for _ in range(6):
+            active.append(inj.drop_announce_active())
+            inj.on_enqueue()
+        assert active == [False, False, True, True, False, False]
+
+    def test_delay_applied_in_window(self):
+        inj = FaultInjector(
+            parse_spec("rank=0:delay=30ms:from_step=1:until_step=2"),
+            rank=0)
+        t0 = time.monotonic()
+        inj.on_enqueue()                     # tick 0: outside window
+        before = time.monotonic() - t0
+        t0 = time.monotonic()
+        inj.on_enqueue()                     # tick 1: 30 ms delay
+        during = time.monotonic() - t0
+        assert before < 0.02 and during >= 0.03
+
+    def test_env_resolution_off_by_default(self, monkeypatch):
+        monkeypatch.delenv("HOROVOD_TPU_FAULT_SPEC", raising=False)
+        monkeypatch.delenv("HOROVOD_FAULT_SPEC", raising=False)
+        faults_mod.reset()
+        try:
+            assert faults_mod.injector() is None
+        finally:
+            faults_mod.reset()
+
+    def test_env_resolution_other_rank_is_none(self, monkeypatch):
+        # This test process is rank 0; a spec targeting rank 7 resolves
+        # to no injector at all (the zero-cost-when-inactive contract).
+        monkeypatch.setenv("HOROVOD_TPU_FAULT_SPEC", "rank=7:delay=1ms")
+        faults_mod.reset()
+        try:
+            assert faults_mod.injector() is None
+        finally:
+            faults_mod.reset()
+
+
+# ---------------------------------------------------------------------------
+# Policy ladder
+# ---------------------------------------------------------------------------
+
+def _cfg(**kw):
+    base = dict(threshold_s=0.05, sustain_s=1.0, cooldown_s=2.0,
+                interval_s=0.0)
+    base.update(kw)
+    return AdaptationConfig(**base)
+
+
+class TestAdaptationPolicy:
+    def test_monotonic_escalation_then_deescalation(self):
+        p = AdaptationPolicy(_cfg())
+        t, evs = 0.0, []
+        for _ in range(20):
+            evs += p.observe({2: 0.2, 0: 0.001}, t)
+            t += 0.6
+        esc = [e["name"] for e in evs if e["action"] == "escalate"]
+        assert esc == ["shrink", "bf16", "int8x256", "fp8x256", "evict"]
+        assert p.evicted == {2}
+        # Straggler evicted → signal clears → the ladder unwinds in
+        # exact reverse order, one cooldown window per step.
+        deesc = [e["name"] for e in evs if e["action"] == "deescalate"]
+        for _ in range(20):
+            for e in p.observe({0: 0.001}, t):
+                deesc.append(e["name"])
+            t += 0.7
+        assert deesc == ["fp8x256", "int8x256", "bf16", "shrink"]
+        assert p.tier == 0 and p.wire_spec() is None
+
+    def test_borderline_rank_no_flapping(self):
+        """Lateness oscillating across the threshold faster than the
+        sustain window produces ZERO transitions (the hysteresis band
+        resets both clocks)."""
+        p = AdaptationPolicy(_cfg())
+        t, evs = 0.0, []
+        for i in range(60):
+            lat = 0.051 if i % 2 == 0 else 0.04
+            evs += p.observe({1: lat}, t)
+            t += 0.6
+        assert evs == [] and p.tier == 0
+
+    def test_each_step_needs_its_own_sustain_window(self):
+        p = AdaptationPolicy(_cfg(sustain_s=1.0))
+        evs = p.observe({1: 0.2}, 0.0)       # starts the clock
+        evs += p.observe({1: 0.2}, 0.5)      # not sustained yet
+        assert evs == []
+        evs = p.observe({1: 0.2}, 1.1)       # first escalation
+        assert [e["name"] for e in evs] == ["shrink"]
+        evs = p.observe({1: 0.2}, 1.6)       # needs a NEW window
+        assert evs == []
+
+    def test_eviction_edge_triggered_second_straggler(self):
+        p = AdaptationPolicy(_cfg(tiers=("shrink", "evict")))
+        t, evs = 0.0, []
+        for _ in range(10):
+            evs += p.observe({1: 0.2, 3: 0.3}, t)
+            t += 1.2
+        # Worst rank 3 evicted first; rank 1 still slow → its own
+        # sustain window earns a second eviction. Both gone, the signal
+        # clears and the remaining shrink tier unwinds.
+        evicts = [e["rank"] for e in evs if e["name"] == "evict"]
+        assert evicts == [3, 1]
+        assert p.evicted == {1, 3}
+        assert p.tier == 0
+
+    def test_evict_gated_without_failure_plane(self):
+        p = AdaptationPolicy(_cfg(tiers=("shrink", "evict")),
+                             allow_evict=False)
+        t, evs = 0.0, []
+        for _ in range(10):
+            evs += p.observe({1: 0.2}, t)
+            t += 1.2
+        assert [e["name"] for e in evs] == ["shrink"]
+        assert p.evicted == set()
+
+    def test_wire_spec_tracks_strongest_active_tier(self):
+        p = AdaptationPolicy(_cfg())
+        assert p.wire_spec() is None
+        p.tier = 2
+        assert p.wire_spec() == "bf16"
+        p.tier = 4
+        assert p.wire_spec() == "fp8x256"
+
+
+# ---------------------------------------------------------------------------
+# Coordinator glue: shrink + wire epochs + eviction event
+# ---------------------------------------------------------------------------
+
+def _skew(svc, rank_late: int, lateness: float, n: int = 6):
+    """Feed the service's skew tracker n completed tensors with
+    ``rank_late`` announcing ``lateness`` behind the others."""
+    nproc = svc._nproc
+    base = time.monotonic()
+    for i in range(n):
+        t = base + i * 1e-3
+        for rk in range(nproc):
+            svc._skew.note(rk, [f"skew.{base}.{i}"],
+                           t + (lateness if rk == rank_late else 0.0))
+
+
+class TestCoordinatorAdaptation:
+    def _svc(self, monkeypatch, timeout="5", tiers=None):
+        monkeypatch.setenv("HOROVOD_TPU_ADAPTATION", "1")
+        monkeypatch.setenv("HOROVOD_TPU_ADAPT_THRESHOLD", "0.01")
+        monkeypatch.setenv("HOROVOD_TPU_ADAPT_SUSTAIN", "0")
+        monkeypatch.setenv("HOROVOD_TPU_ADAPT_INTERVAL", "0")
+        if tiers:
+            monkeypatch.setenv("HOROVOD_TPU_ADAPT_TIERS", tiers)
+        monkeypatch.setenv("HOROVOD_TPU_FAILURE_TIMEOUT", timeout)
+        return CoordinatorService(nproc=2, key=make_secret_key(),
+                                  fusion_threshold=1 << 20, native=False)
+
+    def test_shrink_wire_epochs_and_eviction(self, monkeypatch):
+        svc = self._svc(monkeypatch)
+        try:
+            _skew(svc, rank_late=1, lateness=0.05)
+            for _ in range(8):
+                svc._last_policy_tick = 0.0
+                svc._maybe_adapt()
+            # shrink tier: the PLANNER's threshold dropped.
+            assert svc.fusion_threshold == (1 << 20) // 4
+            # wire epochs published in escalation order, ascending seqs.
+            specs = [sp for _, sp in svc._wire_epochs]
+            assert specs == ["bf16", "int8x256", "fp8x256"]
+            seqs = [s for s, _ in svc._wire_epochs]
+            assert seqs == sorted(seqs)
+            # eviction rode the failure side-channel, typed slow_rank.
+            resp = svc._fetch(FetchRequest(0, 0, 0.0))
+            kinds = {f["kind"] for f in resp.failures}
+            assert "slow_rank" in kinds
+            assert any(f["rank"] == 1 for f in resp.failures)
+            # params carry the overlay for every engine.
+            assert resp.params["fusion_threshold"] == (1 << 20) // 4
+            assert [sp for _, sp in resp.params["wire_epochs"]] == specs
+        finally:
+            svc.shutdown()
+
+    def test_policy_off_by_default(self, monkeypatch):
+        monkeypatch.delenv("HOROVOD_TPU_ADAPTATION", raising=False)
+        monkeypatch.delenv("HOROVOD_ADAPTATION", raising=False)
+        svc = CoordinatorService(nproc=2, key=make_secret_key(),
+                                 native=False)
+        try:
+            assert svc._policy is None
+            _skew(svc, rank_late=1, lateness=0.1)
+            svc._maybe_adapt()
+            assert svc.fusion_threshold == svc._base_fusion_threshold
+            assert svc._wire_epochs == []
+        finally:
+            svc.shutdown()
+
+    def test_eviction_gated_without_failure_timeout(self, monkeypatch):
+        svc = self._svc(monkeypatch, timeout="0", tiers="evict")
+        try:
+            _skew(svc, rank_late=1, lateness=0.05)
+            for _ in range(6):
+                svc._last_policy_tick = 0.0
+                svc._maybe_adapt()
+            assert svc._policy_failures == []
+        finally:
+            svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Stall blame → failure plane (drop_announce fault)
+# ---------------------------------------------------------------------------
+
+class TestStallEscalation:
+    def test_drop_announce_blamed_and_escalated(self, monkeypatch):
+        """A mute-but-breathing worker (drop_announce): its fetch
+        heartbeat stays fresh, so only repeated stall reports can name
+        it — past the failure timeout the repeat offender surfaces as a
+        typed failure event instead of warning forever."""
+        svc = CoordinatorService(nproc=2, key=make_secret_key(),
+                                 native=False, stall_warning_s=0.05)
+        svc.failure_timeout_s = 0.2
+        # Rank 1's client carries a drop_announce injector — announces
+        # are swallowed client-side, exactly the fault's shape.
+        monkeypatch.setattr(
+            faults_mod, "_injector",
+            FaultInjector(parse_spec("rank=1:drop_announce"), rank=1))
+        monkeypatch.setattr(faults_mod, "_resolved", True)
+        c1 = CoordinatorClient([("127.0.0.1", svc.port)], svc.key, 1)
+        try:
+            svc._announce(AnnounceRequest(
+                0, [{"name": "t0", "op": 0, "dtype": "float32",
+                     "shape": (4,), "root_rank": -1, "device": 0}],
+                announce_id=1))
+            c1.announce([{"name": "t0", "op": 0, "dtype": "float32",
+                          "shape": (4,), "root_rank": -1, "device": 0}])
+            # The dropped announce never reached the table.
+            assert "t0" in svc._table
+            assert 1 not in svc._table["t0"].ranks
+            # First report blames rank 1; heartbeats stay fresh the
+            # whole time, so heartbeat detection alone would stay
+            # silent.
+            time.sleep(0.06)
+            svc._last_stall_check = 0.0
+            assert svc.check_stalls()
+            assert 1 in svc._stall_blame
+            resp = svc._fetch(FetchRequest(1, 0, 0.0))
+            assert not any(f["kind"] == "heartbeat_timeout"
+                           for f in resp.failures)
+            # Past the failure window, a repeated report escalates.
+            time.sleep(0.25)
+            svc._last_stall_check = 0.0
+            svc.check_stalls()
+            resp = svc._fetch(FetchRequest(1, 0, 0.0))
+            stalls = [f for f in resp.failures if f["kind"] == "stall"]
+            assert any(f["rank"] == 1 for f in stalls)
+        finally:
+            faults_mod.reset()
+            svc.shutdown()
+
+    def test_blame_cleared_when_episode_resolves(self):
+        svc = CoordinatorService(nproc=2, key=make_secret_key(),
+                                 native=False, stall_warning_s=0.05)
+        svc.failure_timeout_s = 10.0
+        try:
+            svc._announce(AnnounceRequest(
+                0, [{"name": "t1", "op": 0, "dtype": "float32",
+                     "shape": (4,), "root_rank": -1, "device": 0}],
+                announce_id=1))
+            time.sleep(0.06)
+            svc._last_stall_check = 0.0
+            svc.check_stalls()
+            assert 1 in svc._stall_blame
+            # Rank 1 finally announces: quorum completes, the next
+            # check names nobody, the blame entry is dropped.
+            svc._announce(AnnounceRequest(
+                1, [{"name": "t1", "op": 0, "dtype": "float32",
+                     "shape": (4,), "root_rank": -1, "device": 0}],
+                announce_id=1))
+            time.sleep(0.06)
+            svc._last_stall_check = 0.0
+            svc.check_stalls()
+            assert svc._stall_blame == {}
+        finally:
+            svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Hardened coordinator client
+# ---------------------------------------------------------------------------
+
+class TestCoordinatorClientRetry:
+    def test_dead_coordinator_raises_typed_error_bounded(self):
+        svc = CoordinatorService(nproc=1, key=make_secret_key(),
+                                 native=False)
+        key, port = svc.key, svc.port
+        client = CoordinatorClient([("127.0.0.1", port)], key, 0,
+                                   retries=3, backoff_s=0.05)
+        client.fetch(wait_s=0.0)            # rendezvous established
+        svc.shutdown()
+        # Emulate real coordinator death: the persistent connection
+        # breaks too (in-process, shutdown only stops the listener).
+        client._client.close()
+        t0 = time.monotonic()
+        with pytest.raises(CoordinatorUnreachableError,
+                           match="unreachable after 3 attempts"):
+            client.fetch(wait_s=0.0)
+        # Bounded: 2 backoff sleeps of <= ~0.1*1.5 s plus connect
+        # overhead — seconds, never the old hang.
+        assert time.monotonic() - t0 < 5.0
+
+    def test_recovers_across_flapping_coordinator(self):
+        """The retry/backoff schedule rides out a coordinator restart on
+        the same port (the flapping-server scenario)."""
+        svc = CoordinatorService(nproc=1, key=make_secret_key(),
+                                 native=False)
+        key, port = svc.key, svc.port
+        client = CoordinatorClient([("127.0.0.1", port)], key, 0,
+                                   retries=8, backoff_s=0.05)
+        client.fetch(wait_s=0.0)
+        svc.shutdown()                      # flap down
+        client._client.close()              # connection breaks with it
+        holder = {}
+
+        def restart():
+            time.sleep(0.3)                 # a few failed retries first
+            holder["svc"] = CoordinatorService(
+                nproc=1, key=key, native=False, port=port)
+
+        t = threading.Thread(target=restart, daemon=True)
+        t.start()
+        try:
+            resp = client.fetch(wait_s=0.0)  # survives the flap
+            assert resp.groups == []
+        finally:
+            t.join()
+            holder["svc"].shutdown()
+
+    def test_unreachable_is_connection_error(self):
+        # Existing `except ConnectionError` transport handlers keep
+        # catching the typed failure.
+        assert issubclass(CoordinatorUnreachableError, ConnectionError)
+
+
+# ---------------------------------------------------------------------------
+# Straggler telemetry re-keyed across world sizes
+# ---------------------------------------------------------------------------
+
+class TestSkewRekey:
+    def test_evicted_rank_does_not_linger(self):
+        svc4 = CoordinatorService(nproc=4, key=make_secret_key(),
+                                  native=False)
+        try:
+            _skew(svc4, rank_late=3, lateness=0.05)
+            snap = hvd.metrics_snapshot()
+            vals = snap["hvdtpu_negotiate_lateness_seconds"]["values"]
+            assert 'rank="3"' in vals
+            assert snap["hvdtpu_straggler_rank"]["values"][""] == 3
+        finally:
+            svc4.shutdown()
+        # Re-rendezvous at world size 2: the evicted ranks' series are
+        # re-keyed away and the straggler election resets.
+        svc2 = CoordinatorService(nproc=2, key=make_secret_key(),
+                                  native=False)
+        try:
+            snap = hvd.metrics_snapshot()
+            vals = snap["hvdtpu_negotiate_lateness_seconds"]["values"]
+            assert 'rank="3"' not in vals
+            assert set(vals) == {'rank="0"', 'rank="1"'}
+            assert snap["hvdtpu_straggler_rank"]["values"][""] == -1
+            assert snap["hvdtpu_straggler_lateness_seconds"][
+                "values"][""] == 0.0
+        finally:
+            svc2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Engine: wire-epoch selection
+# ---------------------------------------------------------------------------
+
+class TestWireOverride:
+    def _group(self, dtype=jnp.float32, **kw):
+        from horovod_tpu.ops import collective as coll
+        h = coll.Handle(1, "t")
+        return [coll._Request("t", coll.ALLREDUCE,
+                              jnp.ones((8,), dtype), h, **kw)]
+
+    def test_epoch_selection_by_seq(self):
+        from horovod_tpu.ops import collective as coll
+        eng = coll.CollectiveEngine()
+        eng._wire_epochs = [(5, "bf16"), (9, "int8x256"), (12, "")]
+        g = self._group()
+        assert eng._wire_override_for(4, g) is None
+        assert eng._wire_override_for(5, g) == "bf16"
+        assert eng._wire_override_for(8, g) == "bf16"
+        assert eng._wire_override_for(9, g) == "int8x256"
+        assert eng._wire_override_for(12, g) is None   # back to raw
+        assert eng._wire_override_for(None, g) is None
+
+    def test_ineligible_groups_untouched(self):
+        from horovod_tpu.ops import collective as coll
+        eng = coll.CollectiveEngine()
+        eng._wire_epochs = [(0, "int8x256")]
+        assert eng._wire_override_for(
+            3, self._group(dtype=jnp.int32)) is None
+        assert eng._wire_override_for(
+            3, self._group(wire="fp8x256")) is None   # explicit user wire
+        assert eng._wire_override_for(3, self._group()) == "int8x256"
+
+    def test_side_channel_installs_epochs(self):
+        from horovod_tpu.ops import collective as coll
+        from horovod_tpu.ops.control_plane import FetchResponse
+        eng = coll.CollectiveEngine()
+        resp = FetchResponse([], False,
+                             params={"wire_epochs": [[3, "bf16"]]})
+        eng._apply_fetch_side_channel(resp)
+        assert eng._wire_epochs == [(3, "bf16")]
+
+
+# ---------------------------------------------------------------------------
+# Typed failure plumbing + slot penalties
+# ---------------------------------------------------------------------------
+
+class TestTypedFailurePropagation:
+    def test_failure_from_event_types(self):
+        f = failure_from_event({"rank": 2, "kind": "slow_rank",
+                                "detail": "late"})
+        assert isinstance(f, SlowRankFailure) and f.rank == 2
+        f = failure_from_event({"rank": 1, "kind": "heartbeat_timeout"})
+        assert isinstance(f, WorkerFailure)
+        assert not isinstance(f, SlowRankFailure)
+
+    def test_slow_rank_failure_pickles(self):
+        import pickle
+        f = SlowRankFailure(rank=3, host="h1", detail="late")
+        g = pickle.loads(pickle.dumps(f))
+        assert isinstance(g, SlowRankFailure)
+        assert (g.rank, g.host, g.kind) == (3, "h1", "slow_rank")
+
+    def test_driver_service_reraises_typed_failure(self):
+        from horovod_tpu.runner.driver_service import DriverService
+        from horovod_tpu.runner.timeout import Timeout
+        svc = DriverService(2, make_secret_key(), b"")
+        try:
+            svc._results[0] = (None, SlowRankFailure(rank=1, detail="x"))
+            svc._results[1] = ({"ok": True}, None)
+            svc._all_done.set()
+            with pytest.raises(SlowRankFailure) as ei:
+                svc.wait_for_results(Timeout(5, "t {timeout}"))
+            assert ei.value.rank == 1
+        finally:
+            svc.shutdown()
+
+    def test_plain_errors_keep_runtime_error(self):
+        from horovod_tpu.runner.driver_service import DriverService
+        from horovod_tpu.runner.timeout import Timeout
+        svc = DriverService(1, make_secret_key(), b"")
+        try:
+            svc._results[0] = (None, "Traceback ... boom")
+            svc._all_done.set()
+            with pytest.raises(RuntimeError, match="rank 0"):
+                svc.wait_for_results(Timeout(5, "t {timeout}"))
+        finally:
+            svc.shutdown()
+
+
+class TestSlotPenaltyReadmission:
+    def test_probe_gates_readmission_with_backoff(self):
+        from horovod_tpu.elastic.driver import _SlotPenalties
+        verdict = {"alive": False}
+        calls = []
+
+        def probe(host):
+            calls.append(host)
+            return verdict["alive"]
+
+        p = _SlotPenalties(0.05, probe=probe, backoff_factor=2.0)
+        p.penalize("h1", window_s=0.05)
+        slots = [("h1", 2)]
+        assert p.apply(slots) == [("h1", 1)]      # penalty active
+        time.sleep(0.06)
+        # Expired but probe fails → renewed with doubled window.
+        assert p.apply(slots) == [("h1", 1)]
+        assert calls == ["h1"]
+        assert p._until["h1"][0][1] == pytest.approx(0.1)
+        time.sleep(0.11)
+        verdict["alive"] = True                   # host recovered
+        assert p.apply(slots) == [("h1", 2)]      # readmitted
+        assert calls == ["h1", "h1"]
+
+    def test_no_probe_expiry_readmits(self):
+        from horovod_tpu.elastic.driver import _SlotPenalties
+        p = _SlotPenalties(0.03)
+        p.penalize("h1")
+        assert p.apply([("h1", 1)]) == []
+        time.sleep(0.04)
+        assert p.apply([("h1", 1)]) == [("h1", 1)]
+
+    def test_slow_rank_window_distinct(self):
+        from horovod_tpu.elastic.driver import _SlotPenalties
+        p = _SlotPenalties(100.0)
+        p.penalize("h1", window_s=0.02)           # slow-rank short window
+        time.sleep(0.03)
+        assert p.apply([("h1", 1)]) == [("h1", 1)]
+
+    def test_host_alive_local(self):
+        from horovod_tpu.elastic.discovery import host_alive
+        assert host_alive("localhost")
+
+
+# ---------------------------------------------------------------------------
+# Error-feedback residual reset on a mid-run wire switch
+# ---------------------------------------------------------------------------
+
+class TestErrorFeedbackSpecSwitch:
+    def test_residual_reset_on_set_compression(self):
+        import optax
+        from horovod_tpu.compression import Compression
+
+        opt = hvd.DistributedOptimizer(optax.sgd(0.1),
+                                       compression=Compression.int8_blockwise)
+        rng = np.random.RandomState(0)
+        w = jnp.asarray(rng.standard_normal(512).astype(np.float32))
+        g = jnp.asarray(rng.standard_normal(512).astype(np.float32))
+        state = opt.init(w)
+        _, state = opt.update(g, state, w)
+        # Residual is measured against the int8 roundtrip of g.
+        expect_int8 = np.asarray(
+            g - Compression.int8_blockwise.local_roundtrip(g))
+        np.testing.assert_allclose(np.asarray(state.residual),
+                                   expect_int8, rtol=1e-6, atol=1e-7)
+        # Switch specs mid-run: the carried residual belongs to the OLD
+        # quantizer — the next update must start from zero, so the new
+        # residual is exactly g - fp8_roundtrip(g), NOT contaminated by
+        # the int8 residual.
+        opt.set_compression(Compression.fp8_blockwise)
+        _, state = opt.update(g, state, w)
+        expect_fp8 = np.asarray(
+            g - Compression.fp8_blockwise.local_roundtrip(g))
+        np.testing.assert_allclose(np.asarray(state.residual),
+                                   expect_fp8, rtol=1e-6, atol=1e-7)
+
+    def test_ef_default_rederived_unless_pinned(self):
+        import optax
+        from horovod_tpu.compression import Compression
+
+        opt = hvd.DistributedOptimizer(optax.sgd(0.1),
+                                       compression=Compression.int8_blockwise)
+        assert opt.error_feedback
+        opt.set_compression(Compression.none)
+        assert not opt.error_feedback          # re-derived: no lossy wire
+        pinned = hvd.DistributedOptimizer(optax.sgd(0.1),
+                                          compression=Compression.none,
+                                          error_feedback=True)
+        pinned.set_compression(Compression.int8_blockwise)
+        assert pinned.error_feedback           # explicit choice survives
+
+
+# ---------------------------------------------------------------------------
+# Runner CLI
+# ---------------------------------------------------------------------------
+
+class TestRunnerFaultCLI:
+    def test_bad_fault_spec_rejected_at_launch(self):
+        import sys
+        from horovod_tpu.runner.__main__ import main
+        with pytest.raises(ValueError, match="fault-spec"):
+            main(["-np", "1", "--fault-spec", "delay=80ms", "--",
+                  sys.executable, "-c", "pass"])
+
+    def test_fault_spec_and_adaptation_exported(self):
+        import sys
+        from horovod_tpu.runner.__main__ import main
+        rc = main(["-np", "1", "--no-tag-output",
+                   "--fault-spec", "rank=9:delay=1ms", "--adaptation",
+                   "--",
+                   sys.executable, "-c",
+                   "import os; assert os.environ['HOROVOD_TPU_FAULT_SPEC']"
+                   " == 'rank=9:delay=1ms'; "
+                   "assert os.environ['HOROVOD_TPU_ADAPTATION'] == '1'"])
+        assert rc == 0

@@ -1,0 +1,230 @@
+"""Self-healing acceptance (ISSUE 6, docs/adaptation.md) — slow tier.
+
+Three real multi-process scenarios over the TCP control plane:
+
+  1. Injected-slow-rank recovery: 4 processes, rank 2 delayed 100 ms per
+     step via ``HOROVOD_TPU_FAULT_SPEC``. The adaptation policy
+     escalates degradation tiers, evicts the rank, the elastic driver
+     re-rendezvouses at np=3, and steady-state step time recovers to
+     >= 1.5x the unmitigated stalled throughput with no human
+     intervention — the recovery curve lands in BENCH_STRAGGLER-shaped
+     data and the transitions in ``hvdtpu_adaptation_*`` metrics.
+  2. Evicted-host readmission: after the (generation-gated) fault
+     clears, the blacklist expires, the readmission probe passes, and
+     the host grows back in; the final training state matches a clean
+     replay from the restored commit at rtol 1e-5 (the PR 1 elastic
+     equivalence harness).
+  3. drop_announce → failure plane: a mute-but-breathing rank is
+     escalated from repeated stall reports to a typed WorkerFailure and
+     the elastic driver relaunches past it.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from horovod_tpu.elastic import FailureConfig, run_elastic  # noqa: E402
+from horovod_tpu.elastic.discovery import host_alive        # noqa: E402
+from horovod_tpu.runner.api import run as plain_run         # noqa: E402
+
+_BASE_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    "HOROVOD_TPU_DISABLE_NATIVE": "1",
+    "HOROVOD_CYCLE_TIME": "1",
+}
+
+
+class TestSlowRankRecovery:
+    def test_policy_escalates_evicts_and_recovers(self, tmp_path):
+        import bench_engine
+
+        raw = bench_engine.run_straggler_pair(str(tmp_path), steps=20,
+                                              commit_every=2)
+        med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+
+        # Unmitigated arm: the whole fleet runs at the straggler's pace
+        # (>= the injected delay) for every step.
+        un = raw["unmitigated_steps"]
+        assert len(un) == 20
+        un_steady = med([r["t_ms"] for r in un[len(un) // 2:]])
+        assert un_steady >= bench_engine.STRAGGLER_DELAY_MS
+
+        # Adaptive arm: evicted the slow rank, finished at np=3 in a
+        # later generation, and the post-recovery steady state beats the
+        # stalled one by the acceptance margin.
+        assert raw["final_world_size"] == bench_engine.STRAGGLER_NP - 1
+        assert raw["final_generation"] >= 1
+        tl = raw["adaptive_timeline"]
+        assert {r["step"] for r in tl} == set(range(20))  # no step lost
+        rec = [r["t_ms"] for r in tl if r["gen"] > 0]
+        rec_steady = med(rec[len(rec) // 2:])
+        assert un_steady / rec_steady >= 1.5
+
+        # Adaptation events visible in the metrics: the full ladder ran
+        # and the eviction names the injected straggler.
+        g0 = raw["adaptation_metrics"]["g0"]
+        trans = g0["hvdtpu_adaptation_transitions_total"]["values"]
+        for tier in ("shrink", "bf16", "int8x256", "fp8x256", "evict"):
+            assert trans.get(f'action="escalate",tier="{tier}"') == 1.0
+        ev = g0["hvdtpu_adaptation_evictions_total"]["values"]
+        assert ev.get(f'rank="{bench_engine.STRAGGLER_RANK}"') == 1.0
+
+
+def _make_quadratic_worker():
+    """Deterministic quadratic descent (the PR 1 elastic equivalence
+    harness): data is a pure function of (step, rank), gradients are
+    averaged over the world, so a trajectory depends only on (start
+    state, world size) — a clean replay from the same commit at the
+    same world size must match bit-for-bit up to float tolerance."""
+
+    def worker(total_steps, commit_every, replay_from=None):
+        import jax.numpy as jnp
+        import numpy as np
+
+        import horovod_tpu as hvd
+
+        hvd.init()
+        r = hvd.process_rank()
+        gen = hvd.generation()
+        state = hvd.ElasticState(params={"w": jnp.zeros((4,))})
+        state.restore(step=replay_from)
+        w = jnp.asarray(state.params["w"])
+        start = int(state.step)
+        target = jnp.asarray([1.0, -2.0, 3.0, 0.5])
+        for step in range(start, total_steps):
+            scale = 1.0 + 0.1 * ((step * 7 + r * 3) % 5)
+            grad = scale * (w - target)
+            grad = hvd.allreduce(grad, average=True, name=f"g.{step}")
+            w = w - 0.1 * grad
+            state.params = {"w": w}
+            if replay_from is None and (step + 1) % commit_every == 0:
+                state.commit(step + 1)
+        return {"w": np.asarray(w).tolist(), "gen": gen,
+                "size": hvd.size(), "start": start, "rank": r}
+
+    return worker
+
+
+class TestEvictedHostReadmission:
+    def test_probe_readmits_and_state_matches_replay(self, tmp_path):
+        """gen 0: rank 2 slow → evicted (short slow-rank blacklist).
+        gen 1: np=3 (the slot is still penalized); an injected crash
+        ends it. gen 2: the blacklist expired and the readmission probe
+        passed → the world regrows to np=4 and finishes. The final
+        state equals a clean np=4 replay from the commit gen 2 restored
+        (rtol 1e-5)."""
+        state_dir = str(tmp_path / "estate")
+        total, commit_every = 24, 2
+        probe_calls = []
+
+        def probe(host):
+            probe_calls.append(host)
+            return host_alive(host)
+
+        env = dict(_BASE_ENV, **{
+            "HOROVOD_TPU_FAULT_SPEC":
+                "rank=2:delay=100ms:gen=0; rank=0:crash_at=14:gen=1",
+            "HOROVOD_TPU_ADAPTATION": "1",
+            "HOROVOD_TPU_ADAPT_THRESHOLD": "0.03",
+            "HOROVOD_TPU_ADAPT_SUSTAIN": "0.3",
+            "HOROVOD_TPU_ADAPT_COOLDOWN": "30",
+            "HOROVOD_TPU_ADAPT_INTERVAL": "0.1",
+            "HOROVOD_TPU_STALL_CHECK_DISABLE": "1",
+        })
+        # Windows sized against the generation lifecycle: the slow-rank
+        # blacklist (5 s) outlasts gen 1's launch (backoff 1 s) so the
+        # evicted slot stays out, and expires before gen 2's discovery
+        # (gen 1 runtime + 3 s backoff) so the probe can readmit it;
+        # the crash blacklist (0.5 s) expires during the backoff alone.
+        cfg = FailureConfig(failure_timeout_s=60.0, max_restarts=3,
+                            backoff_s=1.0, backoff_factor=3.0,
+                            blacklist_s=0.5, slow_blacklist_s=5.0,
+                            readmit_probe=probe)
+        results = run_elastic(
+            _make_quadratic_worker(), args=(total, commit_every),
+            min_np=1, max_np=4, hosts="localhost:4",
+            state_dir=state_dir, config=cfg,
+            extra_env=env, start_timeout=300)
+
+        final = results[0]
+        assert final["gen"] == 2            # evict, crash, then regrow
+        assert final["size"] == 4           # the host was readmitted
+        assert len(results) == 4
+        assert probe_calls                  # the probe gated readmission
+        restored_step = final["start"]
+        assert 0 < restored_step < total
+
+        # Equivalence harness: clean np=4 replay from the same commit.
+        replay = plain_run(
+            _make_quadratic_worker(), args=(total, commit_every),
+            kwargs={"replay_from": restored_step}, np=4,
+            extra_env=dict(_BASE_ENV,
+                           HOROVOD_TPU_ELASTIC_DIR=state_dir),
+            start_timeout=300)
+        np.testing.assert_allclose(final["w"], replay[0]["w"], rtol=1e-5)
+        assert replay[0]["start"] == restored_step
+
+
+class TestDropAnnounceEscalation:
+    def test_mute_rank_escalates_to_failure_and_recovers(self, tmp_path):
+        """satellite: a stalled-tensor warning naming the same missing
+        rank repeatedly surfaces as a WorkerFailure to the elastic
+        driver (instead of warning forever), proven with a
+        drop_announce fault; the relaunched generation (fault is
+        gen-gated) completes."""
+        # from_step=8 places the mute past rank 1's restore broadcasts
+        # (~4 ticks) and past the first commit's barrier, so generation
+        # 1 provably resumes from a commit instead of step 0.
+        env = dict(_BASE_ENV, **{
+            "HOROVOD_TPU_FAULT_SPEC":
+                "rank=1:drop_announce:from_step=8:gen=0",
+            "HOROVOD_TPU_STALL_WARNING": "0.5",
+            "HOROVOD_TPU_FAILURE_TIMEOUT": "2",
+        })
+        cfg = FailureConfig(failure_timeout_s=2.0, max_restarts=2,
+                            backoff_s=0.2)
+        results = run_elastic(
+            _make_quadratic_worker(), args=(8, 2),
+            min_np=1, max_np=2, hosts="localhost:2",
+            state_dir=str(tmp_path / "estate"), config=cfg,
+            extra_env=env, start_timeout=300)
+        # The mute generation died on a typed failure and the relaunch
+        # (no fault in gen >= 1) finished the job from its last commit.
+        assert all(r["gen"] >= 1 for r in results)
+        assert all(r["start"] >= 2 for r in results)
+
+
+class TestBenchStragglerReproducible:
+    def test_bench_writes_json_and_recovery_ratio_above_one(self, tmp_path):
+        import bench_engine
+
+        out = tmp_path / "BENCH_STRAGGLER.json"
+        result = bench_engine.main_straggler(str(out), steps=16)
+        on_disk = json.loads(out.read_text())
+        assert on_disk["metric"] == "straggler_recovery"
+        # Deterministic fields: the eviction target, the final world
+        # shape, and the complete ladder.
+        assert on_disk["straggler_rank"] == bench_engine.STRAGGLER_RANK
+        rows = on_disk["rows"]
+        assert rows["adaptive"]["final_world_size"] == 3
+        assert rows["adaptive"]["final_generation"] >= 1
+        evs = on_disk["adaptation_events"]
+        assert evs["evictions"].get(
+            f'rank="{bench_engine.STRAGGLER_RANK}"') == 1.0
+        # The headline: recovery beats the stalled baseline.
+        assert on_disk["recovered_throughput_ratio"] is not None
+        assert on_disk["recovered_throughput_ratio"] > 1.0
+        assert result["recovered_throughput_ratio"] > 1.0
+        # Step timeline covers every step exactly once.
+        assert [r["step"] for r in on_disk["step_timeline"]] == \
+            sorted({r["step"] for r in on_disk["step_timeline"]})
