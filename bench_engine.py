@@ -539,6 +539,111 @@ def main_trace(out_path: str, rounds: int = TRACE_ROUNDS) -> dict:
 
 
 # --------------------------------------------------------------------------
+# Flight-recorder overhead A/B (--recorder): the black-box ring buffer
+# (observability/flight_recorder.py) is ALWAYS ON — every fused group
+# appends deliver/done tuples, every StepTimer step appends begin/end.
+# This bench proves that stays invisible: a 2-process fused-allreduce +
+# StepTimer loop with recording enabled vs disabled (toggled in-process
+# with alternating order per round, the BENCH_METRICS method), p25 of
+# pooled per-step wall times. Budget: < 1% of step time.
+# --------------------------------------------------------------------------
+
+RECORDER_STEPS = 40
+RECORDER_ROUNDS = 6
+RECORDER_WARMUP = 8
+RECORDER_BUDGET = 0.01
+
+
+def run_recorder_job(steps: int, warmup: int, rounds: int) -> dict:
+    """One 2-process job; returns {"on": [...], "off": [...]} per-step
+    wall times pooled over both ranks."""
+    from horovod_tpu.runner.api import run as hvd_run
+
+    def worker(steps, warmup, rounds):
+        import time
+
+        import jax.numpy as jnp
+
+        import horovod_tpu as hvd
+        from horovod_tpu.observability import StepTimer
+        from horovod_tpu.observability import flight_recorder as _fr
+        from horovod_tpu.ops import collective as _coll
+
+        hvd.init()
+        eng = _coll.engine()
+        timer = StepTimer("bench", batch_size=32)
+        xs = [jnp.ones((256,), jnp.float32) for _ in range(8)]
+
+        def hot(tag, n):
+            out = []
+            for step in range(n):
+                t0 = time.perf_counter()
+                with timer:
+                    with eng.burst():
+                        hs = [hvd.allreduce_async(
+                            x, average=False,
+                            name=f"rec.{tag}.{step}.{i}")
+                            for i, x in enumerate(xs)]
+                    for h in hs:
+                        h.wait()
+                out.append(time.perf_counter() - t0)
+            return out
+
+        hot("w", warmup)               # compile + engine bring-up
+        times = {"on": [], "off": []}
+        for rep in range(rounds):
+            order = (("on", "off") if rep % 2 == 0 else ("off", "on"))
+            for mode in order:
+                _fr.set_enabled(mode == "on")
+                times[mode].extend(hot(f"{rep}.{mode}", steps))
+        _fr.set_enabled(True)
+        eng.shutdown()
+        return times
+
+    env = {"JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+           "HOROVOD_TPU_DISABLE_NATIVE": "1",
+           "HOROVOD_CYCLE_TIME": "1"}
+    results = hvd_run(worker, args=(steps, warmup, rounds), np=2,
+                      extra_env=env, start_timeout=300)
+    pooled = {"on": [], "off": []}
+    for r in results:
+        pooled["on"].extend(r["on"])
+        pooled["off"].extend(r["off"])
+    return pooled
+
+
+def main_recorder(out_path: str, rounds: int = RECORDER_ROUNDS) -> dict:
+    times = run_recorder_job(RECORDER_STEPS, RECORDER_WARMUP, rounds)
+    p25 = lambda xs: sorted(xs)[len(xs) // 4]  # noqa: E731
+    t_on, t_off = p25(times["on"]), p25(times["off"])
+    overhead = t_on / t_off - 1.0
+    result = {
+        "metric": "flight_recorder_overhead",
+        "note": ("2-process fused-allreduce + StepTimer loop, flight "
+                 "recorder always-on vs disabled, toggled in-process "
+                 "with alternating order per round (the BENCH_METRICS "
+                 "method); p25 of pooled per-step wall times "
+                 "(wall-clock, informational); the slow-tier guard "
+                 "asserts on < 1.01 * off"),
+        "steps_per_mode_per_round": RECORDER_STEPS,
+        "rounds": rounds,
+        "tensors_per_step": 8,
+        "rows": {
+            "recorder_on": {"step_time_ms": round(t_on * 1e3, 4)},
+            "recorder_off": {"step_time_ms": round(t_off * 1e3, 4)},
+        },
+        "overhead_frac": round(overhead, 6),
+        "budget_frac": RECORDER_BUDGET,
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(result))
+    return result
+
+
+# --------------------------------------------------------------------------
 # Straggler A/B (--straggler): a 4-process job with one rank delayed via
 # HOROVOD_TPU_FAULT_SPEC, run WITHOUT adaptation (every fused collective
 # stalls behind the slow rank for the whole job) and WITH the adaptation
@@ -818,6 +923,13 @@ if __name__ == "__main__":
                     help="run the injected-slow-rank A/B (no adaptation "
                          "vs adaptation + eviction) and write "
                          "BENCH_STRAGGLER.json")
+    ap.add_argument("--recorder", action="store_true",
+                    help="run the flight-recorder overhead A/B "
+                         "(always-on ring buffer vs disabled) and "
+                         "write BENCH_RECORDER.json")
+    ap.add_argument("--recorder-rounds", type=int,
+                    default=RECORDER_ROUNDS,
+                    help="alternating on/off rounds for --recorder")
     ap.add_argument("--straggler-steps", type=int, default=STRAGGLER_STEPS,
                     help="training steps per arm for --straggler")
     ap.add_argument("--trace-rounds", type=int, default=TRACE_ROUNDS,
@@ -842,5 +954,9 @@ if __name__ == "__main__":
         main_straggler(args.out or os.path.join(here,
                                                 "BENCH_STRAGGLER.json"),
                        steps=args.straggler_steps)
+    elif args.recorder:
+        main_recorder(args.out or os.path.join(here,
+                                               "BENCH_RECORDER.json"),
+                      rounds=args.recorder_rounds)
     else:
         main()

@@ -211,6 +211,14 @@ class FaultInjector:
     def tick(self) -> int:
         return self._tick
 
+    def _note_fault(self, kind: str, tick: int) -> None:
+        """Flight-recorder breadcrumb, at most once per (kind, window
+        entry): a per-enqueue event for an 80 ms delay fault would be
+        noise; the postmortem only needs to know the fault was ACTIVE."""
+        if tick % 50 == 0 or tick == 0:
+            from ..observability import flight_recorder as _flight
+            _flight.recorder().note("fault", (kind, tick))
+
     def on_enqueue(self) -> None:
         """One collective enqueued: advance the tick and apply any
         active delay/slow_h2d/crash faults."""
@@ -221,14 +229,24 @@ class FaultInjector:
                 self._m["crash"].inc()
                 _log.error("fault injection: crash_at=%d reached on "
                            "rank %d — SIGKILL self", t, self.rank)
+                # Final gasp: a SIGKILL leaves no excepthook/signal
+                # window, but the injector KNOWS it is about to die —
+                # dump the flight recorder + metrics first, exactly what
+                # a real deployment's host agent cannot do for a kernel
+                # kill (docs/postmortem.md).
+                from ..observability import flight_recorder as _flight
+                _flight.recorder().note("fault", ("crash", t))
+                _flight.dump_on("fault_crash")
                 os.kill(os.getpid(), signal.SIGKILL)
             if not c.in_window(t):
                 continue
             if c.delay_s > 0.0:
                 self._m["delay"].inc()
+                self._note_fault("delay", t)
                 time.sleep(c.delay_s)
             if c.slow_h2d_s > 0.0:
                 self._m["slow_h2d"].inc()
+                self._note_fault("slow_h2d", t)
                 time.sleep(c.slow_h2d_s)
 
     def drop_announce_active(self) -> bool:

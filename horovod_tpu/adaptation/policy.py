@@ -44,6 +44,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional, Tuple
 
+from ..observability import flight_recorder as _flight
 from ..observability import registry as _obs
 from ..utils import env as _env
 from ..utils.logging import get_logger
@@ -209,6 +210,9 @@ class AdaptationPolicy:
             _log.warning(
                 "adaptation_event action=evict rank=%d lateness_ms=%.1f",
                 rank, lateness * 1e3)
+            _flight.recorder().note("adapt", (
+                "evict", self.tier, name, rank,
+                round(lateness * 1e3, 3)))
             return {"action": "escalate", "tier": self.tier,
                     "name": name, "rank": rank, "lateness_s": lateness}
         self.tier += 1
@@ -220,6 +224,8 @@ class AdaptationPolicy:
         _log.warning(
             "adaptation_event action=escalate tier=%d name=%s rank=%d "
             "lateness_ms=%.1f", self.tier, name, rank, lateness * 1e3)
+        _flight.recorder().note("adapt", (
+            "escalate", self.tier, name, rank, round(lateness * 1e3, 3)))
         return ev
 
     def _deescalate(self, lateness: float, now: float) -> Optional[dict]:
@@ -237,5 +243,7 @@ class AdaptationPolicy:
         _log.warning(
             "adaptation_event action=deescalate tier=%d dropped=%s "
             "lateness_ms=%.1f", self.tier, name, lateness * 1e3)
+        _flight.recorder().note("adapt", (
+            "deescalate", self.tier, name, -1, round(lateness * 1e3, 3)))
         return {"action": "deescalate", "tier": self.tier, "name": name,
                 "rank": -1, "lateness_s": lateness}

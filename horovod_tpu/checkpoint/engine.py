@@ -197,6 +197,9 @@ class CheckpointEngine:
         self._m["bytes"].inc(written)
         self._m["last_step"].set(handle.step)
         self._m["save"].observe(time.perf_counter() - t0)
+        from ..observability import flight_recorder as _flight
+        _flight.recorder().note("checkpoint",
+                                ("commit", handle.step, "sharded"))
 
     def _commit_rank0(self, handle: SaveHandle,
                       layouts: Dict[str, LeafLayout], pcount: int,

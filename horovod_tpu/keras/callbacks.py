@@ -102,9 +102,13 @@ class MetricsCallback(keras.callbacks.Callback):
     ``logs`` so they surface in progress bars and History."""
 
     def __init__(self, batch_size: Optional[int] = None,
-                 log_metrics: bool = False):
+                 log_metrics: bool = False,
+                 flops_per_step: Optional[float] = None):
         super().__init__()
-        self._timer = StepTimer("keras", batch_size=batch_size)
+        # flops_per_step (e.g. observability.flops_of_lowered) arms the
+        # hvdtpu_mfu / hvdtpu_model_flops_per_second gauges.
+        self._timer = StepTimer("keras", batch_size=batch_size,
+                                flops_per_step=flops_per_step)
         self._log_metrics = log_metrics
 
     def on_train_batch_begin(self, batch, logs=None):
@@ -115,7 +119,9 @@ class MetricsCallback(keras.callbacks.Callback):
         if self._log_metrics and logs is not None:
             if self._timer.batch_size:
                 logs["samples_per_sec"] = self._timer.last_samples_per_s
-            logs["allreduce_share"] = self._timer.last_allreduce_share
+            logs["collective_share"] = self._timer.last_collective_share
+            # Deprecated alias (same all-ops value; see docs/metrics.md).
+            logs["allreduce_share"] = self._timer.last_collective_share
 
 
 class LearningRateScheduleCallback(keras.callbacks.Callback):

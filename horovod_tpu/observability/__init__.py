@@ -21,15 +21,23 @@ from .registry import (Counter, Gauge, Histogram, MetricsRegistry, enabled,
                        set_enabled)
 from .registry import registry as get_registry
 from .registry import snapshot as metrics_snapshot
-from .export import (MetricsServer, histogram_percentiles,
-                     maybe_start_exporters, prometheus_text, stop_exporters,
-                     with_percentiles, write_json_snapshot)
-from .step_metrics import StepTimer
+from .export import (MetricsServer, final_metrics_flush,
+                     histogram_percentiles, maybe_start_exporters,
+                     prometheus_text, stop_exporters, with_percentiles,
+                     write_json_snapshot)
+from .step_metrics import StepTimer, flops_of_lowered
+# NOTE: like ``registry`` above, the name ``flight_recorder`` must keep
+# resolving to the submodule (engine/tools do ``from ..observability
+# import flight_recorder as _fr``); the accessor is exported as
+# :func:`get_flight_recorder`.
+from .flight_recorder import FlightRecorder
+from .flight_recorder import recorder as get_flight_recorder
 
 __all__ = [
-    "Counter", "Gauge", "Histogram", "MetricsRegistry", "MetricsServer",
-    "StepTimer", "enabled", "get_registry", "histogram_percentiles",
-    "maybe_start_exporters", "metrics_snapshot", "prometheus_text",
-    "registry", "set_enabled", "stop_exporters", "with_percentiles",
-    "write_json_snapshot",
+    "Counter", "FlightRecorder", "Gauge", "Histogram", "MetricsRegistry",
+    "MetricsServer", "StepTimer", "enabled", "final_metrics_flush",
+    "flight_recorder", "flops_of_lowered", "get_flight_recorder",
+    "get_registry", "histogram_percentiles", "maybe_start_exporters",
+    "metrics_snapshot", "prometheus_text", "registry", "set_enabled",
+    "stop_exporters", "with_percentiles", "write_json_snapshot",
 ]

@@ -310,6 +310,23 @@ def maybe_start_exporters() -> None:
             atexit.register(stop_exporters)
 
 
+def final_metrics_flush() -> None:
+    """Final-gasp snapshot write (docs/postmortem.md): rewrite the
+    configured HOROVOD_TPU_METRICS_FILE with the current registry state
+    RIGHT NOW — called from the flight recorder's crash hooks so the
+    file is never stale-at-death (the periodic writer's last pass can
+    be up to one interval old, and a SIGKILLed process never reaches
+    its stop() flush). Works whether or not the periodic writer was
+    started; a no-op when no file is configured."""
+    path = _resolved_file_path()
+    if not path:
+        return
+    try:
+        write_json_snapshot(path)
+    except OSError as e:  # never fail a death path over telemetry
+        _log.warning("final metrics flush failed: %s", e)
+
+
 def stop_exporters() -> None:
     """Stop the exporters, flushing one final JSON snapshot."""
     global _json_writer, _server, _started

@@ -46,6 +46,7 @@ from .. import quantization as _quant
 from .. import topology as _topo
 from ..executor import (ALLGATHER, ALLREDUCE, BROADCAST, CollectiveExecutor,
                         default_executor)
+from ..observability import flight_recorder as _flight
 from ..observability import registry as _obs
 from ..utils import env as _env
 from ..utils.logging import get_logger
@@ -518,6 +519,8 @@ class CollectiveEngine:
                         self._write_clock_meta(0.0, 0.0, synced=True)
                     else:
                         self._trace_clock_pending = True
+                else:
+                    self._arm_blackbox_clock()
                 core.set_execute_callback(self._on_native_execute)
                 if topo.process_count > 1:
                     core.set_group_callback(self._on_native_group)
@@ -547,6 +550,7 @@ class CollectiveEngine:
                 return self.timeline
             self._timeline_tried = True
             if not _env.timeline_path() or self._shutdown:
+                self._arm_blackbox_clock()
                 return None
             try:
                 topo = _topo._get()
@@ -555,6 +559,7 @@ class CollectiveEngine:
                 return None
             path = _env.resolved_timeline_path(rank)
             if not path:
+                self._arm_blackbox_clock()
                 return None
             try:
                 from .timeline_py import PyTimeline
@@ -578,6 +583,24 @@ class CollectiveEngine:
                 self._trace_clock_pending = True
             return self.timeline
 
+    def _arm_blackbox_clock(self) -> None:
+        """With a blackbox dir configured but NO per-rank trace, the
+        clock handshake must still run once so postmortem dumps align
+        onto rank 0's clock: nonzero MP ranks mark the sync pending
+        (the next control-plane cycle runs it); rank 0 and
+        single-process jobs ARE the reference clock."""
+        if not _env.blackbox_dir():
+            return
+        try:
+            topo = _topo._get()
+            rank, world = topo.process_index, topo.process_count
+        except Exception:
+            return
+        if rank == 0 or world == 1:
+            _flight.recorder().set_clock_meta(0.0, 0.0, True)
+        else:
+            self._trace_clock_pending = True
+
     def _write_clock_meta(self, offset_s: float, rtt_s: float,
                           synced: bool) -> None:
         """Record this rank's trace clock header: in-band metadata when
@@ -585,6 +608,11 @@ class CollectiveEngine:
         (the native writer's file is owned by C++ — the sidecar is the
         only channel there). ``offset_s`` is the estimated rank-0
         monotonic clock minus ours."""
+        # The flight recorder's dump header carries the same clock
+        # fields, so the postmortem tool aligns per-rank dumps exactly
+        # like the trace merger aligns per-rank timelines
+        # (docs/postmortem.md).
+        _flight.recorder().set_clock_meta(offset_s, rtt_s, synced)
         path = self._trace_path
         if not path:
             return
@@ -800,7 +828,15 @@ class CollectiveEngine:
         if not pairs:
             return
         self._metrics.group_delivered(op, [r for _, r in pairs], t_deliver)
+        # Flight-recorder group lifecycle (docs/postmortem.md): the
+        # native SP wire carries no seq, so a local counter keys the
+        # events (mirrors the timeline's _local_group_seq role).
+        seq = self._local_group_seq
+        self._local_group_seq += 1
+        _flight.recorder().group_deliver(seq, _op_name(op), len(pairs))
         if err:
+            _flight.recorder().group_error(seq, _op_name(op), len(pairs),
+                                           err)
             core.complete([i for i, _ in pairs], 2, err)
             for i, r in pairs:
                 core.release(i)
@@ -837,13 +873,18 @@ class CollectiveEngine:
                 results = self._execute_group(ex, reqs)
             except BaseException as e:
                 msg = str(e)
+                _flight.recorder().group_error(seq, _op_name(op),
+                                               len(reqs), msg)
                 core.complete(ids, 2, msg)
                 for (i, r) in sub:
                     core.release(i)
                     r.handle._fulfill(error=_as_error(e))
                 continue
+            t_end = time.monotonic()
             self._metrics.group_executed(op, len(reqs), t_deliver,
-                                         t_start, time.monotonic())
+                                         t_start, t_end)
+            _flight.recorder().group_done(seq, _op_name(op), len(reqs),
+                                          t_deliver, t_start, t_end)
             core.complete(ids, 0, "")
             for (i, r), out in zip(sub, results):
                 core.release(i)
@@ -870,6 +911,10 @@ class CollectiveEngine:
                 self._coord_stall_lines[name] = (line, time.monotonic())
         failures = getattr(resp, "failures", None)
         if failures:
+            for f in failures:
+                _flight.recorder().note("failure", (
+                    int(f.get("rank", -1)), str(f.get("kind", "")),
+                    str(f.get("detail", ""))[:300]))
             # The coordinator escalated (heartbeat loss / stall past the
             # failure timeout): pending quorums can never complete, so
             # fail every in-flight handle with the TYPED event — the
@@ -894,7 +939,12 @@ class CollectiveEngine:
                 # replace wholesale — the coordinator ships the full
                 # (small) list every fetch, so a late joiner catches up
                 # in one response.
-                self._wire_epochs = [(int(s), str(sp)) for s, sp in we]
+                epochs = [(int(s), str(sp)) for s, sp in we]
+                if epochs != self._wire_epochs:
+                    _flight.recorder().note(
+                        "wire_epoch", (";".join(
+                            f"{s}:{sp or 'raw'}" for s, sp in epochs),))
+                self._wire_epochs = epochs
             cyc = params.get("cycle_time_ms")
             if cyc and abs(cyc - self.cycle_time_s * 1000.0) > 1e-9:
                 self.cycle_time_s = cyc / 1000.0
@@ -1001,7 +1051,11 @@ class CollectiveEngine:
                 r.handle._fulfill(error=desync)
             return
         self._metrics.group_delivered(op, [r for _, r in pairs], t_deliver)
+        _flight.recorder().group_deliver(group_seq, _op_name(op),
+                                         len(pairs))
         if err:
+            _flight.recorder().group_error(group_seq, _op_name(op),
+                                           len(pairs), err)
             ids = [i for i, _ in pairs]
             core.complete(ids, 2, err)
             for i, r in pairs:
@@ -1041,13 +1095,19 @@ class CollectiveEngine:
                 results = self._execute_group_mp(ex, reqs, meta, topo, op)
             except BaseException as e:
                 msg = str(e)
+                _flight.recorder().group_error(group_seq, _op_name(op),
+                                               len(reqs), msg)
                 core.complete(ids, 2, msg)
                 for (i, r) in sub:
                     core.release(i)
                     r.handle._fulfill(error=_as_error(e))
                 continue
+            t_end = time.monotonic()
             self._metrics.group_executed(op, len(reqs), t_deliver,
-                                         t_start, time.monotonic())
+                                         t_start, t_end)
+            _flight.recorder().group_done(group_seq, _op_name(op),
+                                          len(reqs), t_deliver, t_start,
+                                          t_end)
             core.complete(ids, 0, "")
             for (i, r), out in zip(sub, results):
                 core.release(i)
@@ -1314,6 +1374,8 @@ class CollectiveEngine:
             raise err
         if reqs:
             self._metrics.group_delivered(reqs[0].op, reqs, t_deliver)
+            _flight.recorder().group_deliver(
+                group.get("seq"), _op_name(reqs[0].op), len(reqs))
         tl = self.timeline
         if tl is not None:
             for r in reqs:
@@ -1324,6 +1386,10 @@ class CollectiveEngine:
                 tl.negotiate_span(r.name, _op_name(r.op), r.enqueued_at,
                                   t_deliver, group=group.get("seq"))
         if group["error"]:
+            if reqs:
+                _flight.recorder().group_error(
+                    group.get("seq"), _op_name(reqs[0].op), len(reqs),
+                    group["error"])
             for r in reqs:
                 r.handle._fulfill(error=HorovodInternalError(group["error"]))
             return
@@ -1354,6 +1420,9 @@ class CollectiveEngine:
                     for r in sub:
                         tl.execute_span(r.name, _xla_activity(sub[0].op),
                                         t_start, t_end)
+                _flight.recorder().group_error(
+                    group.get("seq"), _op_name(sub[0].op), len(sub),
+                    str(e))
                 err = _as_error(e)
                 for r in sub:
                     r.handle._fulfill(error=err)
@@ -1361,6 +1430,9 @@ class CollectiveEngine:
             t_end = time.monotonic()
             self._metrics.group_executed(sub[0].op, len(sub), t_deliver,
                                          t_start, t_end)
+            _flight.recorder().group_done(
+                group.get("seq"), _op_name(sub[0].op), len(sub),
+                t_deliver, t_start, t_end)
             for r, out in zip(sub, results):
                 if tl is not None:
                     # One complete XLA span per tensor, shape riding
@@ -1563,6 +1635,13 @@ class CollectiveEngine:
         _log.error("escalated %d stalled collectives to WorkerFailure "
                    "after %.1fs: %s", len(overdue),
                    self.failure_timeout_s, names)
+        # Stall escalation is a death sentence for the pending work —
+        # capture the evidence NOW, while the engine still remembers the
+        # episode (the submitter may hang instead of exiting cleanly).
+        _flight.recorder().note(
+            "stall", (names, round(max(now - r.enqueued_at
+                                       for r in overdue), 3)))
+        _flight.dump_on("stall_escalation")
 
     # ------------------------------------------------------------- execution
 
@@ -1616,9 +1695,10 @@ class CollectiveEngine:
             names = [r.name for r in group]
             op = group[0].op
             self._metrics.group_delivered(op, group, t_drain)
+            seq = self._local_group_seq
+            self._local_group_seq += 1
+            _flight.recorder().group_deliver(seq, _op_name(op), len(group))
             if tl is not None:
-                seq = self._local_group_seq
-                self._local_group_seq += 1
                 for r in group:
                     # Same span diet as the MP path: one complete
                     # NEGOTIATE span anchored at the enqueue tick, one
@@ -1637,12 +1717,16 @@ class CollectiveEngine:
                     for n in names:
                         tl.execute_span(n, _xla_activity(op), t_start,
                                         t_end)
+                _flight.recorder().group_error(seq, _op_name(op),
+                                               len(group), str(e))
                 for r in group:
                     r.handle._fulfill(error=_as_error(e))
                 continue
             t_end = time.monotonic()
             self._metrics.group_executed(op, len(group), t_drain,
                                          t_start, t_end)
+            _flight.recorder().group_done(seq, _op_name(op), len(group),
+                                          t_drain, t_start, t_end)
             with self._lock:
                 for r in group:
                     self._in_flight.pop(r.name, None)

@@ -1100,6 +1100,14 @@ class CoordinatorClient:
                     break
                 time.sleep(delay * (0.5 + self._jitter.random()))
                 delay = min(delay * 2.0, self._backoff_max_s)
+        # Flight-recorder evidence (docs/postmortem.md): a worker whose
+        # control plane died records WHY before the typed error unwinds
+        # the engine — the postmortem tool reads this as "coordinator
+        # (rank 0) was unreachable from rank N at t".
+        from ..observability import flight_recorder as _flight
+        _flight.recorder().note("coord_error", (
+            f"coordinator at {self._addresses} unreachable after "
+            f"{self._retries} attempts: {last}",))
         raise CoordinatorUnreachableError(
             f"rank {self._rank}: coordinator at {self._addresses} "
             f"unreachable after {self._retries} attempts with "

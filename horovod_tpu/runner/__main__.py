@@ -84,6 +84,14 @@ def main(argv=None) -> int:
                              "escalate wire compression, and (with "
                              "--elastic) evict the slow rank; exported "
                              "as HOROVOD_TPU_ADAPTATION=1")
+    parser.add_argument("--blackbox-dir", default=None,
+                        help="flight-recorder crash-dump directory "
+                             "(docs/postmortem.md): on a crash, "
+                             "SIGTERM, stall escalation or eviction "
+                             "each rank writes blackbox-rank{rank}"
+                             ".jsonl here for `python -m "
+                             "horovod_tpu.tools.postmortem`; exported "
+                             "as HOROVOD_TPU_BLACKBOX")
     parser.add_argument("--timeout", type=float, default=None,
                         help="overall job timeout in seconds")
     parser.add_argument("--no-tag-output", action="store_true",
@@ -113,6 +121,8 @@ def main(argv=None) -> int:
         # the single-writer and all-ranks capture modes — and elastic
         # relaunches keep rank-correct paths across generations.
         extra_env["HOROVOD_TPU_TIMELINE"] = args.timeline
+    if args.blackbox_dir:
+        extra_env["HOROVOD_TPU_BLACKBOX"] = args.blackbox_dir
 
     provider = None
     hosts = args.hosts

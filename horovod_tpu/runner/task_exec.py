@@ -58,6 +58,15 @@ def main() -> int:
         # Exit 0 once the traceback is registered: the driver raises the
         # real exception from wait_for_results; a nonzero exit here would
         # race failfast into masking it with a generic "exited with code 1".
+        # Final gasp FIRST (docs/postmortem.md): function-mode workers
+        # catch the exception here — sys.excepthook never fires — so
+        # this is the flight recorder's last chance to dump the ring
+        # and flush the metrics file.
+        try:
+            from ..observability import flight_recorder as _flight
+            _flight.dump_on("exception", exc=e)
+        except Exception:
+            pass
         error = traceback.format_exc()
         try:
             # A typed WorkerFailure (e.g. a slow_rank eviction from the

@@ -53,13 +53,18 @@ __all__ = [
 class StepMetrics(_StepTimer):
     """Per-step telemetry hook for TF training loops (docs/metrics.md):
     ``hvdtpu_step_seconds`` / ``hvdtpu_samples_per_second`` /
-    ``hvdtpu_allreduce_step_share``, labeled ``framework=tensorflow``.
-    Use as a context manager around each train step; the allreduce share
-    comes from the engine's execute-time accounting, so it covers the
-    collectives issued through DistributedGradientTape/Optimizer."""
+    ``hvdtpu_collective_step_share`` (plus the deprecated
+    ``hvdtpu_allreduce_step_share`` alias), the per-step
+    input/h2d/compute/collective attribution, HBM gauges, and MFU when
+    ``flops_per_step`` is supplied — labeled ``framework=tensorflow``.
+    Use as a context manager around each train step; the collective
+    share comes from the engine's execute-time accounting, so it covers
+    the collectives issued through DistributedGradientTape/Optimizer."""
 
-    def __init__(self, batch_size: Optional[int] = None):
-        super().__init__("tensorflow", batch_size=batch_size)
+    def __init__(self, batch_size: Optional[int] = None,
+                 flops_per_step: Optional[float] = None):
+        super().__init__("tensorflow", batch_size=batch_size,
+                         flops_per_step=flops_per_step)
 
 # Host-bridge call counter (observability/tests): index 0 counts how many
 # py_function/host crossings carried a GROUP of tensors — the fusion-

@@ -50,9 +50,21 @@ from ..observability.registry import LATENCY_BUCKETS
 from ..ops.timeline_jit import _load_timeline
 from ..ops.timeline_py import TRACE_META_EVENT, clock_sidecar_path
 
-PHASES = ("negotiate", "queue", "h2d", "execute")
+PHASES = ("negotiate", "queue", "h2d", "execute", "input", "compute")
 
-_PHASE_OF = {"QUEUE": "queue", "MEMCPY_IN_FUSION_BUFFER": "h2d"}
+# Verdict buckets (docs/tracing.md): which phases mean the rank is
+# losing time to communication, to the input pipeline, or to compute.
+_BUCKET_OF = {"negotiate": "comm", "queue": "comm", "execute": "comm",
+              "h2d": "input", "input": "input", "compute": "compute"}
+_VERDICT_OF = {"comm": "comm-bound", "input": "input-bound",
+               "compute": "compute-bound"}
+
+_PHASE_OF = {"QUEUE": "queue", "MEMCPY_IN_FUSION_BUFFER": "h2d",
+             # StepTimer's per-step attribution spans (docs/metrics.md):
+             # emitted on the "_step" pseudo-process when a shim
+             # StepTimer runs next to the Python timeline writer.
+             "STEP_INPUT": "input", "STEP_H2D": "h2d",
+             "STEP_COMPUTE": "compute"}
 
 
 def _phase_of(name: str) -> Optional[str]:
@@ -86,22 +98,39 @@ class RankTrace:
         return (float(self.meta.get("start_mono_us", 0))
                 + float(self.meta.get("offset_to_rank0_us", 0.0)))
 
+    @property
+    def clock_missing(self) -> bool:
+        """No clock metadata at all (neither in-band nor sidecar) —
+        alignment degraded to zero offset."""
+        return not self.meta
+
 
 def _read_meta(path: str, events: List[dict]) -> dict:
     """Clock metadata: the LAST in-trace meta event (a sync supersedes
     the unsynced header) or the sidecar; empty dict when neither exists
     (offset 0 — single-host captures still merge correctly since all
     writers share one monotonic clock only if starts are recorded, so a
-    missing header degrades alignment to per-file-relative time)."""
+    missing header degrades alignment to per-file-relative time).
+
+    A missing or corrupt ``.clock.json`` sidecar must DEGRADE, not fail
+    the whole merge: the native writer's sidecar is easily lost when
+    only the trace files are copied off the pod, and N-1 good traces
+    are still worth aligning. The fallback is zero offset, flagged so
+    the report header can warn."""
     meta: dict = {}
     for e in events:
         if e.get("ph") == "M" and e.get("name") == TRACE_META_EVENT:
             meta = dict(e.get("args") or {})
     if not meta:
         sidecar = clock_sidecar_path(path)
-        if os.path.exists(sidecar):
-            with open(sidecar) as f:
-                meta = json.load(f)
+        try:
+            if os.path.exists(sidecar):
+                with open(sidecar) as f:
+                    meta = json.load(f)
+                if not isinstance(meta, dict):
+                    meta = {}
+        except (OSError, ValueError):
+            meta = {}
     return meta
 
 
@@ -240,7 +269,7 @@ def _arrivals(trace: RankTrace) -> Dict[str, float]:
         if not s["name"].startswith("NEGOTIATE_"):
             continue
         tensor = trace.tensor_of.get(s["pid"], str(s["pid"]))
-        if tensor.startswith(("jit::", "_cycles")):
+        if tensor.startswith(("jit::", "_cycles", "_step")):
             continue
         group = s["args"].get("group")
         if group is not None:
@@ -254,8 +283,12 @@ def _arrivals(trace: RankTrace) -> Dict[str, float]:
     return arrivals
 
 
-def _phase_means(trace: RankTrace) -> Dict[str, float]:
-    """Mean span duration (seconds) per lifecycle phase on this rank."""
+def _phase_stats(trace: RankTrace
+                 ) -> Tuple[Dict[str, float], Dict[str, float]]:
+    """(mean span duration, total seconds) per lifecycle phase on this
+    rank — one pass over the spans. Totals feed the bucket shares the
+    bound-verdict is computed from; means feed the fleet-median
+    deviation attribution."""
     sums = {p: 0.0 for p in PHASES}
     counts = {p: 0 for p in PHASES}
     for s in _spans(trace.events):
@@ -264,8 +297,9 @@ def _phase_means(trace: RankTrace) -> Dict[str, float]:
             continue
         sums[phase] += s["dur"] / 1e6
         counts[phase] += 1
-    return {p: (sums[p] / counts[p] if counts[p] else 0.0)
-            for p in PHASES}
+    means = {p: (sums[p] / counts[p] if counts[p] else 0.0)
+             for p in PHASES}
+    return means, sums
 
 
 def _hist_snapshot(samples: List[float]) -> dict:
@@ -307,13 +341,17 @@ def analyze(traces: List[RankTrace], top: int = 0) -> dict:
         group_rows.append({
             "group": key, "critical_rank": critical,
             "lateness_s": round((arr[critical] - t0) / 1e6, 6)})
-    phase_means = {t.rank: _phase_means(t) for t in traces}
+    stats = {t.rank: _phase_stats(t) for t in traces}
+    phase_means = {r: stats[r][0] for r in ranks}
+    phase_totals = {r: stats[r][1] for r in ranks}
     # Lower median: with an even rank count the upper median would let a
     # single slow rank set its own baseline and mask itself.
     fleet_median = {
         p: sorted(phase_means[r][p] for r in ranks)[(len(ranks) - 1) // 2]
         for p in PHASES}
     per_rank = {}
+    bucket_fleet = {"input": 0.0, "compute": 0.0, "comm": 0.0}
+    any_step_spans = False
     for r in ranks:
         samples = lateness[r]
         # Same estimator as the live hvdtpu_negotiate_lateness_seconds
@@ -331,6 +369,31 @@ def analyze(traces: List[RankTrace], top: int = 0) -> dict:
         loses_in = (worst_phase
                     if dev[worst_phase] > max(1e-6, 0.1 * mean)
                     else "upstream(compute/input)")
+        # Bound verdict (docs/tracing.md): where does this rank's time
+        # GO, in absolute terms? With StepTimer step spans in the trace
+        # the input/compute buckets are real and the shares over
+        # input+compute+comm decide; without them the deviation-based
+        # attribution is the only evidence (a uniformly slow input
+        # pipeline is invisible to deviation — instrument the loop with
+        # a StepTimer to expose it).
+        totals = phase_totals[r]
+        bucket = {"input": totals["input"] + totals["h2d"],
+                  "compute": totals["compute"],
+                  "comm": (totals["negotiate"] + totals["queue"]
+                           + totals["execute"])}
+        has_step = (totals["input"] + totals["compute"]) > 0
+        bucket_total = sum(bucket.values())
+        shares = {b: (v / bucket_total if bucket_total > 0 else 0.0)
+                  for b, v in bucket.items()}
+        if has_step:
+            any_step_spans = True
+            for b, v in bucket.items():
+                bucket_fleet[b] += v
+            verdict = _VERDICT_OF[max(bucket, key=bucket.get)]
+        elif loses_in.startswith("upstream"):
+            verdict = "upstream(compute/input)"
+        else:
+            verdict = _VERDICT_OF[_BUCKET_OF[loses_in]]
         per_rank[str(r)] = {
             "groups": len(samples),
             "groups_last": last_count[r],
@@ -343,7 +406,9 @@ def analyze(traces: List[RankTrace], top: int = 0) -> dict:
             },
             "phase_mean_s": {p: round(phase_means[r][p], 6)
                              for p in PHASES},
+            "phase_share": {b: round(shares[b], 4) for b in shares},
             "loses_most_in": loses_in,
+            "verdict": verdict,
         }
     order = sorted(ranks,
                    key=lambda r: (per_rank[str(r)]["lateness"]["p50_s"],
@@ -352,8 +417,16 @@ def analyze(traces: List[RankTrace], top: int = 0) -> dict:
                    reverse=True)
     stragglers = [{"rank": r, **per_rank[str(r)]["lateness"],
                    "groups_last": per_rank[str(r)]["groups_last"],
-                   "loses_most_in": per_rank[str(r)]["loses_most_in"]}
+                   "loses_most_in": per_rank[str(r)]["loses_most_in"],
+                   "verdict": per_rank[str(r)]["verdict"]}
                   for r in order]
+    # Run-level bound verdict: the fleet's dominant cost bucket. Only
+    # meaningful when step spans exist — without input/compute data the
+    # trace ONLY contains collective spans and "comm" would win
+    # vacuously.
+    fleet_total = sum(bucket_fleet.values())
+    bound = (_VERDICT_OF[max(bucket_fleet, key=bucket_fleet.get)]
+             if any_step_spans and fleet_total > 0 else None)
     report = {
         "ranks": ranks,
         "groups_scored": len(common),
@@ -362,10 +435,15 @@ def analyze(traces: List[RankTrace], top: int = 0) -> dict:
                 t.meta.get("offset_to_rank0_us", 0.0)),
             "rtt_us": float(t.meta.get("rtt_us", 0.0)),
             "synced": bool(t.meta.get("clock_synced", False)),
+            "meta_missing": t.clock_missing,
         } for t in traces},
         "per_rank": per_rank,
         "stragglers": stragglers,
         "top_straggler": stragglers[0] if stragglers else None,
+        "bound": bound,
+        "fleet_share": ({b: round(v / fleet_total, 4)
+                         for b, v in bucket_fleet.items()}
+                        if fleet_total > 0 else None),
     }
     if top:
         worst = sorted(group_rows, key=lambda g: -g["lateness_s"])[:top]
@@ -375,27 +453,45 @@ def analyze(traces: List[RankTrace], top: int = 0) -> dict:
 
 def format_report(report: dict) -> str:
     """Human-readable rendering of :func:`analyze`'s JSON."""
-    lines = [
-        f"Cross-rank trace report — {len(report['ranks'])} ranks, "
-        f"{report['groups_scored']} fused groups scored",
+    header = (f"Cross-rank trace report — {len(report['ranks'])} ranks, "
+              f"{report['groups_scored']} fused groups scored")
+    if report.get("bound"):
+        fs = report.get("fleet_share") or {}
+        header += (f"; run verdict: {report['bound']}"
+                   + (f" (input {fs.get('input', 0):.0%} / compute "
+                      f"{fs.get('compute', 0):.0%} / comm "
+                      f"{fs.get('comm', 0):.0%})" if fs else ""))
+    lines = [header]
+    missing = [r for r, c in report["clock"].items()
+               if c.get("meta_missing")]
+    if missing:
+        lines.append(
+            "WARNING: no clock metadata (.clock.json sidecar or in-band "
+            f"header) for ranks {', '.join(sorted(missing))} — zero-"
+            "offset fallback; their timestamps carry the raw inter-host "
+            "clock skew.")
+    lines += [
         "",
         f"{'rank':>4}  {'p50 late':>10}  {'p99 late':>10}  "
-        f"{'mean':>10}  {'last-in':>8}  loses most in",
+        f"{'mean':>10}  {'last-in':>8}  {'verdict':<14} loses most in",
     ]
     for s in report["stragglers"]:
         lines.append(
             f"{s['rank']:>4}  {s['p50_s'] * 1e3:>8.2f}ms  "
             f"{s['p99_s'] * 1e3:>8.2f}ms  {s['mean_s'] * 1e3:>8.2f}ms  "
-            f"{s['groups_last']:>8}  {s['loses_most_in']}")
+            f"{s['groups_last']:>8}  {s['verdict']:<14} "
+            f"{s['loses_most_in']}")
     top = report.get("top_straggler")
     if top and top["mean_s"] > 0:
         lines += ["", f"Top straggler: rank {top['rank']} "
                       f"(p50 lateness {top['p50_s'] * 1e3:.2f} ms, "
                       f"last to arrive in {top['groups_last']} of "
                       f"{report['groups_scored']} groups; "
-                      f"loses time in: {top['loses_most_in']})"]
+                      f"loses time in: {top['loses_most_in']}; "
+                      f"verdict: {top['verdict']})"]
     unsynced = [r for r, c in report["clock"].items()
-                if not c["synced"] and r != "0"]
+                if not c["synced"] and r != "0"
+                and not c.get("meta_missing")]
     if unsynced:
         lines += ["", "WARNING: clock offset unsynced for ranks "
                       f"{', '.join(unsynced)} — lateness numbers for "

@@ -233,6 +233,22 @@ def init(*, coordinator_address: Optional[str] = None,
         from .utils.logging import get_logger
         get_logger("topology").warning("metrics exporters not started: %s",
                                        e)
+    # Flight recorder (docs/postmortem.md): stamp the process identity
+    # on the always-on ring, arm the crash hooks (excepthook + SIGTERM
+    # final gasp — only when a blackbox dir or metrics file is
+    # configured), and record the init event itself.
+    try:
+        from .observability import flight_recorder as _flight
+        _flight.recorder().configure(_topology.process_index,
+                                     _topology.process_count,
+                                     _topology.generation)
+        _flight.recorder().note("init", (
+            _topology.process_index, _topology.process_count,
+            _topology.generation))
+        _flight.maybe_install_hooks()
+    except Exception as e:  # never fail init over telemetry
+        from .utils.logging import get_logger
+        get_logger("topology").warning("flight recorder not armed: %s", e)
     return _topology
 
 

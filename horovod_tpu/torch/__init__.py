@@ -41,21 +41,26 @@ __all__ = [
 class StepMetrics(_StepTimer):
     """Per-step telemetry hook for the torch training loop
     (docs/metrics.md): records ``hvdtpu_step_seconds``,
-    ``hvdtpu_samples_per_second`` and ``hvdtpu_allreduce_step_share``
-    (all labeled ``framework=torch``) into the metrics registry. Use as
-    a context manager around each step::
+    ``hvdtpu_samples_per_second``, ``hvdtpu_collective_step_share``
+    (with ``hvdtpu_allreduce_step_share`` as a deprecated alias), the
+    input/h2d/compute/collective attribution
+    (``hvdtpu_step_phase_seconds``/``_share``), HBM gauges, and — when
+    ``flops_per_step`` is supplied — MFU (all labeled
+    ``framework=torch``). Use as a context manager around each step::
 
         metrics = hvd.torch.StepMetrics(batch_size=64)
         for batch in loader:
             with metrics:
                 loss = train_step(batch)   # backward + optimizer.step()
 
-    The allreduce share is computed from the engine's own execute-time
+    The collective share is computed from the engine's own execute-time
     accounting, so it covers the DistributedOptimizer's async allreduces
     wherever they overlap the step."""
 
-    def __init__(self, batch_size: Optional[int] = None):
-        super().__init__("torch", batch_size=batch_size)
+    def __init__(self, batch_size: Optional[int] = None,
+                 flops_per_step: Optional[float] = None):
+        super().__init__("torch", batch_size=batch_size,
+                         flops_per_step=flops_per_step)
 
 
 class _DistributedOptimizer(torch.optim.Optimizer):

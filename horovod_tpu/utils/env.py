@@ -140,6 +140,58 @@ def checkpoint_keep() -> int:
     return int(v)
 
 
+def blackbox_dir() -> Optional[str]:
+    """Directory for flight-recorder crash dumps (docs/postmortem.md):
+    on a crash, SIGTERM, stall escalation or eviction, each rank writes
+    ``blackbox-rank{rank}.jsonl`` here. None/empty disables dumping —
+    the in-memory ring buffer still records (its cost is one tuple
+    append), but nothing ever reaches disk."""
+    v = _get("BLACKBOX")
+    return v or None
+
+
+def blackbox_window_secs() -> float:
+    """How many seconds of history a blackbox dump carries (the ring
+    buffer is additionally bounded by ``blackbox_capacity`` events)."""
+    v = _get("BLACKBOX_WINDOW")
+    if v in (None, ""):
+        return 120.0
+    return float(v)
+
+
+def blackbox_interval_secs() -> float:
+    """Cadence of the periodic in-flight blackbox dump. The JAX
+    coordination service hard-kills surviving clients (LOG(FATAL))
+    within ~100 ms of any peer's death — no Python exit hook can run —
+    so the recorder continuously persists its ring like a real flight
+    recorder; the final-gasp dump overwrites with the precise reason
+    when the process does get a last word. 0 disables the periodic
+    writer (death-path dumps only)."""
+    v = _get("BLACKBOX_INTERVAL")
+    if v in (None, ""):
+        return 5.0
+    return float(v)
+
+
+def blackbox_capacity() -> int:
+    """Ring-buffer size (events) of the always-on flight recorder."""
+    v = _get("BLACKBOX_EVENTS")
+    if v in (None, ""):
+        return 4096
+    return int(v)
+
+
+def peak_flops() -> Optional[float]:
+    """Peak FLOP/s of this process's devices for the MFU gauge
+    (HOROVOD_TPU_PEAK_FLOPS, total across local devices). None =
+    autodetect from the device kind (TPU generations only); MFU is not
+    exported when neither source yields a number."""
+    v = _get("PEAK_FLOPS")
+    if v in (None, ""):
+        return None
+    return float(v)
+
+
 def timeline_path() -> Optional[str]:
     return _get("TIMELINE")
 

@@ -1,0 +1,247 @@
+"""Flight recorder (docs/postmortem.md): ring-buffer semantics, dump
+format, engine integration, and the final-gasp exit paths (excepthook,
+SIGTERM, kill-mid-step) that must leave BOTH a valid blackbox dump and
+a fresh metrics snapshot behind."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax.numpy as jnp
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.observability import flight_recorder as fr
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_dump(path):
+    header, events = None, []
+    for line in open(path):
+        line = line.strip()
+        if not line:
+            continue
+        obj = json.loads(line)
+        if header is None and obj.get("blackbox"):
+            header = obj
+        else:
+            events.append(obj)
+    return header, events
+
+
+class TestRing:
+    def test_bounded_capacity(self):
+        rec = fr.FlightRecorder(capacity=8)
+        for i in range(100):
+            rec.note("step", (i,))
+        assert len(rec._ring) == 8
+        assert rec._ring[-1][2] == (99,)
+
+    def test_set_enabled_gates_recording(self):
+        rec = fr.FlightRecorder(capacity=8)
+        fr.set_enabled(False)
+        try:
+            rec.note("step", (1,))
+            rec.group_deliver(0, "allreduce", 1)
+            rec.group_done(0, "allreduce", 1, 0.0, 0.0, 0.0)
+        finally:
+            fr.set_enabled(True)
+        assert len(rec._ring) == 0
+
+    def test_dump_returns_none_without_directory(self, monkeypatch):
+        monkeypatch.delenv("HOROVOD_TPU_BLACKBOX", raising=False)
+        rec = fr.FlightRecorder(capacity=8)
+        rec.note("step", (1,))
+        assert rec.dump("test") is None
+
+
+class TestDumpFormat:
+    def test_header_and_event_schema(self, tmp_path):
+        rec = fr.FlightRecorder(capacity=32)
+        rec.configure(rank=3, world=4, generation=1)
+        rec.set_clock_meta(0.25, 0.001, True)
+        rec.note("step", (7,))
+        rec.group_deliver(12, "allreduce", 5)
+        now = time.monotonic()
+        rec.group_done(12, "allreduce", 5, now - 0.75, now - 0.25, now)
+        rec.note("failure", (2, "heartbeat_timeout", "gone"))
+        rec.note("fault", ("delay", 3))
+        path = rec.dump("unit_test", directory=str(tmp_path))
+        assert path == str(tmp_path / "blackbox-rank3.jsonl")
+        header, events = _load_dump(path)
+        assert header["rank"] == 3 and header["world"] == 4
+        assert header["generation"] == 1
+        assert header["reason"] == "unit_test"
+        assert header["offset_to_rank0_us"] == pytest.approx(250000.0)
+        assert header["clock_synced"] is True
+        kinds = [e["kind"] for e in events]
+        assert kinds == ["step", "group_deliver", "group_done",
+                        "failure", "fault"]
+        done = events[2]
+        assert done["seq"] == 12 and done["op"] == "allreduce"
+        assert done["queue_ms"] == pytest.approx(500.0)
+        assert done["exec_ms"] == pytest.approx(250.0)
+        # Payload field names must not collide with the event's own keys
+        # (a 'failure' carries failure_kind, a 'fault' carries fault).
+        assert events[3]["kind"] == "failure"
+        assert events[3]["failure_kind"] == "heartbeat_timeout"
+        assert events[4]["kind"] == "fault"
+        assert events[4]["fault"] == "delay"
+
+    def test_window_drops_old_events(self, tmp_path):
+        rec = fr.FlightRecorder(capacity=128)
+        rec.configure(0, 1)
+        # Backdate one event far past any window by poking the ring.
+        rec._ring.append((time.monotonic() - 3600.0, "step", (0,)))
+        rec.note("step", (1,))
+        path = rec.dump("w", directory=str(tmp_path), window_s=60.0)
+        _, events = _load_dump(path)
+        assert [e["idx"] for e in events] == [1]
+
+    def test_dump_counter_metric(self, tmp_path):
+        rec = fr.FlightRecorder(capacity=8)
+        rec.configure(0, 1)
+        rec.dump("metric_test", directory=str(tmp_path))
+        snap = hvd.metrics_snapshot()
+        vals = snap["hvdtpu_blackbox_dumps_total"]["values"]
+        assert vals.get('reason="metric_test"', 0) >= 1
+
+
+class TestEngineIntegration:
+    def test_collectives_recorded_as_group_events(self, tmp_path):
+        """The live engine's dispatch paths append group lifecycle
+        events to the process-global recorder."""
+        hvd.allreduce(jnp.ones((8,)), name="fr.groups.a")
+        hvd.allgather(jnp.ones((2, 2)), name="fr.groups.b")
+        path = fr.recorder().dump("engine_test", directory=str(tmp_path))
+        _, events = _load_dump(path)
+        done = [e for e in events if e["kind"] == "group_done"]
+        assert any(e["op"] == "allreduce" for e in done)
+        assert any(e["op"] == "allgather" for e in done)
+        delivered = [e for e in events if e["kind"] == "group_deliver"]
+        assert delivered, "no group_deliver events recorded"
+        # Every completed group was delivered first, with a matching seq.
+        done_seqs = {e["seq"] for e in done}
+        assert done_seqs <= {e["seq"] for e in delivered}
+
+    def test_step_timer_records_step_events(self, tmp_path):
+        from horovod_tpu.observability import StepTimer
+        t = StepTimer("fr_test", batch_size=2)
+        with t:
+            hvd.allreduce(jnp.ones((4,)), name="fr.step.a")
+        path = fr.recorder().dump("step_test", directory=str(tmp_path))
+        _, events = _load_dump(path)
+        kinds = {e["kind"] for e in events}
+        assert "step" in kinds and "step_end" in kinds
+        end = [e for e in events if e["kind"] == "step_end"][-1]
+        assert end["step_ms"] > 0
+        for f in ("input_ms", "h2d_ms", "compute_ms", "comm_ms"):
+            assert f in end
+
+
+_FINAL_GASP_SCRIPT = r"""
+import os, sys, time
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import horovod_tpu as hvd
+from horovod_tpu.observability import StepTimer
+
+hvd.init()
+timer = StepTimer("gasp", batch_size=4)
+mode = sys.argv[1]
+for step in range(1000):
+    with timer:
+        hvd.allreduce(jnp.ones((16,)), name=f"gasp.{step}", average=False)
+    if step == 5:
+        print("MIDSTEP", flush=True)
+        if mode == "raise":
+            raise RuntimeError("boom at step 5")
+        time.sleep(120)   # park mid-job; the test kills us here
+"""
+
+
+class TestFinalGasp:
+    """Satellite: the excepthook/SIGTERM path must flush BOTH the
+    flight-recorder dump and the last metrics snapshot — a job killed
+    mid-step leaves neither file stale."""
+
+    def _env(self, tmp_path):
+        env = dict(os.environ)
+        env.update({
+            "HOROVOD_TPU_BLACKBOX": str(tmp_path),
+            "HOROVOD_TPU_METRICS_FILE": str(tmp_path / "metrics.json"),
+            # Long interval: the periodic writer alone would be stale;
+            # only the final gasp can produce a fresh file.
+            "HOROVOD_TPU_METRICS_INTERVAL": "3600",
+        })
+        return env
+
+    def _assert_both_files_valid(self, tmp_path, expect_reason):
+        header, events = _load_dump(str(tmp_path / "blackbox-rank0.jsonl"))
+        assert header["reason"] == expect_reason
+        assert any(e["kind"] == "group_done" for e in events)
+        assert any(e["kind"] == "step_end" for e in events)
+        metrics = json.loads((tmp_path / "metrics.json").read_text())
+        steps = metrics["hvdtpu_step_seconds"]["values"]
+        assert any(v["count"] >= 5 for v in steps.values())
+
+    def test_uncaught_exception_dumps_and_flushes(self, tmp_path):
+        proc = subprocess.run(
+            [sys.executable, "-c", _FINAL_GASP_SCRIPT, "raise"],
+            env=self._env(tmp_path), capture_output=True, text=True,
+            timeout=300, cwd=ROOT)
+        assert proc.returncode != 0
+        assert "boom at step 5" in proc.stderr
+        self._assert_both_files_valid(tmp_path, "exception")
+        header, _ = _load_dump(str(tmp_path / "blackbox-rank0.jsonl"))
+        assert "boom at step 5" in header["error"]
+
+    def test_sigterm_kill_mid_step_dumps_and_flushes(self, tmp_path):
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _FINAL_GASP_SCRIPT, "park"],
+            env=self._env(tmp_path), stdout=subprocess.PIPE, text=True,
+            cwd=ROOT)
+        try:
+            assert proc.stdout.readline().strip() == "MIDSTEP"
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        self._assert_both_files_valid(tmp_path, "sigterm")
+
+    def test_abrupt_kill_leaves_valid_prefix(self, tmp_path):
+        """SIGKILL straight through a dump in progress: whatever made it
+        to disk must parse line-by-line (the postmortem loader's
+        valid-prefix contract). We SIGTERM (which starts the dump) and
+        SIGKILL immediately after — on a slow box the dump may be
+        mid-write."""
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _FINAL_GASP_SCRIPT, "park"],
+            env=self._env(tmp_path), stdout=subprocess.PIPE, text=True,
+            cwd=ROOT)
+        try:
+            assert proc.stdout.readline().strip() == "MIDSTEP"
+            proc.send_signal(signal.SIGTERM)
+            time.sleep(0.05)
+            proc.kill()
+            proc.wait(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        path = tmp_path / "blackbox-rank0.jsonl"
+        if not path.exists():
+            pytest.skip("kill landed before the dump opened the file")
+        from horovod_tpu.tools.postmortem import load_dump
+        dump = load_dump(str(path))
+        # Whatever prefix exists parses; a complete dump has the header
+        # (either the SIGTERM gasp or an earlier in-flight snapshot).
+        if dump is not None and dump.header:
+            assert dump.header["reason"] in ("sigterm", "inflight")

@@ -1,0 +1,80 @@
+"""Satellite CI check: the metric surface cannot silently drift from
+its documentation. Every ``hvdtpu_*`` metric family registered anywhere
+in ``horovod_tpu/`` must appear in docs/metrics.md's reference tables,
+and every table entry must correspond to a registration in code —
+in both directions, by static scan (no imports, no device runtime)."""
+
+import os
+import re
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(ROOT, "horovod_tpu")
+DOC = os.path.join(ROOT, "docs", "metrics.md")
+
+# A registration is a .counter(/.gauge(/.histogram( call whose first
+# argument is an hvdtpu_* string literal — the only way families are
+# created in this codebase. Comments/docstrings mentioning names and
+# the native lib's hvdtpu_* C symbols don't match.
+_REG_RE = re.compile(
+    r"\.(?:counter|gauge|histogram)\(\s*\n?\s*[\"'](hvdtpu_[a-z0-9_]+)"
+    r"[\"']", re.MULTILINE)
+
+_BACKTICK_RE = re.compile(r"`([a-z0-9_]+)`")
+_PAREN_RE = re.compile(r"\([^)]*\)")
+
+
+def _code_metrics():
+    names = set()
+    for dirpath, dirnames, filenames in os.walk(PKG):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fn)) as f:
+                names.update(_REG_RE.findall(f.read()))
+    return names
+
+
+def _doc_metrics():
+    """Metric names from the reference tables: the first cell of every
+    `| ... | type | meaning |` row, parenthesized label lists stripped,
+    remaining backticked tokens taken as (possibly several) metric
+    names. Names are documented without the hvdtpu_ prefix."""
+    names = set()
+    in_reference = False
+    for line in open(DOC):
+        if line.startswith("## "):
+            in_reference = line.strip() == "## Metric reference"
+            continue
+        if not in_reference or not line.startswith("|"):
+            continue
+        cells = line.split("|")
+        if len(cells) < 3:
+            continue
+        first = _PAREN_RE.sub("", cells[1])
+        if cells[2].strip() not in ("counter", "gauge", "histogram",
+                                    "counter / gauge",
+                                    "histogram / gauge"):
+            continue  # header / separator rows
+        for tok in _BACKTICK_RE.findall(first):
+            names.add("hvdtpu_" + tok)
+    return names
+
+
+def test_every_registered_metric_is_documented():
+    code, doc = _code_metrics(), _doc_metrics()
+    assert code, "static scan found no metric registrations — regex rot?"
+    missing = sorted(code - doc)
+    assert not missing, (
+        "metrics registered in code but absent from docs/metrics.md's "
+        f"reference tables: {missing} — document them (the table name "
+        "is the hvdtpu_-stripped family name)")
+
+
+def test_every_documented_metric_exists_in_code():
+    code, doc = _code_metrics(), _doc_metrics()
+    assert doc, "doc table parse found no metrics — parser rot?"
+    stale = sorted(doc - code)
+    assert not stale, (
+        "metrics documented in docs/metrics.md but registered nowhere "
+        f"in horovod_tpu/: {stale} — remove or fix the table entries")

@@ -1,0 +1,239 @@
+"""Flight-recorder + postmortem + attribution acceptance (ISSUE 7,
+docs/postmortem.md) — slow tier.
+
+  1. Crash e2e: a 4-process job with an injected ``crash_at`` on rank 1
+     (the PR 6 fault spec). The crashed rank leaves a final-gasp dump at
+     the injection point; every surviving rank dumps on its death path
+     (coordinator failure escalation or the driver's SIGTERM); and
+     ``python -m horovod_tpu.tools.postmortem`` names the crashed rank,
+     its death phase, and the first divergent group seq.
+
+  2. Attribution e2e: a delayed-input run is classified input-bound and
+     an injected slow rank comm-bound in ``tools/trace report``; MFU and
+     HBM gauges appear in ``hvd.metrics_snapshot()``.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from horovod_tpu.runner.api import run
+
+pytestmark = pytest.mark.slow
+
+_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    # Fallback control plane: deterministic coordinator seqs on the
+    # Python writer/recorder paths.
+    "HOROVOD_TPU_DISABLE_NATIVE": "1",
+    "HOROVOD_CYCLE_TIME": "1",
+}
+
+NP = 4
+
+
+def _load_dump(path):
+    header, events = None, []
+    for line in open(path):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if header is None and obj.get("blackbox"):
+            header = obj
+        else:
+            events.append(obj)
+    return header, events
+
+
+def _make_crash_worker():
+    def worker(steps):
+        import jax.numpy as jnp
+
+        import horovod_tpu as hvd
+        from horovod_tpu.observability import StepTimer
+
+        hvd.init()
+        r = hvd.process_rank()
+        timer = StepTimer("e2e", batch_size=8)
+        for step in range(steps):
+            with timer:
+                hvd.allreduce(jnp.full((16,), float(r)), average=False,
+                              name=f"pm.step{step}")
+        return r
+
+    return worker
+
+
+class TestCrashPostmortem:
+    CRASH_RANK = 1
+    CRASH_TICK = 6
+
+    def test_crash_leaves_dumps_and_postmortem_names_the_rank(
+            self, tmp_path):
+        env = dict(_ENV, **{
+            "HOROVOD_TPU_BLACKBOX": str(tmp_path),
+            # Tight continuous-dump cadence: the JAX coordination
+            # service hard-kills surviving clients ~100 ms after a peer
+            # dies, so their evidence is the last in-flight snapshot.
+            "HOROVOD_TPU_BLACKBOX_INTERVAL": "0.25",
+            "HOROVOD_TPU_FAULT_SPEC":
+                f"rank={self.CRASH_RANK}:crash_at={self.CRASH_TICK}",
+            "HOROVOD_TPU_STALL_CHECK_DISABLE": "1",
+            "HOROVOD_TPU_FAILURE_TIMEOUT": "2",
+        })
+        with pytest.raises(Exception):
+            run(_make_crash_worker(), args=(30,), np=NP,
+                extra_env=env, start_timeout=300)
+
+        # Every rank dumped: rank 1 at the injected crash (final gasp
+        # before SIGKILL), survivors on their own death paths. Dumps
+        # may land a beat after the driver's exception — poll.
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if all((tmp_path / f"blackbox-rank{r}.jsonl").exists()
+                   for r in range(NP)):
+                break
+            time.sleep(0.25)
+        for r in range(NP):
+            assert (tmp_path / f"blackbox-rank{r}.jsonl").exists(), \
+                f"rank {r} left no blackbox dump"
+
+        crash_header, crash_events = _load_dump(
+            str(tmp_path / f"blackbox-rank{self.CRASH_RANK}.jsonl"))
+        assert crash_header["reason"] == "fault_crash"
+        done = [e["seq"] for e in crash_events
+                if e["kind"] == "group_done"]
+        # crash_at=N fires at the N+1-th enqueue, before it joins the
+        # queue: exactly N completed fused groups (one per step).
+        assert max(done) == self.CRASH_TICK - 1
+        for r in range(NP):
+            if r == self.CRASH_RANK:
+                continue
+            header, events = _load_dump(
+                str(tmp_path / f"blackbox-rank{r}.jsonl"))
+            # A survivor either got a final gasp (driver SIGTERM, or a
+            # typed WorkerFailure raised from the wait) or was
+            # hard-killed by the JAX coordination service — in which
+            # case its file is the last in-flight snapshot.
+            assert header["reason"] in ("sigterm", "exception",
+                                        "inflight")
+            assert any(e["kind"] == "group_done" for e in events)
+
+        # Postmortem CLI: names the crashed rank, its death phase, and
+        # the divergence point.
+        from horovod_tpu.tools import postmortem
+        out = tmp_path / "report.json"
+        postmortem._main([str(tmp_path), "--json", str(out)])
+        report = json.loads(out.read_text())
+        assert report["world"] == NP
+        assert report["died_first"]["rank"] == self.CRASH_RANK
+        assert report["died_first"]["how"] == "fault_crash"
+        assert "fault injection" in report["died_first"]["phase"]
+        assert report["common_last_group_seq"] == self.CRASH_TICK - 1
+        # Survivors began the next step / had its group in flight.
+        assert report["first_divergent_group_seq"] == self.CRASH_TICK
+        text = postmortem.format_report(report)
+        assert f"rank {self.CRASH_RANK} went first" in text
+
+
+def _make_attr_worker():
+    def worker(trace_dir, steps, input_sleep_s, slow_rank, slow_sleep_s):
+        import os
+        import time
+
+        import jax.numpy as jnp
+
+        import horovod_tpu as hvd
+        from horovod_tpu.observability import StepTimer
+        from horovod_tpu.ops import collective
+
+        os.environ["HOROVOD_TPU_TIMELINE"] = os.path.join(
+            trace_dir, "trace.{rank}.json")
+        hvd.init()
+        r = hvd.process_rank()
+        timer = StepTimer("attr_e2e", batch_size=8, flops_per_step=1e9)
+        for step in range(steps):
+            if input_sleep_s:
+                time.sleep(input_sleep_s)   # the slow loader
+            with timer:
+                if r == slow_rank and slow_sleep_s:
+                    time.sleep(slow_sleep_s)   # the slow rank, in-step
+                hvd.allreduce(jnp.full((16,), float(r)), average=False,
+                              name=f"attr.step{step}")
+        snap = hvd.metrics_snapshot()
+        collective.engine().shutdown()
+        keep = ("hvdtpu_mfu", "hvdtpu_model_flops_per_second",
+                "hvdtpu_hbm_bytes_in_use", "hvdtpu_hbm_peak_bytes",
+                "hvdtpu_step_phase_share")
+        return {"rank": r,
+                "metrics": {k: snap[k]["values"]
+                            for k in keep if k in snap}}
+
+    return worker
+
+
+class TestAttributionE2E:
+    STEPS = 6
+
+    def _run(self, trace_dir, input_sleep_s, slow_rank, slow_sleep_s,
+             steps=None):
+        env = dict(_ENV, HOROVOD_TPU_PEAK_FLOPS="1e12")
+        return run(_make_attr_worker(),
+                   args=(str(trace_dir), steps or self.STEPS,
+                         input_sleep_s, slow_rank, slow_sleep_s),
+                   np=NP, extra_env=env, start_timeout=300)
+
+    def _report(self, trace_dir, out):
+        from horovod_tpu.tools import trace as trace_tool
+        trace_tool._main(["report",
+                          str(trace_dir / "trace.{rank}.json"),
+                          "--report", str(out)])
+        return json.loads(out.read_text())
+
+    def test_delayed_input_run_is_input_bound(self, tmp_path):
+        # Enough steps that the steady-state input waits dwarf the
+        # first-step XLA compile (which lands in the execute span).
+        results = self._run(tmp_path, input_sleep_s=0.25,
+                            slow_rank=-1, slow_sleep_s=0.0, steps=10)
+        report = self._report(tmp_path, tmp_path / "report.json")
+        assert report["bound"] == "input-bound"
+        for r in range(NP):
+            assert report["per_rank"][str(r)]["verdict"] == "input-bound"
+            assert report["per_rank"][str(r)]["phase_share"]["input"] \
+                > 0.4
+        # MFU and HBM gauges appear in metrics_snapshot() (acceptance).
+        for res in results:
+            m = res["metrics"]
+            assert m["hvdtpu_mfu"]['framework="attr_e2e"'] > 0
+            assert m["hvdtpu_model_flops_per_second"][
+                'framework="attr_e2e"'] > 0
+            assert any(v > 0 for v in
+                       m["hvdtpu_hbm_bytes_in_use"].values())
+            assert any(v > 0 for v in
+                       m["hvdtpu_hbm_peak_bytes"].values())
+            # The live share gauge agrees with the offline verdict.
+            assert m["hvdtpu_step_phase_share"][
+                'framework="attr_e2e",phase="input"'] > 0.4
+
+    def test_slow_rank_run_is_comm_bound(self, tmp_path):
+        slow = 2
+        self._run(tmp_path, input_sleep_s=0.0,
+                  slow_rank=slow, slow_sleep_s=0.12)
+        report = self._report(tmp_path, tmp_path / "report.json")
+        assert report["bound"] == "comm-bound"
+        # The slow rank is the top straggler; the punctual ranks lose
+        # their time WAITING on it — comm-bound.
+        assert report["top_straggler"]["rank"] == slow
+        for r in range(NP):
+            if r == slow:
+                continue
+            assert report["per_rank"][str(r)]["verdict"] == "comm-bound"
+        # The straggler itself burns the time in-step, not in comm.
+        assert report["per_rank"][str(slow)]["verdict"] == "compute-bound"

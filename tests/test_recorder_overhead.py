@@ -1,0 +1,52 @@
+"""Flight-recorder overhead guard (slow tier) — the always-on ring
+buffer must stay invisible: ``bench_engine.py --recorder`` A/Bs a
+2-process fused-allreduce + StepTimer loop with recording enabled vs
+disabled (the BENCH_METRICS in-process interleaved method, p25 of
+pooled per-step wall times) and this guard holds the step-time
+overhead under 1%, regenerating ``BENCH_RECORDER.json``.
+
+One re-measure is allowed before failing — a shared CI box can stay
+saturated through one window (the BENCH_METRICS precedent)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+BUDGET = 0.01
+
+
+def _run_bench(out_path: str, rounds: int) -> dict:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "bench_engine.py"),
+         "--recorder", "--recorder-rounds", str(rounds),
+         "--out", out_path],
+        capture_output=True, text=True, timeout=600, cwd=root)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return json.loads(open(out_path).read())
+
+
+def test_recorder_overhead_under_1_percent(tmp_path):
+    out = tmp_path / "bench_recorder.json"
+    result = _run_bench(str(out), rounds=6)
+    if result["overhead_frac"] >= BUDGET:   # one re-measure
+        result = _run_bench(str(out), rounds=6)
+
+    # Regenerate the committed artifact from the accepted run.
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "BENCH_RECORDER.json"), "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+    assert result["rows"]["recorder_on"]["step_time_ms"] > 0
+    assert result["overhead_frac"] < BUDGET, (
+        f"always-on flight recorder cost {result['overhead_frac']:.2%} "
+        f"of the 2-process step time (on "
+        f"{result['rows']['recorder_on']['step_time_ms']} ms vs off "
+        f"{result['rows']['recorder_off']['step_time_ms']} ms; "
+        f"budget {BUDGET:.0%})")

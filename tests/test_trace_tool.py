@@ -301,6 +301,144 @@ class TestCli:
             trace_tool.load_traces([str(tmp_path / "none.{rank}.json")])
 
 
+class TestClockMetaDegrade:
+    """Satellite: a missing or corrupt .clock.json sidecar degrades to
+    zero offset with a warning in the report header — it must never
+    fail the whole merge."""
+
+    def _cluster_with_broken_sidecar(self, tmp_path, breakage):
+        paths = _make_cluster(tmp_path, [0.0, 0.0], late_rank=1,
+                              late_by_us=20 * MS, n_groups=4,
+                              sidecar_only=True)
+        victim = tmp_path / "trace.1.json.clock.json"
+        if breakage == "missing":
+            victim.unlink()
+        else:
+            victim.write_text('{"rank": 1, "world"')   # torn json
+        return paths
+
+    @pytest.mark.parametrize("breakage", ["missing", "corrupt"])
+    def test_merge_and_report_survive(self, tmp_path, breakage):
+        paths = self._cluster_with_broken_sidecar(tmp_path, breakage)
+        traces = trace_tool.load_traces(paths)
+        assert traces[1].clock_missing is True
+        # Positional rank fallback kept the right identity.
+        assert [t.rank for t in traces] == [0, 1]
+        out = tmp_path / "merged.json"
+        trace_tool.merge_traces(traces, str(out))     # no exception
+        json.loads(out.read_text())
+        report = trace_tool.analyze(traces)
+        assert report["clock"]["1"]["meta_missing"] is True
+        text = trace_tool.format_report(report)
+        assert "no clock metadata" in text
+        assert "zero-offset fallback" in text
+
+    def test_intact_sidecars_not_flagged(self, tmp_path):
+        paths = _make_cluster(tmp_path, [0.0, 0.0], late_rank=1,
+                              late_by_us=10 * MS, n_groups=3,
+                              sidecar_only=True)
+        traces = trace_tool.load_traces(paths)
+        report = trace_tool.analyze(traces)
+        assert not any(c["meta_missing"]
+                       for c in report["clock"].values())
+        assert "no clock metadata" not in trace_tool.format_report(report)
+
+
+def _step_spans(input_us, compute_us, n_steps, t0_us=0, gap_us=None):
+    """StepTimer's STEP_* complete spans on the _step pseudo-process,
+    as step_metrics emits them."""
+    gap_us = gap_us if gap_us is not None else input_us
+    out = []
+    t = t0_us
+    for _ in range(n_steps):
+        t += gap_us
+        if input_us:
+            out.append({"tensor": "_step", "ph": "X", "ts": t - input_us,
+                        "dur": input_us, "name": "STEP_INPUT"})
+        out.append({"tensor": "_step", "ph": "X", "ts": t,
+                    "dur": compute_us, "name": "STEP_COMPUTE"})
+        t += compute_us
+    return out
+
+
+class TestBoundVerdicts:
+    """Tentpole: per-rank and run-level input-bound vs compute-bound vs
+    comm-bound verdicts from the STEP_* attribution spans."""
+
+    def _cluster(self, tmp_path, input_us, compute_us, neg_us,
+                 n_groups=5):
+        world = 2
+        for rank in range(world):
+            events = []
+            for g in range(n_groups):
+                events += _collective_events(
+                    f"t.{g}", group=g, arrival_us=g * 50 * MS,
+                    neg_dur_us=neg_us)
+            events += _step_spans(input_us, compute_us, n_groups)
+            _write_trace(tmp_path / f"v.{rank}.json", rank, world,
+                         events, start_mono_us=0, offset_us=0.0)
+        traces = trace_tool.load_traces([str(tmp_path / "v.{rank}.json")])
+        return trace_tool.analyze(traces)
+
+    def test_input_dominated_run_is_input_bound(self, tmp_path):
+        report = self._cluster(tmp_path, input_us=40 * MS,
+                               compute_us=5 * MS, neg_us=100)
+        assert report["bound"] == "input-bound"
+        for r in ("0", "1"):
+            assert report["per_rank"][r]["verdict"] == "input-bound"
+            assert report["per_rank"][r]["phase_share"]["input"] > 0.5
+
+    def test_compute_dominated_run_is_compute_bound(self, tmp_path):
+        report = self._cluster(tmp_path, input_us=100,
+                               compute_us=40 * MS, neg_us=100)
+        assert report["bound"] == "compute-bound"
+        assert report["per_rank"]["0"]["verdict"] == "compute-bound"
+
+    def test_comm_dominated_run_is_comm_bound(self, tmp_path):
+        # Long negotiate waits (a straggler fleet) dwarf input+compute.
+        report = self._cluster(tmp_path, input_us=100,
+                               compute_us=1 * MS, neg_us=60 * MS)
+        assert report["bound"] == "comm-bound"
+        assert report["per_rank"]["0"]["verdict"] == "comm-bound"
+        assert report["fleet_share"]["comm"] > 0.5
+
+    def test_no_step_spans_means_no_run_verdict(self, tmp_path):
+        """Without StepTimer instrumentation the trace only contains
+        collective spans — claiming comm-bound would be vacuous."""
+        world = 2
+        for rank in range(world):
+            events = []
+            for g in range(4):
+                events += _collective_events(
+                    f"t.{g}", group=g, arrival_us=g * 10 * MS,
+                    neg_dur_us=100)
+            _write_trace(tmp_path / f"n.{rank}.json", rank, world,
+                         events, start_mono_us=0, offset_us=0.0)
+        traces = trace_tool.load_traces([str(tmp_path / "n.{rank}.json")])
+        report = trace_tool.analyze(traces)
+        assert report["bound"] is None
+        assert report["fleet_share"] is None
+
+    def test_deviation_verdict_without_step_spans(self, tmp_path):
+        """An execute-heavy rank still gets a comm-bound verdict from
+        the deviation attribution even without step spans."""
+        world = 2
+        for rank in range(world):
+            events = []
+            for g in range(6):
+                events += _collective_events(
+                    f"t.{g}", group=g, arrival_us=g * 10 * MS,
+                    neg_dur_us=100,
+                    exec_dur_us=(40 * MS if rank == 1 else 500))
+            _write_trace(tmp_path / f"d.{rank}.json", rank, world,
+                         events, start_mono_us=0, offset_us=0.0)
+        traces = trace_tool.load_traces([str(tmp_path / "d.{rank}.json")])
+        report = trace_tool.analyze(traces)
+        assert report["per_rank"]["1"]["verdict"] == "comm-bound"
+        # Report renders the verdict column.
+        assert "comm-bound" in trace_tool.format_report(report)
+
+
 class TestHistogramPercentiles:
     """Satellite: p50/p90/p99 estimation from log-bucketed snapshots,
     exact to within one bucket width, shared by the trace report and the
