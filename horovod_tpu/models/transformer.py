@@ -343,6 +343,144 @@ def apply(params, tokens, cfg: TransformerConfig):
     return _project_logits(params, apply_hidden(params, tokens, cfg), cfg)
 
 
+# --------------------------------------------------------------------------
+# Incremental decode — block-sliced KV cache (the serving tier's forward)
+# --------------------------------------------------------------------------
+#
+# The cache is a list (one entry per layer) of {"k", "v"} arrays of shape
+# [n_blocks, block_size, n_heads, head_dim]: a flat pool of fixed-size
+# token blocks, vLLM-style, so sequences of any length share one
+# allocation and freeing a finished request returns whole blocks to the
+# pool instead of fragmenting a contiguous [B, S_max] cache. A sequence
+# addresses its tokens through a *block table*: entry ``j`` of its table
+# names the pool block holding absolute positions ``[j*bs, (j+1)*bs)``.
+# Block 0 is reserved as a scratch block (serving/kv_cache.py never
+# hands it out): padded or inactive slots write their garbage K/V there,
+# where no live sequence can read it.
+
+
+def init_cache(cfg: TransformerConfig, n_blocks: int, block_size: int):
+    """Zeroed GLOBAL KV pool (shard via :func:`cache_specs`): per layer
+    ``{"k", "v"}`` of [n_blocks, block_size, n_heads, head_dim] in the
+    activation dtype."""
+    hd = cfg.d_model // cfg.n_heads
+    shape = (int(n_blocks), int(block_size), cfg.n_heads, hd)
+    return [{"k": jnp.zeros(shape, cfg.dtype),
+             "v": jnp.zeros(shape, cfg.dtype)}
+            for _ in range(cfg.n_layers)]
+
+
+def cache_specs(cfg: TransformerConfig):
+    """PartitionSpecs for the KV pool — heads over 'tp' (the same axis
+    the wq/wk/wv column splits produce the local heads on), block and
+    token dims replicated."""
+    spec = P(None, None, cfg.tp_axis, None)
+    return [{"k": spec, "v": spec} for _ in range(cfg.n_layers)]
+
+
+def _decode_block(params, x, kc, vc, tables, pos, cfg: TransformerConfig):
+    """One decoder block over the KV cache (shard_map-level, per-shard
+    views: under 'tp' the projections produce local heads and kc/vc hold
+    the matching head shard).
+
+    x: [B, Q, D] new-token activations; pos: [B, Q] absolute positions;
+    tables: [B, T] block ids. Writes this chunk's K/V into the pool,
+    then attends causally over everything cached so far (numerics mirror
+    :func:`full_attention` so incremental logits match the full-context
+    ``apply`` bit-for-bit up to fp reassociation)."""
+    d = cfg.d_model
+    tp_n = _axis_size(cfg.tp_axis)
+    if cfg.n_heads % tp_n:
+        raise ValueError(
+            f"n_heads ({cfg.n_heads}) must be divisible by the tensor-"
+            f"parallel axis size ({tp_n})")
+    h_local = cfg.n_heads // tp_n
+    hd = d // cfg.n_heads
+    dt = cfg.dtype
+    b, q_len, _ = x.shape
+    bs = kc.shape[1]
+
+    y = _layernorm(x, params["ln1"])
+    q = (y @ params["wq"].astype(dt)).reshape(b, q_len, h_local, hd)
+    k = (y @ params["wk"].astype(dt)).reshape(b, q_len, h_local, hd)
+    v = (y @ params["wv"].astype(dt)).reshape(b, q_len, h_local, hd)
+
+    # Scatter the chunk's K/V into its blocks: position p lives at
+    # (table[p // bs], p % bs). Distinct live sequences own disjoint
+    # blocks (the allocator's invariant), so the scatter never collides
+    # except on the shared scratch block 0 — whose content is never
+    # visible under the causal mask below.
+    blk = jnp.take_along_axis(tables, pos // bs, axis=1)        # [B, Q]
+    off = pos % bs
+    kc = kc.at[blk, off].set(k.astype(kc.dtype))
+    vc = vc.at[blk, off].set(v.astype(vc.dtype))
+
+    # Gather the sequence's pages back in table order — entry j covers
+    # positions [j*bs, (j+1)*bs), so the flattened page axis IS the
+    # absolute-position axis and the causal mask is a plain arange
+    # comparison. Unwritten tail blocks are masked off (their positions
+    # exceed every query position).
+    s_pad = tables.shape[1] * bs
+    keys = kc[tables].reshape(b, s_pad, h_local, hd)
+    vals = vc[tables].reshape(b, s_pad, h_local, hd)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, keys.astype(q.dtype),
+                        preferred_element_type=jnp.float32) * (hd ** -0.5)
+    visible = (jnp.arange(s_pad)[None, None, None, :]
+               <= pos[:, None, :, None])
+    scores = jnp.where(visible, scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", p.astype(vals.dtype), vals,
+                      preferred_element_type=jnp.float32).astype(x.dtype)
+    o = attn.reshape(b, q_len, h_local * hd) @ params["wo"].astype(dt)
+    if cfg.tp_axis:
+        o = lax.psum(o, cfg.tp_axis)   # row-parallel out-proj
+    x = x + o
+
+    y = _layernorm(x, params["ln2"])
+    hmid = jax.nn.gelu(y @ params["wi"].astype(dt))
+    m = hmid @ params["wo_mlp"].astype(dt)
+    if cfg.tp_axis:
+        m = lax.psum(m, cfg.tp_axis)
+    return x + m, kc, vc
+
+
+def apply_decode(params, tokens, starts, block_tables, cache,
+                 cfg: TransformerConfig):
+    """Incremental forward through the block-sliced KV cache — the
+    serving counterpart of :func:`apply`, sharing its weights and
+    :func:`param_specs` (shard_map-level; wrap in shard_map over 'tp'
+    for tensor-parallel decode, or call directly on one device).
+
+    tokens: [B, Q] int32 — the NEW tokens only (a prompt chunk at
+    prefill, one token per live slot at decode); starts: [B] int32 —
+    absolute position of ``tokens[:, 0]`` per sequence; block_tables:
+    [B, T] int32 block ids (entry j covers positions [j*bs, (j+1)*bs));
+    cache: from :func:`init_cache`. Returns ``(logits, cache)`` with
+    logits [B, Q, vocab] fp32 — at prefill, row ``n-1`` is the
+    first-token distribution; at decode, row 0 is the next-token one.
+    """
+    if cfg.sp_axis:
+        raise ValueError(
+            "apply_decode does not support sequence parallelism; build "
+            "the serving config with sp_axis=None (decode is one token "
+            "per sequence — there is no sequence to shard)")
+    if cfg.num_experts:
+        raise ValueError(
+            "apply_decode does not support MoE layers yet; serve a "
+            "dense checkpoint (num_experts=0)")
+    dt = cfg.dtype
+    b, q_len = tokens.shape
+    pos = starts[:, None] + jnp.arange(q_len)[None, :]
+    x = params["embed"].astype(dt)[tokens] + params["pos"][pos].astype(dt)
+    new_cache = []
+    for i, layer in enumerate(params["layers"]):
+        x, kc, vc = _decode_block(layer, x, cache[i]["k"], cache[i]["v"],
+                                  block_tables, pos, cfg)
+        new_cache.append({"k": kc, "v": vc})
+    h = _layernorm(x, params["ln_f"])
+    return _project_logits(params, h, cfg), new_cache
+
+
 def loss_fn(params, tokens, targets, cfg: TransformerConfig):
     """Next-token cross-entropy, mean over local tokens; psum-mean over
     'dp'/'sp' happens via the caller's pmean.

@@ -51,6 +51,7 @@ Event kinds (payload fields):
   ``elastic``       event, generation, world — driver transitions
   ``coord_error``   detail — coordinator client gave up (typed error)
   ``stall``         names, age_s — engine stall escalation
+  ``serving``       event, active — serving drain began/finished
   ================  ========================================================
 """
 
@@ -90,6 +91,7 @@ _FIELDS = {
     "elastic": ("event", "generation", "world"),
     "coord_error": ("detail",),
     "stall": ("names", "age_s"),
+    "serving": ("event", "active"),
 }
 
 # Recording lever — module-global single check like registry._enabled.

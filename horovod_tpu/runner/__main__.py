@@ -92,6 +92,13 @@ def main(argv=None) -> int:
                              ".jsonl here for `python -m "
                              "horovod_tpu.tools.postmortem`; exported "
                              "as HOROVOD_TPU_BLACKBOX")
+    parser.add_argument("--serve", action="store_true",
+                        help="serving mode (docs/serving.md): the "
+                             "worker command becomes `python -m "
+                             "horovod_tpu.serving` and remaining "
+                             "arguments are passed to it, e.g. "
+                             "`python -m horovod_tpu.runner --serve -- "
+                             "--checkpoint-dir /ckpts --tp 4`")
     parser.add_argument("--timeout", type=float, default=None,
                         help="overall job timeout in seconds")
     parser.add_argument("--no-tag-output", action="store_true",
@@ -100,11 +107,17 @@ def main(argv=None) -> int:
                         help="worker command, e.g. python train.py")
     args = parser.parse_args(argv)
 
-    if not args.command:
-        parser.error("missing worker command")
     command = args.command
     if command and command[0] == "--":
         command = command[1:]
+    if args.serve:
+        # Serving is a single-process front end per host today; the
+        # remaining argv belongs to `python -m horovod_tpu.serving`.
+        command = [sys.executable, "-m", "horovod_tpu.serving"] + command
+        if args.num_proc is None and not args.discovery:
+            args.num_proc = 1
+    elif not command:
+        parser.error("missing worker command")
 
     extra_env = {}
     if args.fault_spec:

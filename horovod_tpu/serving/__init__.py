@@ -1,0 +1,33 @@
+"""Serving tier — tensor-parallel inference with continuous batching
+(docs/serving.md).
+
+The train→save→serve path:
+
+  - training commits params through the sharded checkpoint engine with
+    ``extra=loader.transformer_extra(cfg)`` so the manifest records the
+    architecture;
+  - :func:`loader.load_params` reshards the ``.npy`` manifest onto the
+    inference mesh via span-overlap reads (a ws-4 training checkpoint
+    serves on a ws-1/2 mesh);
+  - :class:`InferenceEngine` schedules requests with per-decode-step
+    admission/eviction over a block-sliced KV cache;
+  - :class:`server.ServingServer` fronts it with stdlib HTTP
+    (``/generate`` + ``/healthz``), metrics on the existing
+    ``HOROVOD_TPU_METRICS_PORT`` registry endpoint.
+
+``python -m horovod_tpu.serving --checkpoint-dir ...`` wires it all up
+from the command line (docs/running.md).
+"""
+
+from .engine import (DrainingError, InferenceEngine, QueueFullError,
+                     Request, ServingConfig)
+from .kv_cache import BlockAllocator, blocks_needed
+from .loader import (config_from_manifest, load_params, serving_config,
+                     transformer_extra)
+
+__all__ = [
+    "BlockAllocator", "DrainingError", "InferenceEngine",
+    "QueueFullError", "Request", "ServingConfig", "blocks_needed",
+    "config_from_manifest", "load_params", "serving_config",
+    "transformer_extra",
+]

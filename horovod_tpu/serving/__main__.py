@@ -1,0 +1,101 @@
+"""CLI: ``python -m horovod_tpu.serving --checkpoint-dir /ckpts``.
+
+Loads the flagship Transformer straight from a sharded-checkpoint
+manifest (the architecture rides in the manifest's ``extra`` — see
+``loader.transformer_extra``), reshards it onto a tensor-parallel
+inference mesh, and serves ``/generate`` + ``/healthz`` until SIGTERM
+drains it (docs/serving.md, docs/running.md)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    from ..utils import env as _env
+
+    parser = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.serving",
+        description="Serve a sharded checkpoint: tensor-parallel "
+                    "decode with continuous batching.")
+    parser.add_argument("--checkpoint-dir", required=True,
+                        help="sharded checkpoint root (the directory "
+                             "holding step-N/ + LATEST)")
+    parser.add_argument("--step", type=int, default=None,
+                        help="step to serve (default: LATEST)")
+    parser.add_argument("--port", type=int, default=None,
+                        help="HTTP port (default: "
+                             "$HOROVOD_TPU_SERVING_PORT or 8400; 0 = "
+                             "ephemeral)")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--tp", type=int, default=None,
+                        help="tensor-parallel width (default: all "
+                             "local devices)")
+    parser.add_argument("--block-size", type=int, default=16,
+                        help="KV-cache block size in tokens")
+    parser.add_argument("--kv-blocks", type=int, default=128,
+                        help="KV pool size in blocks (scratch included)")
+    parser.add_argument("--slots", type=int, default=8,
+                        help="decode batch width (concurrent "
+                             "generations)")
+    parser.add_argument("--max-queue", type=int, default=None,
+                        help="bounded admission queue (default: "
+                             "$HOROVOD_TPU_SERVING_QUEUE or 32; "
+                             "past it /generate returns 429)")
+    parser.add_argument("--max-new-tokens", type=int, default=64,
+                        help="per-request default generation budget")
+    parser.add_argument("--eos-id", type=int, default=None,
+                        help="stop token id (default: max-tokens only)")
+    parser.add_argument("--temperature", type=float, default=0.0,
+                        help="0 = greedy; > 0 = seeded sampling")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="sampling PRNG seed")
+    args = parser.parse_args(argv)
+
+    import jax
+
+    import horovod_tpu as hvd
+    from ..parallel.mesh import create_mesh
+    from .engine import InferenceEngine, ServingConfig
+    from .loader import config_from_manifest, load_params, serving_config
+    from .server import ServingServer
+
+    hvd.init()   # metrics exporters + flight-recorder hooks
+
+    devices = jax.local_devices()
+    tp = args.tp if args.tp is not None else len(devices)
+    if tp < 1 or tp > len(devices):
+        parser.error(f"--tp {tp} out of range (1..{len(devices)} local "
+                     "devices)")
+    mesh = create_mesh(devices=devices[:tp], tp=tp)
+
+    from ..checkpoint import CheckpointEngine
+    eng = CheckpointEngine(args.checkpoint_dir)
+    man = eng.restore_manifest(args.step)
+    cfg = serving_config(config_from_manifest(man), mesh)
+    params = load_params(args.checkpoint_dir, cfg, mesh,
+                         step=args.step, engine=eng)
+    print(f"[serving] step {man['step']}: d_model={cfg.d_model} "
+          f"layers={cfg.n_layers} heads={cfg.n_heads} "
+          f"vocab={cfg.vocab} tp={tp}", file=sys.stderr)
+
+    config = ServingConfig(
+        block_size=args.block_size, kv_blocks=args.kv_blocks,
+        max_batch_slots=args.slots,
+        max_queue=args.max_queue if args.max_queue is not None
+        else _env.serving_queue(),
+        max_new_tokens=args.max_new_tokens, eos_id=args.eos_id,
+        temperature=args.temperature, seed=args.seed)
+    engine = InferenceEngine(params, cfg, mesh, config)
+    server = ServingServer(engine, port=args.port, host=args.host)
+    server.install_signal_handlers()
+    server.start()
+    print(f"[serving] ready on :{server.port} (/generate, /healthz)",
+          file=sys.stderr, flush=True)
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,502 @@
+"""Continuous-batching inference engine — iteration-level scheduling
+over a block-sliced KV cache.
+
+The scheduling unit is one *decode step*, not one batch (Orca's
+iteration-level scheduling, OSDI '22): every step the engine
+
+  1. **admits** requests from the bounded queue into free batch slots —
+     as many as the KV pool can cover (all-or-nothing block
+     reservation, kv_cache.py) — running each one's prefill and
+     sampling its first token (TTFT ends here);
+  2. runs **one batched decode step** for every live slot through the
+     tensor-parallel ``apply_decode`` (models/transformer.py), samples
+     one token per slot;
+  3. **evicts** finished slots (EOS or max-tokens) immediately, freeing
+     their blocks for the next admit.
+
+A request therefore joins and leaves the batch mid-flight of everyone
+else's generation — no batch-boundary barrier, which is where the
+batched ≥ 2× sequential throughput in BENCH_SERVING.json comes from.
+
+Compile discipline: there is exactly ONE jitted program per shape
+bucket — decode is always ``[slots, 1]`` (one program for the whole
+serve), prefill is ``[1, L]`` with L a power-of-two bucket — so
+recompiles are bounded by the bucket count, counted in
+``hvdtpu_serving_compiles_total``.
+
+Correctness invariant the scheduler edge-tests pin down: per-slot
+computation is independent (causal mask + disjoint block tables), so a
+request's greedy output does not depend on what else is in flight, and
+pool exhaustion can only delay *admission* — live sequences always
+hold every block they will ever need.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models import transformer as tfm
+from ..observability import registry as _obs
+from ..utils.logging import get_logger
+from .kv_cache import SCRATCH_BLOCK, BlockAllocator, blocks_needed
+
+_log = get_logger("serving")
+
+
+class QueueFullError(RuntimeError):
+    """The bounded admission queue is at capacity (HTTP 429)."""
+
+
+class DrainingError(RuntimeError):
+    """The engine is draining (SIGTERM received); no new admissions."""
+
+
+def _metrics():
+    r = _obs.registry()
+    return {
+        "requests": r.counter(
+            "hvdtpu_serving_requests_total",
+            "Requests by terminal status: completed, rejected (queue "
+            "full), failed (draining/validation)"),
+        "queue_depth": r.gauge(
+            "hvdtpu_serving_queue_depth",
+            "Requests waiting for admission").labels(),
+        "active": r.gauge(
+            "hvdtpu_serving_active_requests",
+            "Requests currently holding a batch slot").labels(),
+        "occupancy": r.gauge(
+            "hvdtpu_serving_batch_occupancy",
+            "Fraction of decode batch slots live (the continuous-"
+            "batching utilization number)").labels(),
+        "kv_total": r.gauge(
+            "hvdtpu_serving_kv_blocks_total",
+            "Allocatable KV pool blocks (scratch excluded)").labels(),
+        "kv_used": r.gauge(
+            "hvdtpu_serving_kv_blocks_in_use",
+            "KV pool blocks held by live sequences").labels(),
+        "tokens": r.counter(
+            "hvdtpu_serving_tokens_total",
+            "Tokens processed, kind=prompt (prefilled) or "
+            "kind=generated"),
+        "ttft": r.histogram(
+            "hvdtpu_serving_ttft_seconds",
+            "Time to first token: submit → first sampled token "
+            "(includes queue wait)", buckets=_obs.LATENCY_BUCKETS
+        ).labels(),
+        "tpot": r.histogram(
+            "hvdtpu_serving_tpot_seconds",
+            "Time per output token after the first (per live slot per "
+            "decode step)", buckets=_obs.LATENCY_BUCKETS).labels(),
+        "prefill": r.histogram(
+            "hvdtpu_serving_prefill_seconds",
+            "Prefill forward duration (per admitted request)",
+            buckets=_obs.LATENCY_BUCKETS).labels(),
+        "decode_step": r.histogram(
+            "hvdtpu_serving_decode_step_seconds",
+            "Batched decode step duration (all live slots)",
+            buckets=_obs.LATENCY_BUCKETS).labels(),
+        "decode_steps": r.counter(
+            "hvdtpu_serving_decode_steps_total",
+            "Batched decode steps executed"),
+        "compiles": r.counter(
+            "hvdtpu_serving_compiles_total",
+            "Shape buckets compiled, phase=prefill (per length bucket) "
+            "or phase=decode (once per serve)"),
+        "qps": r.gauge(
+            "hvdtpu_serving_requests_per_second",
+            "Completed requests per second over the last 10 s").labels(),
+    }
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Scheduler knobs (docs/serving.md)."""
+
+    block_size: int = 16          # tokens per KV block
+    kv_blocks: int = 128          # pool size, scratch block included
+    max_batch_slots: int = 8      # decode batch width
+    max_queue: int = 32           # bounded admission queue (429 past it)
+    max_new_tokens: int = 64      # per-request default
+    eos_id: Optional[int] = None  # stop token (None: max-tokens only)
+    temperature: float = 0.0      # 0 = greedy; >0 = seeded sampling
+    seed: int = 0                 # sampling PRNG seed (deterministic)
+    max_blocks_per_seq: Optional[int] = None  # table width; None: from
+    #                                           the model's max_seq
+    min_prefill_bucket: int = 16  # smallest padded prompt length
+
+
+class Request:
+    """One generation request and its lifecycle record."""
+
+    def __init__(self, rid: int, prompt: Sequence[int],
+                 max_new_tokens: int, temperature: float):
+        self.id = rid
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.tokens: List[int] = []       # generated tokens
+        self.status = "queued"            # queued|active|completed|failed
+        self.error: Optional[str] = None
+        self.t_submit = time.perf_counter()
+        self.t_first_token: Optional[float] = None
+        self.t_done: Optional[float] = None
+        self.slot: Optional[int] = None
+        self.blocks: List[int] = []
+        self._done = threading.Event()
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.t_first_token is None:
+            return None
+        return self.t_first_token - self.t_submit
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until terminal; the generated tokens, or raises the
+        failure."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.id} still running")
+        if self.status != "completed":
+            raise RuntimeError(
+                f"request {self.id} {self.status}: {self.error}")
+        return list(self.tokens)
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class InferenceEngine:
+    """Tensor-parallel continuous-batching engine over one model.
+
+    ``params`` are the (mesh-sharded) transformer parameters, ``cfg``
+    the *serving* variant of the model config (loader.serving_config:
+    tp follows the mesh, sp/ep off), ``mesh`` the inference mesh.
+    Thread-safe: ``submit`` may be called from any thread (the HTTP
+    handlers); ``step`` is the single scheduler entry point, driven by
+    one loop thread (or directly by tests and the bench).
+    """
+
+    def __init__(self, params: Any, cfg: tfm.TransformerConfig,
+                 mesh: jax.sharding.Mesh,
+                 config: Optional[ServingConfig] = None):
+        if cfg.sp_axis or cfg.ep_axis or cfg.num_experts:
+            raise ValueError(
+                "serving supports dense tensor-parallel decode only; "
+                "build cfg via serving.loader.serving_config()")
+        self.cfg = cfg
+        self.mesh = mesh
+        self.config = config or ServingConfig()
+        c = self.config
+        bs = int(c.block_size)
+        self._m = _metrics()
+
+        slots = int(c.max_batch_slots)
+        max_tab = c.max_blocks_per_seq if c.max_blocks_per_seq \
+            else -(-cfg.max_seq // bs)
+        self._tab_width = int(max_tab)
+        self._slots = slots
+        self._alloc = BlockAllocator(c.kv_blocks)
+        self._m["kv_total"].set(self._alloc.total)
+
+        self.params = params
+        self._cache = self._put_cache(
+            tfm.init_cache(cfg, c.kv_blocks, bs))
+
+        # host mirrors of the device-side scheduling state
+        self._tables = np.full((slots, self._tab_width), SCRATCH_BLOCK,
+                               np.int32)
+        self._lengths = np.zeros((slots,), np.int32)    # cached tokens
+        self._last_tok = np.zeros((slots,), np.int32)   # next input
+        self._reqs: List[Optional[Request]] = [None] * slots
+
+        self._queue: deque = deque()
+        self._lock = threading.RLock()
+        self._work = threading.Condition(self._lock)
+        self._draining = False
+        self._next_id = 0
+        self._rng = np.random.default_rng(c.seed)
+        self._completions: deque = deque()  # perf_counter stamps
+
+        specs = tfm.param_specs(cfg)
+        cspecs = tfm.cache_specs(cfg)
+        fwd = jax.shard_map(
+            lambda p, kv, t, s, bt: tfm.apply_decode(p, t, s, bt, kv,
+                                                     cfg),
+            mesh=mesh, in_specs=(specs, cspecs, P(), P(), P()),
+            out_specs=(P(), cspecs), check_vma=False)
+        donate = () if jax.default_backend() == "cpu" else (1,)
+        self._fwd = jax.jit(fwd, donate_argnums=donate)
+        self._buckets_seen: set = set()
+
+    # ------------------------------------------------------- submission
+
+    def submit(self, prompt: Sequence[int], *,
+               max_new_tokens: Optional[int] = None,
+               temperature: Optional[float] = None) -> Request:
+        """Enqueue a request; returns immediately with its ticket.
+        Raises :exc:`QueueFullError` past ``max_queue`` (the HTTP 429
+        path) and :exc:`DrainingError` after drain began."""
+        c = self.config
+        max_new = int(max_new_tokens if max_new_tokens is not None
+                      else c.max_new_tokens)
+        temp = float(temperature if temperature is not None
+                     else c.temperature)
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if any(t < 0 or t >= self.cfg.vocab for t in prompt):
+            raise ValueError(f"prompt token out of range "
+                             f"[0, {self.cfg.vocab})")
+        if len(prompt) + max_new > self.cfg.max_seq:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new}) "
+                f"exceeds the model's max_seq ({self.cfg.max_seq})")
+        need = blocks_needed(len(prompt), max_new, c.block_size)
+        if need > min(self._alloc.total, self._tab_width):
+            raise ValueError(
+                f"request needs {need} KV blocks but the pool holds "
+                f"{self._alloc.total} (table width {self._tab_width}) "
+                "— raise kv_blocks or lower max_new_tokens")
+        with self._lock:
+            if self._draining:
+                raise DrainingError("server is draining")
+            if len(self._queue) >= c.max_queue:
+                self._m["requests"].labels(status="rejected").inc()
+                raise QueueFullError(
+                    f"admission queue full ({c.max_queue})")
+            req = Request(self._next_id, prompt, max_new, temp)
+            self._next_id += 1
+            self._queue.append(req)
+            self._m["queue_depth"].set(len(self._queue))
+            self._work.notify()
+            return req
+
+    def generate(self, prompt: Sequence[int], *,
+                 max_new_tokens: Optional[int] = None,
+                 temperature: Optional[float] = None) -> List[int]:
+        """Synchronous single-request convenience: submit + drive the
+        scheduler until THIS request finishes (single-threaded use;
+        under a running serve loop, use submit().result())."""
+        req = self.submit(prompt, max_new_tokens=max_new_tokens,
+                          temperature=temperature)
+        while not req.done:
+            if not self.step():
+                time.sleep(0.001)
+        return req.result()
+
+    # -------------------------------------------------------- scheduler
+
+    @property
+    def active_count(self) -> int:
+        return sum(1 for r in self._reqs if r is not None)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def idle(self) -> bool:
+        with self._lock:
+            return self.active_count == 0 and not self._queue
+
+    def step(self) -> bool:
+        """One scheduler iteration: admit → batched decode → evict.
+        Returns True when any work was done."""
+        with self._lock:
+            admitted = self._admit()
+            worked = admitted > 0
+            if self.active_count:
+                self._decode_step()
+                worked = True
+            self._update_gauges()
+            return worked
+
+    def wait_for_work(self, timeout: float) -> None:
+        """Serve-loop parking: block until a submit arrives (or
+        timeout) instead of spinning on an idle engine."""
+        with self._work:
+            if self.idle and not self._draining:
+                self._work.wait(timeout)
+
+    def run_until_idle(self, max_steps: int = 100000) -> None:
+        for _ in range(max_steps):
+            if self.idle:
+                return
+            self.step()
+        raise RuntimeError("run_until_idle: scheduler did not converge")
+
+    def drain(self) -> None:
+        """Graceful shutdown: refuse new admissions, fail everything
+        still queued, finish every live slot's generation."""
+        with self._lock:
+            self._draining = True
+            while self._queue:
+                req = self._queue.popleft()
+                self._finish(req, "failed", error="server draining")
+            self._m["queue_depth"].set(0)
+        from ..observability import flight_recorder as _flight
+        _flight.recorder().note("serving", ("drain", self.active_count))
+        while True:
+            with self._lock:
+                if self.active_count == 0:
+                    break
+                self._decode_step()
+                self._update_gauges()
+        _flight.recorder().note("serving", ("drained", 0))
+
+    # -------------------------------------------------------- internals
+
+    def _put_cache(self, cache):
+        cspecs = tfm.cache_specs(self.cfg)
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+            cache, cspecs, is_leaf=lambda x: isinstance(x, P))
+
+    def _admit(self) -> int:
+        """Move queued requests into free slots while the pool covers
+        them, running each prefill immediately (this is the per-step
+        admission that makes the batching *continuous*)."""
+        admitted = 0
+        while self._queue:
+            slot = next((i for i, r in enumerate(self._reqs)
+                         if r is None), None)
+            if slot is None:
+                break
+            req = self._queue[0]
+            need = blocks_needed(len(req.prompt), req.max_new_tokens,
+                                 self.config.block_size)
+            blocks = self._alloc.alloc(need)
+            if blocks is None:
+                break    # pool exhausted: nothing admits, nothing evicts
+            self._queue.popleft()
+            req.blocks = blocks
+            req.slot = slot
+            req.status = "active"
+            self._reqs[slot] = req
+            self._tables[slot, :] = SCRATCH_BLOCK
+            self._tables[slot, :need] = blocks
+            self._prefill(req)
+            admitted += 1
+        self._m["queue_depth"].set(len(self._queue))
+        return admitted
+
+    def _bucket(self, n: int) -> int:
+        b = max(self.config.min_prefill_bucket, _next_pow2(n))
+        return min(b, self.cfg.max_seq)
+
+    def _record_bucket(self, phase: str, key) -> None:
+        if (phase, key) not in self._buckets_seen:
+            self._buckets_seen.add((phase, key))
+            self._m["compiles"].labels(phase=phase).inc()
+
+    def _prefill(self, req: Request) -> None:
+        t0 = time.perf_counter()
+        n = len(req.prompt)
+        L = self._bucket(n)
+        self._record_bucket("prefill", L)
+        toks = np.zeros((1, L), np.int32)
+        toks[0, :n] = req.prompt
+        logits, self._cache = self._fwd(
+            self.params, self._cache, jnp.asarray(toks),
+            jnp.zeros((1,), jnp.int32),
+            jnp.asarray(self._tables[req.slot:req.slot + 1]))
+        slot = req.slot
+        self._lengths[slot] = n
+        first = self._sample(np.asarray(logits[0, n - 1]), req)
+        req.t_first_token = time.perf_counter()
+        req.tokens.append(first)
+        self._last_tok[slot] = first
+        self._m["prefill"].observe(time.perf_counter() - t0)
+        self._m["ttft"].observe(req.t_first_token - req.t_submit)
+        self._m["tokens"].labels(kind="prompt").inc(n)
+        self._m["tokens"].labels(kind="generated").inc()
+        self._check_finished(req)
+
+    def _decode_step(self) -> None:
+        t0 = time.perf_counter()
+        self._record_bucket("decode", self._slots)
+        logits, self._cache = self._fwd(
+            self.params, self._cache,
+            jnp.asarray(self._last_tok[:, None]),
+            jnp.asarray(self._lengths),
+            jnp.asarray(self._tables))
+        lg = np.asarray(logits[:, 0])
+        dt = time.perf_counter() - t0
+        self._m["decode_step"].observe(dt)
+        self._m["decode_steps"].inc()
+        for slot, req in enumerate(self._reqs):
+            if req is None:
+                continue
+            # the input token's K/V is cached now; its position is used
+            self._lengths[slot] += 1
+            tok = self._sample(lg[slot], req)
+            req.tokens.append(tok)
+            self._last_tok[slot] = tok
+            self._m["tpot"].observe(dt)
+            self._m["tokens"].labels(kind="generated").inc()
+            self._check_finished(req)
+
+    def _sample(self, logits: np.ndarray, req: Request) -> int:
+        if req.temperature <= 0.0:
+            return int(np.argmax(logits))
+        x = logits.astype(np.float64) / req.temperature
+        x -= x.max()
+        p = np.exp(x)
+        p /= p.sum()
+        return int(self._rng.choice(len(p), p=p))
+
+    def _check_finished(self, req: Request) -> None:
+        eos = self.config.eos_id
+        if (eos is not None and req.tokens
+                and req.tokens[-1] == eos) \
+                or len(req.tokens) >= req.max_new_tokens:
+            self._evict(req, "completed")
+
+    def _evict(self, req: Request, status: str,
+               error: Optional[str] = None) -> None:
+        """Free the slot mid-stream — the rest of the batch keeps
+        decoding; the blocks return to the pool for the next admit."""
+        slot = req.slot
+        self._tables[slot, :] = SCRATCH_BLOCK
+        self._lengths[slot] = 0
+        self._last_tok[slot] = 0
+        self._reqs[slot] = None
+        self._alloc.release(req.blocks)
+        req.blocks = []
+        self._finish(req, status, error=error)
+
+    def _finish(self, req: Request, status: str,
+                error: Optional[str] = None) -> None:
+        req.status = status
+        req.error = error
+        req.t_done = time.perf_counter()
+        self._m["requests"].labels(status=status).inc()
+        if status == "completed":
+            now = req.t_done
+            self._completions.append(now)
+            while self._completions and now - self._completions[0] > 10:
+                self._completions.popleft()
+            self._m["qps"].set(len(self._completions) / 10.0)
+        req._done.set()
+
+    def _update_gauges(self) -> None:
+        self._m["active"].set(self.active_count)
+        self._m["occupancy"].set(self.active_count / self._slots)
+        self._m["kv_used"].set(self._alloc.in_use)

@@ -1,0 +1,101 @@
+"""Block-granular KV-cache accounting — the free-list behind continuous
+batching.
+
+The device-side pool (``models.transformer.init_cache``) is a flat
+array of fixed-size token blocks; this module owns the *host-side*
+bookkeeping: which blocks are free, which sequence holds which, and how
+many a request needs end-to-end. Slicing the cache into blocks is what
+lets concurrency be bounded by total tokens instead of by
+``max_batch × max_seq`` — a finished request returns whole blocks to
+the pool and the next admit reuses them, with no fragmentation between
+differently-sized sequences (vLLM's PagedAttention argument, SOSP '23).
+
+Allocation is all-or-nothing and up-front: :class:`BlockAllocator`
+hands a request every block its worst case needs (prompt + max new
+tokens) at admission, or none at all. That conservative reservation is
+the engine's no-preemption guarantee — pool exhaustion can only ever
+*defer admission*; it can never strand a live sequence mid-decode or
+force evicting one to disk (docs/serving.md).
+
+Block 0 is reserved as scratch and never handed out: padded prefill
+positions and inactive batch slots point their block tables at it, so
+their garbage K/V writes land where no live sequence reads.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+SCRATCH_BLOCK = 0
+
+
+def blocks_needed(prompt_len: int, max_new_tokens: int,
+                  block_size: int) -> int:
+    """Worst-case block count for a request: K/V is written for the
+    prompt and for every generated token that is fed back (the last
+    generated token is output-only), i.e. positions
+    ``[0, prompt_len + max_new_tokens - 1)``."""
+    if prompt_len < 1:
+        raise ValueError(f"prompt_len must be >= 1, got {prompt_len}")
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    tokens = prompt_len + max_new_tokens - 1
+    return -(-tokens // int(block_size))
+
+
+class BlockAllocator:
+    """Free-list over pool blocks ``1..n_blocks-1`` (0 is scratch).
+
+    Not thread-safe by itself — the engine serializes all scheduler
+    state under its own lock.
+    """
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 2:
+            raise ValueError(
+                f"the pool needs the scratch block plus at least one "
+                f"allocatable block; got n_blocks={n_blocks}")
+        self.n_blocks = int(n_blocks)
+        # LIFO free-list, low ids first out — deterministic layouts for
+        # the seeded bench.
+        self._free: List[int] = list(range(self.n_blocks - 1, 0, -1))
+        self._held = [False] * self.n_blocks
+
+    @property
+    def total(self) -> int:
+        """Allocatable blocks (excludes scratch)."""
+        return self.n_blocks - 1
+
+    @property
+    def free(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.total - self.free
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` blocks, all-or-nothing; None when the pool cannot cover
+        the request (the admission gate's signal to leave it queued)."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._held[b] = True
+        return out
+
+    def release(self, blocks: List[int]) -> None:
+        """Return a finished sequence's blocks. Double-free and
+        scratch-release are hard errors — both would hand one block to
+        two live sequences and silently corrupt their caches."""
+        for b in blocks:
+            if b == SCRATCH_BLOCK:
+                raise ValueError("block 0 is the scratch block; it is "
+                                 "never allocated and never released")
+            if not self._held[b]:
+                raise ValueError(f"double free of KV block {b}")
+            self._held[b] = False
+            self._free.append(b)

@@ -1,0 +1,177 @@
+"""Checkpoint → inference-mesh loader — the "save" half of
+train→save→serve.
+
+The training side commits through the sharded checkpoint engine
+(docs/checkpoint.md); nothing serving-specific is written — the
+manifest's ``extra`` payload just needs the model architecture
+(:func:`transformer_extra`, a plain JSON dict) so the server can
+rebuild the :class:`~horovod_tpu.models.transformer.TransformerConfig`
+without a side-channel config file.
+
+The load is the resharding restore from PR 4 pointed at a *different*
+mesh: :func:`load_params` derives each parameter's target layout from
+``param_specs`` on the **inference** mesh (no arrays needed — the
+layout comes straight from ``NamedSharding.devices_indices_map``),
+hands it to ``CheckpointEngine.restore_addressable``, and each process
+reads only the saved shard-file spans overlapping its new blocks. A
+world-size-4 tensor-parallel training checkpoint therefore serves on a
+ws-1 or ws-2 mesh with no gather step and no full-tree host copy —
+every device's block is assembled from exactly the ``.npy`` spans that
+cover it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..checkpoint import CheckpointEngine
+from ..checkpoint.layout import LeafLayout, Shard, full_index, \
+    normalize_index
+from ..models import transformer as tfm
+
+# Manifest ``extra`` key under which trainers record the architecture.
+CONFIG_EXTRA_KEY = "transformer_config"
+
+_DTYPE_NAMES = {"float32", "bfloat16", "float16", "float64"}
+
+
+def transformer_extra(cfg: tfm.TransformerConfig) -> dict:
+    """JSON-able ``extra`` payload for ``CheckpointEngine.save`` that
+    lets the serving tier rebuild the config. ``n_heads`` is recorded
+    explicitly (the config's own CHANGELOG note: the derived default
+    changed across rounds, and attention depends on it)."""
+    d = dataclasses.asdict(cfg)
+    d["dtype"] = np.dtype(cfg.dtype).name
+    return {CONFIG_EXTRA_KEY: d}
+
+
+def config_from_manifest(man: dict, **overrides: Any
+                         ) -> tfm.TransformerConfig:
+    """Rebuild the training ``TransformerConfig`` from a manifest whose
+    save passed :func:`transformer_extra`. ``overrides`` replace fields
+    (the serving path uses them for the axis names)."""
+    extra = man.get("extra") or {}
+    if CONFIG_EXTRA_KEY not in extra:
+        raise KeyError(
+            f"manifest extra has no {CONFIG_EXTRA_KEY!r} entry — save "
+            "with extra=transformer_extra(cfg) (docs/serving.md) or "
+            "pass the config explicitly")
+    d = dict(extra[CONFIG_EXTRA_KEY])
+    name = d.get("dtype", "float32")
+    if name not in _DTYPE_NAMES:
+        raise ValueError(f"unsupported checkpoint dtype {name!r}")
+    import jax.numpy as jnp
+    d["dtype"] = getattr(jnp, name)
+    d.update(overrides)
+    return tfm.TransformerConfig(**d)
+
+
+def serving_config(cfg: tfm.TransformerConfig,
+                   mesh: jax.sharding.Mesh) -> tfm.TransformerConfig:
+    """The inference variant of a training config: tensor parallelism
+    follows the serving mesh's 'tp' axis, sequence/expert axes are
+    dropped (decode shards heads, not sequence), remat is off (no
+    backward pass to trade HBM against)."""
+    tp = "tp" if "tp" in mesh.axis_names and mesh.shape["tp"] > 1 \
+        else None
+    return dataclasses.replace(cfg, tp_axis=tp, sp_axis=None,
+                               ep_axis=None, num_experts=0, remat=False)
+
+
+def _spec_by_key(cfg: tfm.TransformerConfig) -> Tuple[Any, Dict[str, P]]:
+    """(specs treedef, {leaf keystr: PartitionSpec}) — the spec tree has
+    the params tree's structure, so its tree-path strings match the
+    manifest's leaf keys."""
+    specs = tfm.param_specs(cfg)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(
+        specs, is_leaf=lambda x: isinstance(x, P))
+    return treedef, {jax.tree_util.keystr(path): spec
+                     for path, spec in flat}
+
+
+def target_layouts(cfg: tfm.TransformerConfig, man: dict,
+                   mesh: jax.sharding.Mesh
+                   ) -> Tuple[Dict[str, LeafLayout],
+                              Dict[str, NamedSharding]]:
+    """Per-leaf target :class:`LeafLayout` + ``NamedSharding`` on the
+    inference mesh, derived from ``param_specs`` and the manifest's
+    shapes — no arrays materialized (the point: the layout must exist
+    *before* the data so the restore can read only what it needs)."""
+    _, by_key = _spec_by_key(cfg)
+    layouts: Dict[str, LeafLayout] = {}
+    shardings: Dict[str, NamedSharding] = {}
+    for entry in man["leaves"]:
+        key = entry["key"]
+        if key not in by_key:
+            raise KeyError(
+                f"checkpoint leaf {key!r} has no param_specs entry — "
+                "is this checkpoint the flagship transformer's params "
+                f"tree? (specs hold {sorted(by_key)[:4]}...)")
+        shape = tuple(int(d) for d in entry["shape"])
+        sharding = NamedSharding(mesh, by_key[key])
+        shardings[key] = sharding
+        if sharding.is_fully_replicated:
+            layouts[key] = LeafLayout(
+                shape=shape, dtype=entry["dtype"],
+                shards=(Shard(index=full_index(shape), process=0),),
+                replicated=True)
+            continue
+        owners: Dict[tuple, int] = {}
+        for dev, slices in sharding.devices_indices_map(shape).items():
+            idx = normalize_index(slices, shape)
+            proc = int(dev.process_index)
+            prev = owners.get(idx)
+            if prev is None or proc < prev:
+                owners[idx] = proc
+        layouts[key] = LeafLayout(
+            shape=shape, dtype=entry["dtype"],
+            shards=tuple(Shard(index=idx, process=proc)
+                         for idx, proc in sorted(owners.items())),
+            replicated=False)
+    return layouts, shardings
+
+
+def load_params(directory: str, cfg: tfm.TransformerConfig,
+                mesh: jax.sharding.Mesh, *,
+                step: Optional[int] = None,
+                engine: Optional[CheckpointEngine] = None) -> Any:
+    """Assemble the transformer's parameter tree on the inference mesh
+    from a committed sharded checkpoint — span-overlap reads only
+    (``restore_addressable``), so the save-time world size / mesh never
+    has to match the serving one.
+
+    ``engine`` lets callers keep corruption-fallback/process settings;
+    by default one is built over ``directory``. Returns the params
+    pytree with every leaf a sharded ``jax.Array`` on ``mesh``.
+    """
+    eng = engine if engine is not None else CheckpointEngine(directory)
+    man = eng.restore_manifest(step)
+    treedef, by_key = _spec_by_key(cfg)
+    layouts, shardings = target_layouts(cfg, man, mesh)
+    missing = sorted(set(by_key) - set(layouts))
+    if missing:
+        raise KeyError(
+            f"checkpoint step {man['step']} is missing param leaves "
+            f"{missing[:4]}{'...' if len(missing) > 4 else ''}")
+    blocks = eng.restore_addressable(layouts, step)
+    leaves = []
+    for key in by_key:   # spec flatten order == tree order
+        shape = layouts[key].shape
+        sharding = shardings[key]
+        by_index = {shard.index: arr for shard, arr in blocks[key]}
+        bufs = []
+        for dev, slices in \
+                sharding.addressable_devices_indices_map(shape).items():
+            idx = normalize_index(slices, shape)
+            if layouts[key].replicated:
+                idx = full_index(shape)
+            bufs.append(jax.device_put(by_index[idx], dev))
+        leaves.append(jax.make_array_from_single_device_arrays(
+            shape, sharding, bufs))
+    return jax.tree_util.tree_unflatten(treedef, leaves)

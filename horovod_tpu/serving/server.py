@@ -1,0 +1,209 @@
+"""Stdlib HTTP front end for the inference engine.
+
+Same no-new-deps pattern as the metrics exporter (observability/
+export.py): ``http.server.ThreadingHTTPServer``, one handler thread per
+connection, all of them funneling into the engine's thread-safe
+``submit``.
+
+Routes:
+
+  ``POST /generate``   {"tokens": [...], "max_new_tokens"?,
+                        "temperature"?} → 200 {"tokens", "id",
+                        "ttft_ms", "latency_ms"}; 429 when the bounded
+                        queue is full; 503 while draining; 400 on a bad
+                        body.
+  ``GET /healthz``     200 {"status": "serving", ...} with live queue /
+                        slot / KV-pool numbers; 503 once draining.
+
+Metrics deliberately do NOT get a route here: the registry endpoint
+(``HOROVOD_TPU_METRICS_PORT``, started by ``hvd.init()``) already
+serves every ``hvdtpu_serving_*`` family — one scrape target per
+process, no second port.
+
+Shutdown: ``install_signal_handlers`` makes SIGTERM/SIGINT request a
+graceful drain — admission stops (healthz flips 503), queued requests
+fail fast, live slots decode to completion, then the process exits 0.
+The flight recorder's atexit hook then writes its ``exit`` dump, so a
+drained shutdown is post-mortem-distinguishable from a crash
+(docs/postmortem.md).
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import threading
+from typing import Optional
+
+from ..observability import registry as _obs
+from ..utils import env as _env
+from ..utils.logging import get_logger
+from .engine import DrainingError, InferenceEngine, QueueFullError
+
+_log = get_logger("serving.server")
+
+# A generation can legitimately take a while under load; handlers wait
+# this long on the ticket before giving up with a 504.
+REQUEST_TIMEOUT_S = 600.0
+
+
+def _http_metrics():
+    return _obs.registry().counter(
+        "hvdtpu_serving_http_requests_total",
+        "HTTP requests served, by route and status code")
+
+
+class ServingServer:
+    """HTTP front + scheduler loop around one :class:`InferenceEngine`.
+
+    ``port=0`` binds an ephemeral port (tests); default comes from
+    ``HOROVOD_TPU_SERVING_PORT``.
+    """
+
+    def __init__(self, engine: InferenceEngine,
+                 port: Optional[int] = None, host: str = "0.0.0.0"):
+        from http.server import BaseHTTPRequestHandler, \
+            ThreadingHTTPServer
+
+        self.engine = engine
+        self._stop = threading.Event()
+        self._loop_thread: Optional[threading.Thread] = None
+        self._http = _http_metrics()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _reply(self, code: int, payload: dict,
+                       route: str) -> None:
+                # Count BEFORE writing: the client may observe the
+                # response (and assert on the metric) the instant the
+                # body lands.
+                outer._http.labels(route=route, code=str(code)).inc()
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path.split("?")[0] != "/healthz":
+                    self._reply(404, {"error": "not found"}, "other")
+                    return
+                eng = outer.engine
+                if outer._stop.is_set():
+                    self._reply(503, {"status": "draining"}, "healthz")
+                    return
+                self._reply(200, {
+                    "status": "serving",
+                    "active_requests": eng.active_count,
+                    "queue_depth": eng.queue_depth,
+                    "batch_slots": eng.config.max_batch_slots,
+                    "kv_blocks_free": eng._alloc.free,
+                    "kv_blocks_total": eng._alloc.total,
+                }, "healthz")
+
+            def do_POST(self):  # noqa: N802 (http.server API)
+                if self.path.split("?")[0] != "/generate":
+                    self._reply(404, {"error": "not found"}, "other")
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    tokens = body["tokens"]
+                    if not isinstance(tokens, list):
+                        raise ValueError("'tokens' must be a list")
+                except (KeyError, ValueError, json.JSONDecodeError) as e:
+                    self._reply(400, {"error": f"bad request: {e}"},
+                                "generate")
+                    return
+                try:
+                    req = outer.engine.submit(
+                        tokens,
+                        max_new_tokens=body.get("max_new_tokens"),
+                        temperature=body.get("temperature"))
+                except QueueFullError as e:
+                    self._reply(429, {"error": str(e)}, "generate")
+                    return
+                except DrainingError as e:
+                    self._reply(503, {"error": str(e)}, "generate")
+                    return
+                except ValueError as e:
+                    self._reply(400, {"error": str(e)}, "generate")
+                    return
+                try:
+                    out = req.result(timeout=REQUEST_TIMEOUT_S)
+                except TimeoutError as e:
+                    self._reply(504, {"error": str(e)}, "generate")
+                    return
+                except RuntimeError as e:
+                    self._reply(503, {"error": str(e)}, "generate")
+                    return
+                self._reply(200, {
+                    "id": req.id,
+                    "tokens": out,
+                    "ttft_ms": round(req.ttft_s * 1e3, 3),
+                    "latency_ms": round(
+                        (req.t_done - req.t_submit) * 1e3, 3),
+                }, "generate")
+
+            def log_message(self, *args):  # silence per-request stderr
+                pass
+
+        port = _env.serving_port() if port is None else int(port)
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="hvd-tpu-serving-http",
+            daemon=True)
+
+    # -------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        """Start the HTTP listener and the scheduler loop thread."""
+        self._http_thread.start()
+        self._loop_thread = threading.Thread(
+            target=self._loop, name="hvd-tpu-serving-sched", daemon=True)
+        self._loop_thread.start()
+        _log.info("serving on :%d (/generate, /healthz); metrics on the "
+                  "registry endpoint", self.port)
+
+    def _loop(self) -> None:
+        eng = self.engine
+        while not self._stop.is_set():
+            if not eng.step():
+                eng.wait_for_work(0.05)
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT → graceful drain. Installed on top of the
+        flight recorder's handler chain: ours runs the drain and lets
+        the process exit cleanly, so the recorder's atexit dump records
+        ``exit`` — not ``sigterm`` — for a drained shutdown."""
+        def _on_signal(signum, frame):
+            self.request_stop()
+
+        signal.signal(signal.SIGTERM, _on_signal)
+        signal.signal(signal.SIGINT, _on_signal)
+
+    def request_stop(self) -> None:
+        self._stop.set()
+        with self.engine._work:
+            self.engine._work.notify_all()
+
+    def serve_forever(self) -> None:
+        """Block until a stop is requested, then drain and shut down."""
+        if self._loop_thread is None:
+            self.start()
+        while not self._stop.wait(0.1):
+            pass
+        self.shutdown()
+
+    def shutdown(self) -> None:
+        """Drain (finish live generations, fail queued) and stop."""
+        self._stop.set()
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=30.0)
+        self.engine.drain()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._http_thread.join(timeout=5.0)
+        _log.info("serving drained and stopped")

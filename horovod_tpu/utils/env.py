@@ -281,6 +281,27 @@ def metrics_interval_secs() -> float:
     return float(v)
 
 
+def serving_port() -> int:
+    """HTTP port of the serving front end (``python -m
+    horovod_tpu.serving``); 0 binds an ephemeral port. Default 8400 —
+    distinct from the metrics endpoint, which stays on
+    HOROVOD_TPU_METRICS_PORT (the serving tier never binds a second
+    metrics port; docs/serving.md)."""
+    v = _get("SERVING_PORT")
+    if v in (None, ""):
+        return 8400
+    return int(v)
+
+
+def serving_queue() -> int:
+    """Bounded admission-queue depth of the serving engine (requests
+    past it are rejected with HTTP 429). Default 32."""
+    v = _get("SERVING_QUEUE")
+    if v in (None, ""):
+        return 32
+    return int(v)
+
+
 def timeline_mark_cycles() -> bool:
     return _get("TIMELINE_MARK_CYCLES") not in (None, "", "0")
 

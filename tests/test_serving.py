@@ -1,0 +1,468 @@
+"""Serving tier (docs/serving.md): block-sliced KV cache, incremental
+decode parity against the full-context forward, the resharded
+checkpoint→inference-mesh loader, continuous-batching scheduler edges,
+and the HTTP front end. The train→save→serve acceptance e2e and the
+SIGTERM drain live in test_serving_e2e.py (slow tier)."""
+
+import http.client
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.checkpoint import CheckpointEngine
+from horovod_tpu.models import transformer as tfm
+from horovod_tpu.parallel.mesh import create_mesh
+from horovod_tpu.serving import (BlockAllocator, DrainingError,
+                                 InferenceEngine, QueueFullError,
+                                 ServingConfig, blocks_needed,
+                                 config_from_manifest, load_params,
+                                 serving_config, transformer_extra)
+from horovod_tpu.serving.kv_cache import SCRATCH_BLOCK
+
+
+def _cfg(**over):
+    kw = dict(vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+              max_seq=64, dtype=jnp.float32, remat=False)
+    kw.update(over)
+    return tfm.TransformerConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    return create_mesh(devices=jax.devices()[:1], tp=1)
+
+
+def _engine(params, cfg, mesh, **over):
+    kw = dict(block_size=4, kv_blocks=40, max_batch_slots=4,
+              max_queue=8, max_new_tokens=8, min_prefill_bucket=8)
+    kw.update(over)
+    return InferenceEngine(params, cfg, mesh, ServingConfig(**kw))
+
+
+class TestBlockAllocator:
+    def test_scratch_is_never_handed_out(self):
+        a = BlockAllocator(8)
+        got = a.alloc(7)
+        assert got is not None and SCRATCH_BLOCK not in got
+        assert a.alloc(1) is None          # pool exactly exhausted
+
+    def test_all_or_nothing(self):
+        a = BlockAllocator(5)
+        assert a.alloc(5) is None          # only 4 allocatable
+        assert a.free == 4                 # failed alloc took nothing
+        got = a.alloc(3)
+        assert len(got) == 3 and a.free == 1
+
+    def test_release_recycles(self):
+        a = BlockAllocator(4)
+        got = a.alloc(3)
+        a.release(got)
+        assert a.free == 3
+        assert len(a.alloc(3)) == 3
+
+    def test_double_free_and_scratch_release_raise(self):
+        a = BlockAllocator(4)
+        got = a.alloc(2)
+        a.release(got)
+        with pytest.raises(ValueError, match="double free"):
+            a.release([got[0]])
+        with pytest.raises(ValueError, match="scratch"):
+            a.release([SCRATCH_BLOCK])
+
+    def test_blocks_needed(self):
+        # prompt + max_new - 1 cached positions (the last generated
+        # token is never fed back), ceil-divided by block size
+        assert blocks_needed(4, 1, 4) == 1
+        assert blocks_needed(4, 2, 4) == 2
+        assert blocks_needed(5, 8, 4) == 3
+        with pytest.raises(ValueError):
+            blocks_needed(0, 4, 4)
+
+
+class TestDecodeParity:
+    """apply_decode through the block-sliced cache must reproduce the
+    full-context apply at EVERY position (rtol — fp reassociation
+    only)."""
+
+    def test_prefill_matches_full_apply(self, model):
+        cfg, params = model
+        tok = jax.random.randint(jax.random.PRNGKey(1), (1, 12), 0, 64)
+        ref = tfm.apply(params, tok, cfg)
+        cache = tfm.init_cache(cfg, n_blocks=10, block_size=4)
+        tables = jnp.arange(1, 7, dtype=jnp.int32)[None, :]
+        logits, _ = tfm.apply_decode(params, tok, jnp.zeros((1,), jnp.int32),
+                                     tables, cache, cfg)
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_incremental_matches_full_apply(self, model):
+        """Token-by-token decode crosses block boundaries (block 4,
+        sequence 11) and must match the monolithic forward at every
+        position."""
+        cfg, params = model
+        tok = jax.random.randint(jax.random.PRNGKey(2), (1, 11), 0, 64)
+        ref = np.asarray(tfm.apply(params, tok, cfg))
+        cache = tfm.init_cache(cfg, n_blocks=10, block_size=4)
+        tables = jnp.arange(1, 7, dtype=jnp.int32)[None, :]
+        for i in range(11):
+            lg, cache = tfm.apply_decode(
+                params, tok[:, i:i + 1], jnp.array([i], jnp.int32),
+                tables, cache, cfg)
+            np.testing.assert_allclose(np.asarray(lg[:, 0]), ref[:, i],
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_prefill_then_decode(self, model):
+        cfg, params = model
+        tok = jax.random.randint(jax.random.PRNGKey(3), (1, 10), 0, 64)
+        ref = np.asarray(tfm.apply(params, tok, cfg))
+        cache = tfm.init_cache(cfg, n_blocks=10, block_size=4)
+        tables = jnp.arange(1, 7, dtype=jnp.int32)[None, :]
+        lg, cache = tfm.apply_decode(params, tok[:, :6],
+                                     jnp.zeros((1,), jnp.int32),
+                                     tables, cache, cfg)
+        np.testing.assert_allclose(np.asarray(lg), ref[:, :6],
+                                   rtol=1e-5, atol=1e-5)
+        for i in range(6, 10):
+            lg, cache = tfm.apply_decode(
+                params, tok[:, i:i + 1], jnp.array([i], jnp.int32),
+                tables, cache, cfg)
+            np.testing.assert_allclose(np.asarray(lg[:, 0]), ref[:, i],
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_padded_prefill_ignores_padding(self, model):
+        """A bucket-padded prompt produces the same logits at the real
+        positions — padding writes land in scratch/future blocks behind
+        the causal mask."""
+        cfg, params = model
+        tok = jax.random.randint(jax.random.PRNGKey(4), (1, 5), 0, 64)
+        ref = np.asarray(tfm.apply(params, tok, cfg))
+        cache = tfm.init_cache(cfg, n_blocks=10, block_size=4)
+        tables = jnp.arange(1, 7, dtype=jnp.int32)[None, :]
+        padded = jnp.concatenate(
+            [tok, jnp.zeros((1, 3), tok.dtype)], axis=1)
+        lg, _ = tfm.apply_decode(params, padded,
+                                 jnp.zeros((1,), jnp.int32),
+                                 tables, cache, cfg)
+        np.testing.assert_allclose(np.asarray(lg[:, :5]), ref,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_rejects_sp_and_moe(self, model):
+        cfg, params = model
+        cache = tfm.init_cache(cfg, 4, 4)
+        tok = jnp.zeros((1, 1), jnp.int32)
+        with pytest.raises(ValueError, match="sequence parallelism"):
+            tfm.apply_decode(params, tok, jnp.zeros((1,), jnp.int32),
+                             jnp.ones((1, 2), jnp.int32), cache,
+                             _cfg(sp_axis="sp"))
+
+
+class TestDecodeParityTP:
+    def test_tp2_matches_single_device(self, model):
+        """Tensor-parallel decode (heads over 'tp', shard_map) equals
+        the single-device incremental path."""
+        cfg, params = model
+        cfg_tp = _cfg(tp_axis="tp")
+        mesh = create_mesh(devices=jax.devices()[:2], tp=2)
+        specs = tfm.param_specs(cfg_tp)
+        cspecs = tfm.cache_specs(cfg_tp)
+
+        def put(tree, sp):
+            return jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+                tree, sp, is_leaf=lambda x: isinstance(x, P))
+
+        sp_params = put(params, specs)
+        sp_cache = put(tfm.init_cache(cfg_tp, 10, 4), cspecs)
+        fn = jax.jit(jax.shard_map(
+            lambda p, c, t, s, bt: tfm.apply_decode(p, t, s, bt, c,
+                                                    cfg_tp),
+            mesh=mesh, in_specs=(specs, cspecs, P(), P(), P()),
+            out_specs=(P(), cspecs), check_vma=False))
+
+        tok = jax.random.randint(jax.random.PRNGKey(5), (2, 7), 0, 64)
+        tables = jnp.array([[1, 2, 3], [4, 5, 6]], jnp.int32)
+        lg, sp_cache = fn(sp_params, sp_cache, tok,
+                          jnp.zeros((2,), jnp.int32), tables)
+        ref = np.asarray(tfm.apply(params, tok, cfg))
+        np.testing.assert_allclose(np.asarray(lg), ref, rtol=1e-4,
+                                   atol=1e-5)
+        # one decode step on both sequences
+        nxt = jnp.array([[9], [17]], jnp.int32)
+        lg2, _ = fn(sp_params, sp_cache, nxt,
+                    jnp.full((2,), 7, jnp.int32), tables)
+        full = np.asarray(tfm.apply(
+            params, jnp.concatenate([tok, nxt], axis=1), cfg))
+        np.testing.assert_allclose(np.asarray(lg2[:, 0]), full[:, 7],
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestLoader:
+    def _save_ws4(self, tmp_path, cfg, params):
+        """Commit a simulated 4-host tensor-parallel checkpoint (the
+        bench's process_fn trick: 8 devices / 2 per 'host')."""
+        train_cfg = _cfg(tp_axis="tp")
+        mesh = create_mesh(dp=2, tp=4)
+        specs = tfm.param_specs(train_cfg)
+        sharded = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            params, specs, is_leaf=lambda x: isinstance(x, P))
+        engines = [CheckpointEngine(
+            str(tmp_path), process_index=p, process_count=4,
+            process_fn=lambda d: d.id // 2, barrier=lambda n: None)
+            for p in range(4)]
+        for e in engines:
+            e.save(sharded, 7, extra=transformer_extra(train_cfg))
+        for e in engines:
+            e.wait()
+
+    def test_resharded_restore_ws4_to_ws2_and_ws1(self, tmp_path, model):
+        cfg, params = model
+        self._save_ws4(tmp_path, cfg, params)
+        ref = jax.tree_util.tree_leaves(params)
+        for n in (2, 1):
+            mesh = create_mesh(devices=jax.devices()[:n], tp=n)
+            man = CheckpointEngine(str(tmp_path)).restore_manifest()
+            scfg = serving_config(config_from_manifest(man), mesh)
+            assert scfg.tp_axis == ("tp" if n > 1 else None)
+            assert scfg.n_heads == cfg.n_heads   # recorded explicitly
+            loaded = jax.tree_util.tree_leaves(
+                load_params(str(tmp_path), scfg, mesh))
+            for a, b in zip(loaded, ref):
+                np.testing.assert_array_equal(np.asarray(a),
+                                              np.asarray(b))
+
+    def test_config_roundtrip_requires_extra(self, tmp_path, model):
+        cfg, params = model
+        eng = CheckpointEngine(str(tmp_path), process_count=1,
+                               barrier=lambda n: None)
+        eng.save(params, 1, block=True)   # no transformer_extra
+        with pytest.raises(KeyError, match="transformer_config"):
+            config_from_manifest(eng.restore_manifest())
+
+
+class TestSchedulerEdges:
+    @pytest.fixture(scope="class")
+    def served(self, model, mesh1):
+        """One engine reused across edge tests (jit programs compile
+        once); each test uses fresh requests."""
+        cfg, params = model
+        return _engine(params, cfg, mesh1)
+
+    def test_batched_equals_sequential(self, model, mesh1, served):
+        """The continuous batch never perturbs a request's greedy
+        output: per-slot compute is independent (disjoint blocks +
+        causal mask)."""
+        cfg, params = model
+        rng = np.random.RandomState(0)
+        prompts = [list(rng.randint(0, 64, int(n)))
+                   for n in rng.randint(3, 12, 5)]
+        reqs = [served.submit(p, max_new_tokens=6) for p in prompts]
+        served.run_until_idle()
+        batched = [r.result() for r in reqs]
+        solo = _engine(params, cfg, mesh1, max_batch_slots=1)
+        sequential = [solo.generate(p, max_new_tokens=6)
+                      for p in prompts]
+        assert batched == sequential
+
+    def test_queue_full_rejects(self, model, mesh1):
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1, max_queue=2)
+        eng.submit([1, 2, 3])
+        eng.submit([4, 5, 6])
+        before = hvd.metrics_snapshot()[
+            "hvdtpu_serving_requests_total"]["values"].get(
+            'status="rejected"', 0)
+        with pytest.raises(QueueFullError):
+            eng.submit([7, 8, 9])
+        after = hvd.metrics_snapshot()[
+            "hvdtpu_serving_requests_total"]["values"]['status="rejected"']
+        assert after == before + 1
+        eng.run_until_idle()   # drain so the jitted cache isn't donated
+
+    def test_kv_exhaustion_defers_admission_without_corruption(
+            self, model, mesh1, served):
+        """A request the pool cannot cover stays QUEUED — live
+        sequences keep decoding and their output is byte-identical to
+        an uncontended run."""
+        cfg, params = model
+        # pool: 7 usable blocks; each request needs 4 (prompt 9 +
+        # max_new 8 - 1 = 16 tokens / block 4)
+        eng = _engine(params, cfg, mesh1, kv_blocks=8,
+                      max_batch_slots=4)
+        p1, p2 = [1] * 9, [2] * 9
+        r1 = eng.submit(p1)
+        r2 = eng.submit(p2)
+        assert eng.step()               # admits r1 only; r2 can't fit
+        assert eng.active_count == 1 and eng.queue_depth == 1
+        assert r2.status == "queued"
+        eng.run_until_idle()
+        out1, out2 = r1.result(), r2.result()
+        assert out1 == served.generate(p1)
+        assert out2 == served.generate(p2)
+        assert eng._alloc.in_use == 0   # everything returned
+
+    def test_oversized_request_rejected_upfront(self, model, mesh1):
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1, kv_blocks=4)
+        with pytest.raises(ValueError, match="KV blocks"):
+            eng.submit([1] * 9, max_new_tokens=8)   # needs 4, pool has 3
+        with pytest.raises(ValueError, match="max_seq"):
+            eng.submit([1] * 60, max_new_tokens=8)
+
+    def test_mid_stream_eviction_on_max_tokens(self, model, mesh1,
+                                               served):
+        """A short request leaves the batch while a long one keeps
+        decoding; the freed blocks re-admit a third request
+        mid-flight."""
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1, kv_blocks=10,
+                      max_batch_slots=2)
+        long = eng.submit([3] * 5, max_new_tokens=8)   # 3 blocks
+        short = eng.submit([4] * 5, max_new_tokens=3)  # 2 blocks
+        third = eng.submit([5] * 5, max_new_tokens=3)  # waits for a slot
+        eng.step()
+        assert eng.active_count == 2 and third.status == "queued"
+        while not short.done:
+            eng.step()
+        assert not long.done            # still decoding mid-stream
+        eng.run_until_idle()
+        assert long.result() == served.generate([3] * 5,
+                                                max_new_tokens=8)
+        assert short.result() == served.generate([4] * 5,
+                                                 max_new_tokens=3)
+        assert third.result() == served.generate([5] * 5,
+                                                 max_new_tokens=3)
+
+    def test_eos_stops_generation(self, model, mesh1):
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1)
+        probe = eng.generate([6] * 4, max_new_tokens=8)
+        eos = probe[1]   # force EOS at the second generated token
+        eng2 = _engine(params, cfg, mesh1, eos_id=eos)
+        out = eng2.generate([6] * 4, max_new_tokens=8)
+        assert out == probe[:out.index(eos) + 1]
+        assert out[-1] == eos and len(out) < 8
+
+    def test_drain_finishes_active_fails_queued(self, model, mesh1):
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1, max_batch_slots=1)
+        active = eng.submit([7] * 4, max_new_tokens=4)
+        queued = eng.submit([8] * 4, max_new_tokens=4)
+        eng.step()   # admit the first
+        eng.drain()
+        assert active.status == "completed" and len(active.result()) == 4
+        assert queued.status == "failed" and "draining" in queued.error
+        with pytest.raises(DrainingError):
+            eng.submit([9] * 4)
+
+    def test_temperature_sampling_is_seeded(self, model, mesh1):
+        cfg, params = model
+        outs = []
+        for _ in range(2):
+            eng = _engine(params, cfg, mesh1, temperature=1.0, seed=3)
+            outs.append(eng.generate([5, 6, 7], max_new_tokens=6))
+        assert outs[0] == outs[1]   # same seed, same stream
+
+
+class TestServingMetrics:
+    def test_counters_and_gauges_populated(self, model, mesh1):
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1)
+        eng.generate([1, 2, 3, 4], max_new_tokens=4)
+        snap = hvd.metrics_snapshot()
+        assert snap["hvdtpu_serving_ttft_seconds"]["values"][""][
+            "count"] >= 1
+        assert snap["hvdtpu_serving_tpot_seconds"]["values"][""][
+            "count"] >= 1
+        gen = snap["hvdtpu_serving_tokens_total"]["values"][
+            'kind="generated"']
+        assert gen >= 4
+        assert snap["hvdtpu_serving_kv_blocks_total"]["values"][""] > 0
+        assert snap["hvdtpu_serving_compiles_total"]["values"][
+            'phase="decode"'] >= 1
+
+
+class TestServerHTTP:
+    @pytest.fixture()
+    def served(self, model, mesh1):
+        from horovod_tpu.serving.server import ServingServer
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1, max_batch_slots=2,
+                      max_new_tokens=4)
+        srv = ServingServer(eng, port=0, host="127.0.0.1")
+        srv.start()
+        yield eng, srv
+        srv.shutdown()
+
+    def _post(self, port, body, timeout=120):
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=timeout)
+        conn.request("POST", "/generate", json.dumps(body),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+
+    def test_generate_and_healthz(self, served, model, mesh1):
+        cfg, params = model
+        eng, srv = served
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=30)
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        health = json.loads(resp.read())
+        assert resp.status == 200 and health["status"] == "serving"
+        assert health["kv_blocks_total"] == 39
+
+        status, body = self._post(srv.port, {"tokens": [1, 2, 3]})
+        assert status == 200
+        reference = _engine(params, cfg, mesh1).generate(
+            [1, 2, 3], max_new_tokens=4)
+        assert body["tokens"] == reference
+        assert body["ttft_ms"] > 0 and body["latency_ms"] >= \
+            body["ttft_ms"]
+
+    def test_bad_request_400_and_404(self, served):
+        _, srv = served
+        status, body = self._post(srv.port, {"tokens": "nope"})
+        assert status == 400
+        status, _ = self._post(srv.port, {"tokens": [1],
+                                          "max_new_tokens": 10 ** 6})
+        assert status == 400
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=30)
+        conn.request("GET", "/nothing")
+        assert conn.getresponse().status == 404
+
+    def test_queue_full_is_429(self, model, mesh1):
+        """Saturate the bounded queue with the scheduler loop parked
+        (server never started) — the next HTTP submit must 429."""
+        from horovod_tpu.serving.server import ServingServer
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1, max_queue=1)
+        srv = ServingServer(eng, port=0, host="127.0.0.1")
+        srv._http_thread.start()   # HTTP only: no scheduler drains
+        try:
+            eng.submit([1, 2, 3])          # fills the queue
+            status, body = self._post(srv.port, {"tokens": [4, 5, 6]})
+            assert status == 429 and "queue full" in body["error"]
+            snap = hvd.metrics_snapshot()
+            assert snap["hvdtpu_serving_http_requests_total"]["values"][
+                'code="429",route="generate"'] >= 1
+        finally:
+            eng.run_until_idle()
+            srv._httpd.shutdown()
+            srv._httpd.server_close()
