@@ -213,24 +213,55 @@ for _ in range(ITERS):
     step()
     rates.append(B * S / (time.perf_counter() - t0))
 stats = {}
+extra = {}
 backend = "none"
 if use_shim:
     import jax
+    import horovod_tpu
     from horovod_tpu.utils import interop
     backend = jax.default_backend()
+
+    def counters():
+        snap = horovod_tpu.metrics_snapshot()
+
+        def val(fam, key=""):
+            return snap.get(fam, {}).get("values", {}).get(key, 0)
+
+        return {
+            "compile_misses": val("hvdtpu_executor_cache_misses_total"),
+            "compile_hits": val("hvdtpu_executor_cache_hits_total"),
+            "bucket_fires_hook": val("hvdtpu_torch_bucket_fires_total",
+                                     'trigger="hook"'),
+            "bucket_fires_flush": val("hvdtpu_torch_bucket_fires_total",
+                                      'trigger="flush"'),
+            "bucket_bytes": val("hvdtpu_torch_bucket_bytes_total"),
+        }
+
+    # Steady-state counter deltas over ONE step: interop split proves
+    # the DLPack path carries the gradients; compile_misses == 0 proves
+    # the per-bucket programs are REUSED, not rebuilt.
     interop.reset_stats()
+    before = counters()
     step()
+    after = counters()
     stats = interop.stats()
+    extra = {
+        "buckets": len(getattr(opt, "_buckets", [])),
+        "dlpack_available": bool(interop.transfer_egress_supported()),
+        "one_step": {k: round(after[k] - before[k], 1) for k in before},
+    }
 arm = "torch_plain"
 if use_shim:
     arm = "torch_shim_cpu" if os.environ.get("FORCE_CPU") == "1" \
         else "torch_shim"
-print(json.dumps({"arm": arm,
-                  "tok_s": round(float(np.median(rates)), 1),
-                  "params_m": round(n_params / 1e6, 1),
-                  "grad_mb_per_step": round(n_params * 4 / 2**20, 1),
-                  "backend": backend,
-                  "interop_one_step": stats}))
+row = {"arm": arm,
+       "tok_s": round(float(np.median(rates)), 1),
+       "params_m": round(n_params / 1e6, 1),
+       "grad_mb_per_step": round(n_params * 4 / 2**20, 1),
+       "backend": backend,
+       "interop_one_step": stats}
+row.update(extra)
+print(json.dumps(row))
 """
 
 ARM_BUCKETED = COMMON + """
@@ -279,40 +310,72 @@ def run_arm(code: str, extra_env=None, timeout=3600) -> dict:
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
-def main():
-    rows = {}
-    rows["jax"] = run_arm(ARM_JAX)
-    rows["keras_fit"] = run_arm(ARM_KERAS)
-    rows["torch_plain"] = run_arm(ARM_TORCH, {"TORCH_SHIM": "0"})
-    rows["torch_shim"] = run_arm(ARM_TORCH, {"TORCH_SHIM": "1"})
-    rows["torch_shim_cpu"] = run_arm(
-        ARM_TORCH, {"TORCH_SHIM": "1", "FORCE_CPU": "1"})
-    rows["bucketed"] = run_arm(ARM_BUCKETED)
+ARMS = {
+    "jax": (ARM_JAX, None),
+    "keras_fit": (ARM_KERAS, None),
+    "torch_plain": (ARM_TORCH, {"TORCH_SHIM": "0"}),
+    "torch_shim": (ARM_TORCH, {"TORCH_SHIM": "1"}),
+    "torch_shim_cpu": (ARM_TORCH, {"TORCH_SHIM": "1", "FORCE_CPU": "1"}),
+    "bucketed": (ARM_BUCKETED, None),
+}
 
-    j, k = rows["jax"], rows["keras_fit"]
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--arms", default="all",
+        help="comma list of arms to re-measure (%s); arms not listed "
+             "are carried forward from the existing BENCH_SHIMS.json "
+             "with a carried_from_previous_run marker, so a torch-only "
+             "re-run does not have to repay the heavy jax/keras "
+             "control arms" % ",".join(ARMS))
+    args = ap.parse_args(argv)
+    selected = (set(ARMS) if args.arms == "all"
+                else set(a.strip() for a in args.arms.split(",")))
+    unknown = selected - set(ARMS)
+    if unknown:
+        ap.error(f"unknown arms: {sorted(unknown)}")
+    prior = {}
+    path = os.path.join(REPO, "BENCH_SHIMS.json")
+    if os.path.exists(path):
+        with open(path) as f:
+            prior = json.load(f).get("rows", {})
+
+    rows = {}
+    for name, (code, extra_env) in ARMS.items():
+        if name in selected:
+            rows[name] = run_arm(code, extra_env)
+        elif name in prior:
+            rows[name] = dict(prior[name], carried_from_previous_run=True)
+
+    j, k = rows.get("jax"), rows.get("keras_fit")
     tp = rows["torch_plain"]
     result = {
         "metric": "framework_shim_throughput",
-        "value": round(k["tok_s"] / j["tok_s_per_call"], 3),
+        "value": (round(k["tok_s"] / j["tok_s_per_call"], 3)
+                  if j and k else None),
         "unit": "keras-fit / pure-jax-per-call tok rate",
         "torch_shim_retention_chip": round(
             rows["torch_shim"]["tok_s"] / tp["tok_s"], 3),
         "torch_shim_retention_cpu": round(
             rows["torch_shim_cpu"]["tok_s"] / tp["tok_s"], 3),
         "rows": rows,
-        "note": ("per-call rows share the ~100 ms/step axon-tunnel "
-                 "dispatch floor; chained10 is the bench_lm headline "
-                 "shape no per-step framework loop can use. The chip "
-                 "torch row and the bucketed row are bound by this "
-                 "box's D2H tunnel, whose measured bandwidth varied "
-                 "5-27 MB/s across the session (packed single-transfer "
-                 "and per-array reads measured equally slow at the low "
-                 "end - it is the link, not the boundary code); every "
-                 "gradient must return to torch host memory each step. "
-                 "The cpu row is the same shim with a memcpy boundary "
-                 "and isolates the shim's intrinsic cost."),
+        "note": ("per-call rows share the per-call dispatch floor of "
+                 "whatever link fronts the accelerator; chained10 is "
+                 "the bench_lm headline shape no per-step framework "
+                 "loop can use. The torch shim rows run the bucketed "
+                 "hot path (docs/torch.md): gradients pack into "
+                 "size-targeted buckets fired during backward, one "
+                 "engine call + one DLPack crossing each way per "
+                 "bucket per step, per-bucket programs reused across "
+                 "steps (one_step.compile_misses == 0 in steady "
+                 "state). The cpu row is the same shim with a memcpy "
+                 "boundary and isolates the shim's intrinsic cost; "
+                 "interop_one_step proves which boundary path carried "
+                 "the gradients."),
     }
-    with open(os.path.join(REPO, "BENCH_SHIMS.json"), "w") as f:
+    with open(path, "w") as f:
         f.write(json.dumps(result) + "\n")
     print(json.dumps(result))
 

@@ -343,20 +343,29 @@ class CollectiveExecutor:
         that already carry the replicated sharding — in a steady-state
         training loop the outputs of step N are the inputs of step N+1
         and re-running device_put on them is a per-tensor dispatch for
-        nothing."""
+        nothing. Everything that DOES transfer rides ONE ``device_put``
+        call: on a latency-heavy host↔device link each put is its own
+        round-trip dispatch, so a fused group of host gradients (the
+        torch/keras shim shape) pays the floor once, not once per
+        tensor."""
         sh = NamedSharding(mesh, P())
-        out = []
-        for t in tensors:
+        out: List = [None] * len(tensors)
+        moving = []
+        for i, t in enumerate(tensors):
             if isinstance(t, jax.Array):
                 try:
                     if t.sharding.is_equivalent_to(sh, t.ndim):
-                        out.append(t)
+                        out[i] = t
                         continue
                 except Exception:
                     pass
-            self.device_put_count += 1
-            self._metrics.device_puts.inc()
-            out.append(jax.device_put(t, sh))
+            moving.append(i)
+        if moving:
+            self.device_put_count += len(moving)
+            self._metrics.device_puts.inc(len(moving))
+            put = jax.device_put([tensors[i] for i in moving], sh)
+            for i, a in zip(moving, put):
+                out[i] = a
         return out
 
     def _program(self, key, builder):

@@ -1,25 +1,37 @@
 """horovod_tpu.torch — the PyTorch framework shim.
 
 Parity target: horovod/torch/__init__.py (348 LoC) + mpi_ops.py (438 LoC):
-``DistributedOptimizer`` firing an async allreduce per parameter as its
-gradient is accumulated, ``synchronize()`` flushing handles before
-``step()``, ``backward_passes_per_step`` gradient accumulation,
-``broadcast_parameters`` and ``broadcast_optimizer_state``. Torch stays the
-autograd/optimizer engine; the collectives run on the TPU-native XLA data
-plane (see mpi_ops.py in this package).
+``DistributedOptimizer`` launching collectives as gradients are
+accumulated, ``synchronize()`` flushing before ``step()``,
+``backward_passes_per_step`` gradient accumulation,
+``broadcast_parameters`` and ``broadcast_optimizer_state``. Torch stays
+the autograd/optimizer engine; the collectives run on the TPU-native XLA
+data plane (see mpi_ops.py in this package).
+
+Hot path (docs/torch.md): where the reference fires one async allreduce
+per parameter and lets its background fusion cycle re-pack them, this
+shim packs at the SOURCE — parameters partition into size-targeted
+gradient buckets at wrap time, each bucket owns a persistent flat wire
+buffer and one persistent compiled allreduce program, hooks memcpy
+gradients into the buffer, and the bucket's last hook fires its
+collective while backward still runs (backward-overlap). The per-call
+dispatch floor is paid per bucket, not per tensor.
 """
 
 from __future__ import annotations
 
 import warnings
 from contextlib import contextmanager
-from typing import Iterable, Optional, Tuple
+from typing import Iterable, List, Optional, Tuple
 
 import torch
 
+from .. import ops as _ops
 from ..topology import (init, shutdown, is_initialized, rank, local_rank,
                         size, local_size, mpi_threads_supported)
 from ..observability import StepTimer as _StepTimer
+from ..observability import registry as _obs
+from ..utils import env as _env
 from .compression import Compression
 from .mpi_ops import (allreduce, allreduce_, allreduce_async,
                       allreduce_async_, allgather, allgather_async,
@@ -63,19 +75,117 @@ class StepMetrics(_StepTimer):
                          flops_per_step=flops_per_step)
 
 
+class _ShimMetrics:
+    """Registry handles for the torch shim's bucket plane, resolved once
+    per process (docs/metrics.md) — the same lazy-singleton pattern as
+    the engine/executor metric classes."""
+
+    _instance = None
+
+    def __init__(self):
+        r = _obs.registry()
+        fires = r.counter(
+            "hvdtpu_torch_bucket_fires_total",
+            "Torch gradient buckets submitted to the engine, by trigger "
+            "(hook = last grad hook landed during backward — the "
+            "overlap path; flush = synchronize() drained a bucket whose "
+            "hooks had not all fired)")
+        self.fires = {t: fires.labels(trigger=t) for t in ("hook", "flush")}
+        self.bucket_bytes = r.counter(
+            "hvdtpu_torch_bucket_bytes_total",
+            "Bytes of bucketed gradient payload submitted to the "
+            "engine (bucket-buffer bytes at the wire dtype)").labels()
+        self.buckets = r.gauge(
+            "hvdtpu_torch_buckets",
+            "Gradient buckets configured by the most recently "
+            "constructed DistributedOptimizer (0 = per-tensor "
+            "mode)").labels()
+
+    @classmethod
+    def get(cls) -> "_ShimMetrics":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+
+class _GradBucket:
+    """One fusion bucket of the DistributedOptimizer's backward-overlap
+    plane: a fixed flat wire-dtype buffer covering a contiguous span of
+    parameters, fired as ONE engine allreduce per step. The buffer shape
+    is constant across steps, so the executor's fused-path cache key
+    ("ar", ((numel,),), (dtype,), ...) resolves to one persistent jitted
+    program per bucket — the reference's fusion-buffer cycle
+    (operations.cc:1221-1243) with the memcpy hoisted to hook time."""
+
+    __slots__ = ("index", "params", "offsets", "numel", "buffer", "ready",
+                 "name")
+
+    def __init__(self, index: int, params: List[torch.Tensor],
+                 dtype: torch.dtype, name: str):
+        self.index = index
+        self.params = params
+        self.offsets = {}
+        off = 0
+        for p in params:
+            n = p.numel()
+            self.offsets[id(p)] = (off, n)
+            off += n
+        self.numel = off
+        self.buffer = torch.zeros(off, dtype=dtype)
+        self.ready: set = set()
+        self.name = name
+
+    def fill(self, p: torch.Tensor) -> None:
+        off, n = self.offsets[id(p)]
+        with torch.no_grad():
+            # copy_ casts param-dtype grads onto the wire dtype (the
+            # cast compressor's compress, fused into the pack memcpy).
+            self.buffer[off:off + n].copy_(p.grad.detach().reshape(-1))
+
+    def scatter(self, p: torch.Tensor) -> None:
+        off, n = self.offsets[id(p)]
+        with torch.no_grad():
+            # ...and back (decompress): copy_ casts wire -> grad dtype.
+            p.grad.copy_(self.buffer[off:off + n].view(p.grad.shape))
+
+
+_opt_counter = [0]
+
+
+def _bucketable(compression) -> bool:
+    """Bucketing understands the STOCK compressors (none / fp16 / bf16 /
+    blockwise — their transform is a dtype cast or a wire spec, both of
+    which fuse into the bucket pack-copy). Anything else — including a
+    subclass that may override compress/decompress with arbitrary
+    logic — falls back to the per-tensor path, where the compressor is
+    invoked verbatim."""
+    return compression in (Compression.none, Compression.fp16,
+                           Compression.bf16, Compression.int8_blockwise,
+                           Compression.fp8_blockwise)
+
+
 class _DistributedOptimizer(torch.optim.Optimizer):
     """Mixin installed on a dynamic subclass of the wrapped optimizer
     (horovod/torch/__init__.py:42-151).
 
-    Each parameter gets a post-grad-accumulation hook that launches an
-    async in-place allreduce as soon as its gradient is ready (the
-    reference registers hooks on the grad accumulator nodes,
-    torch/__init__.py:95-130); ``step()`` synchronizes all outstanding
-    handles first (torch/__init__.py:149-151).
+    Hot path (docs/torch.md): parameters are partitioned at construction
+    into size-targeted gradient BUCKETS (``bucket_cap_mb``, default
+    HOROVOD_TPU_TORCH_BUCKET_MB = the engine fusion threshold), walked
+    in reverse registration order so the earliest-completing gradients
+    share the first bucket. Each parameter's post-grad-accumulation hook
+    copies its gradient into the bucket's flat wire-dtype buffer; the
+    LAST hook of a bucket fires one in-place async allreduce on the
+    whole buffer — communication overlaps the remainder of backward,
+    the reference's fusion cycle (operations.cc:2149-2265) driven from
+    the autograd graph. ``synchronize()`` drains *buckets*, not
+    tensors: one engine flush, one batched DLPack egress, then a
+    scatter back into each ``p.grad``. ``bucket_cap_mb=0`` (or an
+    unrecognized custom compressor) keeps the original per-tensor hook
+    path (torch/__init__.py:95-130).
     """
 
     def __init__(self, params, named_parameters, compression,
-                 backward_passes_per_step=1):
+                 backward_passes_per_step=1, bucket_cap_mb=None):
         super(self.__class__, self).__init__(params)
         self._compression = compression
         self.backward_passes_per_step = backward_passes_per_step
@@ -105,7 +215,98 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         self._allreduce_delay = {id(v): backward_passes_per_step
                                  for group in self.param_groups
                                  for v in group["params"]}
+        if bucket_cap_mb is None:
+            bucket_cap_mb = _env.torch_bucket_mb()
+        self._buckets: List[_GradBucket] = []
+        self._param_bucket = {}
+        self._bucket_residuals = {}
+        self._metrics = _ShimMetrics.get()
+        if bucket_cap_mb > 0 and _bucketable(compression):
+            self._build_buckets(float(bucket_cap_mb) * 2 ** 20)
+        self._metrics.buckets.set(len(self._buckets))
         self._register_hooks()
+
+    # ------------------------------------------------------------- buckets
+
+    def _wire_dtype(self, p: torch.Tensor) -> torch.dtype:
+        """Bucket-buffer dtype for ``p``'s gradient: the cast
+        compressor's wire dtype for floating params, else the param's
+        own dtype (blockwise specs quantize inside the fused XLA
+        program, so their buffer stays at the logical dtype)."""
+        wd = getattr(self._compression, "wire_dtype", None)
+        if wd is not None and p.dtype.is_floating_point:
+            return wd
+        return p.dtype
+
+    def _build_buckets(self, cap_bytes: float) -> None:
+        _opt_counter[0] += 1
+        prefix = f"hvd.torch.{_opt_counter[0]}.bucket"
+        params = [p for group in self.param_groups
+                  for p in group["params"] if p.requires_grad]
+        # Reverse registration order approximates autograd completion
+        # order (backward walks the graph output->input), so the
+        # gradients that finish first share the first-fired bucket —
+        # the overlap-maximizing assignment the reference gets from its
+        # arrival-ordered fusion queue.
+        open_spans = {}   # wire dtype -> (param list, bytes)
+        spans = []
+        for p in reversed(params):
+            dt = self._wire_dtype(p)
+            nbytes = p.numel() * p.element_size()
+            span = open_spans.get(dt)
+            if span is None or (span[1] + nbytes > cap_bytes and span[0]):
+                span = [[], 0]
+                spans.append(span)
+                open_spans[dt] = span
+            span[0].append(p)
+            span[1] += nbytes
+        for members, _ in spans:
+            b = _GradBucket(len(self._buckets), members,
+                            self._wire_dtype(members[0]),
+                            f"{prefix}.{len(self._buckets)}")
+            self._buckets.append(b)
+            for p in members:
+                self._param_bucket[id(p)] = b
+
+    def _fire_bucket(self, b: _GradBucket, trigger: str) -> None:
+        blockwise = self._compression if getattr(
+            self._compression, "wire_spec", None) is not None else None
+        if blockwise is not None and b.buffer.dtype == torch.float32:
+            self._apply_error_feedback(b, blockwise.wire_spec)
+        self._metrics.fires[trigger].inc()
+        self._metrics.bucket_bytes.inc(b.numel * b.buffer.element_size())
+        self._handles[b.index] = allreduce_async_(
+            b.buffer, average=True, name=b.name, compression=blockwise)
+
+    def _apply_error_feedback(self, b: _GradBucket, spec) -> None:
+        """Per-BUCKET error-feedback residual for quantized wire specs:
+        the bucket buffer is what the engine quantizes as one flat
+        tensor (blocks span the original parameter boundaries), so the
+        residual must be keyed and shaped by bucket, not by parameter —
+        wire input = grads + residual, new residual = wire input minus
+        its local quantize/dequantize roundtrip
+        (quantization.local_roundtrip, the phase-1 wire contribution),
+        computed on the JAX CPU backend so no extra device dispatch
+        rides the hook path."""
+        import jax
+        import numpy as np
+        from .. import quantization as _quant
+
+        res = self._bucket_residuals.get(b.index)
+        if res is None:
+            res = torch.zeros_like(b.buffer)
+            self._bucket_residuals[b.index] = res
+        with torch.no_grad():
+            b.buffer.add_(res)
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu):
+            rt = _quant.local_roundtrip(
+                jax.device_put(b.buffer.detach().numpy(), cpu), spec)
+        # Write the residual through numpy views — no writable-flag
+        # dance, no extra staging copy of a bucket-sized array.
+        np.subtract(b.buffer.numpy(), np.asarray(rt), out=res.numpy())
+
+    # --------------------------------------------------------------- hooks
 
     def _register_hooks(self):
         for group in self.param_groups:
@@ -114,6 +315,28 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                     p.register_post_accumulate_grad_hook(self._make_hook())
 
     def _make_hook(self):
+        if self._buckets:
+            def hook(p):
+                b = self._param_bucket[id(p)]
+                if id(p) in b.ready:
+                    raise AssertionError(
+                        "Gradient for this parameter was already "
+                        "allreduced this step. If you call backward() "
+                        "more than once per step, pass "
+                        "backward_passes_per_step=<number of backward "
+                        "passes> to DistributedOptimizer "
+                        "(torch/__init__.py:114-124).")
+                self._allreduce_delay[id(p)] -= 1
+                if self._allreduce_delay[id(p)] == 0:
+                    b.fill(p)
+                    b.ready.add(id(p))
+                    if len(b.ready) == len(b.params):
+                        # Backward-overlap: the bucket's last gradient
+                        # just landed — fire its collective NOW, while
+                        # autograd still works on the rest of the graph.
+                        self._fire_bucket(b, trigger="hook")
+            return hook
+
         def hook(p):
             if id(p) in self._handles:
                 raise AssertionError(
@@ -142,9 +365,17 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                                compression=blockwise)
 
     def synchronize(self):
-        """Flush: enqueue any parameter whose hook never fired, then block
-        on every handle and install the (decompressed) averaged gradients
-        (torch/__init__.py:132-147)."""
+        """Flush: enqueue anything whose hook never fired, then block on
+        every handle and install the (decompressed) averaged gradients
+        (torch/__init__.py:132-147). In bucket mode the unit of flushing
+        is the BUCKET: partially-filled buckets (early ``step()``
+        mid-accumulation, dynamic graphs) are topped up from whatever
+        gradients exist and fired whole — the buffer shape never
+        changes, so the same compiled program serves full and partial
+        steps — then one batched wait scatters results back into each
+        ``p.grad``."""
+        if self._buckets:
+            return self._synchronize_buckets()
         # Every parameter not already in flight gets flushed here — even one
         # mid-accumulation (delay > 0), matching the reference, so that an
         # early step() never applies un-allreduced local gradients
@@ -169,6 +400,30 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 p.grad.copy_(self._compression.decompress(out, ctx)
                              .reshape(p.grad.shape))
             self._allreduce_delay[pid] = self.backward_passes_per_step
+        self._handles.clear()
+        self._synchronized = True
+
+    def _synchronize_buckets(self):
+        with _ops.engine().burst():
+            for b in self._buckets:
+                if b.index in self._handles:
+                    continue
+                for p in b.params:
+                    if p.grad is not None and id(p) not in b.ready:
+                        b.fill(p)
+                        b.ready.add(id(p))
+                if b.ready:
+                    self._fire_bucket(b, trigger="flush")
+        fired = sorted(self._handles)
+        synchronize_many([self._handles[i] for i in fired])
+        for i in fired:
+            b = self._buckets[i]
+            for p in b.params:
+                if id(p) in b.ready and p.grad is not None:
+                    b.scatter(p)
+                    self._allreduce_delay[id(p)] = \
+                        self.backward_passes_per_step
+            b.ready.clear()
         self._handles.clear()
         self._synchronized = True
 
@@ -214,15 +469,21 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          named_parameters: Optional[
                              Iterable[Tuple[str, torch.Tensor]]] = None,
                          compression=Compression.none,
-                         backward_passes_per_step: int = 1):
+                         backward_passes_per_step: int = 1,
+                         bucket_cap_mb: Optional[float] = None):
     """Wrap a torch optimizer so ``step()`` applies allreduce-averaged
     gradients — the reference builds a dynamic subclass of the wrapped
     optimizer's class so isinstance() and LR schedulers keep working
-    (torch/__init__.py:154-197)."""
+    (torch/__init__.py:154-197).
+
+    ``bucket_cap_mb`` sizes the backward-overlap gradient buckets
+    (docs/torch.md): None reads HOROVOD_TPU_TORCH_BUCKET_MB (default =
+    the engine fusion threshold, 64 MB), 0 disables bucketing and keeps
+    the per-tensor hook path."""
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                dict(_DistributedOptimizer.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression,
-               backward_passes_per_step)
+               backward_passes_per_step, bucket_cap_mb)
 
 
 def broadcast_parameters(params, root_rank: int = 0) -> None:
@@ -234,12 +495,17 @@ def broadcast_parameters(params, root_rank: int = 0) -> None:
     else:
         items = list(params)
     handles = []
-    for name, p in items:
-        if p is None or not isinstance(p, torch.Tensor):
-            continue
-        handles.append(broadcast_async_(p, root_rank, name=f"bcast.{name}"))
-    for h in handles:
-        synchronize(h)
+    # One fusion burst + one batched synchronize for the whole variable
+    # set: a model-sized broadcast is hundreds of tensors, and draining
+    # them one synchronize() at a time pays a readback round trip each
+    # (the grouped path mpi_ops.synchronize_many exists for).
+    with _ops.engine().burst():
+        for name, p in items:
+            if p is None or not isinstance(p, torch.Tensor):
+                continue
+            handles.append(
+                broadcast_async_(p, root_rank, name=f"bcast.{name}"))
+    synchronize_many(handles)
 
 
 def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
@@ -280,46 +546,52 @@ def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
         scalars[key] = (t, type(value))
         handles.append(broadcast_async_(t, root_rank, name=f"opt.{key}"))
 
-    for gi, group in enumerate(state_dict["param_groups"]):
-        for key, value in group.items():
-            if key == "params":
-                continue
-            if isinstance(value, (int, float)) and not isinstance(
-                    value, bool):
-                skey = f"group.{gi}.{key}"
-                _tensorize(skey, value)
+    with _ops.engine().burst():
+        # The whole mixed bag — tensorized scalars, 0-dim views, tensor
+        # state — enqueues as ONE fusion burst, then drains through one
+        # batched synchronize below (the grouped path).
+        for gi, group in enumerate(state_dict["param_groups"]):
+            for key, value in group.items():
+                if key == "params":
+                    continue
+                if isinstance(value, (int, float)) and not isinstance(
+                        value, bool):
+                    skey = f"group.{gi}.{key}"
+                    _tensorize(skey, value)
 
-                def make_cb(gi=gi, key=key, skey=skey):
-                    def cb():
-                        t, typ = scalars[skey]
-                        optimizer.param_groups[gi][key] = typ(t.item())
-                    return cb
-                callbacks.append(make_cb())
-    for pid, pstate in state_dict["state"].items():
-        for key, value in pstate.items():
-            if isinstance(value, torch.Tensor):
-                if value.ndim == 0:
-                    # 0-dim tensors (modern torch 'step') broadcast via a
-                    # 1-element view-alike then copy back.
-                    flat = value.reshape(1).clone()
-                    handles.append(broadcast_async_(
-                        flat, root_rank, name=f"opt.state.{pid}.{key}"))
-
-                    def make_cb0(value=value, flat=flat):
+                    def make_cb(gi=gi, key=key, skey=skey):
                         def cb():
-                            value.copy_(flat[0])
+                            t, typ = scalars[skey]
+                            optimizer.param_groups[gi][key] = typ(t.item())
                         return cb
-                    callbacks.append(make_cb0())
-                else:
-                    handles.append(broadcast_async_(
-                        value, root_rank, name=f"opt.state.{pid}.{key}"))
-            elif isinstance(value, (int, float)) and not isinstance(
-                    value, bool):
-                skey = f"state.{pid}.{key}"
-                _tensorize(skey, value)
-                scalar_state_keys.append((pid, key, skey))
-    for h in handles:
-        synchronize(h)
+                    callbacks.append(make_cb())
+        for pid, pstate in state_dict["state"].items():
+            for key, value in pstate.items():
+                if isinstance(value, torch.Tensor):
+                    if value.ndim == 0:
+                        # 0-dim tensors (modern torch 'step') broadcast
+                        # via a 1-element view-alike then copy back.
+                        flat = value.reshape(1).clone()
+                        handles.append(broadcast_async_(
+                            flat, root_rank, name=f"opt.state.{pid}.{key}"))
+
+                        def make_cb0(value=value, flat=flat):
+                            def cb():
+                                value.copy_(flat[0])
+                            return cb
+                        callbacks.append(make_cb0())
+                    else:
+                        handles.append(broadcast_async_(
+                            value, root_rank, name=f"opt.state.{pid}.{key}"))
+                elif isinstance(value, (int, float)) and not isinstance(
+                        value, bool):
+                    skey = f"state.{pid}.{key}"
+                    _tensorize(skey, value)
+                    scalar_state_keys.append((pid, key, skey))
+    # Same grouped path as broadcast_parameters: every tensorized scalar
+    # and state tensor rides one burst + one batched synchronize; the
+    # per-key callbacks then re-cast from the landed buffers.
+    synchronize_many(handles)
     for cb in callbacks:
         cb()
     if scalar_state_keys:

@@ -76,15 +76,26 @@ def _bits32(t: torch.Tensor) -> np.ndarray:
     return t.view(torch.int32).numpy()
 
 
+def _np_private(arr: np.ndarray) -> np.ndarray:
+    """EXACTLY one host copy: a contiguous, writable array that does not
+    alias the source. ``np.ascontiguousarray(x).copy()`` paid two copies
+    for a non-contiguous source (ascontiguousarray already copies) and
+    one avoidable copy chain for bf16; branch instead of stacking."""
+    if arr.flags["C_CONTIGUOUS"]:
+        # May alias an engine/XLA buffer (np.asarray on a CPU backend
+        # array is zero-copy and read-only) — one defensive copy.
+        return arr.copy()
+    return np.ascontiguousarray(arr)
+
+
 def _to_torch_host(arr: np.ndarray, dtype: torch.dtype,
                    from_bits: bool = False) -> torch.Tensor:
     """Host numpy array (already transferred) -> torch tensor."""
     if from_bits:
-        bits = torch.from_numpy(np.ascontiguousarray(arr).copy())
-        return bits.view(dtype)
+        return torch.from_numpy(_np_private(arr)).view(dtype)
     if dtype == torch.bfloat16:
-        bits = np.ascontiguousarray(arr.view(np.uint16))
-        return torch.from_numpy(bits.copy()).view(torch.bfloat16)
+        return torch.from_numpy(
+            _np_private(arr.view(np.uint16))).view(torch.bfloat16)
     return torch.from_numpy(np.array(arr)).to(dtype)
 
 
@@ -141,14 +152,17 @@ def synchronize(handle: int) -> torch.Tensor:
 
 
 def synchronize_many(handles) -> list:
-    """Synchronize a batch of handles with BATCHED device-to-host
-    transfer. Per-handle ``synchronize`` reads each result back with its
-    own transfer; on accelerators behind a latency-heavy link each read
-    is a round trip (measured through the axon tunnel: ~70 ms floor,
-    ~2x total via ``jax.device_get`` on the whole list — the
-    bridge-batching fix the BENCH_SHIMS measurement exposed). Zero-copy
-    DLPack egress still short-circuits per handle where the buffer
-    exports; only the remainder is batch-fetched."""
+    """Synchronize a batch of handles through ONE engine flush and
+    BATCHED device-to-host egress. The first ``wait`` hints the engine
+    to drain the whole burst; per-handle ``synchronize`` would instead
+    pay one readback round trip each — on accelerators behind a
+    latency-heavy link that is ~70 ms a transfer (measured through the
+    axon tunnel; batching the list is ~2x on a ResNet-50-shaped
+    gradient set). Egress is DLPack wherever the backend allows
+    (zero-copy alias on the CPU mesh, one batched device→CPU transfer
+    on chip — interop.torch_egress_many); only what DLPack cannot carry
+    (64-bit bit-pair transport, export refusals) is fetched via
+    numpy."""
     handles = list(handles)
     with _lock:
         # Validate BEFORE popping: one bad id must not destroy the
@@ -161,24 +175,32 @@ def synchronize_many(handles) -> list:
         ths = [_handles.pop(h) for h in handles]
     outs = [th.inner.wait() for th in ths]
     results: list = [None] * len(ths)
-    rest = []
-    for i, (th, out) in enumerate(zip(ths, outs)):
-        if not th.from_bits:
-            aliased = _interop.try_jax_to_torch(out)
-            if aliased is not None and aliased.dtype == th.dtype:
-                if th.target is None:
-                    # Out-of-place result: the DLPack tensor ALIASES the
-                    # engine-owned XLA buffer, and torch has no read-only
-                    # tensors — handing the alias out would let ordinary
-                    # in-place math (result.add_(...)) silently mutate an
-                    # array the engine still retains. Clone before
-                    # release; in-place variants below only read the
-                    # alias as a copy_ source, so they keep zero-copy.
-                    aliased = aliased.clone()
-                results[i] = aliased
-                continue
-        rest.append(i)
+    # DLPack egress for everything but the 64-bit bit-pair transport:
+    # zero-copy alias on the CPU mesh, ONE batched device->CPU transfer
+    # + alias on accelerator backends (interop.torch_egress_many). The
+    # remainder (bits transport, export refusals, kill switch) is
+    # batch-fetched through numpy.
+    egress_idx = [i for i, th in enumerate(ths) if not th.from_bits]
+    exported = _interop.torch_egress_many([outs[i] for i in egress_idx])
+    rest = [i for i, th in enumerate(ths) if th.from_bits]
+    for i, exp in zip(egress_idx, exported):
+        th = ths[i]
+        if exp is None or exp[0].dtype != th.dtype:
+            rest.append(i)
+            continue
+        t, private = exp
+        if th.target is None and not private:
+            # Out-of-place result aliasing an ENGINE-RETAINED buffer
+            # (zero-copy CPU-mesh egress): torch has no read-only
+            # tensors, and handing the alias out would let ordinary
+            # in-place math (result.add_(...)) silently mutate an array
+            # the engine still retains. Clone before release. Transfer
+            # egress (private=True) and in-place variants (the alias is
+            # only a copy_ source) keep the single-copy path.
+            t = t.clone()
+        results[i] = t
     if rest:
+        rest.sort()
         hosts = _interop.to_host_many([outs[i] for i in rest])
         for i, arr in zip(rest, hosts):
             results[i] = _to_torch_host(arr, ths[i].dtype,

@@ -33,6 +33,18 @@ def fusion_threshold_bytes() -> int:
     return DEFAULT_FUSION_THRESHOLD_MB * 1024 * 1024
 
 
+def torch_bucket_mb() -> float:
+    """Gradient-bucket size target for the torch DistributedOptimizer's
+    backward-overlap bucketing (docs/torch.md). Default 64 MB — matched
+    to the engine's fusion threshold so each bucket fills one fused
+    group; 0 disables bucketing (per-tensor hooks, the pre-bucketing
+    path). Overridden per-optimizer by ``bucket_cap_mb=``."""
+    v = _get("TORCH_BUCKET_MB")
+    if v is not None:
+        return float(v)
+    return float(DEFAULT_FUSION_THRESHOLD_MB)
+
+
 def cycle_time_ms() -> float:
     v = _get("CYCLE_TIME")
     if v is not None:

@@ -14,9 +14,16 @@ analogue is buffer aliasing across the DLPack boundary:
            the ONE unavoidable host->device transfer.
   egress   engine output (replicated over the mesh) -> shard-0
            single-device buffer --``__dlpack__``--> torch/TF tensor.
-           Zero-copy on the CPU mesh; on a real TPU the device buffer
-           cannot export DLPack, so egress falls back to numpy (one D2H
-           copy — also unavoidable) and the shims alias that.
+           Zero-copy on the CPU mesh. On a real TPU the device buffer
+           cannot export DLPack directly, so egress transfers it onto
+           the always-present JAX *CPU backend* first (``jax.device_put``
+           — the one unavoidable D2H copy, batched for a whole handle
+           group) and exports THAT buffer: still exactly one host copy,
+           but the torch tensor aliases it instead of paying the numpy
+           materialize + ``torch.from_numpy`` + ``.copy()`` chain.
+           bf16 rides the same path; where the DLPack exchange refuses
+           bfloat16, the buffer crosses as a uint16 bitcast and is
+           re-viewed as bf16 on the torch side (bitcast transport).
 
 Fallbacks (the numpy path) cover everything DLPack cannot carry exactly:
 
@@ -39,14 +46,36 @@ from typing import Optional
 import numpy as np
 
 __all__ = [
-    "try_torch_to_jax", "try_jax_to_torch",
+    "try_torch_to_jax", "try_jax_to_torch", "torch_egress_many",
+    "transfer_egress_supported",
     "try_tf_to_jax", "try_jax_to_tf", "jax_to_tf",
     "exportable_buffer", "to_host", "stats", "reset_stats",
 ]
 
 # Observability: tests assert the fast path actually ran; the A/B bench
-# reports the split.
+# reports the split. The same four series are mirrored into the metrics
+# registry (hvdtpu_interop_transfers_total{direction,path}) so the
+# steady-state split is visible next to the engine counters; this dict
+# stays the reset-able per-process view tests and benches diff.
 _stats = {"dlpack_in": 0, "numpy_in": 0, "dlpack_out": 0, "numpy_out": 0}
+
+_reg_children = None
+
+
+def _bump(key: str, n: int = 1) -> None:
+    global _reg_children
+    _stats[key] += n
+    if _reg_children is None:
+        from ..observability import registry as _obs
+        fam = _obs.registry().counter(
+            "hvdtpu_interop_transfers_total",
+            "Framework-boundary tensor crossings by direction and path "
+            "(dlpack = zero-copy / single-transfer export, numpy = host "
+            "materialize fallback)")
+        _reg_children = {
+            k: fam.labels(direction=k.split("_")[1], path=k.split("_")[0])
+            for k in _stats}
+    _reg_children[key].inc(n)
 
 
 def stats() -> dict:
@@ -81,7 +110,7 @@ def try_torch_to_jax(tensor) -> Optional["jax.Array"]:
 
     t = tensor.detach()
     if not _enabled() or t.device.type != "cpu" or not t.is_contiguous():
-        _stats["numpy_in"] += 1
+        _bump("numpy_in")
         return None
     wide = (torch.int64, torch.float64, torch.complex128,
             getattr(torch, "uint64", torch.int64))
@@ -89,14 +118,14 @@ def try_torch_to_jax(tensor) -> Optional["jax.Array"]:
         # DLPack import would truncate (int64/uint64 -> 32-bit,
         # complex128 -> complex64, all measured); the shim's
         # guard/bits transport handles 64-bit explicitly.
-        _stats["numpy_in"] += 1
+        _bump("numpy_in")
         return None
     try:
         a = jax.dlpack.from_dlpack(t)
     except Exception:
-        _stats["numpy_in"] += 1
+        _bump("numpy_in")
         return None
-    _stats["dlpack_in"] += 1
+    _bump("dlpack_in")
     return a
 
 
@@ -107,27 +136,27 @@ def try_tf_to_jax(tensor) -> Optional["jax.Array"]:
     import jax
 
     if not _enabled():
-        _stats["numpy_in"] += 1
+        _bump("numpy_in")
         return None
     dt = getattr(tensor, "dtype", None)
     if dt is not None and getattr(dt, "name", "") in (
             "int64", "uint64", "float64", "complex128") \
             and not _x64_enabled():
-        _stats["numpy_in"] += 1
+        _bump("numpy_in")
         return None
     if not hasattr(tensor, "__dlpack__") \
             or not hasattr(tensor, "__dlpack_device__"):
-        _stats["numpy_in"] += 1
+        _bump("numpy_in")
         return None
     try:
         if tensor.__dlpack_device__()[0] != 1:  # kDLCPU
-            _stats["numpy_in"] += 1
+            _bump("numpy_in")
             return None
         a = jax.dlpack.from_dlpack(tensor)
     except Exception:
-        _stats["numpy_in"] += 1
+        _bump("numpy_in")
         return None
-    _stats["dlpack_in"] += 1
+    _bump("dlpack_in")
     return a
 
 
@@ -176,15 +205,154 @@ def try_jax_to_torch(a) -> Optional["torch.Tensor"]:
 
     buf = exportable_buffer(a) if _enabled() else None
     if buf is None:
-        _stats["numpy_out"] += 1
+        _bump("numpy_out")
         return None
     try:
         t = torch.from_dlpack(buf)
     except Exception:
-        _stats["numpy_out"] += 1
+        _bump("numpy_out")
         return None
-    _stats["dlpack_out"] += 1
+    _bump("dlpack_out")
     return t
+
+
+_transfer_probe: Optional[bool] = None
+
+
+def _buffer_platform(buf) -> Optional[str]:
+    """Platform string of a single-device buffer, or None when it cannot
+    be determined (fallback slot). Separated out so tests can simulate a
+    chip-resident buffer on the CPU backend."""
+    try:
+        return next(iter(buf.sharding.device_set)).platform
+    except Exception:
+        return None
+
+
+def _cpu_device():
+    import jax
+    try:
+        return jax.devices("cpu")[0]
+    except Exception:
+        return None
+
+
+def transfer_egress_supported() -> bool:
+    """Capability probe, resolved once: can a default-backend buffer be
+    copied onto the always-present JAX CPU backend and exported through
+    DLPack? This is what lets egress stay on the DLPack path on a real
+    chip, whose device buffers refuse ``__dlpack__`` directly. Trivially
+    true when the default backend IS cpu; False disables the transfer
+    leg and egress falls back to numpy (``HOROVOD_TPU_DLPACK=0`` kills
+    both)."""
+    global _transfer_probe
+    if _transfer_probe is None:
+        _transfer_probe = _probe_transfer()
+    return _transfer_probe
+
+
+def _probe_transfer() -> bool:
+    try:
+        import jax
+        import jax.numpy as jnp
+        import torch
+
+        dev = _cpu_device()
+        if dev is None:
+            return False
+        moved = jax.device_put(jnp.zeros((2,), jnp.float32), dev)
+        torch.from_dlpack(moved)
+        return True
+    except Exception:
+        return False
+
+
+def _export_cpu_buffer_torch(buf) -> Optional["torch.Tensor"]:
+    """CPU jax buffer -> torch tensor aliasing it, or None. bf16 exports
+    natively where the exchange allows; otherwise it crosses as a uint16
+    bitcast re-viewed as bf16 torch-side (bitcast transport — the bits
+    buffer is a fresh CPU array the capsule keeps alive)."""
+    import torch
+
+    if str(buf.dtype) == "bfloat16":
+        try:
+            return torch.from_dlpack(buf)
+        except Exception:
+            pass
+        try:
+            import jax
+            import jax.numpy as jnp
+            bits = jax.lax.bitcast_convert_type(buf, jnp.uint16)
+            return torch.from_dlpack(bits).view(torch.bfloat16)
+        except Exception:
+            return None
+    try:
+        return torch.from_dlpack(buf)
+    except Exception:
+        return None
+
+
+def torch_egress_many(arrays) -> list:
+    """Batched DLPack egress for a group of engine outputs: one slot per
+    input, each ``None`` (numpy fallback needed) or ``(tensor, private)``.
+
+    ``private=False``: the tensor ALIASES an engine-retained buffer (the
+    zero-copy CPU-mesh case) — out-of-place callers must clone before
+    releasing it to user code. ``private=True``: the tensor aliases a
+    buffer created by this call's device→CPU transfer, which nothing
+    else references — safe to hand out directly, so the chip path stays
+    at exactly one host copy.
+
+    All device→CPU transfers in the group ride ONE ``jax.device_put``
+    call (each read through a latency-heavy link is its own round trip —
+    the to_host_many lesson applied to the DLPack path). Counts one
+    dlpack_out or numpy_out per slot; callers falling back must not
+    re-count."""
+    n = len(arrays)
+    results: list = [None] * n
+    if n == 0:
+        return results
+    if not _enabled():
+        _bump("numpy_out", n)
+        return results
+    import jax
+
+    bufs = [_single_buffer(a) for a in arrays]
+    moved = [False] * n
+    transfer = []
+    for i, buf in enumerate(bufs):
+        if buf is None:
+            continue
+        plat = _buffer_platform(buf)
+        if plat is None:
+            bufs[i] = None
+        elif plat != "cpu":
+            transfer.append(i)
+    if transfer:
+        if transfer_egress_supported():
+            try:
+                put = jax.device_put([bufs[i] for i in transfer],
+                                     _cpu_device())
+                for i, m in zip(transfer, put):
+                    bufs[i] = m
+                    moved[i] = True
+            except Exception:
+                for i in transfer:
+                    bufs[i] = None
+        else:
+            for i in transfer:
+                bufs[i] = None
+    for i, buf in enumerate(bufs):
+        if buf is None:
+            _bump("numpy_out")
+            continue
+        t = _export_cpu_buffer_torch(buf)
+        if t is None:
+            _bump("numpy_out")
+            continue
+        _bump("dlpack_out")
+        results[i] = (t, moved[i])
+    return results
 
 
 def try_jax_to_tf(a):
@@ -196,14 +364,14 @@ def try_jax_to_tf(a):
 
     buf = exportable_buffer(a) if _enabled() else None
     if buf is None:
-        _stats["numpy_out"] += 1
+        _bump("numpy_out")
         return None
     try:
         out = tf.experimental.dlpack.from_dlpack(buf.__dlpack__())
     except Exception:
-        _stats["numpy_out"] += 1
+        _bump("numpy_out")
         return None
-    _stats["dlpack_out"] += 1
+    _bump("dlpack_out")
     return out
 
 
