@@ -864,6 +864,221 @@ def main_straggler(out_path: str, steps: int = STRAGGLER_STEPS) -> dict:
     return result
 
 
+# --------------------------------------------------------------------------
+# Pipeline-schedule bench (--pipeline): static bubble share + numerics
+# parity per schedule (gpipe / 1f1b / interleaved) over a microbatch sweep,
+# plus the hierarchical (in-slice ICI, then cross-slice DCN) gradient
+# reduction vs the flat allreduce — cross-slice bytes/step and gradient
+# equality. All recorded DELTAS (bubble shares, tick budgets, parity
+# errors, dcn bytes, grad diffs) are deterministic — seeded data, static
+# schedule math, CPU backend — so BENCH_PIPELINE.json regenerates
+# reproducibly; only the *_ms fields are wall-clock and informational.
+# --------------------------------------------------------------------------
+
+PIPELINE_WORKER = r"""
+import json, os, sys, time
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["HOROVOD_TPU_DCN_AXES"] = "dcn"
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+from horovod_tpu.parallel import create_mesh
+from horovod_tpu.parallel.collectives import (cross_slice_bytes,
+                                              hierarchical_psum)
+from horovod_tpu.parallel.pipeline import (pipeline_value_and_grad,
+                                           schedule_info)
+from horovod_tpu.quantization import wire_nbytes
+
+microbatches = [int(x) for x in sys.argv[1].split(",")]
+PP, V, D, MB = 4, 2, 32, 4
+
+def stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+def loss_fn(y):
+    return jnp.mean(y.astype(jnp.float32) ** 2)
+
+def make_stages(n_total, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"w": jnp.asarray(rng.randn(D, D), jnp.float32) * 0.5,
+             "b": jnp.asarray(rng.randn(D), jnp.float32) * 0.1}
+            for _ in range(n_total)]
+
+def reference(stages, x_mb):
+    def total(stages):
+        losses = []
+        for j in range(x_mb.shape[0]):
+            h = x_mb[j]
+            for p in stages:
+                h = stage_fn(p, h)
+            losses.append(loss_fn(h))
+        return jnp.mean(jnp.asarray(losses))
+    return jax.value_and_grad(total)(stages)
+
+def pack(stages, n, v):
+    def f(*ls):
+        arr = jnp.stack(ls)
+        if v == 1:
+            return arr
+        return arr.reshape((v, n) + arr.shape[1:]).swapaxes(0, 1)
+    return jax.tree_util.tree_map(f, *stages)
+
+mesh_pp = create_mesh(devices=jax.devices()[:PP], pp=PP)
+
+def run_schedule(schedule, m):
+    v = V if schedule == "interleaved" else 1
+    stages = make_stages(PP * v)
+    x = jnp.asarray(np.random.RandomState(1).randn(m, MB, D), jnp.float32)
+    packed = pack(stages, PP, v)
+    def run(p_local, x):
+        p = jax.tree_util.tree_map(lambda l: l[0], p_local)
+        loss, g = pipeline_value_and_grad(
+            stage_fn, loss_fn, p, x, axis_name="pp", schedule=schedule,
+            num_virtual=v)
+        return loss, jax.tree_util.tree_map(lambda l: l[None], g)
+    f = jax.jit(jax.shard_map(
+        run, mesh=mesh_pp,
+        in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), packed), P()),
+        out_specs=(P(), P("pp")), check_vma=False))
+    loss, grads = f(packed, x)             # compile + first run
+    jax.block_until_ready(grads)
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        out = f(packed, x)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    ref_loss, ref_grads = reference(stages, x)
+    err = abs(float(loss) - float(ref_loss)) / max(abs(float(ref_loss)),
+                                                   1e-9)
+    for c in range(PP * v):
+        r_, v_ = c % PP, c // PP
+        got = jax.tree_util.tree_map(
+            lambda l: l[r_] if v == 1 else l[r_][v_], grads)
+        for a, b in zip(jax.tree_util.tree_leaves(got),
+                        jax.tree_util.tree_leaves(ref_grads[c])):
+            denom = max(float(jnp.max(jnp.abs(b))), 1e-9)
+            err = max(err, float(jnp.max(jnp.abs(a - b))) / denom)
+    sched = schedule_info(schedule, PP, m, num_virtual=v)
+    return {
+        "bubble_share": round(sched.bubble_share, 6),
+        "ticks": sched.ticks,
+        "num_virtual": v,
+        "parity_max_rel_err": round(err, 9),
+        "step_ms": round(sorted(times)[len(times) // 2] * 1e3, 3),
+    }
+
+bubble = {s: {str(m): run_schedule(s, m) for m in microbatches}
+          for s in ("gpipe", "1f1b", "interleaved")}
+
+# --- hierarchical vs flat reduction on a dcn(2) x dp(4) mesh -------------
+mesh_dp = create_mesh(dcn=2, dp=4)
+rng = np.random.RandomState(2)
+tree = {
+    "embed": jnp.asarray(rng.standard_normal(262144).astype(np.float32)
+                         * 1e-3),
+    "w1": jnp.asarray(rng.standard_normal(65536).astype(np.float32)
+                      * 1e-2),
+    "w2": jnp.asarray(rng.standard_normal(16384).astype(np.float32)
+                      * 1e-1),
+    "b": jnp.asarray(rng.standard_normal(333).astype(np.float32)),
+}
+n_total = sum(int(v.size) for v in tree.values())
+ICI = 4
+
+def reduce_with(kind):
+    def shard(t):
+        if kind == "flat":
+            return jax.tree_util.tree_map(
+                lambda g: lax.psum(g, ("dcn", "dp")), t)
+        wire = "int8x256" if kind == "hier_int8" else None
+        return jax.tree_util.tree_map(
+            lambda g: hierarchical_psum(g, "dp", "dcn", wire=wire), t)
+    return jax.jit(jax.shard_map(shard, mesh=mesh_dp, in_specs=(P(),),
+                                 out_specs=P(), check_vma=False))
+
+results = {}
+flat_out = None
+for kind in ("flat", "hier", "hier_int8"):
+    f = reduce_with(kind)
+    out = f(tree)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        o = f(tree)
+        jax.block_until_ready(o)
+        times.append(time.perf_counter() - t0)
+    wire = "int8x256" if kind == "hier_int8" else None
+    dcn_bytes = sum(
+        cross_slice_bytes(int(v.size), ICI,
+                          hierarchical=(kind != "flat"), wire=wire)
+        for v in tree.values())
+    row = {"dcn_bytes_per_step": int(dcn_bytes),
+           "step_ms": round(sorted(times)[len(times) // 2] * 1e3, 3)}
+    if kind == "flat":
+        flat_out = out
+    else:
+        diff = max(float(jnp.max(jnp.abs(a - b)))
+                   for a, b in zip(jax.tree_util.tree_leaves(out),
+                                   jax.tree_util.tree_leaves(flat_out)))
+        scale = max(float(jnp.max(jnp.abs(b)))
+                    for b in jax.tree_util.tree_leaves(flat_out))
+        row["grad_max_abs_diff_vs_flat"] = round(diff, 9)
+        row["grad_max_rel_diff_vs_flat"] = round(diff / scale, 9)
+    results[kind] = row
+
+print(json.dumps({
+    "bubble": bubble,
+    "hierarchical": results,
+    "gradient_elements": n_total,
+    "ici_size": ICI,
+    "pp": PP,
+}))
+"""
+
+
+def run_pipeline_bench(microbatches: str) -> dict:
+    env = dict(os.environ)
+    proc = subprocess.run(
+        [sys.executable, "-c", PIPELINE_WORKER, microbatches],
+        env=env, capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"pipeline bench worker failed:\n{proc.stderr[-2000:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def main_pipeline(out_path: str, microbatches: str = "4,8,16") -> dict:
+    r = run_pipeline_bench(microbatches)
+    result = {
+        "metric": "pipeline_schedules",
+        "note": ("bubble_share/ticks are the schedules' static budgets "
+                 "(docs/pipeline.md: gpipe = activation stash + "
+                 "recompute backward, 1f1b/interleaved = residual-stash "
+                 "ring, cost_bwd=2); parity is vs the single-program "
+                 "autodiff reference; dcn bytes count one rank's "
+                 "cross-slice leg per reduction. step_ms fields are "
+                 "wall-clock and informational only"),
+        "bubble": r["bubble"],
+        "hierarchical": r["hierarchical"],
+        "gradient_elements": r["gradient_elements"],
+        "ici_size": r["ici_size"],
+        "pp": r["pp"],
+        "microbatches": [int(x) for x in microbatches.split(",")],
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(result))
+    return result
+
+
 def main():
     sweep = {}
     best = 0.0
@@ -927,6 +1142,14 @@ if __name__ == "__main__":
                     help="run the flight-recorder overhead A/B "
                          "(always-on ring buffer vs disabled) and "
                          "write BENCH_RECORDER.json")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="run the pipeline-schedule bench (bubble share "
+                         "vs microbatch count for gpipe/1f1b/interleaved "
+                         "+ hierarchical vs flat cross-slice reduction) "
+                         "and write BENCH_PIPELINE.json")
+    ap.add_argument("--pipeline-microbatches", default="4,8,16",
+                    help="comma-separated microbatch counts for "
+                         "--pipeline")
     ap.add_argument("--recorder-rounds", type=int,
                     default=RECORDER_ROUNDS,
                     help="alternating on/off rounds for --recorder")
@@ -958,5 +1181,9 @@ if __name__ == "__main__":
         main_recorder(args.out or os.path.join(here,
                                                "BENCH_RECORDER.json"),
                       rounds=args.recorder_rounds)
+    elif args.pipeline:
+        main_pipeline(args.out or os.path.join(here,
+                                               "BENCH_PIPELINE.json"),
+                      microbatches=args.pipeline_microbatches)
     else:
         main()

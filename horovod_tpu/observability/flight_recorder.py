@@ -52,6 +52,8 @@ Event kinds (payload fields):
   ``coord_error``   detail — coordinator client gave up (typed error)
   ``stall``         names, age_s — engine stall escalation
   ``serving``       event, active — serving drain began/finished
+  ``pipeline``      schedule, stages, microbatches, virtual, warmup,
+                    steady, drain, bubble_share — pipeline program built
   ================  ========================================================
 """
 
@@ -92,6 +94,8 @@ _FIELDS = {
     "coord_error": ("detail",),
     "stall": ("names", "age_s"),
     "serving": ("event", "active"),
+    "pipeline": ("schedule", "stages", "microbatches", "virtual",
+                 "warmup", "steady", "drain", "bubble_share"),
 }
 
 # Recording lever — module-global single check like registry._enabled.

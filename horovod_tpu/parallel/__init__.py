@@ -14,23 +14,33 @@ built on the same mesh-axis collective layer, designed TPU-first:
   (ppermute ring with online-softmax accumulation)
 - :mod:`ulysses`    — all-to-all sequence parallelism (DeepSpeed-Ulysses:
   reshard seq->heads, local attention, reshard back)
-- :mod:`pipeline`   — GPipe-style microbatch pipeline over 'pp'
+- :mod:`pipeline`   — schedule-driven microbatch pipeline over 'pp'
+  (gpipe / 1f1b / interleaved virtual stages, forward AND backward,
+  docs/pipeline.md)
 - :mod:`expert`     — mixture-of-experts dispatch over 'ep' (all_to_all)
 - :mod:`zero`       — ZeRO-1 optimizer-state sharding over 'dp'
   (psum_scatter grads, shard moments 1/N, all_gather updates)
 """
 
-from .mesh import MeshSpec, create_mesh
+from .mesh import (MeshSpec, axis_kinds, create_mesh, dcn_axes,
+                   ici_axes)
 from .collectives import (all_gather, all_to_all, axis_index, axis_size,
-                          ppermute, psum, psum_scatter, ring_shift)
+                          cross_slice_bytes, hierarchical_psum,
+                          hierarchical_psum_tree, ppermute, psum,
+                          psum_scatter, ring_shift)
 from .data_parallel import shard_batch, allreduce_gradients_in_jit
+from .pipeline import (PipelineSchedule, pipeline_apply,
+                       pipeline_value_and_grad, schedule_info)
 from .zero import (Zero1State, zero1_init, zero1_state_specs,
                    zero1_update)
 
 __all__ = [
-    "MeshSpec", "create_mesh",
+    "MeshSpec", "create_mesh", "axis_kinds", "dcn_axes", "ici_axes",
     "psum", "all_gather", "ppermute", "all_to_all", "psum_scatter",
     "axis_index", "axis_size", "ring_shift",
+    "hierarchical_psum", "hierarchical_psum_tree", "cross_slice_bytes",
     "shard_batch", "allreduce_gradients_in_jit",
+    "PipelineSchedule", "pipeline_apply", "pipeline_value_and_grad",
+    "schedule_info",
     "Zero1State", "zero1_init", "zero1_state_specs", "zero1_update",
 ]

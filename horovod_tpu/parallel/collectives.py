@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 
 
@@ -69,3 +70,78 @@ def all_to_all(x, axis: str, *, split_axis: int, concat_axis: int,
     DeepSpeed-Ulysses sequence<->head exchange)."""
     return lax.all_to_all(x, axis, split_axis=split_axis,
                           concat_axis=concat_axis, tiled=tiled)
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical (ICI-then-DCN) reduction — the 2D topology-aware summation
+# of "Scale MLPerf-0.6 models on Google TPU-v3 Pods" (arXiv 1909.09756),
+# docs/pipeline.md. On a pp×dp multislice mesh the data-parallel gradient
+# reduction would otherwise push the FULL gradient vector over the
+# cross-slice DCN links; reducing in-slice first (reduce-scatter on ICI)
+# shrinks the DCN leg to 1/ici_size of the bytes, and the PR 2 wire specs
+# quantize that leg further where bytes are most expensive.
+# ---------------------------------------------------------------------------
+
+
+def hierarchical_psum(x, ici_axis: str, dcn_axis: str, *,
+                      wire=None, average: bool = False):
+    """Sum (or mean) ``x`` over BOTH axes via the two-stage reduction:
+
+      1. ``psum_scatter`` over ``ici_axis`` — each in-slice rank ends up
+         owning the in-slice sum of a 1/ici_size span,
+      2. ``psum`` of the span over ``dcn_axis`` — the only cross-slice
+         traffic, 1/ici_size of the flat-allreduce bytes; with ``wire``
+         (a :mod:`horovod_tpu.quantization` spec name like
+         ``"int8x256"``) the span crosses block-quantized,
+      3. ``all_gather`` over ``ici_axis`` to rebuild the full tensor.
+
+    Mathematically equal to ``psum(x, (ici_axis, dcn_axis))`` up to fp
+    summation order (and, with ``wire``, quantization error on the DCN
+    leg). Arbitrary shapes are handled by flattening and zero-padding to
+    a multiple of the ici axis size."""
+    n_ici = lax.axis_size(ici_axis)
+    shape, dtype = x.shape, x.dtype
+    flat = jnp.ravel(x).astype(jnp.float32)
+    n = flat.size
+    if n == 0:
+        return x
+    pad = (-n) % n_ici
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    span = lax.psum_scatter(flat, ici_axis, scatter_dimension=0,
+                            tiled=True)
+    if wire is not None:
+        from .. import quantization as _quant
+        span = _quant.quantized_psum(span, dcn_axis, wire)
+    else:
+        span = lax.psum(span, dcn_axis)
+    out = lax.all_gather(span, ici_axis, axis=0, tiled=True)[:n]
+    if average:
+        out = out / (n_ici * lax.axis_size(dcn_axis))
+    return out.reshape(shape).astype(dtype)
+
+
+def hierarchical_psum_tree(tree, ici_axis: str, dcn_axis: str, *,
+                           wire=None, average: bool = False):
+    """Leaf-wise :func:`hierarchical_psum` over a pytree (gradients)."""
+    return jax.tree_util.tree_map(
+        lambda g: hierarchical_psum(g, ici_axis, dcn_axis, wire=wire,
+                                    average=average), tree)
+
+
+def cross_slice_bytes(n_elements: int, ici_size: int, *,
+                      hierarchical: bool = True, wire=None,
+                      dtype_bytes: int = 4) -> int:
+    """Static bytes one rank contributes to the CROSS-SLICE (DCN) leg
+    per reduction of ``n_elements``: the flat allreduce moves the full
+    tensor over the combined axis, the hierarchical reduction only its
+    1/ici_size span — block-quantized when ``wire`` is set. Used by
+    ``bench_engine.py --pipeline`` and the docs' sizing math; the
+    measured counterpart is the engine's wire-byte accounting."""
+    if not hierarchical:
+        return int(n_elements) * dtype_bytes
+    span = -(-int(n_elements) // int(ici_size))
+    if wire is not None:
+        from .. import quantization as _quant
+        return _quant.wire_nbytes(wire, span)
+    return span * dtype_bytes

@@ -55,6 +55,58 @@ class MeshSpec:
         return out
 
 
+def axis_kinds(mesh: Mesh) -> Dict[str, str]:
+    """Classify every mesh axis as ``"ici"`` (stays inside one
+    slice/host — chip-to-chip interconnect) or ``"dcn"`` (crosses slice
+    or host boundaries — data-center network), by walking the device
+    grid: an axis is DCN iff stepping along it ever changes the device's
+    ``slice_index`` (TPU multislice) or, failing that attribute,
+    ``process_index`` (multi-host).
+
+    The CPU-emulation mesh has a single process, so every axis reads as
+    ICI there; ``HOROVOD_TPU_DCN_AXES`` (comma-separated axis names)
+    overrides the detection for tests, benches, and exotic fabrics —
+    the same simulated-multihost lever as the checkpoint engine's
+    ``process_fn``."""
+    import os
+    forced = {a.strip()
+              for a in os.environ.get("HOROVOD_TPU_DCN_AXES", "").split(",")
+              if a.strip()}
+    devs = mesh.devices
+    kinds: Dict[str, str] = {}
+    for k, name in enumerate(mesh.axis_names):
+        if name in forced:
+            kinds[name] = "dcn"
+            continue
+        crosses = False
+        if devs.shape[k] > 1:
+            rolled = np.roll(devs, -1, axis=k)
+            for a, b in zip(devs.ravel(), rolled.ravel()):
+                sa = getattr(a, "slice_index", None)
+                sb = getattr(b, "slice_index", None)
+                if sa is not None and sb is not None:
+                    if sa != sb:
+                        crosses = True
+                        break
+                elif getattr(a, "process_index", 0) != \
+                        getattr(b, "process_index", 0):
+                    crosses = True
+                    break
+        kinds[name] = "dcn" if crosses else "ici"
+    return kinds
+
+
+def dcn_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """Mesh axes that cross slice/host boundaries (see
+    :func:`axis_kinds`)."""
+    return tuple(a for a, k in axis_kinds(mesh).items() if k == "dcn")
+
+
+def ici_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """Mesh axes that stay on the chip interconnect."""
+    return tuple(a for a, k in axis_kinds(mesh).items() if k == "ici")
+
+
 def create_mesh(spec: Optional[MeshSpec] = None,
                 devices: Optional[Sequence] = None,
                 **axis_sizes: int) -> Mesh:

@@ -1,29 +1,212 @@
-"""Pipeline parallelism — GPipe-style microbatching over the 'pp' axis.
+"""Pipeline parallelism — schedule-driven microbatch pipelining over 'pp'.
 
-No reference equivalent (SURVEY.md §2.1: PP absent). TPU-first design: the
-whole pipeline is ONE jitted SPMD program. Each 'pp' rank holds the
-parameters of its stage; activations move between neighboring ranks with
-``ppermute`` (collective-permute rides ICI); the microbatch schedule is a
-``lax.scan`` with a static trip count of (num_microbatches + num_stages - 1)
-ticks — the classic skewed schedule where tick t has stage s working on
-microbatch t - s (bubbles at the ends).
+No reference equivalent (SURVEY.md §2.1: PP absent). TPU-first design: each
+schedule is ONE jitted SPMD program. Every 'pp' rank holds the parameters
+of its stage (or, interleaved, of V non-contiguous stage chunks);
+activations and cotangents move between neighboring ranks with ``ppermute``
+(collective-permute rides ICI), and XLA overlaps the permute with the stage
+compute exactly as the original forward-only scan did.
 
-This is the "collective permute pipeline" pattern (cf. praxis/t5x-style
-pipelining): no host control flow, no per-stage programs, and XLA overlaps
-the permute with the stage compute.
+Three schedules (docs/pipeline.md; the exemplar is "Scaling Deep Learning
+Training with MPMD Pipeline Parallelism", arXiv 2412.14374, recast onto the
+single-SPMD-program collective-permute pattern):
+
+``gpipe``
+    The baseline. Forward sweep (skewed ``lax.scan``, ``m + n - 1`` ticks)
+    stashes only the per-microbatch stage INPUT — O(m) small activations —
+    then a backward sweep re-linearizes each stage from the stash
+    (recompute, the GPipe paper's rematerialization design) and flows
+    cotangents last→first. Static tick budget:
+    ``(m+n-1)·cF + (m+n-1)·(cF+cB)``.
+
+``1f1b``
+    One-forward-one-backward. Three scans — warmup (forward-only ticks),
+    steady state (one F and one B per tick), drain (backward-only) — so the
+    in-flight window is O(n) microbatches, which makes it affordable to
+    stash the stage's VJP RESIDUALS in a ring buffer instead of
+    recomputing: budget ``(m+n-1)·(cF+cB)``, strictly below gpipe's. The
+    ring holds ``2n - 1`` slots (the maximum ticks between a microbatch's
+    F and its B on any stage).
+
+``interleaved``
+    Virtual stages: each rank holds V non-contiguous chunks (chunk-stage
+    ``c = v·n + r`` lives on rank ``r = c mod n``), a microbatch loops the
+    rank ring V times, and each tick moves one CHUNK (cost/V). The fill
+    skew stays ``n - 1`` chunk-ticks while the useful work per rank grows
+    to ``m·V`` chunk computes: budget ``(mV+n-1)·(cF+cB)/V``, bubble
+    ``(n-1)/(mV+n-1)`` — the gpipe/1f1b bubble shrunk by ~1/V.
+
+Bubble accounting is STATIC (``PipelineSchedule.bubble_share``): every tick
+of the scan costs real wall time on every rank (masked computes are wasted
+work, not idle time, in SPMD), so the bubble share is the exact fraction of
+the schedule's compute-cost budget not spent on useful microbatch work. It
+feeds the ``hvdtpu_pipeline_bubble_share`` gauge and BENCH_PIPELINE.json.
 """
 
 from __future__ import annotations
 
-from typing import Callable
+import dataclasses
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+SCHEDULES = ("gpipe", "1f1b", "interleaved")
+
+
+# ---------------------------------------------------------------------------
+# Static schedule accounting
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineSchedule:
+    """Static tick/cost budget of one pipelined step.
+
+    Costs are in forward-compute units per FULL stage (``cost_fwd`` for a
+    stage forward, ``cost_bwd`` for a stage backward — the conventional
+    backward:forward ratio is 2). Interleaved ticks move one chunk, i.e.
+    1/V of a stage, and are costed accordingly. ``bubble_share`` is
+    ``1 - useful_cost / total_cost`` — the fraction of the program's
+    compute budget spent on masked (bubble) work, including gpipe's
+    backward recompute."""
+
+    name: str
+    num_stages: int
+    num_microbatches: int
+    num_virtual: int = 1
+    cost_fwd: float = 1.0
+    cost_bwd: float = 2.0
+
+    @property
+    def ticks(self) -> dict:
+        """Scan trip counts per phase. gpipe phases are its two sweeps
+        (warmup = forward sweep, steady = 0, drain = backward sweep);
+        1f1b/interleaved are warmup/steady/drain of the fused schedule."""
+        n, m, v = self.num_stages, self.num_microbatches, self.num_virtual
+        if self.name == "gpipe":
+            return {"warmup": m + n - 1, "steady": 0, "drain": m + n - 1}
+        warmup = n * v - 1
+        steady = (m - n) * v + n
+        drain = n * v - 1
+        return {"warmup": warmup, "steady": steady, "drain": drain}
+
+    @property
+    def total_cost(self) -> float:
+        n, m, v = self.num_stages, self.num_microbatches, self.num_virtual
+        cf, cb = self.cost_fwd, self.cost_bwd
+        if self.name == "gpipe":
+            # Forward sweep at cF a tick; backward sweep re-linearizes
+            # from the activation stash (recompute), cF + cB a tick.
+            return (m + n - 1) * cf + (m + n - 1) * (cf + cb)
+        t = self.ticks
+        per = 1.0 / v
+        return (t["warmup"] * cf * per + t["steady"] * (cf + cb) * per
+                + t["drain"] * cb * per)
+
+    @property
+    def useful_cost(self) -> float:
+        return self.num_microbatches * (self.cost_fwd + self.cost_bwd)
+
+    @property
+    def bubble_share(self) -> float:
+        return 1.0 - self.useful_cost / self.total_cost
+
+
+def schedule_info(schedule: str, num_stages: int, num_microbatches: int,
+                  *, num_virtual: int = 1, cost_fwd: float = 1.0,
+                  cost_bwd: float = 2.0) -> PipelineSchedule:
+    """Static budget of a pipelined step — the numbers behind the
+    ``hvdtpu_pipeline_bubble_share`` gauge and ``bench_engine.py
+    --pipeline`` (docs/pipeline.md)."""
+    if schedule not in SCHEDULES:
+        raise ValueError(f"unknown pipeline schedule {schedule!r}; "
+                         f"expected one of {SCHEDULES}")
+    v = num_virtual if schedule == "interleaved" else 1
+    _validate(schedule, num_stages, num_microbatches, v)
+    return PipelineSchedule(schedule, num_stages, num_microbatches, v,
+                            cost_fwd, cost_bwd)
+
+
+def _validate(schedule: str, n: int, m: int, v: int) -> None:
+    if m < 1:
+        raise ValueError("need at least one microbatch")
+    if v < 1:
+        raise ValueError("num_virtual must be >= 1")
+    if schedule == "interleaved":
+        if v < 2:
+            raise ValueError("interleaved needs num_virtual >= 2 "
+                             "(num_virtual=1 IS the 1f1b schedule)")
+        if m < n or m % n:
+            raise ValueError(
+                f"interleaved needs num_microbatches ({m}) to be a "
+                f"multiple of the stage count ({n}) at least as large "
+                "as it — the circular schedule streams microbatches in "
+                "rounds of one per stage")
+
+
+# ---------------------------------------------------------------------------
+# Observability (docs/metrics.md + the flight recorder, docs/postmortem.md)
+# ---------------------------------------------------------------------------
+
+
+class _PipelineMetrics:
+    _instance = None
+
+    def __init__(self):
+        from ..observability import registry as _obs
+        r = _obs.registry()
+        self.bubble = r.gauge(
+            "hvdtpu_pipeline_bubble_share",
+            "Static bubble share of the most recently built pipeline "
+            "program per schedule: the fraction of the schedule's "
+            "compute-cost budget spent on masked (non-microbatch) work, "
+            "from the tick budget — compare against the measured step "
+            "phases to see how much of a comm-bound verdict is schedule "
+            "bubble (docs/pipeline.md)")
+        self.ticks = r.gauge(
+            "hvdtpu_pipeline_ticks",
+            "Scan trip counts of the most recently built pipeline "
+            "program, by schedule and phase (warmup/steady/drain)")
+
+    @classmethod
+    def get(cls) -> "_PipelineMetrics":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+
+def _record_schedule(sched: PipelineSchedule) -> None:
+    """Trace-time (python-side) bookkeeping for a freshly built pipeline
+    program: the static-bubble gauge plus a flight-recorder event so a
+    post-mortem can attribute a death phase inside a pipelined step
+    (tools/postmortem)."""
+    try:
+        metrics = _PipelineMetrics.get()
+        metrics.bubble.labels(schedule=sched.name).set(
+            round(sched.bubble_share, 6))
+        for phase, count in sched.ticks.items():
+            metrics.ticks.labels(schedule=sched.name, phase=phase).set(
+                float(count))
+        from ..observability import flight_recorder as _fr
+        _fr.recorder().note("pipeline", (
+            sched.name, sched.num_stages, sched.num_microbatches,
+            sched.num_virtual, sched.ticks["warmup"],
+            sched.ticks["steady"], sched.ticks["drain"],
+            round(sched.bubble_share, 6)))
+    except Exception:  # pragma: no cover — telemetry must never break jit
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Forward-only pipeline (the seed API, kept)
+# ---------------------------------------------------------------------------
+
 
 def pipeline_apply(stage_fn: Callable, params, x_microbatches, *,
-                   axis_name: str = "pp"):
+                   axis_name: str = "pp",
+                   replicate_output: str = "relay"):
     """Run a pipelined forward pass inside shard_map.
 
     Args:
@@ -33,10 +216,20 @@ def pipeline_apply(stage_fn: Callable, params, x_microbatches, *,
       params: this rank's stage parameters (pytree).
       x_microbatches: [num_micro, micro_batch, ...] input, meaningful on
         stage 0 (other ranks' copies are ignored).
+      replicate_output: how the last stage's outputs reach every rank.
+        ``"relay"`` (default) rides each finished microbatch around the
+        ring ONE HOP PER TICK on a second ppermute channel overlapped
+        with the remaining compute (plus an ``n - 1``-tick permute-only
+        drain) — each output crosses each link exactly once.
+        ``"psum"`` is the original path: a full ``[m, ...]``-buffer
+        allreduce of the masked outputs after the scan (~2x the wire
+        bytes, one extra unoverlapped collective), kept for comparison.
 
     Returns: [num_micro, micro_batch, ...] outputs of the LAST stage,
-      replicated to all 'pp' ranks (one masked psum at the end).
+      replicated to all 'pp' ranks.
     """
+    if replicate_output not in ("relay", "psum"):
+        raise ValueError("replicate_output must be 'relay' or 'psum'")
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     m = x_microbatches.shape[0]
@@ -46,28 +239,365 @@ def pipeline_apply(stage_fn: Callable, params, x_microbatches, *,
     state0 = jnp.zeros_like(x_microbatches[0])
     outs0 = jnp.zeros_like(x_microbatches)
 
-    def tick(carry, t):
-        state, outs = carry
-        # Stage 0 feeds microbatch t while they last; later stages consume
-        # the activations handed over on the previous tick.
+    def compute(state, t):
+        """One pipeline tick: feed/consume, run the stage, hand off."""
         mb_idx = jnp.clip(t, 0, m - 1)
         fed = jnp.where(t < m, x_microbatches[mb_idx],
                         jnp.zeros_like(state0))
         inp = jnp.where(idx == 0, fed, state)
         y = stage_fn(params, inp)
-        # The last stage finishes microbatch t-(n-1) at tick t.
-        out_idx = t - (n - 1)
-        record = jnp.logical_and(out_idx >= 0, idx == n - 1)
-        safe_idx = jnp.clip(out_idx, 0, m - 1)
-        outs = jnp.where(
-            record,
-            outs.at[safe_idx].set(y.astype(outs.dtype)),
-            outs)
-        state = lax.ppermute(y, axis_name, fwd_perm)
-        return (state, outs), None
+        return y
 
-    (_, outs), _ = lax.scan(tick, (state0, outs0), jnp.arange(ticks))
-    # Replicate the last stage's outputs to every 'pp' rank.
-    outs = lax.psum(jnp.where(idx == n - 1, outs, jnp.zeros_like(outs)),
-                    axis_name)
+    if replicate_output == "psum":
+        def tick(carry, t):
+            state, outs = carry
+            y = compute(state, t)
+            out_idx = t - (n - 1)
+            record = jnp.logical_and(out_idx >= 0, idx == n - 1)
+            safe_idx = jnp.clip(out_idx, 0, m - 1)
+            outs = jnp.where(
+                record,
+                outs.at[safe_idx].set(y.astype(outs.dtype)),
+                outs)
+            state = lax.ppermute(y, axis_name, fwd_perm)
+            return (state, outs), None
+
+        (_, outs), _ = lax.scan(tick, (state0, outs0), jnp.arange(ticks))
+        # Replicate the last stage's outputs to every 'pp' rank.
+        return lax.psum(
+            jnp.where(idx == n - 1, outs, jnp.zeros_like(outs)), axis_name)
+
+    # "relay": a second ppermute channel carries finished outputs around
+    # the ring n-1 → 0 → 1 → ... → n-2, one hop per tick. The last stage
+    # records its own y at compute time and originates the relay; every
+    # other rank records the value arriving at tick t as microbatch
+    # t - n - idx and forwards it unchanged (masked select).
+    def relay_record(outs, relay, t):
+        j_in = t - n - idx
+        rec_in = jnp.logical_and(
+            jnp.logical_and(j_in >= 0, j_in < m), idx != n - 1)
+        jc = jnp.clip(j_in, 0, m - 1)
+        val = jnp.where(rec_in, relay.astype(outs.dtype), outs[jc])
+        return lax.dynamic_update_index_in_dim(outs, val, jc, 0)
+
+    def tick(carry, t):
+        state, relay, outs = carry
+        outs = relay_record(outs, relay, t)
+        y = compute(state, t)
+        out_idx = t - (n - 1)
+        own = jnp.logical_and(out_idx >= 0, idx == n - 1)
+        oc = jnp.clip(out_idx, 0, m - 1)
+        val = jnp.where(own, y.astype(outs.dtype), outs[oc])
+        outs = lax.dynamic_update_index_in_dim(outs, val, oc, 0)
+        # Originate at the last stage, forward everywhere else.
+        relay = lax.ppermute(jnp.where(idx == n - 1, y, relay),
+                             axis_name, fwd_perm)
+        state = lax.ppermute(y, axis_name, fwd_perm)
+        return (state, relay, outs), None
+
+    def drain_tick(carry, t):
+        relay, outs = carry
+        outs = relay_record(outs, relay, t)
+        relay = lax.ppermute(relay, axis_name, fwd_perm)
+        return (relay, outs), None
+
+    relay0 = jnp.zeros_like(state0)
+    (state, relay, outs), _ = lax.scan(
+        tick, (state0, relay0, outs0), jnp.arange(ticks))
+    if n > 1:
+        (_, outs), _ = lax.scan(drain_tick, (relay, outs),
+                                jnp.arange(ticks, ticks + n - 1))
     return outs
+
+
+# ---------------------------------------------------------------------------
+# Training schedules: loss + gradients in one SPMD program
+# ---------------------------------------------------------------------------
+
+
+def _tree_add(a, b):
+    return jax.tree_util.tree_map(lambda x, y: x + y, a, b)
+
+
+def _vjp_template(stage_fn, params, x0):
+    """Residual-stash plumbing: capture the TREEDEF and leaf avals of
+    ``jax.vjp(stage_fn, params, x)`` via ``eval_shape`` (no FLOPs
+    staged). The treedef embeds the pullback jaxpr — rebuilt later with
+    leaves read from a ring buffer, it runs the stage backward from
+    stashed residuals without recomputing the forward. Structure and
+    shapes are identical across ticks because stage_fn and the
+    activation shape are fixed."""
+    _, vjp_aval = jax.eval_shape(
+        lambda p, x: jax.vjp(stage_fn, p, x), params, x0)
+    leaves, treedef = jax.tree_util.tree_flatten(vjp_aval)
+    return leaves, treedef
+
+
+def pipeline_value_and_grad(stage_fn: Callable, loss_fn: Callable, params,
+                            x_microbatches, *, axis_name: str = "pp",
+                            schedule: str = "1f1b",
+                            num_virtual: int = 1,
+                            cost_backward: float = 2.0):
+    """Pipelined loss AND stage-parameter gradients inside shard_map.
+
+    The pipelined model is the composition of every rank's
+    ``stage_fn(params, x)`` along the 'pp' ring (interleaved: of all
+    ``n·V`` chunk applications in chunk-stage order ``c = v·n + r``);
+    the total loss is ``mean_j loss_fn(y_j)`` over the ``m``
+    microbatches' last-stage outputs.
+
+    Args:
+      stage_fn: ``stage_fn(params, x) -> y``, ``y.shape == x.shape``.
+      loss_fn: ``loss_fn(y) -> scalar`` per microbatch output.
+      params: this rank's stage parameters. For ``interleaved``, a pytree
+        whose leaves carry a leading ``num_virtual`` axis — chunk slot
+        ``v`` on rank ``r`` is chunk-stage ``v·n + r``.
+      x_microbatches: [num_micro, micro_batch, ...], read on stage 0.
+      schedule: ``"gpipe"`` | ``"1f1b"`` | ``"interleaved"``
+        (docs/pipeline.md: memory/bubble tradeoffs).
+      num_virtual: chunk count V for ``interleaved`` (ignored otherwise).
+      cost_backward: backward:forward cost ratio used for the static
+        bubble accounting only (never changes the program).
+
+    Returns ``(loss, grads)``: the scalar total loss (replicated) and the
+    gradient of it w.r.t. THIS rank's ``params`` (same structure).
+    """
+    n = lax.axis_size(axis_name)
+    m = x_microbatches.shape[0]
+    if schedule not in SCHEDULES:
+        raise ValueError(f"unknown pipeline schedule {schedule!r}; "
+                         f"expected one of {SCHEDULES}")
+    v = num_virtual if schedule == "interleaved" else 1
+    _validate(schedule, n, m, v)
+    sched = PipelineSchedule(schedule, n, m, v, 1.0, float(cost_backward))
+    _record_schedule(sched)
+    if schedule == "gpipe":
+        return _gpipe_value_and_grad(stage_fn, loss_fn, params,
+                                     x_microbatches, axis_name)
+    return _fused_value_and_grad(stage_fn, loss_fn, params,
+                                 x_microbatches, axis_name, v)
+
+
+def _gpipe_value_and_grad(stage_fn, loss_fn, params, x_mb, axis_name):
+    """Forward sweep + backward sweep with full flush. The stash holds
+    only each microbatch's stage INPUT; the backward sweep re-linearizes
+    (recomputes) the stage — GPipe's rematerialization, which is what
+    keeps its memory O(m · activation) instead of O(m · residuals)."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    m = x_mb.shape[0]
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+    rev_perm = [((i + 1) % n, i) for i in range(n)]
+
+    state0 = jnp.zeros_like(x_mb[0])
+    outs0 = jnp.zeros_like(x_mb)
+    stash0 = jnp.zeros_like(x_mb)
+
+    def fwd_tick(carry, t):
+        state, outs, stash = carry
+        j = t - idx
+        valid = jnp.logical_and(j >= 0, j < m)
+        jc = jnp.clip(j, 0, m - 1)
+        fed = jnp.where(t < m, x_mb[jnp.clip(t, 0, m - 1)],
+                        jnp.zeros_like(state0))
+        inp = jnp.where(idx == 0, fed, state)
+        stash = lax.dynamic_update_index_in_dim(
+            stash, jnp.where(valid, inp, stash[jc]), jc, 0)
+        y = stage_fn(params, inp)
+        out_j = t - (n - 1)
+        rec = jnp.logical_and(out_j >= 0, idx == n - 1)
+        oc = jnp.clip(out_j, 0, m - 1)
+        outs = lax.dynamic_update_index_in_dim(
+            outs, jnp.where(rec, y.astype(outs.dtype), outs[oc]), oc, 0)
+        state = lax.ppermute(y, axis_name, fwd_perm)
+        return (state, outs, stash), None
+
+    (_, outs, stash), _ = lax.scan(
+        fwd_tick, (state0, outs0, stash0), jnp.arange(m + n - 1))
+
+    # Per-microbatch losses + cotangent seeds, all on the last stage
+    # (other ranks compute on garbage outs; every use below is masked).
+    def total_loss(o):
+        return jnp.mean(jax.vmap(loss_fn)(o))
+
+    loss_local, loss_vjp = jax.vjp(total_loss, outs)
+    (seeds,) = loss_vjp(jnp.ones((), loss_local.dtype))
+
+    grad0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+    def bwd_tick(carry, u):
+        g_state, gacc = carry
+        j = u - (n - 1 - idx)
+        valid = jnp.logical_and(j >= 0, j < m)
+        jc = jnp.clip(j, 0, m - 1)
+        g_in = jnp.where(idx == n - 1, seeds[jnp.clip(u, 0, m - 1)],
+                         g_state)
+        g_in = jnp.where(valid, g_in, jnp.zeros_like(g_in))
+        # Re-linearize the stage at the stashed input (the recompute).
+        _, vjp_fn = jax.vjp(stage_fn, params, stash[jc])
+        dp, dx = vjp_fn(g_in)
+        gacc = _tree_add(gacc, dp)   # masked ticks contribute exact zeros
+        g_state = lax.ppermute(dx, axis_name, rev_perm)
+        return (g_state, gacc), None
+
+    (_, grads), _ = lax.scan(bwd_tick, (jnp.zeros_like(x_mb[0]), grad0),
+                             jnp.arange(m + n - 1))
+    loss = lax.psum(jnp.where(idx == n - 1, loss_local, 0.0), axis_name)
+    return loss, grads
+
+
+def _fused_value_and_grad(stage_fn, loss_fn, params, x_mb, axis_name, V):
+    """The 1F1B engine (V = 1) and its interleaved generalization
+    (V >= 2): warmup / steady / drain scans over global tick indices.
+
+    Chunk-stage ``c = v·n + r`` of microbatch ``j`` (group ``g = j // n``,
+    in-group index ``jr = j % n``) runs its FORWARD at tick
+
+        t_F = g·nV + v·n + r + jr
+
+    and its BACKWARD at ``t_B = t_F + 2·(nV - 1 - c)`` — the mirror
+    schedule that retires the last chunk-stage's backward in the same
+    tick as its forward. Both tilings are conflict-free per rank, the
+    forward ring permute serves intra-slot hops and the n-1 → 0
+    wrap-around alike, and the reverse permute carries cotangents. The
+    VJP residuals of each forward live in a ring of ``2nV - 1`` slots
+    keyed by ``t_F mod W`` — the in-flight window is O(n·V), never O(m),
+    which is what lets this schedule stash residuals instead of
+    recomputing the forward (contrast ``_gpipe_value_and_grad``)."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    m = x_mb.shape[0]
+    nV = n * V
+    W = 2 * nV - 1
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+    rev_perm = [((i + 1) % n, i) for i in range(n)]
+
+    # Virtual-chunk plumbing: params leaves carry a leading V axis; V=1
+    # callers pass plain stage params and we add the axis here.
+    stacked = V > 1
+    p_stacked = params if stacked else jax.tree_util.tree_map(
+        lambda l: l[None], params)
+
+    def chunk_params(vc):
+        return jax.tree_util.tree_map(
+            lambda l: lax.dynamic_index_in_dim(l, vc, 0, keepdims=False),
+            p_stacked)
+
+    res_avals, res_treedef = _vjp_template(
+        stage_fn, chunk_params(jnp.int32(0)), x_mb[0])
+    ring0 = [jnp.zeros((W,) + tuple(a.shape), a.dtype) for a in res_avals]
+
+    def f_sched(t):
+        """(valid, j, v) of this rank's forward work at tick t."""
+        u = t - idx
+        g = jnp.maximum(u, 0) // nV
+        w = jnp.maximum(u, 0) % nV
+        vv = w // n
+        jr = w % n
+        j = g * n + jr
+        valid = jnp.logical_and(u >= 0, j < m)
+        return valid, j, vv
+
+    def b_sched(t):
+        """(valid, j, v) of this rank's backward work at tick t."""
+        q = t - (2 * nV - 2) + idx + (V - 1) * n
+        g = jnp.maximum(q, 0) // nV
+        w = jnp.maximum(q, 0) % nV
+        vv = (V - 1) - w // n
+        jr = w % n
+        j = g * n + jr
+        valid = jnp.logical_and(q >= 0, j < m)
+        return valid, j, vv
+
+    def f_part(t, fwd_state, ring, loss_acc, with_loss):
+        validF, jF, vF = f_sched(t)
+        jc = jnp.clip(jF, 0, m - 1)
+        vc = jnp.clip(vF, 0, V - 1)
+        fresh = jnp.logical_and(idx == 0, vF == 0)
+        inp = jnp.where(fresh, x_mb[jc], fwd_state)
+        y, vjp_fn = jax.vjp(stage_fn, chunk_params(vc), inp)
+        slot = (g_tF(jc, vc)) % W
+        leaves = jax.tree_util.tree_leaves(vjp_fn)
+        ring = [lax.dynamic_update_index_in_dim(
+                    r, jnp.where(validF, l,
+                                 lax.dynamic_index_in_dim(
+                                     r, slot, 0, keepdims=False)),
+                    slot, 0)
+                for r, l in zip(ring, leaves)]
+        seed = jnp.zeros_like(y)
+        if with_loss:
+            # Per-microbatch loss + cotangent seed at the last
+            # chunk-stage, in the same tick as its forward.
+            mb_loss, loss_vjp = jax.vjp(loss_fn, y)
+            (seed,) = loss_vjp(jnp.ones((), mb_loss.dtype) / m)
+            last = jnp.logical_and(idx == n - 1, vF == V - 1)
+            loss_acc = loss_acc + jnp.where(
+                jnp.logical_and(validF, last),
+                mb_loss.astype(loss_acc.dtype), 0.0)
+        fwd_state = lax.ppermute(y, axis_name, fwd_perm)
+        return fwd_state, ring, loss_acc, seed
+
+    def g_tF(j, vv):
+        """Forward tick of (microbatch j, chunk slot vv) on THIS rank."""
+        return (j // n) * nV + vv * n + idx + (j % n)
+
+    def b_part(t, bwd_state, ring, gacc, seed):
+        validB, jB, vB = b_sched(t)
+        jc = jnp.clip(jB, 0, m - 1)
+        vc = jnp.clip(vB, 0, V - 1)
+        slot = g_tF(jc, vc) % W
+        stashed = [lax.dynamic_index_in_dim(r, slot, 0, keepdims=False)
+                   for r in ring]
+        vjp_fn = jax.tree_util.tree_unflatten(res_treedef, stashed)
+        last = jnp.logical_and(idx == n - 1, vB == V - 1)
+        g_in = jnp.where(last, seed, bwd_state)
+        g_in = jnp.where(validB, g_in, jnp.zeros_like(g_in))
+        dp, dx = vjp_fn(g_in)    # zero cotangent -> exact zero dp/dx
+        gacc = jax.tree_util.tree_map(
+            lambda a, d: lax.dynamic_update_index_in_dim(
+                a, lax.dynamic_index_in_dim(a, vc, 0, keepdims=False) + d,
+                vc, 0),
+            gacc, dp)
+        bwd_state = lax.ppermute(dx, axis_name, rev_perm)
+        return bwd_state, gacc
+
+    grad0 = jax.tree_util.tree_map(jnp.zeros_like, p_stacked)
+    fwd0 = jnp.zeros_like(x_mb[0])
+    bwd0 = jnp.zeros_like(x_mb[0])
+
+    def warmup_tick(carry, t):
+        fwd_state, bwd_state, ring, gacc, loss_acc = carry
+        fwd_state, ring, loss_acc, _ = f_part(
+            t, fwd_state, ring, loss_acc, with_loss=False)
+        return (fwd_state, bwd_state, ring, gacc, loss_acc), None
+
+    def steady_tick(carry, t):
+        fwd_state, bwd_state, ring, gacc, loss_acc = carry
+        fwd_state, ring, loss_acc, seed = f_part(
+            t, fwd_state, ring, loss_acc, with_loss=True)
+        bwd_state, gacc = b_part(t, bwd_state, ring, gacc, seed)
+        return (fwd_state, bwd_state, ring, gacc, loss_acc), None
+
+    def drain_tick(carry, t):
+        fwd_state, bwd_state, ring, gacc, loss_acc = carry
+        bwd_state, gacc = b_part(t, bwd_state, ring, gacc,
+                                 jnp.zeros_like(bwd_state))
+        return (fwd_state, bwd_state, ring, gacc, loss_acc), None
+
+    warmup = nV - 1
+    steady_end = m * V + n - 1          # one past the last F tick
+    drain_end = steady_end + nV - 1     # one past the last B tick
+
+    carry = (fwd0, bwd0, ring0, grad0, jnp.zeros((), jnp.float32))
+    if warmup:
+        carry, _ = lax.scan(warmup_tick, carry, jnp.arange(warmup))
+    carry, _ = lax.scan(steady_tick, carry,
+                        jnp.arange(warmup, steady_end))
+    if nV > 1:
+        carry, _ = lax.scan(drain_tick, carry,
+                            jnp.arange(steady_end, drain_end))
+    _, _, _, grads, loss_acc = carry
+    loss = lax.psum(jnp.where(idx == n - 1, loss_acc / m, 0.0), axis_name)
+    if not stacked:
+        grads = jax.tree_util.tree_map(lambda l: l[0], grads)
+    return loss, grads

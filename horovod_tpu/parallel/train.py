@@ -47,15 +47,30 @@ def _spec_axes(spec) -> set:
     return set(_spec_axes_ordered(spec))
 
 
-def reduce_gradients(grads, specs, mesh: Mesh, skip=()):
+def reduce_gradients(grads, specs, mesh: Mesh, skip=(),
+                     hierarchical=None, dcn_wire=None):
     """Apply the reduction rule leaf-by-leaf (see module docstring).
     ``skip`` omits axes whose reduction happens elsewhere (ZeRO-1 sums
-    over 'dp' inside its psum_scatter)."""
+    over 'dp' inside its psum_scatter).
+
+    ``hierarchical=(ici_axis, dcn_axis)`` routes leaves that reduce
+    over BOTH axes through the two-stage in-slice-then-cross-slice
+    reduction (collectives.hierarchical_psum: reduce-scatter on ICI,
+    1/ici_size-sized — optionally ``dcn_wire``-quantized — psum on DCN,
+    all-gather back), instead of one flat psum over the pair. Leaves
+    missing only one of the two keep the plain psum."""
     mesh_axes = [a for a in mesh.axis_names if a not in skip]
 
     def red(g, spec):
         have = _spec_axes(spec)
         missing = [ax for ax in mesh_axes if ax not in have]
+        if hierarchical is not None:
+            ici_ax, dcn_ax = hierarchical
+            if ici_ax in missing and dcn_ax in missing:
+                from .collectives import hierarchical_psum
+                g = hierarchical_psum(g, ici_ax, dcn_ax, wire=dcn_wire)
+                missing = [ax for ax in missing
+                           if ax not in (ici_ax, dcn_ax)]
         if missing:
             g = lax.psum(g, tuple(missing))
         return g
@@ -64,18 +79,48 @@ def reduce_gradients(grads, specs, mesh: Mesh, skip=()):
                                   is_leaf=lambda x: isinstance(x, P))
 
 
-def build_train_step(cfg: tfm.TransformerConfig, mesh: Mesh, optimizer):
+def build_train_step(cfg: tfm.TransformerConfig, mesh: Mesh, optimizer,
+                     *, dcn_axis: Optional[str] = None,
+                     dcn_wire: Optional[str] = None,
+                     dcn_hierarchical: bool = True):
     """Returns ``(step_fn, shard_params, shard_batch)``.
 
     step_fn(params, opt_state, tokens, targets) -> (params, opt_state, loss)
     — jitted over the mesh; tokens/targets are [B, S] global arrays sharded
     batch-over-'dp', sequence-over-'sp'.
-    """
+
+    ``dcn_axis`` names an OUTER data-parallel mesh axis that crosses
+    slice/host boundaries (``"auto"`` discovers one via
+    :func:`horovod_tpu.parallel.mesh.dcn_axes`): the batch shards over
+    ``(dcn_axis, 'dp')`` jointly and the gradient reduction runs
+    hierarchically — in-slice reduce-scatter over 'dp' first, then the
+    1/dp-sized (optionally ``dcn_wire``-block-quantized, docs/compression.md)
+    cross-slice psum, then the in-slice all-gather (docs/pipeline.md).
+    ``dcn_hierarchical=False`` keeps the identical data layout but
+    reduces with one flat psum over the axis pair — the A/B baseline
+    the bench measures bytes against. ZeRO-1 states keep their own
+    dp-space reduction and are not supported together with
+    ``dcn_axis``."""
     specs = tfm.param_specs(cfg)
     axis_names = set(mesh.axis_names)
 
-    data_spec = P("dp" if "dp" in axis_names else None,
-                  cfg.sp_axis if cfg.sp_axis else None)
+    if dcn_axis == "auto":
+        from .mesh import dcn_axes as _dcn_axes
+        found = [a for a in _dcn_axes(mesh) if a not in
+                 (cfg.tp_axis, cfg.sp_axis, cfg.ep_axis)]
+        dcn_axis = found[0] if found else None
+    if dcn_axis is not None:
+        if dcn_axis not in axis_names:
+            raise ValueError(f"dcn_axis {dcn_axis!r} is not a mesh axis "
+                             f"(axes: {sorted(axis_names)})")
+        if "dp" not in axis_names:
+            raise ValueError("hierarchical reduction needs an in-slice "
+                             "'dp' axis under dcn_axis "
+                             f"{dcn_axis!r}")
+
+    batch_axes = ((dcn_axis, "dp") if dcn_axis is not None
+                  else ("dp" if "dp" in axis_names else None))
+    data_spec = P(batch_axes, cfg.sp_axis if cfg.sp_axis else None)
 
     def _per_shard_step(zero1_mode):
         from .zero import zero1_update
@@ -85,6 +130,8 @@ def build_train_step(cfg: tfm.TransformerConfig, mesh: Mesh, optimizer):
             for ax in DATA_AXES:
                 if ax in axis_names:
                     n_data *= mesh.shape[ax]
+            if dcn_axis is not None:
+                n_data *= mesh.shape[dcn_axis]
 
             def local_loss(p):
                 loss = tfm.loss_fn(p, tokens, targets, cfg) / n_data
@@ -107,7 +154,12 @@ def build_train_step(cfg: tfm.TransformerConfig, mesh: Mesh, optimizer):
                 updates, opt_state = zero1_update(
                     optimizer, grads, opt_state, params, axis="dp")
             else:
-                grads = reduce_gradients(grads, specs, mesh)
+                hier = (("dp", dcn_axis)
+                        if dcn_axis is not None and dcn_hierarchical
+                        else None)
+                grads = reduce_gradients(grads, specs, mesh,
+                                         hierarchical=hier,
+                                         dcn_wire=dcn_wire)
                 updates, opt_state = optimizer.update(grads, opt_state,
                                                       params)
             import optax
@@ -123,6 +175,12 @@ def build_train_step(cfg: tfm.TransformerConfig, mesh: Mesh, optimizer):
 
         zero1_mode = isinstance(opt_state, Zero1State)
         if zero1_mode:
+            if dcn_axis is not None:
+                raise ValueError(
+                    "ZeRO-1 optimizer state and dcn_axis hierarchical "
+                    "reduction are mutually exclusive: ZeRO-1's "
+                    "psum_scatter already owns the 'dp'-space "
+                    "reduction (docs/pipeline.md)")
             if "dp" not in axis_names:
                 raise ValueError(
                     "Zero1State optimizer state requires a 'dp' mesh "

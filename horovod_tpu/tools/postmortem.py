@@ -150,6 +150,16 @@ def _group_state(dump: RankDump) -> Tuple[Optional[int], Optional[int]]:
     return last_done, (max(open_seqs) if open_seqs else None)
 
 
+def _pipeline_state(dump: RankDump) -> Optional[dict]:
+    """The most recently built pipeline program, if any — recorded at
+    build time so an in-step death can be attributed to a schedule
+    (docs/pipeline.md)."""
+    for e in reversed(dump.events):
+        if e.get("kind") == "pipeline":
+            return e
+    return None
+
+
 def _death_phase(dump: RankDump) -> str:
     """Best-effort phase the rank was in when the dump fired, from the
     tail of its event stream."""
@@ -167,8 +177,16 @@ def _death_phase(dump: RankDump) -> str:
         if kind == "step_end":
             return f"between steps (step {e.get('idx')} completed)"
         if kind == "step":
+            pipe = _pipeline_state(dump)
+            inside = ""
+            if pipe is not None:
+                inside = (
+                    f", inside a pipelined step (schedule "
+                    f"{pipe.get('schedule')}, "
+                    f"{pipe.get('warmup')}/{pipe.get('steady')}/"
+                    f"{pipe.get('drain')} warmup/steady/drain ticks)")
             return (f"in-step (step {e.get('idx')} began, never "
-                    "finished — compute/input/comm submission)")
+                    f"finished — compute/input/comm submission{inside})")
         if kind in ("group_done", "group_deliver", "group_error",
                     "failure", "stall", "coord_error", "adapt",
                     "wire_epoch", "checkpoint", "elastic", "init"):
@@ -204,6 +222,7 @@ def analyze(dumps: List[RankDump]) -> dict:
         last_done, open_seq = _group_state(d)
         t_dump = float(d.header.get("mono_us", 0)) + d.offset_us
         death_t_us[d.rank] = t_dump
+        pipe = _pipeline_state(d)
         per_rank[str(d.rank)] = {
             "reason": d.header.get("reason"),
             "error": d.header.get("error"),
@@ -211,6 +230,8 @@ def analyze(dumps: List[RankDump]) -> dict:
             "last_group_seq": last_done,
             "open_group_seq": open_seq,
             "death_phase": _death_phase(d),
+            "pipeline_schedule": (pipe.get("schedule")
+                                  if pipe is not None else None),
             "events": len(d.events),
             "truncated_dump": d.truncated,
             "clock_synced": d.clock_synced,

@@ -100,6 +100,19 @@ class _ShimMetrics:
             "Gradient buckets configured by the most recently "
             "constructed DistributedOptimizer (0 = per-tensor "
             "mode)").labels()
+        self.view_rebinds = r.counter(
+            "hvdtpu_torch_grad_view_rebinds_total",
+            "gradient_as_bucket_view repairs: autograd (or user code) "
+            "replaced an aliased p.grad with a fresh tensor — e.g. "
+            "zero_grad(set_to_none=True) outside the optimizer — and "
+            "the hook copied it back into the bucket buffer and "
+            "re-aliased. A steadily climbing count means the zero-copy "
+            "pack is silently degrading to the memcpy path "
+            "(docs/torch.md)").labels()
+        self.view_params = r.gauge(
+            "hvdtpu_torch_grad_view_params",
+            "Parameters whose .grad is aliased into a bucket buffer by "
+            "the most recently constructed DistributedOptimizer").labels()
 
     @classmethod
     def get(cls) -> "_ShimMetrics":
@@ -148,6 +161,14 @@ class _GradBucket:
             # ...and back (decompress): copy_ casts wire -> grad dtype.
             p.grad.copy_(self.buffer[off:off + n].view(p.grad.shape))
 
+    def view_of(self, p: torch.Tensor) -> torch.Tensor:
+        """The bucket-buffer span of ``p``'s gradient, shaped like the
+        parameter — the tensor installed as ``p.grad`` under
+        ``gradient_as_bucket_view`` (only when the wire dtype equals the
+        parameter dtype, so no cast hides in the alias)."""
+        off, n = self.offsets[id(p)]
+        return self.buffer[off:off + n].view(p.shape)
+
 
 _opt_counter = [0]
 
@@ -185,7 +206,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     """
 
     def __init__(self, params, named_parameters, compression,
-                 backward_passes_per_step=1, bucket_cap_mb=None):
+                 backward_passes_per_step=1, bucket_cap_mb=None,
+                 gradient_as_bucket_view=None):
         super(self.__class__, self).__init__(params)
         self._compression = compression
         self.backward_passes_per_step = backward_passes_per_step
@@ -220,10 +242,16 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         self._buckets: List[_GradBucket] = []
         self._param_bucket = {}
         self._bucket_residuals = {}
+        self._grad_views = {}
         self._metrics = _ShimMetrics.get()
         if bucket_cap_mb > 0 and _bucketable(compression):
             self._build_buckets(float(bucket_cap_mb) * 2 ** 20)
+        if gradient_as_bucket_view is None:
+            gradient_as_bucket_view = _env.torch_grad_view()
+        if gradient_as_bucket_view and self._buckets:
+            self._install_grad_views()
         self._metrics.buckets.set(len(self._buckets))
+        self._metrics.view_params.set(len(self._grad_views))
         self._register_hooks()
 
     # ------------------------------------------------------------- buckets
@@ -267,6 +295,34 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             self._buckets.append(b)
             for p in members:
                 self._param_bucket[id(p)] = b
+
+    def _install_grad_views(self) -> None:
+        """gradient_as_bucket_view (docs/torch.md): alias every eligible
+        ``p.grad`` into its bucket's flat buffer at wrap time, so
+        autograd accumulates STRAIGHT into the fused-collective payload
+        — the hook-time pack memcpy and the post-allreduce scatter-back
+        both disappear. Eligible = the bucket's wire dtype equals the
+        parameter dtype (a cast compressor's pack IS a cast, which an
+        alias cannot hide); ineligible parameters keep the copy path
+        within the same bucket. A pre-existing gradient is copied in
+        before aliasing so wrap-time state is preserved."""
+        for b in self._buckets:
+            for p in b.params:
+                if b.buffer.dtype != p.dtype:
+                    continue
+                view = b.view_of(p)
+                with torch.no_grad():
+                    if p.grad is not None:
+                        view.copy_(p.grad.detach())
+                    else:
+                        view.zero_()
+                p.grad = view
+                self._grad_views[id(p)] = view
+
+    def _grad_is_view(self, p: torch.Tensor) -> bool:
+        view = self._grad_views.get(id(p))
+        return (view is not None and p.grad is not None
+                and p.grad.data_ptr() == view.data_ptr())
 
     def _fire_bucket(self, b: _GradBucket, trigger: str) -> None:
         blockwise = self._compression if getattr(
@@ -328,7 +384,21 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                         "(torch/__init__.py:114-124).")
                 self._allreduce_delay[id(p)] -= 1
                 if self._allreduce_delay[id(p)] == 0:
-                    b.fill(p)
+                    view = self._grad_views.get(id(p))
+                    if view is None:
+                        b.fill(p)
+                    elif not self._grad_is_view(p):
+                        # Someone replaced the aliased grad (e.g.
+                        # zero_grad(set_to_none=True) outside this
+                        # optimizer): autograd accumulated into a fresh
+                        # tensor. Copy it home and re-alias for the
+                        # next step.
+                        b.fill(p)
+                        with torch.no_grad():
+                            p.grad = view
+                        self._metrics.view_rebinds.inc()
+                    # else: autograd already accumulated into the
+                    # bucket buffer through the view — zero-copy pack.
                     b.ready.add(id(p))
                     if len(b.ready) == len(b.params):
                         # Backward-overlap: the bucket's last gradient
@@ -375,7 +445,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         steps — then one batched wait scatters results back into each
         ``p.grad``."""
         if self._buckets:
-            return self._synchronize_buckets()
+            self._synchronize_buckets()
+            return
         # Every parameter not already in flight gets flushed here — even one
         # mid-accumulation (delay > 0), matching the reference, so that an
         # early step() never applies un-allreduced local gradients
@@ -410,7 +481,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                     continue
                 for p in b.params:
                     if p.grad is not None and id(p) not in b.ready:
-                        b.fill(p)
+                        if not self._grad_is_view(p):
+                            b.fill(p)
                         b.ready.add(id(p))
                 if b.ready:
                     self._fire_bucket(b, trigger="flush")
@@ -420,7 +492,11 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             b = self._buckets[i]
             for p in b.params:
                 if id(p) in b.ready and p.grad is not None:
-                    b.scatter(p)
+                    # The in-place allreduce landed in the bucket
+                    # buffer; aliased gradients already see it — only
+                    # copy-path parameters need the scatter-back.
+                    if not self._grad_is_view(p):
+                        b.scatter(p)
                     self._allreduce_delay[id(p)] = \
                         self.backward_passes_per_step
             b.ready.clear()
@@ -462,6 +538,14 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 "optimizer.zero_grad() was called after loss.backward() "
                 "but before optimizer.step() or optimizer.synchronize(); "
                 "this would discard in-flight allreduced gradients.")
+        if self._grad_views and not args and "set_to_none" not in kwargs:
+            # gradient_as_bucket_view: the default zero_grad()
+            # (set_to_none=True) would drop every alias and force a
+            # rebind each step; zero in place instead so the views —
+            # and the zero-copy pack — survive. An EXPLICIT
+            # set_to_none=True is honored (the hook repairs the alias
+            # and counts the rebind).
+            kwargs["set_to_none"] = False
         return super(self.__class__, self).zero_grad(*args, **kwargs)
 
 
@@ -470,7 +554,8 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                              Iterable[Tuple[str, torch.Tensor]]] = None,
                          compression=Compression.none,
                          backward_passes_per_step: int = 1,
-                         bucket_cap_mb: Optional[float] = None):
+                         bucket_cap_mb: Optional[float] = None,
+                         gradient_as_bucket_view: Optional[bool] = None):
     """Wrap a torch optimizer so ``step()`` applies allreduce-averaged
     gradients — the reference builds a dynamic subclass of the wrapped
     optimizer's class so isinstance() and LR schedulers keep working
@@ -479,11 +564,19 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
     ``bucket_cap_mb`` sizes the backward-overlap gradient buckets
     (docs/torch.md): None reads HOROVOD_TPU_TORCH_BUCKET_MB (default =
     the engine fusion threshold, 64 MB), 0 disables bucketing and keeps
-    the per-tensor hook path."""
+    the per-tensor hook path.
+
+    ``gradient_as_bucket_view`` aliases each ``p.grad`` into its
+    bucket's flat buffer at wrap time (docs/torch.md) — autograd then
+    accumulates directly into the collective payload, dropping the
+    hook-time pack memcpy and the scatter-back; bitwise-identical
+    results to the copying path. None reads HOROVOD_TPU_TORCH_GRAD_VIEW
+    (default off)."""
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                dict(_DistributedOptimizer.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression,
-               backward_passes_per_step, bucket_cap_mb)
+               backward_passes_per_step, bucket_cap_mb,
+               gradient_as_bucket_view)
 
 
 def broadcast_parameters(params, root_rank: int = 0) -> None:

@@ -45,6 +45,18 @@ def torch_bucket_mb() -> float:
     return float(DEFAULT_FUSION_THRESHOLD_MB)
 
 
+def torch_grad_view() -> bool:
+    """Default for the torch DistributedOptimizer's
+    ``gradient_as_bucket_view`` (docs/torch.md): alias each ``p.grad``
+    into its bucket's flat wire buffer at wrap time so autograd
+    accumulates straight into the fused-collective payload and the
+    hook-time pack memcpy (and the post-allreduce scatter-back)
+    disappear. Off by default — it changes the identity of ``p.grad``
+    tensors, which code that stashes or replaces gradient tensors may
+    not expect."""
+    return _get("TORCH_GRAD_VIEW") not in (None, "", "0")
+
+
 def cycle_time_ms() -> float:
     v = _get("CYCLE_TIME")
     if v is not None:

@@ -477,7 +477,8 @@ class TestGradientBuckets:
         # A subclass may override compress/decompress with arbitrary
         # logic the bucket pack cannot fuse — only the STOCK compressor
         # classes bucket; anything else keeps the per-tensor path where
-        # the compressor runs verbatim.
+        # the compressor runs verbatim (gradient-as-bucket-view cases
+        # live in TestGradientAsBucketView below).
         class Doubler(hvd_torch.Compression.none):
             @staticmethod
             def compress(tensor):
@@ -567,6 +568,107 @@ class TestGradientBuckets:
         # floor is >= 1 program reuse; at the default cap (== fusion
         # threshold) it is one reused program per bucket.
         assert hits1 - hits0 >= 1 and nb > 1
+
+
+class TestGradientAsBucketView:
+    """gradient_as_bucket_view (docs/torch.md): each eligible p.grad is
+    a VIEW into its bucket's flat buffer, so autograd accumulates
+    straight into the collective payload — no pack memcpy, no
+    scatter-back — and the results stay bitwise identical to the
+    copying path."""
+
+    def _model(self, seed=0):
+        torch.manual_seed(seed)
+        return torch.nn.Sequential(
+            torch.nn.Linear(16, 32), torch.nn.Tanh(),
+            torch.nn.Linear(32, 32), torch.nn.Tanh(),
+            torch.nn.Linear(32, 4))
+
+    def _train(self, view, steps=3, compression=None, zero_none=False,
+               seed=0):
+        model = self._model(seed)
+        kwargs = dict(named_parameters=model.named_parameters(),
+                      bucket_cap_mb=0.001,
+                      gradient_as_bucket_view=view)
+        if compression is not None:
+            kwargs["compression"] = compression
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.05), **kwargs)
+        torch.manual_seed(7)
+        for _ in range(steps):
+            model(torch.rand(8, 16)).sum().backward()
+            opt.step()
+            opt.zero_grad(set_to_none=True) if zero_none \
+                else opt.zero_grad()
+        return model, opt
+
+    def _rebinds(self):
+        from horovod_tpu import metrics_snapshot
+        vals = metrics_snapshot().get(
+            "hvdtpu_torch_grad_view_rebinds_total", {}).get("values", {})
+        return sum(vals.values()) if vals else 0
+
+    def test_views_installed_and_aliased(self):
+        _, opt = self._train(True, steps=1)
+        n_bucketed = sum(len(b.params) for b in opt._buckets)
+        assert len(opt._grad_views) == n_bucketed > 0
+        for group in opt.param_groups:
+            for p in group["params"]:
+                assert opt._grad_is_view(p)
+                b = opt._param_bucket[id(p)]
+                assert p.grad.data_ptr() == b.view_of(p).data_ptr()
+
+    def test_bitwise_equals_copy_path(self):
+        m_copy, _ = self._train(False)
+        m_view, _ = self._train(True)
+        for (n, a), (_, b) in zip(m_copy.named_parameters(),
+                                  m_view.named_parameters()):
+            assert torch.equal(a, b), n
+
+    def test_bitwise_equals_copy_path_blockwise_ef(self):
+        # Per-bucket error feedback reads/writes the bucket buffer the
+        # views alias — the quantized path must agree bitwise too.
+        m_copy, _ = self._train(
+            False, compression=hvd_torch.Compression.int8_blockwise)
+        m_view, _ = self._train(
+            True, compression=hvd_torch.Compression.int8_blockwise)
+        for (n, a), (_, b) in zip(m_copy.named_parameters(),
+                                  m_view.named_parameters()):
+            assert torch.equal(a, b), n
+
+    def test_fp16_wire_keeps_copy_path(self):
+        # A cast compressor's pack IS a cast: fp32 params with an fp16
+        # bucket buffer cannot alias — no views, copy path preserved.
+        _, opt = self._train(True, steps=1,
+                             compression=hvd_torch.Compression.fp16)
+        assert opt._grad_views == {}
+
+    def test_zero_grad_default_preserves_views(self):
+        r0 = self._rebinds()
+        m, opt = self._train(True)
+        for group in opt.param_groups:
+            for p in group["params"]:
+                assert opt._grad_is_view(p)
+        assert self._rebinds() == r0   # no alias was ever lost
+
+    def test_set_to_none_rebinds_and_counts(self):
+        r0 = self._rebinds()
+        m_view, _ = self._train(True, zero_none=True)
+        rebinds = self._rebinds() - r0
+        assert rebinds > 0             # every post-zero step repaired
+        m_copy, _ = self._train(False, zero_none=True)
+        for (n, a), (_, b) in zip(m_copy.named_parameters(),
+                                  m_view.named_parameters()):
+            assert torch.equal(a, b), n
+
+    def test_env_default(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_TPU_TORCH_GRAD_VIEW", "1")
+        model = self._model()
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.0),
+            named_parameters=model.named_parameters(),
+            bucket_cap_mb=0.001)
+        assert opt._grad_views
 
 
 class TestResultAliasing:
