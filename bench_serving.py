@@ -3,6 +3,15 @@
 throughput, and latency percentiles from the live registry histograms
 (docs/serving.md, docs/benchmarks.md).
 
+``--fleet`` instead measures AVAILABILITY: a 3-replica fleet behind
+the failover router, with replica 1 hard-crashed mid-load by a
+deterministic ``replica_crash_at`` fault — requests
+attempted/succeeded/retried, failover latency p50/p99 (from the
+router's ``hvdtpu_fleet_failover_seconds`` histogram), and the
+output-token checksum, which is identical to an uncrashed run because
+greedy decode makes the router's re-prefill resume byte-exact. Writes
+BENCH_FLEET.json.
+
 Each arm runs in a fresh subprocess on the CPU platform (fresh jit
 cache, fresh metrics registry — the TTFT/TPOT percentiles reported for
 an arm come from ITS OWN registry snapshot through the same
@@ -117,6 +126,166 @@ print(json.dumps({
 """
 
 
+FLEET_WORKER = r"""
+import json, os, sys, tempfile, time
+from concurrent.futures import ThreadPoolExecutor
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from horovod_tpu.checkpoint import CheckpointEngine
+from horovod_tpu.models import transformer as tfm
+from horovod_tpu.parallel.mesh import create_mesh
+from horovod_tpu.serving import (InferenceEngine, Router, ServingConfig,
+                                 config_from_manifest, load_params,
+                                 serving_config, transformer_extra)
+from horovod_tpu.serving.fleet import Fleet
+from horovod_tpu.observability import (histogram_percentiles,
+                                       metrics_snapshot)
+
+n_replicas = int(sys.argv[1])
+n_requests = int(sys.argv[2])
+max_new = int(sys.argv[3])
+crash_tick = int(sys.argv[4])
+
+tmp = tempfile.mkdtemp(prefix="bench_fleet_")
+ckpt = os.path.join(tmp, "ckpt")
+cfg = tfm.TransformerConfig(
+    vocab=256, d_model=64, n_heads=2, n_layers=2, d_ff=128,
+    max_seq=128, dtype=jnp.float32, remat=False)
+params = tfm.init_params(cfg, jax.random.PRNGKey(42))
+CheckpointEngine(ckpt, process_count=1, barrier=lambda n: None).save(
+    params, 1, block=True, extra=transformer_extra(cfg))
+
+# Uncontended reference (seeded prompts, greedy): the availability
+# claim is not just "200 OK" but token-identical output through the
+# crash.
+mesh1 = create_mesh(devices=jax.devices()[:1], tp=1)
+man = CheckpointEngine(ckpt).restore_manifest()
+scfg = serving_config(config_from_manifest(man), mesh1)
+ref = InferenceEngine(load_params(ckpt, scfg, mesh1), scfg, mesh1,
+                      ServingConfig(block_size=8, kv_blocks=64,
+                                    max_batch_slots=4,
+                                    max_new_tokens=max_new))
+rng = np.random.RandomState(7)
+prompts = [[int(t) for t in rng.randint(0, 256, int(n))]
+           for n in rng.randint(8, 25, n_requests)]
+expected = [ref.generate(p) for p in prompts]
+
+env = dict(os.environ)
+env["HOROVOD_TPU_FAULT_SPEC"] = (
+    "rank=1:replica_crash_at=%d:gen=0" % crash_tick)
+fleet = Fleet(n_replicas,
+              ["--checkpoint-dir", ckpt, "--tp", "1",
+               "--block-size", "8", "--kv-blocks", "64",
+               "--slots", "4", "--max-new-tokens", str(max_new)],
+              env=env)
+router = Router(fleet, port=0, host="127.0.0.1",
+                scrape_interval_s=0.1)
+fleet.start()
+fleet.wait_ready(600.0)
+router.start()
+
+import http.client
+
+def one(i):
+    conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                      timeout=300)
+    conn.request("POST", "/generate",
+                 json.dumps({"tokens": prompts[i],
+                             "max_new_tokens": max_new}),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    return resp.status, json.loads(resp.read())
+
+t0 = time.perf_counter()
+with ThreadPoolExecutor(max_workers=6) as pool:
+    results = list(pool.map(one, range(n_requests)))
+wall = time.perf_counter() - t0
+fleet_stop_ok = True
+try:
+    router.shutdown()
+    fleet.stop()
+except Exception:
+    fleet_stop_ok = False
+
+succeeded = sum(1 for s, _ in results if s == 200)
+outputs_equal = all(
+    s == 200 and b["tokens"] == expected[i]
+    for i, (s, b) in enumerate(results))
+checksum = int(sum((i + 1) * t
+                   for _, b in results if isinstance(b, dict)
+                   for i, t in enumerate(b.get("tokens", [])))
+               % (1 << 31))
+
+snap = metrics_snapshot()
+def count(name, labels=None):
+    vals = snap.get(name, {"values": {}})["values"]
+    if labels is None:
+        return {k: v for k, v in vals.items()}
+    return vals.get(labels, 0)
+
+fo = snap.get("hvdtpu_fleet_failover_seconds",
+              {"values": {}})["values"].get("")
+fo_pct = ({k: round(v * 1e3, 3)
+           for k, v in histogram_percentiles(fo).items()}
+          if fo else None)
+
+print(json.dumps({
+    "wall_ms": round(wall * 1e3, 3),
+    "replicas": n_replicas,
+    "requests_attempted": n_requests,
+    "requests_succeeded": succeeded,
+    "requests_failed": n_requests - succeeded,
+    "outputs_equal_uncontended": outputs_equal,
+    "output_checksum": checksum,
+    "retries_by_reason": count("hvdtpu_fleet_retries_total"),
+    "failovers_by_phase": count("hvdtpu_fleet_failovers_total"),
+    "replica_restarts": sum(r.restarts for r in fleet.replicas),
+    "failover_ms": fo_pct,
+    "clean_stop": fleet_stop_ok,
+}))
+"""
+
+
+def run_fleet(out_path):
+    """The --fleet availability arm, in a fresh subprocess (its own
+    registry, its own jit cache) like every other arm."""
+    env = dict(os.environ)
+    env.pop("HOROVOD_TPU_METRICS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", FLEET_WORKER, "3", "32", "16", "25"],
+        env=env, capture_output=True, text=True, timeout=900,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"fleet bench worker failed:\n{proc.stderr[-3000:]}")
+    r = json.loads(proc.stdout.strip().splitlines()[-1])
+    result = {
+        "metric": "fleet_availability_under_replica_crash",
+        "model": {"d_model": 64, "n_layers": 2, "n_heads": 2,
+                  "vocab": 256, "dtype": "float32"},
+        "fault": "rank=1:replica_crash_at=25:gen=0",
+        "note": ("3-replica fleet behind the failover router; replica "
+                 "1 is SIGKILLed by a deterministic fault mid-load. "
+                 "requests_*, outputs_equal_uncontended and "
+                 "output_checksum are seeded-deterministic (greedy "
+                 "decode; the router's re-prefill resume is "
+                 "token-exact, so the crash is invisible in the "
+                 "checksum). retries/failover counts and *_ms are "
+                 "run-dependent (which requests sat on the dying "
+                 "replica is a scheduling accident)."),
+        **r,
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+            f.write("\n")
+    print(json.dumps(result))
+
+
 def run_arm(slots: int, concurrency: int) -> dict:
     env = dict(os.environ)
     env.pop("HOROVOD_TPU_METRICS", None)   # percentiles need recording
@@ -135,8 +304,17 @@ def run_arm(slots: int, concurrency: int) -> dict:
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default=None,
-                    help="write BENCH_SERVING.json here")
+                    help="write BENCH_SERVING.json (or, with --fleet, "
+                         "BENCH_FLEET.json) here")
+    ap.add_argument("--fleet", action="store_true",
+                    help="measure fleet availability under an injected "
+                         "replica crash instead of single-replica "
+                         "throughput")
     args = ap.parse_args()
+
+    if args.fleet:
+        run_fleet(args.out)
+        return
 
     sweep = {}
     for c in (1, 2, 4, 8):

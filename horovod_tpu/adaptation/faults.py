@@ -28,9 +28,25 @@ Grammar (clauses separated by ``;``, fields by ``:``)::
     drop_announce          suppress coordinator announces while active
                            (mute worker: fetch heartbeat stays alive, so
                            only the stall detector can name it)
+    replica_crash_at=N     serving: SIGKILL self at decode tick N (the
+                           hard replica-loss fault the fleet router's
+                           failover is proven against)
+    slow_decode=50ms       serving: sleep per batched decode step
+    slow_prefill=200ms     serving: sleep per prefill forward (widens
+                           the drain/prefill race window determinist-
+                           ically)
+    drop_health            serving: /healthz and /readyz hang up without
+                           answering while active (a live-locked front
+                           end only the prober can catch)
 
 A *tick* is one enqueued collective on this rank — for the common
-one-fused-allreduce-per-step training loop, tick == training step.
+one-fused-allreduce-per-step training loop, tick == training step. The
+serving clauses count their own tick stream: one tick per batched
+decode step (``serving`` processes run no training collectives). In a
+fleet (docs/serving.md#fleet), ``rank`` is the replica id
+(``HOROVOD_TPU_REPLICA_ID``, exported by the supervisor) and ``gen``
+the replica's restart incarnation — ``rank=1:replica_crash_at=30:gen=0``
+crashes replica 1 once and lets its restart run clean.
 
 Examples::
 
@@ -81,7 +97,9 @@ class FaultClause:
     generation)."""
 
     __slots__ = ("rank", "gen", "from_step", "until_step", "delay_s",
-                 "slow_h2d_s", "crash_at", "drop_announce")
+                 "slow_h2d_s", "crash_at", "drop_announce",
+                 "replica_crash_at", "slow_decode_s", "slow_prefill_s",
+                 "drop_health")
 
     def __init__(self):
         self.rank: Optional[int] = None        # None == '*'
@@ -92,6 +110,10 @@ class FaultClause:
         self.slow_h2d_s = 0.0
         self.crash_at: Optional[int] = None
         self.drop_announce = False
+        self.replica_crash_at: Optional[int] = None
+        self.slow_decode_s = 0.0
+        self.slow_prefill_s = 0.0
+        self.drop_health = False
 
     def matches(self, rank: int, generation: int) -> bool:
         if self.rank is not None and self.rank != rank:
@@ -117,6 +139,14 @@ class FaultClause:
             parts.append(f"crash_at={self.crash_at}")
         if self.drop_announce:
             parts.append("drop_announce")
+        if self.replica_crash_at is not None:
+            parts.append(f"replica_crash_at={self.replica_crash_at}")
+        if self.slow_decode_s:
+            parts.append(f"slow_decode={self.slow_decode_s * 1e3:g}ms")
+        if self.slow_prefill_s:
+            parts.append(f"slow_prefill={self.slow_prefill_s * 1e3:g}ms")
+        if self.drop_health:
+            parts.append("drop_health")
         if self.from_step:
             parts.append(f"from_step={self.from_step}")
         if self.until_step is not None:
@@ -162,11 +192,23 @@ def parse_spec(text: str) -> List[FaultClause]:
                     raise ValueError(
                         f"drop_announce takes no value, got {value!r}")
                 c.drop_announce = True
+            elif key == "replica_crash_at":
+                c.replica_crash_at = int(value)
+            elif key == "slow_decode":
+                c.slow_decode_s = _parse_duration(value)
+            elif key == "slow_prefill":
+                c.slow_prefill_s = _parse_duration(value)
+            elif key == "drop_health":
+                if sep and value not in ("", "1", "true"):
+                    raise ValueError(
+                        f"drop_health takes no value, got {value!r}")
+                c.drop_health = True
             else:
                 raise ValueError(
                     f"unknown fault-spec field {key!r} in clause {raw!r} "
                     "(expected rank/gen/from_step/until_step/delay/"
-                    "slow_h2d/crash_at/drop_announce)")
+                    "slow_h2d/crash_at/drop_announce/replica_crash_at/"
+                    "slow_decode/slow_prefill/drop_health)")
         if not saw_rank:
             raise ValueError(
                 f"fault-spec clause {raw!r} is missing the required "
@@ -184,6 +226,11 @@ class FaultInjector:
         collective (delay / slow_h2d / crash_at).
       - :meth:`drop_announce_active` — the coordinator client consults
         this before each announce leg (mute-worker fault).
+      - :meth:`on_serving_decode` / :meth:`on_serving_prefill` — the
+        inference engine's scheduler (slow_decode / slow_prefill /
+        replica_crash_at; decode steps drive the serving tick stream).
+      - :meth:`drop_health_active` — the serving HTTP front consults
+        this before answering /healthz and /readyz.
     """
 
     def __init__(self, clauses: List[FaultClause], rank: int,
@@ -193,6 +240,7 @@ class FaultInjector:
         self.clauses = [c for c in clauses
                         if c.matches(self.rank, self.generation)]
         self._tick = 0
+        self._serving_tick = 0
         # Metric handles resolved once (docs/metrics.md); label children
         # cached since the kinds are a tiny fixed set.
         from ..observability import registry as _obs
@@ -201,7 +249,9 @@ class FaultInjector:
             "Faults injected by the HOROVOD_TPU_FAULT_SPEC harness, "
             "by kind")
         self._m = {k: fam.labels(kind=k)
-                   for k in ("delay", "slow_h2d", "crash", "drop_announce")}
+                   for k in ("delay", "slow_h2d", "crash", "drop_announce",
+                             "replica_crash", "slow_decode",
+                             "slow_prefill", "drop_health")}
         if self.clauses:
             _log.warning("fault injection ARMED for rank %d gen %d: %s",
                          self.rank, self.generation,
@@ -260,6 +310,59 @@ class FaultInjector:
                 return True
         return False
 
+    # ------------------------------------------------- serving hook points
+
+    @property
+    def serving_tick(self) -> int:
+        return self._serving_tick
+
+    def _sigkill_self(self, kind: str, tick: int) -> None:
+        self._m[kind].inc()
+        _log.error("fault injection: %s reached at serving tick %d on "
+                   "replica %d — SIGKILL self", kind, tick, self.rank)
+        from ..observability import flight_recorder as _flight
+        _flight.recorder().note("fault", (kind, tick))
+        _flight.dump_on("fault_crash")
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    def on_serving_decode(self) -> None:
+        """One batched decode step: advance the serving tick and apply
+        slow_decode / replica_crash_at faults. The crash is a SIGKILL
+        with the same final-gasp blackbox dump as crash_at — the
+        postmortem tool names the replica from it."""
+        t = self._serving_tick
+        self._serving_tick = t + 1
+        for c in self.clauses:
+            if c.replica_crash_at is not None and t == c.replica_crash_at:
+                self._sigkill_self("replica_crash", t)
+            if not c.in_window(t):
+                continue
+            if c.slow_decode_s > 0.0:
+                self._m["slow_decode"].inc()
+                self._note_fault("slow_decode", t)
+                time.sleep(c.slow_decode_s)
+
+    def on_serving_prefill(self) -> None:
+        """One prefill forward: apply slow_prefill (windowed on the
+        serving tick; the tick itself only advances on decode steps, so
+        a prefill burst cannot skip a replica_crash_at point)."""
+        for c in self.clauses:
+            if c.slow_prefill_s > 0.0 and c.in_window(self._serving_tick):
+                self._m["slow_prefill"].inc()
+                self._note_fault("slow_prefill", self._serving_tick)
+                time.sleep(c.slow_prefill_s)
+
+    def drop_health_active(self) -> bool:
+        """True while a drop_health clause covers the current serving
+        tick — the HTTP front then hangs up on /healthz and /readyz
+        without a response, so only a probing supervisor can tell this
+        replica from a healthy one."""
+        for c in self.clauses:
+            if c.drop_health and c.in_window(self._serving_tick):
+                self._m["drop_health"].inc()
+                return True
+        return False
+
 
 # ---------------------------------------------------------------------------
 # Process-global resolution — once, lazily, off by default.
@@ -282,11 +385,19 @@ def injector() -> Optional[FaultInjector]:
         _resolved = True
         return None
     clauses = parse_spec(text)
-    try:
-        from .. import topology as _topo
-        rank = _topo._get().process_index
-    except Exception:
-        rank = int(os.environ.get("HOROVOD_TPU_PROCESS_ID", "0") or 0)
+    replica = os.environ.get("HOROVOD_TPU_REPLICA_ID")
+    if replica not in (None, ""):
+        # Serving-fleet replica: the supervisor exports the replica id
+        # (and the restart incarnation as the generation) — a replica
+        # process is always jax process 0, so topology cannot tell
+        # replicas apart.
+        rank = int(replica)
+    else:
+        try:
+            from .. import topology as _topo
+            rank = _topo._get().process_index
+        except Exception:
+            rank = int(os.environ.get("HOROVOD_TPU_PROCESS_ID", "0") or 0)
     gen = int(os.environ.get("HOROVOD_TPU_ELASTIC_GENERATION", "0") or 0)
     inj = FaultInjector(clauses, rank=rank, generation=gen)
     _injector = inj if inj.clauses else None

@@ -327,6 +327,15 @@ def final_metrics_flush() -> None:
         _log.warning("final metrics flush failed: %s", e)
 
 
+def server_port() -> Optional[int]:
+    """Port of the live metrics endpoint, or None when none is bound.
+    HOROVOD_TPU_METRICS_PORT=0 binds an ephemeral port — this is how a
+    caller (the serving replica announcing itself to the fleet
+    supervisor, docs/serving.md#fleet) learns which one."""
+    with _lock:
+        return _server.port if _server is not None else None
+
+
 def stop_exporters() -> None:
     """Stop the exporters, flushing one final JSON snapshot."""
     global _json_writer, _server, _started

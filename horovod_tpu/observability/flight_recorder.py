@@ -52,6 +52,8 @@ Event kinds (payload fields):
   ``coord_error``   detail — coordinator client gave up (typed error)
   ``stall``         names, age_s — engine stall escalation
   ``serving``       event, active — serving drain began/finished
+  ``serving_replica`` event, replica, detail — fleet supervisor
+                    lifecycle: spawn/ready/crash/restart/drain/exit
   ``pipeline``      schedule, stages, microbatches, virtual, warmup,
                     steady, drain, bubble_share — pipeline program built
   ================  ========================================================
@@ -94,6 +96,7 @@ _FIELDS = {
     "coord_error": ("detail",),
     "stall": ("names", "age_s"),
     "serving": ("event", "active"),
+    "serving_replica": ("event", "replica", "detail"),
     "pipeline": ("schedule", "stages", "microbatches", "virtual",
                  "warmup", "steady", "drain", "bubble_share"),
 }

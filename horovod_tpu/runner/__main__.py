@@ -99,6 +99,12 @@ def main(argv=None) -> int:
                              "arguments are passed to it, e.g. "
                              "`python -m horovod_tpu.runner --serve -- "
                              "--checkpoint-dir /ckpts --tp 4`")
+    parser.add_argument("--fleet", type=int, default=None,
+                        help="with --serve: supervise N serving "
+                             "replicas behind the failover router "
+                             "(docs/serving.md#fleet) — shorthand for "
+                             "passing --fleet N to `python -m "
+                             "horovod_tpu.serving`")
     parser.add_argument("--timeout", type=float, default=None,
                         help="overall job timeout in seconds")
     parser.add_argument("--no-tag-output", action="store_true",
@@ -110,10 +116,16 @@ def main(argv=None) -> int:
     command = args.command
     if command and command[0] == "--":
         command = command[1:]
+    if args.fleet is not None and not args.serve:
+        parser.error("--fleet requires --serve")
     if args.serve:
-        # Serving is a single-process front end per host today; the
-        # remaining argv belongs to `python -m horovod_tpu.serving`.
-        command = [sys.executable, "-m", "horovod_tpu.serving"] + command
+        # Serving is one front-end process per host (which itself
+        # supervises --fleet N replica processes); the remaining argv
+        # belongs to `python -m horovod_tpu.serving`.
+        fleet = ["--fleet", str(args.fleet)] \
+            if args.fleet is not None else []
+        command = [sys.executable, "-m", "horovod_tpu.serving"] \
+            + fleet + command
         if args.num_proc is None and not args.discovery:
             args.num_proc = 1
     elif not command:

@@ -19,15 +19,18 @@ The train→save→serve path:
 from the command line (docs/running.md).
 """
 
-from .engine import (DrainingError, InferenceEngine, QueueFullError,
-                     Request, ServingConfig)
+from .engine import (DEADLINE_ERROR, DrainingError, InferenceEngine,
+                     QueueFullError, Request, ServingConfig)
 from .kv_cache import BlockAllocator, blocks_needed
-from .loader import (config_from_manifest, load_params, serving_config,
-                     transformer_extra)
+from .loader import (TORCH_MODEL_PREFIX, config_from_manifest,
+                     load_params, serving_config, transformer_extra)
+from .fleet import Fleet, ReplicaEndpoint
+from .router import Router, StaticBackends
 
 __all__ = [
-    "BlockAllocator", "DrainingError", "InferenceEngine",
-    "QueueFullError", "Request", "ServingConfig", "blocks_needed",
-    "config_from_manifest", "load_params", "serving_config",
-    "transformer_extra",
+    "BlockAllocator", "DEADLINE_ERROR", "DrainingError", "Fleet",
+    "InferenceEngine", "QueueFullError", "ReplicaEndpoint", "Request",
+    "Router", "ServingConfig", "StaticBackends", "TORCH_MODEL_PREFIX",
+    "blocks_needed", "config_from_manifest", "load_params",
+    "serving_config", "transformer_extra",
 ]

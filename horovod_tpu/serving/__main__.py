@@ -3,13 +3,94 @@
 Loads the flagship Transformer straight from a sharded-checkpoint
 manifest (the architecture rides in the manifest's ``extra`` — see
 ``loader.transformer_extra``), reshards it onto a tensor-parallel
-inference mesh, and serves ``/generate`` + ``/healthz`` until SIGTERM
-drains it (docs/serving.md, docs/running.md)."""
+inference mesh, and serves ``/generate`` + ``/healthz`` + ``/readyz``
+until SIGTERM drains it (docs/serving.md, docs/running.md).
+
+``--fleet N`` turns this process into a SUPERVISOR instead: it spawns
+N independent replica processes of itself (fleet.py), fronts them with
+the failover router (router.py) on ``--port``, and keeps the fleet at
+strength — crashed replicas restart from the same checkpoint and
+re-enter rotation. ``--framework torch`` serves a checkpoint committed
+by ``horovod_tpu.torch.checkpoint_hook`` (the model subtree of its
+manifest; state-dict keys must mirror the flagship tree —
+docs/serving.md#torch).
+"""
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
+
+
+def _replica_argv(args) -> list:
+    """Rebuild the argv tail a fleet replica needs — every model/engine
+    knob, minus --fleet/--port/--replica-id (the supervisor owns
+    those)."""
+    argv = ["--checkpoint-dir", args.checkpoint_dir,
+            "--block-size", str(args.block_size),
+            "--kv-blocks", str(args.kv_blocks),
+            "--slots", str(args.slots),
+            "--max-new-tokens", str(args.max_new_tokens),
+            "--temperature", str(args.temperature),
+            "--seed", str(args.seed),
+            "--framework", args.framework,
+            "--host", "127.0.0.1"]
+    if args.step is not None:
+        argv += ["--step", str(args.step)]
+    if args.tp is not None:
+        argv += ["--tp", str(args.tp)]
+    if args.max_queue is not None:
+        argv += ["--max-queue", str(args.max_queue)]
+    if args.eos_id is not None:
+        argv += ["--eos-id", str(args.eos_id)]
+    return argv
+
+
+def _run_fleet(args, parser) -> int:
+    """Supervisor mode: no JAX in this process — the replicas own the
+    devices; we own processes, probes and routing."""
+    from ..observability import flight_recorder as _flight
+    from ..observability.export import maybe_start_exporters
+    from ..utils import env as _env
+    from .fleet import Fleet
+    from .router import Router
+
+    maybe_start_exporters()      # the router's own hvdtpu_fleet_* families
+    _flight.maybe_install_hooks()
+    # Supervisor blackbox identity: rank n (replicas are 0..n-1), so
+    # its dump never collides with replica 0's in a shared dir.
+    _flight.recorder().configure(rank=args.fleet, world=args.fleet + 1)
+
+    fleet = Fleet(args.fleet, _replica_argv(args))
+    router = Router(fleet, port=(args.port if args.port is not None
+                                 else _env.serving_port()),
+                    host=args.host)
+    print(f"[fleet] spawning {args.fleet} replica(s) from "
+          f"{args.checkpoint_dir}", file=sys.stderr, flush=True)
+    fleet.start()
+    try:
+        fleet.wait_ready(600.0)
+    except TimeoutError as e:
+        fleet.stop()
+        parser.error(str(e))
+    router.start()
+    print(f"[fleet] routing on :{router.port} across {args.fleet} "
+          "replica(s) (/generate, /healthz, /readyz)",
+          file=sys.stderr, flush=True)
+
+    import signal
+    import threading
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    while not stop.wait(0.2):
+        pass
+    print("[fleet] stopping: draining replicas", file=sys.stderr,
+          flush=True)
+    router.shutdown()
+    fleet.stop()
+    return 0
 
 
 def main(argv=None) -> int:
@@ -18,7 +99,9 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m horovod_tpu.serving",
         description="Serve a sharded checkpoint: tensor-parallel "
-                    "decode with continuous batching.")
+                    "decode with continuous batching — one replica, or "
+                    "a supervised fleet behind the failover router "
+                    "(--fleet N).")
     parser.add_argument("--checkpoint-dir", required=True,
                         help="sharded checkpoint root (the directory "
                              "holding step-N/ + LATEST)")
@@ -27,8 +110,25 @@ def main(argv=None) -> int:
     parser.add_argument("--port", type=int, default=None,
                         help="HTTP port (default: "
                              "$HOROVOD_TPU_SERVING_PORT or 8400; 0 = "
-                             "ephemeral)")
+                             "ephemeral); with --fleet, the ROUTER's "
+                             "port (replicas bind ephemeral ports)")
     parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--fleet", type=int, default=None,
+                        help="supervise N replica processes behind the "
+                             "failover router (docs/serving.md#fleet): "
+                             "crash detection, restart, queue-depth-"
+                             "aware routing, zero-dropped-request "
+                             "failover")
+    parser.add_argument("--replica-id", type=int, default=None,
+                        help="(internal, set by the fleet supervisor) "
+                             "this replica's index — names its "
+                             "blackbox dump and fault-spec rank")
+    parser.add_argument("--framework", choices=("jax", "torch"),
+                        default="jax",
+                        help="checkpoint flavor: 'jax' (params tree at "
+                             "the manifest root) or 'torch' (a "
+                             "torch.checkpoint_hook commit; the model "
+                             "subtree is served)")
     parser.add_argument("--tp", type=int, default=None,
                         help="tensor-parallel width (default: all "
                              "local devices)")
@@ -53,15 +153,38 @@ def main(argv=None) -> int:
                         help="sampling PRNG seed")
     args = parser.parse_args(argv)
 
+    if args.fleet is not None:
+        if args.fleet < 1:
+            parser.error(f"--fleet {args.fleet} must be >= 1")
+        if args.replica_id is not None:
+            parser.error("--fleet and --replica-id are mutually "
+                         "exclusive (the supervisor assigns ids)")
+        return _run_fleet(args, parser)
+
+    replica_id = args.replica_id if args.replica_id is not None \
+        else _env.replica_id()
+    if replica_id is not None:
+        # Before anything resolves faults/metrics: the fault injector
+        # and blackbox dumps key on the replica id (docs/serving.md#fleet).
+        os.environ["HOROVOD_TPU_REPLICA_ID"] = str(replica_id)
+
     import jax
 
     import horovod_tpu as hvd
     from ..parallel.mesh import create_mesh
     from .engine import InferenceEngine, ServingConfig
-    from .loader import config_from_manifest, load_params, serving_config
+    from .loader import (TORCH_MODEL_PREFIX, config_from_manifest,
+                         load_params, serving_config)
     from .server import ServingServer
 
     hvd.init()   # metrics exporters + flight-recorder hooks
+
+    if replica_id is not None:
+        from ..observability import flight_recorder as _flight
+        gen = int(os.environ.get("HOROVOD_TPU_ELASTIC_GENERATION",
+                                 "0") or 0)
+        _flight.recorder().configure(rank=replica_id, world=0,
+                                     generation=gen)
 
     devices = jax.local_devices()
     tp = args.tp if args.tp is not None else len(devices)
@@ -74,11 +197,14 @@ def main(argv=None) -> int:
     eng = CheckpointEngine(args.checkpoint_dir)
     man = eng.restore_manifest(args.step)
     cfg = serving_config(config_from_manifest(man), mesh)
+    key_prefix = TORCH_MODEL_PREFIX if args.framework == "torch" else ""
     params = load_params(args.checkpoint_dir, cfg, mesh,
-                         step=args.step, engine=eng)
+                         step=args.step, engine=eng,
+                         key_prefix=key_prefix)
     print(f"[serving] step {man['step']}: d_model={cfg.d_model} "
           f"layers={cfg.n_layers} heads={cfg.n_heads} "
-          f"vocab={cfg.vocab} tp={tp}", file=sys.stderr)
+          f"vocab={cfg.vocab} tp={tp} framework={args.framework}",
+          file=sys.stderr)
 
     config = ServingConfig(
         block_size=args.block_size, kv_blocks=args.kv_blocks,
@@ -91,8 +217,16 @@ def main(argv=None) -> int:
     server = ServingServer(engine, port=args.port, host=args.host)
     server.install_signal_handlers()
     server.start()
-    print(f"[serving] ready on :{server.port} (/generate, /healthz)",
-          file=sys.stderr, flush=True)
+    from ..observability.export import server_port as _metrics_port
+    mport = _metrics_port()
+    tail = f" metrics=:{mport}" if mport is not None else ""
+    if replica_id is not None:
+        tail += f" replica={replica_id}"
+    # "ready on :PORT" is parsed by the fleet supervisor and the e2e
+    # tests — keep the phrase stable. Printed to stdout: the supervisor
+    # owns that pipe.
+    print(f"[serving] ready on :{server.port} (/generate, /healthz, "
+          f"/readyz){tail}", flush=True)
     server.serve_forever()
     return 0
 

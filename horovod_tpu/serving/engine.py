@@ -34,6 +34,7 @@ hold every block they will ever need.
 from __future__ import annotations
 
 import dataclasses
+import math
 import threading
 import time
 from collections import deque
@@ -59,6 +60,12 @@ class QueueFullError(RuntimeError):
 
 class DrainingError(RuntimeError):
     """The engine is draining (SIGTERM received); no new admissions."""
+
+
+# Error string a request fails with when its deadline passes before it
+# could be served; the HTTP front maps it to 504 (and the router never
+# retries an expired request).
+DEADLINE_ERROR = "deadline exceeded"
 
 
 def _metrics():
@@ -112,6 +119,11 @@ def _metrics():
             "hvdtpu_serving_compiles_total",
             "Shape buckets compiled, phase=prefill (per length bucket) "
             "or phase=decode (once per serve)"),
+        "slots": r.gauge(
+            "hvdtpu_serving_batch_slots",
+            "Decode batch width (max concurrent generations) — the "
+            "denominator the fleet router's load score divides by"
+        ).labels(),
         "qps": r.gauge(
             "hvdtpu_serving_requests_per_second",
             "Completed requests per second over the last 10 s").labels(),
@@ -136,14 +148,27 @@ class ServingConfig:
 
 
 class Request:
-    """One generation request and its lifecycle record."""
+    """One generation request and its lifecycle record.
+
+    ``deadline`` is an absolute ``time.monotonic()`` instant (or None):
+    a queued request past it is failed with ``DEADLINE_ERROR`` instead
+    of being admitted — the router's per-request deadline propagation
+    maps that to HTTP 504 without retry (docs/serving.md#fleet).
+
+    Tokens are observable *incrementally*: the engine notifies
+    :meth:`next_tokens` waiters after every appended token, which is
+    what the streaming HTTP path (and through it the router's
+    mid-stream failover) consumes.
+    """
 
     def __init__(self, rid: int, prompt: Sequence[int],
-                 max_new_tokens: int, temperature: float):
+                 max_new_tokens: int, temperature: float,
+                 deadline: Optional[float] = None):
         self.id = rid
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
+        self.deadline = deadline          # absolute monotonic, or None
         self.tokens: List[int] = []       # generated tokens
         self.status = "queued"            # queued|active|completed|failed
         self.error: Optional[str] = None
@@ -153,6 +178,7 @@ class Request:
         self.slot: Optional[int] = None
         self.blocks: List[int] = []
         self._done = threading.Event()
+        self._progress = threading.Condition()
 
     @property
     def done(self) -> bool:
@@ -163,6 +189,33 @@ class Request:
         if self.t_first_token is None:
             return None
         return self.t_first_token - self.t_submit
+
+    def _notify(self) -> None:
+        """Wake next_tokens() waiters (engine-side, after appending
+        tokens or reaching a terminal state)."""
+        with self._progress:
+            self._progress.notify_all()
+
+    def next_tokens(self, start: int,
+                    timeout: Optional[float] = None) -> List[int]:
+        """Block until tokens beyond index ``start`` exist (or the
+        request is terminal); returns the new slice — empty only once
+        terminal. Raises :exc:`TimeoutError` if nothing happens within
+        ``timeout``. The consumer side of token streaming."""
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        with self._progress:
+            while len(self.tokens) <= start and not self._done.is_set():
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"request {self.id}: no token progress in "
+                        f"{timeout}s")
+                self._progress.wait(remaining)
+        # list.append is atomic; len() then slice is safe outside the
+        # engine lock.
+        return self.tokens[start:len(self.tokens)]
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         """Block until terminal; the generated tokens, or raises the
@@ -214,6 +267,14 @@ class InferenceEngine:
         self._slots = slots
         self._alloc = BlockAllocator(c.kv_blocks)
         self._m["kv_total"].set(self._alloc.total)
+        self._m["slots"].set(slots)
+
+        # Serving fault injection (docs/adaptation.md): slow_decode /
+        # slow_prefill / replica_crash_at ride the same declarative spec
+        # as the training faults; resolved once, a single `is None`
+        # check per step when unset.
+        from ..adaptation import faults as _faults
+        self._inj = _faults.injector()
 
         self.params = params
         self._cache = self._put_cache(
@@ -249,10 +310,16 @@ class InferenceEngine:
 
     def submit(self, prompt: Sequence[int], *,
                max_new_tokens: Optional[int] = None,
-               temperature: Optional[float] = None) -> Request:
+               temperature: Optional[float] = None,
+               deadline_s: Optional[float] = None) -> Request:
         """Enqueue a request; returns immediately with its ticket.
         Raises :exc:`QueueFullError` past ``max_queue`` (the HTTP 429
-        path) and :exc:`DrainingError` after drain began."""
+        path) and :exc:`DrainingError` after drain began.
+
+        ``deadline_s`` is a *relative* budget in seconds (the router
+        propagates the client's remaining deadline per hop): a request
+        still queued when it expires fails with ``DEADLINE_ERROR``
+        instead of occupying a slot."""
         c = self.config
         max_new = int(max_new_tokens if max_new_tokens is not None
                       else c.max_new_tokens)
@@ -281,7 +348,10 @@ class InferenceEngine:
                 self._m["requests"].labels(status="rejected").inc()
                 raise QueueFullError(
                     f"admission queue full ({c.max_queue})")
-            req = Request(self._next_id, prompt, max_new, temp)
+            deadline = None if deadline_s is None \
+                else time.monotonic() + float(deadline_s)
+            req = Request(self._next_id, prompt, max_new, temp,
+                          deadline=deadline)
             self._next_id += 1
             self._queue.append(req)
             self._m["queue_depth"].set(len(self._queue))
@@ -316,6 +386,19 @@ class InferenceEngine:
         with self._lock:
             return self.active_count == 0 and not self._queue
 
+    def retry_after_s(self) -> int:
+        """Back-off hint for a 429: how long until the bounded queue
+        has plausibly drained, from the measured completion rate (the
+        same 10 s window behind ``hvdtpu_serving_requests_per_second``).
+        Clamped to [1, 60] whole seconds — a cold server (no completions
+        yet) answers 1 rather than guessing."""
+        with self._lock:
+            depth = len(self._queue) + self.active_count
+            rate = len(self._completions) / 10.0
+        if rate <= 0.0:
+            return 1
+        return max(1, min(60, math.ceil(depth / rate)))
+
     def step(self) -> bool:
         """One scheduler iteration: admit → batched decode → evict.
         Returns True when any work was done."""
@@ -343,21 +426,30 @@ class InferenceEngine:
         raise RuntimeError("run_until_idle: scheduler did not converge")
 
     def drain(self) -> None:
-        """Graceful shutdown: refuse new admissions, fail everything
-        still queued, finish every live slot's generation."""
+        """Graceful shutdown: refuse NEW submissions, then finish every
+        request already accepted — live slots decode to completion AND
+        queued requests are still admitted as slots/blocks free up.
+
+        An accepted request is a promise (its client got past the
+        429/503 gate); whether the scheduler thread happened to admit it
+        before SIGTERM landed must not decide its fate — the old
+        fail-the-queue behavior made drain outcomes race the prefill
+        phase (the regression test injects a slow_prefill fault to pin
+        the window open). Zero requests dropped by a drain is the fleet
+        tier's base invariant (docs/serving.md#fleet)."""
         with self._lock:
             self._draining = True
-            while self._queue:
-                req = self._queue.popleft()
-                self._finish(req, "failed", error="server draining")
-            self._m["queue_depth"].set(0)
+            waiting = self.active_count + len(self._queue)
         from ..observability import flight_recorder as _flight
-        _flight.recorder().note("serving", ("drain", self.active_count))
+        _flight.recorder().note("serving", ("drain", waiting))
         while True:
             with self._lock:
-                if self.active_count == 0:
+                self._admit()
+                if self.active_count == 0 and not self._queue:
+                    self._update_gauges()
                     break
-                self._decode_step()
+                if self.active_count:
+                    self._decode_step()
                 self._update_gauges()
         _flight.recorder().note("serving", ("drained", 0))
 
@@ -375,11 +467,18 @@ class InferenceEngine:
         admission that makes the batching *continuous*)."""
         admitted = 0
         while self._queue:
+            req = self._queue[0]
+            if req.deadline is not None \
+                    and time.monotonic() > req.deadline:
+                # Expired while queued: fail instead of burning a slot
+                # on an answer nobody is waiting for (HTTP 504 path).
+                self._queue.popleft()
+                self._finish(req, "failed", error=DEADLINE_ERROR)
+                continue
             slot = next((i for i, r in enumerate(self._reqs)
                          if r is None), None)
             if slot is None:
                 break
-            req = self._queue[0]
             need = blocks_needed(len(req.prompt), req.max_new_tokens,
                                  self.config.block_size)
             blocks = self._alloc.alloc(need)
@@ -407,6 +506,8 @@ class InferenceEngine:
             self._m["compiles"].labels(phase=phase).inc()
 
     def _prefill(self, req: Request) -> None:
+        if self._inj is not None:
+            self._inj.on_serving_prefill()
         t0 = time.perf_counter()
         n = len(req.prompt)
         L = self._bucket(n)
@@ -422,6 +523,7 @@ class InferenceEngine:
         first = self._sample(np.asarray(logits[0, n - 1]), req)
         req.t_first_token = time.perf_counter()
         req.tokens.append(first)
+        req._notify()
         self._last_tok[slot] = first
         self._m["prefill"].observe(time.perf_counter() - t0)
         self._m["ttft"].observe(req.t_first_token - req.t_submit)
@@ -430,6 +532,8 @@ class InferenceEngine:
         self._check_finished(req)
 
     def _decode_step(self) -> None:
+        if self._inj is not None:
+            self._inj.on_serving_decode()
         t0 = time.perf_counter()
         self._record_bucket("decode", self._slots)
         logits, self._cache = self._fwd(
@@ -448,6 +552,7 @@ class InferenceEngine:
             self._lengths[slot] += 1
             tok = self._sample(lg[slot], req)
             req.tokens.append(tok)
+            req._notify()
             self._last_tok[slot] = tok
             self._m["tpot"].observe(dt)
             self._m["tokens"].labels(kind="generated").inc()
@@ -495,6 +600,7 @@ class InferenceEngine:
                 self._completions.popleft()
             self._m["qps"].set(len(self._completions) / 10.0)
         req._done.set()
+        req._notify()
 
     def _update_gauges(self) -> None:
         self._m["active"].set(self.active_count)

@@ -1,0 +1,364 @@
+"""Fleet supervisor — spawn and babysit N independent serving replicas.
+
+The single-replica serving tier (docs/serving.md) dies with its
+process: one crash takes down every in-flight request. The fleet layer
+applies the adaptation plane's observe→detect→act discipline
+(docs/adaptation.md) to the tier where failures are user-visible:
+
+  - **observe**: every replica is a separate ``python -m
+    horovod_tpu.serving`` process announcing its HTTP + metrics ports
+    on stdout; the supervisor owns the pipe.
+  - **detect**: crash via process exit (``poll()``), hang via a
+    periodic ``/healthz`` probe — a replica that stops answering for
+    ``HOROVOD_TPU_FLEET_PROBE_FAILURES`` consecutive probes is declared
+    dead and killed (the ``drop_health`` fault clause exists to prove
+    this path deterministically).
+  - **act**: restart from the same checkpoint directory with the
+    replica's *incarnation* bumped (exported as
+    ``HOROVOD_TPU_ELASTIC_GENERATION``, so a ``gen=0``-scoped
+    ``replica_crash_at`` fault crashes the first incarnation once and
+    lets the restart run clean), and record every transition as a
+    flight-recorder ``serving_replica`` event + ``hvdtpu_fleet_*``
+    metric.
+
+The supervisor never routes: :class:`~horovod_tpu.serving.router.Router`
+reads :meth:`Fleet.endpoints` each scrape cycle, so a restarted replica
+(new ephemeral port) re-enters rotation the moment its ready line
+appears. Replica *identity* is the index; ports are cattle.
+
+Isolation is deliberate — replicas share nothing but the checkpoint
+directory. A replica process wedged in XLA cannot poison its siblings,
+and SIGKILL is always a safe supervisor action because the KV cache and
+batch state are process-local (requests are recovered by the router's
+failover, not by the replica).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..observability import flight_recorder as _flight
+from ..observability import registry as _obs
+from ..utils import env as _env
+from ..utils.logging import get_logger
+
+_log = get_logger("serving.fleet")
+
+# The replica's announce line (serving/__main__.py). The leading
+# ``ready on :PORT`` phrase is load-bearing API — tests and the
+# pre-fleet tooling grep for it.
+_READY_RE = re.compile(r"ready on :(\d+)")
+_METRICS_RE = re.compile(r"metrics=:(\d+)")
+
+
+def _metrics():
+    r = _obs.registry()
+    return {
+        "live": r.gauge(
+            "hvdtpu_fleet_replicas_live",
+            "Replica processes currently alive with a bound serving "
+            "port").labels(),
+        "restarts": r.counter(
+            "hvdtpu_fleet_replica_restarts_total",
+            "Replica restarts by the supervisor, by replica index and "
+            "why the previous incarnation ended"),
+        "probe_failures": r.counter(
+            "hvdtpu_fleet_probe_failures_total",
+            "Failed replica health probes (timeouts / refused / "
+            "dropped), by replica index"),
+    }
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaEndpoint:
+    """What the router needs to know about one live replica."""
+
+    index: int
+    host: str
+    port: int
+    metrics_port: Optional[int] = None
+
+
+class Replica:
+    """One supervised replica process (identity = index; the process,
+    port and incarnation all change across restarts)."""
+
+    def __init__(self, index: int):
+        self.index = index
+        self.proc: Optional[subprocess.Popen] = None
+        self.port: Optional[int] = None
+        self.metrics_port: Optional[int] = None
+        self.generation = 0          # incarnation (restart count)
+        self.restarts = 0
+        self.probe_failures = 0
+        self.t_spawn = 0.0
+        self.ready = threading.Event()   # ready line seen (this proc)
+        self._reader: Optional[threading.Thread] = None
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    @property
+    def up(self) -> bool:
+        return self.alive and self.port is not None
+
+
+class Fleet:
+    """Supervisor for ``n`` serving replicas launched from one
+    checkpoint.
+
+    ``replica_argv`` is the argv tail handed to every ``python -m
+    horovod_tpu.serving`` child (``--checkpoint-dir ...`` etc.);
+    the supervisor adds ``--replica-id``/``--port 0`` itself and forces
+    an ephemeral per-replica metrics endpoint
+    (``HOROVOD_TPU_METRICS_PORT=0``) so the router has a queue-gauge
+    scrape target per replica.
+    """
+
+    def __init__(self, n: int, replica_argv: List[str], *,
+                 host: str = "127.0.0.1",
+                 env: Optional[Dict[str, str]] = None,
+                 probe_interval_s: Optional[float] = None,
+                 probe_failures: Optional[int] = None,
+                 max_restarts: Optional[int] = None,
+                 restart_backoff_s: float = 0.5):
+        if n < 1:
+            raise ValueError(f"fleet needs >= 1 replica, got {n}")
+        self.n = int(n)
+        self.host = host
+        self.replica_argv = list(replica_argv)
+        self._env = dict(env) if env is not None else None
+        self._probe_interval = (probe_interval_s
+                                if probe_interval_s is not None
+                                else _env.fleet_probe_interval_secs())
+        self._probe_failures = (probe_failures
+                                if probe_failures is not None
+                                else _env.fleet_probe_failures())
+        self.max_restarts = max_restarts
+        self._backoff = float(restart_backoff_s)
+        self.replicas = [Replica(i) for i in range(self.n)]
+        self._m = _metrics()
+        self._lock = threading.Lock()
+        self._stopping = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ----------------------------------------------------------- spawn
+
+    def _note(self, event: str, replica: int, detail: str = "") -> None:
+        _flight.recorder().note("serving_replica",
+                                (event, replica, detail))
+
+    def _spawn(self, rep: Replica) -> None:
+        env = dict(os.environ if self._env is None else self._env)
+        env["HOROVOD_TPU_REPLICA_ID"] = str(rep.index)
+        # The incarnation rides the elastic-generation contract: fault
+        # clauses scope to one incarnation with gen=N exactly like they
+        # scope to one elastic generation in training.
+        env["HOROVOD_TPU_ELASTIC_GENERATION"] = str(rep.generation)
+        # One scrape target per replica: ephemeral port, announced on
+        # the ready line. A parent-level plain port would collide
+        # across replicas.
+        env["HOROVOD_TPU_METRICS_PORT"] = "0"
+        # Blackbox dumps go to a per-INCARNATION subdir: a restarted
+        # replica's periodic inflight snapshots would otherwise
+        # overwrite its dead predecessor's final-gasp dump — the one
+        # file the postmortem needs to name the crash
+        # (docs/postmortem.md).
+        bb = env.get("HOROVOD_TPU_BLACKBOX")
+        if bb:
+            env["HOROVOD_TPU_BLACKBOX"] = os.path.join(
+                bb, f"gen{rep.generation}")
+        cmd = [sys.executable, "-m", "horovod_tpu.serving",
+               "--replica-id", str(rep.index), "--port", "0"] \
+            + self.replica_argv
+        rep.port = None
+        rep.metrics_port = None
+        rep.ready = threading.Event()
+        rep.probe_failures = 0
+        rep.t_spawn = time.monotonic()
+        rep.proc = subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL if env.get(
+                "HOROVOD_TPU_FLEET_QUIET") else None,
+            text=True, bufsize=1)
+        self._note("spawn", rep.index, f"gen={rep.generation}")
+        _log.info("replica %d spawned (pid %d, gen %d)", rep.index,
+                  rep.proc.pid, rep.generation)
+        rep._reader = threading.Thread(
+            target=self._read_stdout, args=(rep, rep.proc),
+            name=f"hvd-tpu-fleet-r{rep.index}", daemon=True)
+        rep._reader.start()
+
+    def _read_stdout(self, rep: Replica, proc: subprocess.Popen) -> None:
+        """Own the replica's stdout pipe: parse the announce line, tag
+        and forward everything else (a supervisor that doesn't drain
+        the pipe deadlocks its child on a full buffer)."""
+        try:
+            for line in proc.stdout:
+                m = _READY_RE.search(line)
+                if m and rep.proc is proc:
+                    rep.port = int(m.group(1))
+                    mm = _METRICS_RE.search(line)
+                    rep.metrics_port = int(mm.group(1)) if mm else None
+                    rep.ready.set()
+                    self._note("ready", rep.index,
+                               f"port={rep.port}")
+                    _log.info("replica %d ready on :%d (metrics %s)",
+                              rep.index, rep.port, rep.metrics_port)
+                else:
+                    sys.stderr.write(f"[replica {rep.index}] {line}")
+        except (ValueError, OSError):  # pipe closed mid-read
+            pass
+
+    # ------------------------------------------------------- lifecycle
+
+    def start(self, ready_timeout_s: Optional[float] = None) -> None:
+        """Spawn every replica and start the supervision loop;
+        optionally block until all announce ready."""
+        for rep in self.replicas:
+            self._spawn(rep)
+        self._thread = threading.Thread(
+            target=self._supervise, name="hvd-tpu-fleet", daemon=True)
+        self._thread.start()
+        if ready_timeout_s is not None:
+            self.wait_ready(ready_timeout_s)
+
+    def wait_ready(self, timeout_s: float) -> None:
+        deadline = time.monotonic() + timeout_s
+        for rep in self.replicas:
+            if not rep.ready.wait(max(0.0,
+                                      deadline - time.monotonic())):
+                raise TimeoutError(
+                    f"replica {rep.index} not ready within "
+                    f"{timeout_s}s")
+
+    def endpoints(self) -> List[ReplicaEndpoint]:
+        """Live, port-announced replicas — the router's backend list,
+        re-read every scrape cycle so restarts re-enter rotation."""
+        out = []
+        for rep in self.replicas:
+            if rep.up:
+                out.append(ReplicaEndpoint(
+                    index=rep.index, host=self.host, port=rep.port,
+                    metrics_port=rep.metrics_port))
+        return out
+
+    def _probe(self, rep: Replica) -> bool:
+        """One /healthz liveness probe (readiness is the router's
+        business — a draining replica must NOT be shot)."""
+        import http.client
+        try:
+            conn = http.client.HTTPConnection(
+                self.host, rep.port, timeout=max(
+                    1.0, self._probe_interval * 4))
+            try:
+                conn.request("GET", "/healthz")
+                return conn.getresponse().status == 200
+            finally:
+                conn.close()
+        except OSError:
+            return False
+
+    def _supervise(self) -> None:
+        while not self._stopping.is_set():
+            for rep in self.replicas:
+                if self._stopping.is_set():
+                    break
+                if rep.proc is None:
+                    continue
+                rc = rep.proc.poll()
+                if rc is not None:
+                    self._on_exit(rep, rc)
+                    continue
+                if rep.port is not None:
+                    if self._probe(rep):
+                        rep.probe_failures = 0
+                    else:
+                        rep.probe_failures += 1
+                        self._m["probe_failures"].labels(
+                            replica=str(rep.index)).inc()
+                        if rep.probe_failures >= self._probe_failures:
+                            self._note("health_timeout", rep.index,
+                                       f"{rep.probe_failures} probes")
+                            _log.error(
+                                "replica %d unresponsive for %d "
+                                "probes — killing for restart",
+                                rep.index, rep.probe_failures)
+                            try:
+                                rep.proc.send_signal(signal.SIGKILL)
+                            except OSError:
+                                pass
+            self._m["live"].set(
+                sum(1 for r in self.replicas if r.up))
+            self._stopping.wait(self._probe_interval)
+
+    def _on_exit(self, rep: Replica, rc: int) -> None:
+        why = "exit" if rc == 0 else "crash"
+        self._note(why, rep.index, f"rc={rc} gen={rep.generation}")
+        _log.log(30 if rc else 20,
+                 "replica %d (gen %d) %s with rc=%s", rep.index,
+                 rep.generation, "exited" if rc == 0 else "CRASHED", rc)
+        if self._stopping.is_set():
+            rep.proc = None
+            return
+        if self.max_restarts is not None \
+                and rep.restarts >= self.max_restarts:
+            self._note("gave_up", rep.index,
+                       f"restarts={rep.restarts}")
+            _log.error("replica %d exceeded max_restarts=%d — leaving "
+                       "it down", rep.index, self.max_restarts)
+            rep.proc = None
+            return
+        # Fast-crash backoff: a replica dying within 2 s of spawn
+        # (bad checkpoint, port clash) must not spin the supervisor.
+        if time.monotonic() - rep.t_spawn < 2.0:
+            self._stopping.wait(self._backoff)
+        rep.restarts += 1
+        rep.generation += 1
+        self._m["restarts"].labels(replica=str(rep.index),
+                                   why=why).inc()
+        self._note("restart", rep.index, f"gen={rep.generation}")
+        self._spawn(rep)
+
+    def drain_replica(self, index: int) -> None:
+        """Operator action: SIGTERM one replica so it drains cleanly
+        (readyz flips 503, the router stops admitting, accepted work
+        completes, exit 0 — and the supervisor restarts it)."""
+        rep = self.replicas[index]
+        if rep.alive:
+            self._note("drain", index, "sigterm")
+            rep.proc.send_signal(signal.SIGTERM)
+
+    def stop(self, timeout_s: float = 30.0) -> None:
+        """Tear the fleet down: stop restarting, SIGTERM every replica
+        (graceful drain), escalate to SIGKILL past the timeout."""
+        self._stopping.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self._probe_interval * 4 + 1)
+        for rep in self.replicas:
+            if rep.alive:
+                rep.proc.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + timeout_s
+        for rep in self.replicas:
+            if rep.proc is None:
+                continue
+            try:
+                rep.proc.wait(timeout=max(
+                    0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                _log.warning("replica %d did not drain in %.0fs — "
+                             "SIGKILL", rep.index, timeout_s)
+                rep.proc.kill()
+                rep.proc.wait(timeout=10.0)
+            self._note("stopped", rep.index,
+                       f"rc={rep.proc.returncode}")
+        self._m["live"].set(0)

@@ -38,6 +38,12 @@ from ..models import transformer as tfm
 # Manifest ``extra`` key under which trainers record the architecture.
 CONFIG_EXTRA_KEY = "transformer_config"
 
+# Where the torch save hook (torch.checkpoint_hook) roots the model
+# tree inside its checkpoint: manifest leaf keys come out as
+# ``['model']['embed']...`` — this prefix selects them (and skips the
+# optimizer subtree) for ``--framework torch`` serving.
+TORCH_MODEL_PREFIX = "['model']"
+
 _DTYPE_NAMES = {"float32", "bfloat16", "float16", "float64"}
 
 
@@ -96,25 +102,40 @@ def _spec_by_key(cfg: tfm.TransformerConfig) -> Tuple[Any, Dict[str, P]]:
 
 
 def target_layouts(cfg: tfm.TransformerConfig, man: dict,
-                   mesh: jax.sharding.Mesh
+                   mesh: jax.sharding.Mesh, *,
+                   key_prefix: str = ""
                    ) -> Tuple[Dict[str, LeafLayout],
                               Dict[str, NamedSharding]]:
     """Per-leaf target :class:`LeafLayout` + ``NamedSharding`` on the
     inference mesh, derived from ``param_specs`` and the manifest's
     shapes — no arrays materialized (the point: the layout must exist
-    *before* the data so the restore can read only what it needs)."""
+    *before* the data so the restore can read only what it needs).
+
+    ``key_prefix`` selects a subtree of the checkpoint: a torch save
+    hook commits ``{"model": ..., "optimizer": ...}``, so serving reads
+    only the leaves under :data:`TORCH_MODEL_PREFIX` and ignores the
+    rest (an unprefixed load still rejects unknown leaves loudly —
+    silently skipping them would mask a wrong checkpoint)."""
     _, by_key = _spec_by_key(cfg)
     layouts: Dict[str, LeafLayout] = {}
     shardings: Dict[str, NamedSharding] = {}
     for entry in man["leaves"]:
-        key = entry["key"]
-        if key not in by_key:
+        key = entry["key"]      # manifest key — stays the dict key so
+        #                         restore_addressable finds the shards
+        spec_key = key
+        if key_prefix:
+            if not key.startswith(key_prefix):
+                continue   # outside the selected subtree (optimizer…)
+            spec_key = key[len(key_prefix):]
+            if spec_key not in by_key:
+                continue
+        elif key not in by_key:
             raise KeyError(
                 f"checkpoint leaf {key!r} has no param_specs entry — "
                 "is this checkpoint the flagship transformer's params "
                 f"tree? (specs hold {sorted(by_key)[:4]}...)")
         shape = tuple(int(d) for d in entry["shape"])
-        sharding = NamedSharding(mesh, by_key[key])
+        sharding = NamedSharding(mesh, by_key[spec_key])
         shardings[key] = sharding
         if sharding.is_fully_replicated:
             layouts[key] = LeafLayout(
@@ -140,28 +161,35 @@ def target_layouts(cfg: tfm.TransformerConfig, man: dict,
 def load_params(directory: str, cfg: tfm.TransformerConfig,
                 mesh: jax.sharding.Mesh, *,
                 step: Optional[int] = None,
-                engine: Optional[CheckpointEngine] = None) -> Any:
+                engine: Optional[CheckpointEngine] = None,
+                key_prefix: str = "") -> Any:
     """Assemble the transformer's parameter tree on the inference mesh
     from a committed sharded checkpoint — span-overlap reads only
     (``restore_addressable``), so the save-time world size / mesh never
     has to match the serving one.
 
     ``engine`` lets callers keep corruption-fallback/process settings;
-    by default one is built over ``directory``. Returns the params
-    pytree with every leaf a sharded ``jax.Array`` on ``mesh``.
+    by default one is built over ``directory``. ``key_prefix`` roots
+    the read in a checkpoint subtree — :data:`TORCH_MODEL_PREFIX` for
+    checkpoints committed by ``torch.checkpoint_hook`` (the
+    ``--framework torch`` serving path). Returns the params pytree with
+    every leaf a sharded ``jax.Array`` on ``mesh``.
     """
     eng = engine if engine is not None else CheckpointEngine(directory)
     man = eng.restore_manifest(step)
     treedef, by_key = _spec_by_key(cfg)
-    layouts, shardings = target_layouts(cfg, man, mesh)
-    missing = sorted(set(by_key) - set(layouts))
+    layouts, shardings = target_layouts(cfg, man, mesh,
+                                        key_prefix=key_prefix)
+    missing = sorted(key_prefix + k for k in by_key
+                     if key_prefix + k not in layouts)
     if missing:
         raise KeyError(
             f"checkpoint step {man['step']} is missing param leaves "
             f"{missing[:4]}{'...' if len(missing) > 4 else ''}")
     blocks = eng.restore_addressable(layouts, step)
     leaves = []
-    for key in by_key:   # spec flatten order == tree order
+    for spec_key in by_key:   # spec flatten order == tree order
+        key = key_prefix + spec_key
         shape = layouts[key].shape
         sharding = shardings[key]
         by_index = {shard.index: arr for shard, arr in blocks[key]}

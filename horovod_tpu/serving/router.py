@@ -1,0 +1,649 @@
+"""Fleet router — queue-depth-aware request routing with
+zero-dropped-request failover across serving replicas.
+
+The router is the only thing a client talks to. It keeps a scraped
+view of every replica (``/readyz`` for admission, the
+``hvdtpu_serving_*`` queue gauges from each replica's metrics endpoint
+for load), and for each ``POST /generate``:
+
+  1. **admits** onto the least-loaded ready replica — score is
+     ``(active + queue_depth) / batch_slots``, i.e. outstanding work
+     per slot, so a draining or backed-up replica naturally repels
+     traffic before it starts rejecting it;
+  2. **streams** tokens from the replica (the replica-side NDJSON
+     protocol, server.py) and relays them to the client;
+  3. **fails over**: a replica that dies before the first token is
+     transparently retried on a healthy replica (the request is simply
+     re-prefilled); one that dies mid-stream is *resumed* — the router
+     re-submits ``prompt + tokens-emitted-so-far`` with the remaining
+     token budget, so the client's stream continues seamlessly and, for
+     greedy decode, token-for-token identically to an uncontended run
+     (the KV cache the dead replica lost is rebuilt by one prefill on
+     the survivor — prefill is the recovery primitive, exactly like
+     re-rendezvous is for training, docs/elastic.md).
+
+Deadlines propagate: the client's ``deadline_ms`` budget is decremented
+per hop and shipped to the replica, an expired request answers **504
+and is never retried** (a retry nobody waits for is pure waste), and
+queue-full (**429**) carries a ``Retry-After`` derived from the
+fleet-wide drain rate.
+
+The router deliberately holds NO generation state beyond the in-flight
+request's emitted tokens — replicas own KV; the router owns retry. That
+is what makes a replica process disposable (fleet.py can SIGKILL one at
+any time) without the serving tier as a whole dropping a request.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ..observability import registry as _obs
+from ..utils import env as _env
+from ..utils.logging import get_logger
+from .engine import DEADLINE_ERROR
+from .fleet import ReplicaEndpoint
+
+_log = get_logger("serving.router")
+
+# Server-side cap on one routed generation (mirrors server.py).
+ROUTER_TIMEOUT_S = 600.0
+# How long a replica stays excluded from a request's retry loop after
+# failing it (it usually also drops from the scrape view, but the
+# scrape cadence must not gate failover).
+_EXCLUDE_S = 2.0
+# Per-read socket timeout on a replica token stream: generous (a decode
+# step under load is milliseconds; even a slow_decode fault is tens of
+# ms) but finite, so a fully hung replica cannot wedge a client that
+# set no deadline.
+_STREAM_READ_S = 120.0
+
+
+def _metrics():
+    r = _obs.registry()
+    return {
+        "requests": r.counter(
+            "hvdtpu_fleet_requests_total",
+            "Routed requests by outcome: completed, expired (deadline "
+            "→ 504), rejected (fleet-wide queue-full → 429), failed, "
+            "bad_request"),
+        "retries": r.counter(
+            "hvdtpu_fleet_retries_total",
+            "Dispatch attempts beyond the first, by reason: connect, "
+            "crash (stream broke), queue_full, draining, failed"),
+        "failovers": r.counter(
+            "hvdtpu_fleet_failovers_total",
+            "Requests moved to another replica after their replica "
+            "died, by phase: prefill (before first token) or "
+            "midstream (resumed with re-prefill)"),
+        "failover_s": r.histogram(
+            "hvdtpu_fleet_failover_seconds",
+            "Failure detection → first token from the replacement "
+            "replica", buckets=_obs.LATENCY_BUCKETS).labels(),
+        "dispatch": r.counter(
+            "hvdtpu_fleet_dispatch_total",
+            "Dispatches by replica index (the admission policy, "
+            "observable)"),
+        "ready": r.gauge(
+            "hvdtpu_fleet_replicas_ready",
+            "Replicas currently admitting (readyz 200 at last "
+            "scrape)").labels(),
+        "queue": r.gauge(
+            "hvdtpu_fleet_replica_queue_depth",
+            "Scraped hvdtpu_serving_queue_depth per replica index — "
+            "the router's own view of the signal it balances on"),
+    }
+
+
+@dataclasses.dataclass
+class ReplicaView:
+    """The router's scraped view of one replica."""
+
+    endpoint: ReplicaEndpoint
+    ready: bool = False
+    ok: bool = False              # at least one successful scrape
+    queue_depth: float = 0.0
+    active: float = 0.0
+    slots: float = 1.0
+    t_scraped: float = 0.0
+
+    @property
+    def score(self) -> float:
+        """Outstanding work per decode slot — lower admits first."""
+        return (self.active + self.queue_depth) / max(1.0, self.slots)
+
+
+class StaticBackends:
+    """Fixed endpoint list (external replicas / stub-replica tests) —
+    the same ``endpoints()`` surface as :class:`fleet.Fleet`."""
+
+    def __init__(self, endpoints: Sequence[ReplicaEndpoint]):
+        self._endpoints = list(endpoints)
+
+    def endpoints(self) -> List[ReplicaEndpoint]:
+        return list(self._endpoints)
+
+
+def pick_replica(views: Sequence[ReplicaView],
+                 exclude: Optional[set] = None,
+                 rr: int = 0) -> Optional[ReplicaView]:
+    """The routing policy, isolated for unit testing: among ready,
+    scrape-confirmed, non-excluded replicas, the lowest load score;
+    ties broken round-robin by ``rr``. None when nobody can admit."""
+    exclude = exclude or set()
+    ok = [v for v in views
+          if v.ready and v.ok and v.endpoint.index not in exclude]
+    if not ok:
+        return None
+    best = min(v.score for v in ok)
+    tied = [v for v in ok if v.score == best]
+    return tied[rr % len(tied)]
+
+
+class Router:
+    """HTTP front end balancing ``/generate`` across a replica fleet.
+
+    ``backends`` is anything with ``endpoints() ->
+    List[ReplicaEndpoint]`` — a :class:`fleet.Fleet` (endpoints move as
+    replicas restart) or a :class:`StaticBackends`.
+    """
+
+    def __init__(self, backends, port: int = 0, host: str = "0.0.0.0",
+                 scrape_interval_s: Optional[float] = None,
+                 max_attempts: Optional[int] = None):
+        self.backends = backends
+        self._scrape_interval = (scrape_interval_s
+                                 if scrape_interval_s is not None
+                                 else _env.fleet_probe_interval_secs())
+        self._max_attempts = max_attempts
+        self._views: Dict[int, ReplicaView] = {}
+        self._views_lock = threading.Lock()
+        self._rr = 0
+        self._m = _metrics()
+        self._stop = threading.Event()
+        self._scrape_thread: Optional[threading.Thread] = None
+        self._next_id = 0
+        self._id_lock = threading.Lock()
+        self._build_http(host, port)
+
+    # ------------------------------------------------------ scraping
+
+    def _scrape_one(self, view: ReplicaView) -> None:
+        ep = view.endpoint
+        try:
+            conn = http.client.HTTPConnection(
+                ep.host, ep.port, timeout=max(
+                    1.0, self._scrape_interval * 4))
+            try:
+                conn.request("GET", "/readyz")
+                view.ready = conn.getresponse().status == 200
+            finally:
+                conn.close()
+        except OSError:
+            view.ready = False
+            view.ok = False
+            return
+        got = False
+        if ep.metrics_port:
+            got = self._scrape_metrics(view)
+        if not got:
+            got = self._scrape_healthz(view)
+        view.ok = got
+        view.t_scraped = time.monotonic()
+
+    def _scrape_metrics(self, view: ReplicaView) -> bool:
+        """The primary load signal: the replica's own
+        ``hvdtpu_serving_*`` gauges from its registry endpoint."""
+        ep = view.endpoint
+        try:
+            conn = http.client.HTTPConnection(
+                ep.host, ep.metrics_port, timeout=max(
+                    1.0, self._scrape_interval * 4))
+            try:
+                conn.request("GET", "/metrics.json")
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    return False
+                snap = json.loads(resp.read())
+            finally:
+                conn.close()
+        except (OSError, ValueError):
+            return False
+
+        def gauge(name, default=None):
+            try:
+                return float(snap[name]["values"][""])
+            except (KeyError, TypeError, ValueError):
+                return default
+
+        q = gauge("hvdtpu_serving_queue_depth")
+        a = gauge("hvdtpu_serving_active_requests")
+        s = gauge("hvdtpu_serving_batch_slots")
+        if q is None or a is None:
+            return False
+        view.queue_depth, view.active = q, a
+        if s:
+            view.slots = s
+        return True
+
+    def _scrape_healthz(self, view: ReplicaView) -> bool:
+        """Fallback when the replica runs with metrics disabled
+        (HOROVOD_TPU_METRICS=0): /healthz carries the same numbers."""
+        ep = view.endpoint
+        try:
+            conn = http.client.HTTPConnection(
+                ep.host, ep.port, timeout=max(
+                    1.0, self._scrape_interval * 4))
+            try:
+                conn.request("GET", "/healthz")
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    return False
+                h = json.loads(resp.read())
+            finally:
+                conn.close()
+        except (OSError, ValueError):
+            return False
+        view.queue_depth = float(h.get("queue_depth", 0))
+        view.active = float(h.get("active_requests", 0))
+        view.slots = float(h.get("batch_slots", 1) or 1)
+        return True
+
+    def _scrape_cycle(self) -> None:
+        eps = {ep.index: ep for ep in self.backends.endpoints()}
+        with self._views_lock:
+            # Drop vanished replicas; reset views whose port moved
+            # (a restarted replica is a NEW backend).
+            for idx in list(self._views):
+                if idx not in eps:
+                    del self._views[idx]
+                elif self._views[idx].endpoint != eps[idx]:
+                    self._views[idx] = ReplicaView(endpoint=eps[idx])
+            for idx, ep in eps.items():
+                if idx not in self._views:
+                    self._views[idx] = ReplicaView(endpoint=ep)
+            views = list(self._views.values())
+        for v in views:
+            self._scrape_one(v)
+            self._m["queue"].labels(
+                replica=str(v.endpoint.index)).set(v.queue_depth)
+        self._m["ready"].set(sum(1 for v in views if v.ready))
+
+    def _scrape_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._scrape_cycle()
+            except Exception as e:  # never die over telemetry
+                _log.warning("scrape cycle failed: %s", e)
+            self._stop.wait(self._scrape_interval)
+
+    def _pick(self, exclude: Dict[int, float]) -> Optional[ReplicaView]:
+        now = time.monotonic()
+        live = {i for i, until in exclude.items() if until > now}
+        with self._views_lock:
+            views = list(self._views.values())
+        self._rr += 1
+        return pick_replica(views, exclude=live, rr=self._rr)
+
+    # ------------------------------------------------------ dispatch
+
+    def _relay(self, prompt: List[int], max_new: int,
+               temperature: Optional[float],
+               deadline: Optional[float], emit) -> dict:
+        """Drive one client request across the fleet until it
+        completes: pick → stream → (on death) fail over. ``emit(tok)``
+        is called once per generated token in order; returns the
+        terminal meta dict {"status": ..., "retries": N, ...}."""
+        emitted: List[int] = []
+        exclude: Dict[int, float] = {}
+        attempts = 0
+        retries = 0
+        t_fail: Optional[float] = None     # failover stopwatch
+        n_backends = max(1, len(self.backends.endpoints()))
+        max_attempts = self._max_attempts or max(6, 3 * n_backends)
+
+        def expired() -> bool:
+            return deadline is not None and time.monotonic() > deadline
+
+        def retry(reason: str) -> None:
+            nonlocal retries
+            retries += 1
+            self._m["retries"].labels(reason=reason).inc()
+
+        def emit_observed(tok: int) -> None:
+            # First token after a failover closes the detection→resume
+            # stopwatch (kept across back-to-back failed attempts: the
+            # client's gap is measured from the FIRST detection).
+            nonlocal t_fail
+            if t_fail is not None:
+                self._m["failover_s"].observe(
+                    time.monotonic() - t_fail)
+                t_fail = None
+            emit(tok)
+
+        while True:
+            if expired():
+                return {"status": "expired", "error": DEADLINE_ERROR,
+                        "retries": retries, "tokens": emitted}
+            if attempts >= max_attempts:
+                return {"status": "failed",
+                        "error": f"no replica completed the request "
+                                 f"after {attempts} attempts",
+                        "retries": retries, "tokens": emitted}
+            view = self._pick(exclude)
+            if view is None:
+                # Nobody ready right now (mass restart, all draining):
+                # wait out a scrape cycle rather than failing a
+                # promised request — bounded by deadline/attempts.
+                attempts += 1
+                wait = self._scrape_interval
+                if deadline is not None:
+                    wait = min(wait, max(0.0,
+                                         deadline - time.monotonic()))
+                time.sleep(wait)
+                continue
+            attempts += 1
+            idx = view.endpoint.index
+            self._m["dispatch"].labels(replica=str(idx)).inc()
+            outcome = self._stream_from(
+                view.endpoint, prompt + emitted,
+                max_new - len(emitted), temperature, deadline,
+                emitted, emit_observed)
+            if outcome["kind"] == "done":
+                return {"status": "completed", "retries": retries,
+                        "tokens": emitted, "replica": idx,
+                        **outcome.get("meta", {})}
+            if outcome["kind"] == "deadline":
+                return {"status": "expired", "error": DEADLINE_ERROR,
+                        "retries": retries, "tokens": emitted}
+            if outcome["kind"] == "bad_request":
+                return {"status": "bad_request",
+                        "error": outcome["error"],
+                        "retries": retries, "tokens": emitted}
+            # Retryable: crash/connect/queue_full/draining/failed.
+            exclude[idx] = time.monotonic() + _EXCLUDE_S
+            retry(outcome["kind"])
+            if outcome["kind"] in ("crash", "connect"):
+                phase = "midstream" if emitted else "prefill"
+                self._m["failovers"].labels(phase=phase).inc()
+                if t_fail is None:
+                    t_fail = time.monotonic()
+                _log.warning(
+                    "replica %d died %s request (%d tokens emitted) — "
+                    "failing over", idx,
+                    "mid-stream of" if emitted else "before first "
+                    "token of", len(emitted))
+
+    def _stream_from(self, ep: ReplicaEndpoint, prompt: List[int],
+                     max_new: int, temperature: Optional[float],
+                     deadline: Optional[float], emitted: List[int],
+                     emit) -> dict:
+        """One dispatch attempt against one replica, streaming. Appends
+        to ``emitted`` / calls ``emit`` as tokens land. Returns a
+        tagged outcome: done / deadline / bad_request, or a retryable
+        kind (connect, crash, queue_full, draining, failed)."""
+        body = {"tokens": prompt, "max_new_tokens": max_new,
+                "stream": True}
+        if temperature is not None:
+            body["temperature"] = temperature
+        if deadline is not None:
+            remaining_ms = (deadline - time.monotonic()) * 1e3
+            if remaining_ms <= 0:
+                return {"kind": "deadline"}
+            body["deadline_ms"] = round(remaining_ms, 1)
+        read_timeout = _STREAM_READ_S
+        if deadline is not None:
+            read_timeout = min(read_timeout, max(
+                0.1, deadline - time.monotonic() + 1.0))
+        try:
+            conn = http.client.HTTPConnection(ep.host, ep.port,
+                                              timeout=read_timeout)
+            try:
+                conn.request(
+                    "POST", "/generate", json.dumps(body),
+                    {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                if resp.status == 429:
+                    resp.read()
+                    return {"kind": "queue_full"}
+                if resp.status == 503:
+                    resp.read()
+                    return {"kind": "draining"}
+                if resp.status == 400:
+                    err = resp.read().decode(errors="replace")
+                    return {"kind": "bad_request", "error": err}
+                if resp.status == 504:
+                    resp.read()
+                    return {"kind": "deadline"}
+                if resp.status != 200:
+                    resp.read()
+                    return {"kind": "failed"}
+                saw_done = False
+                while True:
+                    line = resp.readline()
+                    if not line:
+                        break
+                    line = line.strip()
+                    if not line:
+                        continue
+                    obj = json.loads(line)
+                    if "t" in obj:
+                        emitted.append(int(obj["t"]))
+                        emit(int(obj["t"]))
+                    elif obj.get("done"):
+                        saw_done = True
+                        if obj.get("status") == "completed":
+                            return {"kind": "done", "meta": {
+                                k: obj[k] for k in ("ttft_ms",
+                                                    "latency_ms")
+                                if k in obj}}
+                        if DEADLINE_ERROR in str(obj.get("error")):
+                            return {"kind": "deadline"}
+                        return {"kind": "failed"}
+                if not saw_done:
+                    # Stream broke without a terminal line: the
+                    # replica died under this request.
+                    return {"kind": "crash"}
+                return {"kind": "failed"}
+            finally:
+                conn.close()
+        except (http.client.HTTPException, TimeoutError, OSError,
+                ValueError):
+            # Connection refused/reset, torn JSON line (killed
+            # mid-write), read timeout: all read as replica loss. If
+            # the status line never arrived, the request may not have
+            # been admitted at all — still safe to retry, generation
+            # is idempotent (greedy) or re-sampled (temperature).
+            return {"kind": "crash" if emitted else "connect"}
+
+    # ---------------------------------------------------------- HTTP
+
+    def _build_http(self, host: str, port: int) -> None:
+        from http.server import BaseHTTPRequestHandler, \
+            ThreadingHTTPServer
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _reply(self, code: int, payload: dict,
+                       headers: Optional[dict] = None) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 (http.server API)
+                path = self.path.split("?")[0]
+                if path == "/healthz":
+                    with outer._views_lock:
+                        views = list(outer._views.values())
+                    self._reply(200, {
+                        "status": "routing",
+                        "replicas": [{
+                            "index": v.endpoint.index,
+                            "port": v.endpoint.port,
+                            "ready": v.ready,
+                            "queue_depth": v.queue_depth,
+                            "active": v.active,
+                            "slots": v.slots,
+                            "score": round(v.score, 4),
+                        } for v in views],
+                        "ready_replicas": sum(
+                            1 for v in views if v.ready),
+                    })
+                    return
+                if path == "/readyz":
+                    with outer._views_lock:
+                        n = sum(1 for v in outer._views.values()
+                                if v.ready)
+                    if n > 0:
+                        self._reply(200, {"status": "ready",
+                                          "ready_replicas": n})
+                    else:
+                        self._reply(503, {"status": "no ready "
+                                                    "replicas"})
+                    return
+                self._reply(404, {"error": "not found"})
+
+            def do_POST(self):  # noqa: N802 (http.server API)
+                if self.path.split("?")[0] != "/generate":
+                    self._reply(404, {"error": "not found"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    tokens = body["tokens"]
+                    if not isinstance(tokens, list) or not tokens:
+                        raise ValueError(
+                            "'tokens' must be a non-empty list")
+                    tokens = [int(t) for t in tokens]
+                    max_new = int(body.get("max_new_tokens", 64))
+                    temperature = body.get("temperature")
+                    stream = bool(body.get("stream", False))
+                    deadline_ms = body.get(
+                        "deadline_ms",
+                        self.headers.get("X-Request-Deadline-Ms"))
+                except (KeyError, ValueError, TypeError,
+                        json.JSONDecodeError) as e:
+                    outer._m["requests"].labels(
+                        outcome="bad_request").inc()
+                    self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                deadline = None
+                if deadline_ms not in (None, ""):
+                    deadline = time.monotonic() \
+                        + float(deadline_ms) / 1e3
+                else:
+                    deadline = time.monotonic() + ROUTER_TIMEOUT_S
+                rid = outer._request_id()
+                if stream:
+                    self._do_stream(rid, tokens, max_new, temperature,
+                                    deadline)
+                else:
+                    self._do_unary(rid, tokens, max_new, temperature,
+                                   deadline)
+
+            def _do_unary(self, rid, tokens, max_new, temperature,
+                          deadline) -> None:
+                t0 = time.perf_counter()
+                meta = outer._relay(tokens, max_new, temperature,
+                                    deadline, emit=lambda t: None)
+                outer._count(meta["status"])
+                if meta["status"] == "completed":
+                    self._reply(200, {
+                        "id": rid, "tokens": meta["tokens"],
+                        "retries": meta["retries"],
+                        "replica": meta.get("replica"),
+                        "latency_ms": round(
+                            (time.perf_counter() - t0) * 1e3, 3)})
+                elif meta["status"] == "expired":
+                    self._reply(504, {"error": DEADLINE_ERROR,
+                                      "retries": meta["retries"]})
+                elif meta["status"] == "bad_request":
+                    self._reply(400, {"error": meta["error"]})
+                else:
+                    self._reply(503, {"error": meta["error"],
+                                      "retries": meta["retries"]},
+                                headers={"Retry-After": 1})
+
+            def _do_stream(self, rid, tokens, max_new, temperature,
+                           deadline) -> None:
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/x-ndjson")
+                self.send_header("Cache-Control", "no-store")
+                self.close_connection = True
+                self.end_headers()
+
+                def line(obj) -> None:
+                    self.wfile.write(
+                        json.dumps(obj).encode() + b"\n")
+                    self.wfile.flush()
+
+                try:
+                    line({"id": rid})
+                    meta = outer._relay(
+                        tokens, max_new, temperature, deadline,
+                        emit=lambda t: line({"t": t}))
+                    outer._count(meta["status"])
+                    done = {"done": True,
+                            "status": ("completed"
+                                       if meta["status"] == "completed"
+                                       else "failed"),
+                            "n": len(meta["tokens"]),
+                            "retries": meta["retries"]}
+                    if meta["status"] != "completed":
+                        done["error"] = meta.get("error")
+                    line(done)
+                except (BrokenPipeError, ConnectionResetError,
+                        OSError):
+                    pass   # client hung up; nothing to unwind
+
+            def log_message(self, *args):  # silence per-request stderr
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="hvd-tpu-fleet-router", daemon=True)
+
+    def _request_id(self) -> int:
+        with self._id_lock:
+            rid = self._next_id
+            self._next_id += 1
+            return rid
+
+    def _count(self, status: str) -> None:
+        outcome = {"completed": "completed", "expired": "expired",
+                   "bad_request": "bad_request"}.get(status, "failed")
+        self._m["requests"].labels(outcome=outcome).inc()
+
+    # ------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self._scrape_cycle()   # one synchronous pass: never route blind
+        self._scrape_thread = threading.Thread(
+            target=self._scrape_loop, name="hvd-tpu-fleet-scrape",
+            daemon=True)
+        self._scrape_thread.start()
+        self._http_thread.start()
+        _log.info("fleet router on :%d (%d replica(s) scraped)",
+                  self.port, len(self._views))
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._scrape_thread is not None:
+            self._scrape_thread.join(timeout=5.0)
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._http_thread.join(timeout=5.0)

@@ -8,12 +8,31 @@ connection, all of them funneling into the engine's thread-safe
 Routes:
 
   ``POST /generate``   {"tokens": [...], "max_new_tokens"?,
-                        "temperature"?} → 200 {"tokens", "id",
-                        "ttft_ms", "latency_ms"}; 429 when the bounded
-                        queue is full; 503 while draining; 400 on a bad
+                        "temperature"?, "stream"?, "deadline_ms"?} →
+                        200 {"tokens", "id", "ttft_ms", "latency_ms"}
+                        (or an NDJSON token stream with "stream": true);
+                        429 + ``Retry-After`` when the bounded queue is
+                        full; 503 + ``Connection: close`` while
+                        draining; 504 past the deadline; 400 on a bad
                         body.
-  ``GET /healthz``     200 {"status": "serving", ...} with live queue /
-                        slot / KV-pool numbers; 503 once draining.
+  ``GET /healthz``     LIVENESS: 200 while the process can answer —
+                        including during a drain (status flips to
+                        "draining" but the code stays 200, so a
+                        supervisor doesn't shoot a replica that is
+                        cleanly finishing its work).
+  ``GET /readyz``      READINESS: 200 {"status": "ready"} while
+                        admitting; 503 {"status": "draining"} once a
+                        drain began — the fleet router stops routing
+                        here the moment this flips
+                        (docs/serving.md#fleet).
+
+Token streaming (``"stream": true``): the reply is
+``application/x-ndjson`` with no Content-Length — one ``{"id": ...}``
+header line, one ``{"t": <token>}`` line per generated token flushed as
+it is sampled, and a final ``{"done": true, ...}`` line, then the
+connection closes. A connection that closes WITHOUT a ``done`` line
+means the replica died mid-generation — that is exactly the signal the
+fleet router's mid-stream failover keys on.
 
 Metrics deliberately do NOT get a route here: the registry endpoint
 (``HOROVOD_TPU_METRICS_PORT``, started by ``hvd.init()``) already
@@ -21,8 +40,9 @@ serves every ``hvdtpu_serving_*`` family — one scrape target per
 process, no second port.
 
 Shutdown: ``install_signal_handlers`` makes SIGTERM/SIGINT request a
-graceful drain — admission stops (healthz flips 503), queued requests
-fail fast, live slots decode to completion, then the process exits 0.
+graceful drain — admission stops (``/readyz`` flips 503), every
+ACCEPTED request completes (queued ones included — acceptance is a
+promise, see ``InferenceEngine.drain``), then the process exits 0.
 The flight recorder's atexit hook then writes its ``exit`` dump, so a
 drained shutdown is post-mortem-distinguishable from a crash
 (docs/postmortem.md).
@@ -33,12 +53,14 @@ from __future__ import annotations
 import json
 import signal
 import threading
+import time
 from typing import Optional
 
 from ..observability import registry as _obs
 from ..utils import env as _env
 from ..utils.logging import get_logger
-from .engine import DrainingError, InferenceEngine, QueueFullError
+from .engine import (DEADLINE_ERROR, DrainingError, InferenceEngine,
+                     QueueFullError)
 
 _log = get_logger("serving.server")
 
@@ -69,11 +91,17 @@ class ServingServer:
         self._stop = threading.Event()
         self._loop_thread: Optional[threading.Thread] = None
         self._http = _http_metrics()
+        # Live /generate handlers: shutdown() must not close the process
+        # under a handler still flushing a drained generation to its
+        # client — that would turn a zero-drop drain into a dropped
+        # response at the socket layer.
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
-            def _reply(self, code: int, payload: dict,
-                       route: str) -> None:
+            def _reply(self, code: int, payload: dict, route: str,
+                       headers: Optional[dict] = None) -> None:
                 # Count BEFORE writing: the client may observe the
                 # response (and assert on the metric) the instant the
                 # body lands.
@@ -82,61 +110,123 @@ class ServingServer:
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
+                    if k.lower() == "connection" \
+                            and str(v).lower() == "close":
+                        self.close_connection = True
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _drop_health(self) -> bool:
+                """drop_health fault (docs/adaptation.md): hang up on
+                the probe without any status line — the supervisor's
+                probe timeout, not the HTTP code, must catch it."""
+                inj = outer.engine._inj
+                if inj is not None and inj.drop_health_active():
+                    self.close_connection = True
+                    return True
+                return False
+
             def do_GET(self):  # noqa: N802 (http.server API)
-                if self.path.split("?")[0] != "/healthz":
-                    self._reply(404, {"error": "not found"}, "other")
-                    return
+                path = self.path.split("?")[0]
                 eng = outer.engine
-                if outer._stop.is_set():
-                    self._reply(503, {"status": "draining"}, "healthz")
+                if path == "/healthz":
+                    if self._drop_health():
+                        return
+                    # Liveness: 200 even while draining — the process
+                    # is alive and finishing promised work.
+                    self._reply(200, {
+                        "status": ("draining" if outer._stop.is_set()
+                                   else "serving"),
+                        "active_requests": eng.active_count,
+                        "queue_depth": eng.queue_depth,
+                        "batch_slots": eng.config.max_batch_slots,
+                        "kv_blocks_free": eng._alloc.free,
+                        "kv_blocks_total": eng._alloc.total,
+                    }, "healthz")
                     return
-                self._reply(200, {
-                    "status": "serving",
-                    "active_requests": eng.active_count,
-                    "queue_depth": eng.queue_depth,
-                    "batch_slots": eng.config.max_batch_slots,
-                    "kv_blocks_free": eng._alloc.free,
-                    "kv_blocks_total": eng._alloc.total,
-                }, "healthz")
+                if path == "/readyz":
+                    if self._drop_health():
+                        return
+                    if outer._stop.is_set():
+                        self._reply(503, {"status": "draining"},
+                                    "readyz",
+                                    headers={"Connection": "close"})
+                    else:
+                        self._reply(200, {"status": "ready"}, "readyz")
+                    return
+                self._reply(404, {"error": "not found"}, "other")
 
             def do_POST(self):  # noqa: N802 (http.server API)
                 if self.path.split("?")[0] != "/generate":
                     self._reply(404, {"error": "not found"}, "other")
                     return
+                with outer._inflight_lock:
+                    outer._inflight += 1
+                try:
+                    self._generate()
+                finally:
+                    with outer._inflight_lock:
+                        outer._inflight -= 1
+
+            def _generate(self) -> None:
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     body = json.loads(self.rfile.read(n) or b"{}")
                     tokens = body["tokens"]
                     if not isinstance(tokens, list):
                         raise ValueError("'tokens' must be a list")
-                except (KeyError, ValueError, json.JSONDecodeError) as e:
+                    stream = bool(body.get("stream", False))
+                    deadline_ms = body.get(
+                        "deadline_ms",
+                        self.headers.get("X-Request-Deadline-Ms"))
+                    deadline_s = None if deadline_ms in (None, "") \
+                        else float(deadline_ms) / 1e3
+                except (KeyError, ValueError, TypeError,
+                        json.JSONDecodeError) as e:
                     self._reply(400, {"error": f"bad request: {e}"},
+                                "generate")
+                    return
+                if deadline_s is not None and deadline_s <= 0:
+                    self._reply(504, {"error": DEADLINE_ERROR},
                                 "generate")
                     return
                 try:
                     req = outer.engine.submit(
                         tokens,
                         max_new_tokens=body.get("max_new_tokens"),
-                        temperature=body.get("temperature"))
+                        temperature=body.get("temperature"),
+                        deadline_s=deadline_s)
                 except QueueFullError as e:
-                    self._reply(429, {"error": str(e)}, "generate")
+                    self._reply(429, {"error": str(e)}, "generate",
+                                headers={"Retry-After":
+                                         outer.engine.retry_after_s()})
                     return
                 except DrainingError as e:
-                    self._reply(503, {"error": str(e)}, "generate")
+                    # Draining: this replica will never take the
+                    # request — close the connection so clients (and
+                    # the router) re-resolve instead of reusing a
+                    # socket into a dying server.
+                    self._reply(503, {"error": str(e)}, "generate",
+                                headers={"Connection": "close"})
                     return
                 except ValueError as e:
                     self._reply(400, {"error": str(e)}, "generate")
                     return
+                wait_s = REQUEST_TIMEOUT_S if deadline_s is None \
+                    else min(REQUEST_TIMEOUT_S, deadline_s + 5.0)
+                if stream:
+                    self._stream(req, wait_s)
+                    return
                 try:
-                    out = req.result(timeout=REQUEST_TIMEOUT_S)
+                    out = req.result(timeout=wait_s)
                 except TimeoutError as e:
                     self._reply(504, {"error": str(e)}, "generate")
                     return
                 except RuntimeError as e:
-                    self._reply(503, {"error": str(e)}, "generate")
+                    code = 504 if DEADLINE_ERROR in str(e) else 503
+                    self._reply(code, {"error": str(e)}, "generate")
                     return
                 self._reply(200, {
                     "id": req.id,
@@ -145,6 +235,53 @@ class ServingServer:
                     "latency_ms": round(
                         (req.t_done - req.t_submit) * 1e3, 3),
                 }, "generate")
+
+            def _stream(self, req, wait_s: float) -> None:
+                """NDJSON token stream: header line, one line per
+                token as it lands, terminal ``done`` line. No
+                Content-Length — the close is the framing."""
+                outer._http.labels(route="generate", code="200").inc()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Cache-Control", "no-store")
+                self.close_connection = True
+                self.end_headers()
+
+                def line(obj) -> None:
+                    self.wfile.write(json.dumps(obj).encode() + b"\n")
+                    self.wfile.flush()
+
+                t_end = time.monotonic() + wait_s
+                try:
+                    line({"id": req.id})
+                    idx = 0
+                    while True:
+                        fresh = req.next_tokens(
+                            idx, timeout=max(0.0,
+                                             t_end - time.monotonic()))
+                        for t in fresh:
+                            line({"t": int(t)})
+                        idx += len(fresh)
+                        if req.done and not fresh:
+                            break
+                    meta = {"done": True, "status": req.status,
+                            "n": idx}
+                    if req.status == "completed":
+                        meta["ttft_ms"] = round(req.ttft_s * 1e3, 3)
+                        meta["latency_ms"] = round(
+                            (req.t_done - req.t_submit) * 1e3, 3)
+                    else:
+                        meta["error"] = req.error
+                    line(meta)
+                except TimeoutError:
+                    line({"done": True, "status": "failed",
+                          "error": "stream timed out", "n": idx})
+                except (BrokenPipeError, ConnectionResetError,
+                        OSError):
+                    # Client hung up mid-stream; the generation keeps
+                    # decoding (its slot finishes normally) — nothing
+                    # to clean up here.
+                    pass
 
             def log_message(self, *args):  # silence per-request stderr
                 pass
@@ -164,8 +301,8 @@ class ServingServer:
         self._loop_thread = threading.Thread(
             target=self._loop, name="hvd-tpu-serving-sched", daemon=True)
         self._loop_thread.start()
-        _log.info("serving on :%d (/generate, /healthz); metrics on the "
-                  "registry endpoint", self.port)
+        _log.info("serving on :%d (/generate, /healthz, /readyz); "
+                  "metrics on the registry endpoint", self.port)
 
     def _loop(self) -> None:
         eng = self.engine
@@ -198,11 +335,19 @@ class ServingServer:
         self.shutdown()
 
     def shutdown(self) -> None:
-        """Drain (finish live generations, fail queued) and stop."""
+        """Drain (finish every accepted request) and stop."""
         self._stop.set()
         if self._loop_thread is not None:
             self._loop_thread.join(timeout=30.0)
         self.engine.drain()
+        # Let handler threads flush the drained results to their
+        # clients before tearing the listener (and the process) down.
+        t_end = time.monotonic() + 10.0
+        while time.monotonic() < t_end:
+            with self._inflight_lock:
+                if self._inflight == 0:
+                    break
+            time.sleep(0.02)
         self._httpd.shutdown()
         self._httpd.server_close()
         self._http_thread.join(timeout=5.0)

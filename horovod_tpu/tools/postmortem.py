@@ -173,7 +173,13 @@ def _death_phase(dump: RankDump) -> str:
             if str(e.get("fault")) == "crash":
                 return (f"fault injection (crash at enqueue path, tick "
                         f"{e.get('tick')})")
+            if str(e.get("fault")) == "replica_crash":
+                return (f"fault injection (serving replica crash at "
+                        f"decode tick {e.get('tick')})")
             break
+        if kind == "serving":
+            return (f"serving ({e.get('event')}, "
+                    f"{e.get('active')} request(s) in flight)")
         if kind == "step_end":
             return f"between steps (step {e.get('idx')} completed)"
         if kind == "step":

@@ -699,7 +699,7 @@ def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
 
 
 def checkpoint_hook(directory=None, *, engine=None, model=None,
-                    optimizer=None, every: int = 100):
+                    optimizer=None, every: int = 100, extra=None):
     """Async save hook for the torch training loop on the sharded
     checkpoint engine (docs/checkpoint.md).
 
@@ -714,6 +714,11 @@ def checkpoint_hook(directory=None, *, engine=None, model=None,
     nested dicts, so no template is needed — then
     ``model.load_state_dict``/``optimizer.load_state_dict`` with
     re-tensorized leaves.
+
+    ``extra`` is a JSON-able dict recorded in every commit's manifest —
+    pass ``serving.transformer_extra(cfg)`` (plus matching state-dict
+    keys, docs/serving.md#torch) to make the checkpoint directly
+    servable by ``python -m horovod_tpu.serving --framework torch``.
     """
     if (directory is None) == (engine is None):
         raise ValueError("pass exactly one of directory= or engine=")
@@ -748,7 +753,7 @@ def checkpoint_hook(directory=None, *, engine=None, model=None,
         if not tree:
             raise ValueError("checkpoint_hook needs model= and/or "
                              "optimizer=")
-        return engine.save(tree, step=step, block=block)
+        return engine.save(tree, step=step, block=block, extra=extra)
 
     save.engine = engine
     return save

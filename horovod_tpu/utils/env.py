@@ -326,6 +326,36 @@ def serving_queue() -> int:
     return int(v)
 
 
+def replica_id() -> Optional[int]:
+    """This process's serving-fleet replica id, exported by the fleet
+    supervisor (docs/serving.md#fleet): blackbox dumps are named
+    ``blackbox-rank{replica}.jsonl`` and fault-spec ``rank=`` clauses
+    target it. None outside a fleet."""
+    v = _get("REPLICA_ID")
+    if v in (None, ""):
+        return None
+    return int(v)
+
+
+def fleet_probe_interval_secs() -> float:
+    """Cadence of the fleet supervisor's replica health probes and the
+    router's queue-gauge scrapes (docs/serving.md#fleet)."""
+    v = _get("FLEET_PROBE_INTERVAL")
+    if v in (None, ""):
+        return 0.25
+    return float(v)
+
+
+def fleet_probe_failures() -> int:
+    """Consecutive failed health probes before the supervisor declares
+    a replica dead and restarts it (crash-via-process-exit is detected
+    immediately; this catches the hung-but-alive case)."""
+    v = _get("FLEET_PROBE_FAILURES")
+    if v in (None, ""):
+        return 4
+    return int(v)
+
+
 def timeline_mark_cycles() -> bool:
     return _get("TIMELINE_MARK_CYCLES") not in (None, "", "0")
 

@@ -1,0 +1,429 @@
+"""Fleet router unit tests against STUB replicas (docs/serving.md#fleet):
+queue-depth-aware admission scoring, draining-replica exclusion,
+deadline expiry → 504 without retry, and both failover shapes —
+pre-first-token re-prefill and mid-stream resume — all over real HTTP
+but with no model and no jax compute. The full-fleet acceptance e2e
+(real replicas, injected crashes, postmortem) is test_fleet_e2e.py
+(slow tier)."""
+
+import http.client
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.observability import metrics_snapshot
+from horovod_tpu.serving.fleet import ReplicaEndpoint
+from horovod_tpu.serving.router import (ReplicaView, Router,
+                                        StaticBackends, pick_replica)
+
+# Deterministic stub "generation": token i of a reply to a prompt of
+# length L is (L + i) % 97. Crucially suffix-consistent: re-prefilling
+# prompt+emitted continues the exact sequence — the same contract
+# greedy decode gives the real router.
+
+
+def stub_tokens(prompt_len: int, n: int):
+    return [(prompt_len + i) % 97 for i in range(n)]
+
+
+class StubReplica:
+    """A fake serving replica: /readyz, /healthz (scrape fallback),
+    /generate streaming the deterministic stub sequence. Behavior
+    knobs are plain attributes, mutable mid-test."""
+
+    def __init__(self, queue_depth=0, active=0, slots=8, ready=True,
+                 die_after=None, reject=None, token_delay_s=0.0):
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+        self.queue_depth = queue_depth
+        self.active = active
+        self.slots = slots
+        self.ready = ready
+        self.die_after = die_after      # close stream after N tokens
+        self.reject = reject            # HTTP code to refuse with
+        self.token_delay_s = token_delay_s
+        self.requests = []              # bodies of /generate calls
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _json(self, code, payload, headers=None):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                path = self.path.split("?")[0]
+                if path == "/readyz":
+                    if outer.ready:
+                        self._json(200, {"status": "ready"})
+                    else:
+                        self._json(503, {"status": "draining"})
+                elif path == "/healthz":
+                    self._json(200, {
+                        "status": "serving",
+                        "queue_depth": outer.queue_depth,
+                        "active_requests": outer.active,
+                        "batch_slots": outer.slots,
+                    })
+                else:
+                    self._json(404, {})
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                outer.requests.append(body)
+                if outer.reject:
+                    self._json(outer.reject,
+                               {"error": f"stub {outer.reject}"},
+                               headers={"Retry-After": 1}
+                               if outer.reject == 429 else None)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/x-ndjson")
+                self.end_headers()
+                self.wfile.write(b'{"id": 0}\n')
+                toks = stub_tokens(len(body["tokens"]),
+                                   int(body["max_new_tokens"]))
+                for i, t in enumerate(toks):
+                    if outer.die_after is not None \
+                            and i >= outer.die_after:
+                        # Mid-stream death: hang up with no done line.
+                        self.wfile.flush()
+                        self.connection.close()
+                        return
+                    if outer.token_delay_s:
+                        time.sleep(outer.token_delay_s)
+                    self.wfile.write(
+                        json.dumps({"t": t}).encode() + b"\n")
+                    self.wfile.flush()
+                self.wfile.write(json.dumps(
+                    {"done": True, "status": "completed",
+                     "n": len(toks), "ttft_ms": 1.0,
+                     "latency_ms": 2.0}).encode() + b"\n")
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def _router(stubs, **kw):
+    backends = StaticBackends([
+        ReplicaEndpoint(index=i, host="127.0.0.1", port=s.port)
+        for i, s in enumerate(stubs)])
+    kw.setdefault("scrape_interval_s", 0.05)
+    r = Router(backends, port=0, host="127.0.0.1", **kw)
+    r.start()
+    return r
+
+
+def _post(port, body, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port,
+                                      timeout=timeout)
+    conn.request("POST", "/generate", json.dumps(body),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    return resp.status, json.loads(resp.read())
+
+
+def _counter(name, labels):
+    fam = metrics_snapshot().get(name, {"values": {}})["values"]
+    return fam.get(labels, 0)
+
+
+class TestRoutingPolicy:
+    """pick_replica in isolation — the pure scoring function."""
+
+    def _views(self, *specs):
+        out = []
+        for i, (ready, q, a, s) in enumerate(specs):
+            out.append(ReplicaView(
+                endpoint=ReplicaEndpoint(index=i, host="h", port=i),
+                ready=ready, ok=True, queue_depth=q, active=a,
+                slots=s))
+        return out
+
+    def test_lowest_outstanding_work_per_slot_wins(self):
+        views = self._views((True, 4, 8, 8),    # score 1.5
+                            (True, 0, 2, 8),    # score 0.25  ← winner
+                            (True, 0, 6, 8))    # score 0.75
+        assert pick_replica(views).endpoint.index == 1
+
+    def test_queue_depth_dominates_when_slots_full(self):
+        views = self._views((True, 9, 8, 8),
+                            (True, 1, 8, 8))    # same active, shorter q
+        assert pick_replica(views).endpoint.index == 1
+
+    def test_draining_replica_excluded(self):
+        views = self._views((False, 0, 0, 8),   # idle but draining
+                            (True, 5, 8, 8))
+        assert pick_replica(views).endpoint.index == 1
+
+    def test_unscraped_replica_not_routed_blind(self):
+        views = self._views((True, 0, 0, 8), (True, 5, 8, 8))
+        views[0].ok = False                      # no successful scrape
+        assert pick_replica(views).endpoint.index == 1
+
+    def test_exclusion_and_nobody_left(self):
+        views = self._views((True, 0, 0, 8), (True, 0, 0, 8))
+        assert pick_replica(views, exclude={0}).endpoint.index == 1
+        assert pick_replica(views, exclude={0, 1}) is None
+
+    def test_tie_breaks_round_robin(self):
+        views = self._views((True, 0, 0, 8), (True, 0, 0, 8))
+        picked = {pick_replica(views, rr=r).endpoint.index
+                  for r in (0, 1)}
+        assert picked == {0, 1}
+
+
+class TestRouterHTTP:
+    def test_routes_to_least_loaded_and_completes(self):
+        busy = StubReplica(queue_depth=6, active=8)
+        idle = StubReplica(queue_depth=0, active=1)
+        router = _router([busy, idle])
+        try:
+            status, body = _post(router.port,
+                                 {"tokens": [1, 2, 3],
+                                  "max_new_tokens": 5})
+            assert status == 200
+            assert body["tokens"] == stub_tokens(3, 5)
+            assert body["replica"] == 1 and body["retries"] == 0
+            assert len(idle.requests) == 1 and not busy.requests
+            # the replica saw the router's streaming dialect
+            assert idle.requests[0]["stream"] is True
+        finally:
+            router.shutdown()
+            busy.stop()
+            idle.stop()
+
+    def test_draining_replica_gets_no_traffic(self):
+        draining = StubReplica(ready=False)          # readyz 503
+        ready = StubReplica(queue_depth=3, active=8)  # busy but ready
+        router = _router([draining, ready])
+        try:
+            for _ in range(3):
+                status, _ = _post(router.port,
+                                  {"tokens": [5], "max_new_tokens": 2})
+                assert status == 200
+            assert not draining.requests
+            assert len(ready.requests) == 3
+        finally:
+            router.shutdown()
+            draining.stop()
+            ready.stop()
+
+    def test_deadline_expired_is_504_without_retry(self):
+        stub = StubReplica()
+        router = _router([stub])
+        try:
+            before = _counter("hvdtpu_fleet_requests_total",
+                              'outcome="expired"')
+            status, body = _post(router.port,
+                                 {"tokens": [1], "max_new_tokens": 4,
+                                  "deadline_ms": -1})
+            assert status == 504
+            assert "deadline" in body["error"]
+            assert not stub.requests       # never dispatched, no retry
+            assert _counter("hvdtpu_fleet_requests_total",
+                            'outcome="expired"') == before + 1
+        finally:
+            router.shutdown()
+            stub.stop()
+
+    def test_failover_before_first_token(self):
+        """A dead backend (connection refused) is transparently
+        retried on the healthy one — the client sees one clean 200."""
+        dead_port = socket.socket()
+        dead_port.bind(("127.0.0.1", 0))
+        port = dead_port.getsockname()[1]
+        dead_port.close()                  # nothing listens here now
+        alive = StubReplica(queue_depth=5, active=8)  # worse score
+        backends = StaticBackends([
+            ReplicaEndpoint(index=0, host="127.0.0.1", port=port),
+            ReplicaEndpoint(index=1, host="127.0.0.1",
+                            port=alive.port)])
+        router = Router(backends, port=0, host="127.0.0.1",
+                        scrape_interval_s=0.05)
+        # Hand-plant a stale-but-ready view of the dead backend so the
+        # router genuinely dispatches to it first (a real crash window:
+        # the replica died after the last scrape).
+        router._scrape_cycle()
+        v = router._views[0]
+        v.ready = v.ok = True
+        v.queue_depth = v.active = 0.0
+        router._http_thread.start()
+        try:
+            before = _counter("hvdtpu_fleet_failovers_total",
+                              'phase="prefill"')
+            status, body = _post(router.port,
+                                 {"tokens": [7, 8], "max_new_tokens": 3})
+            assert status == 200
+            assert body["tokens"] == stub_tokens(2, 3)
+            assert body["retries"] >= 1
+            assert _counter("hvdtpu_fleet_failovers_total",
+                            'phase="prefill"') >= before + 1
+        finally:
+            router._stop.set()
+            router._httpd.shutdown()
+            router._httpd.server_close()
+            alive.stop()
+
+    def test_midstream_death_resumes_seamlessly(self):
+        """Replica 0 dies after 3 tokens (stream breaks, no done
+        line); the router re-prefills prompt+emitted on replica 1 and
+        the client's assembled output is identical to an uncontended
+        run."""
+        flaky = StubReplica(die_after=3)               # preferred: idle
+        backup = StubReplica(queue_depth=2, active=4)
+        router = _router([flaky, backup])
+        try:
+            before = _counter("hvdtpu_fleet_failovers_total",
+                              'phase="midstream"')
+            status, body = _post(router.port,
+                                 {"tokens": [1, 2, 3, 4],
+                                  "max_new_tokens": 8})
+            assert status == 200
+            assert body["tokens"] == stub_tokens(4, 8)   # seamless
+            assert body["retries"] >= 1
+            # the resume carried prompt+emitted and the REMAINING budget
+            resume = backup.requests[-1]
+            assert resume["tokens"] == [1, 2, 3, 4] + stub_tokens(4, 3)
+            assert resume["max_new_tokens"] == 5
+            assert _counter("hvdtpu_fleet_failovers_total",
+                            'phase="midstream"') >= before + 1
+        finally:
+            router.shutdown()
+            flaky.stop()
+            backup.stop()
+
+    def test_midstream_resume_streams_to_client(self):
+        """Same failover, but the CLIENT is streaming: the token lines
+        it reads across the replica death form the uninterrupted
+        sequence, ending in one done line."""
+        flaky = StubReplica(die_after=2)
+        backup = StubReplica(queue_depth=2, active=4)
+        router = _router([flaky, backup])
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                              timeout=30)
+            conn.request("POST", "/generate",
+                         json.dumps({"tokens": [9, 9, 9],
+                                     "max_new_tokens": 6,
+                                     "stream": True}),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 200
+            lines = [json.loads(ln) for ln in resp.read().splitlines()
+                     if ln.strip()]
+            assert "id" in lines[0]
+            toks = [ln["t"] for ln in lines[1:-1]]
+            assert toks == stub_tokens(3, 6)
+            assert lines[-1]["done"] and \
+                lines[-1]["status"] == "completed"
+            assert lines[-1]["retries"] >= 1
+        finally:
+            router.shutdown()
+            flaky.stop()
+            backup.stop()
+
+    def test_fleet_wide_queue_full_gives_up_with_retry_after(self):
+        stubs = [StubReplica(reject=429), StubReplica(reject=429)]
+        router = _router([s for s in stubs], max_attempts=3)
+        try:
+            before = _counter("hvdtpu_fleet_retries_total",
+                              'reason="queue_full"')
+            status, body = _post(router.port,
+                                 {"tokens": [1], "max_new_tokens": 2})
+            assert status == 503
+            assert _counter("hvdtpu_fleet_retries_total",
+                            'reason="queue_full"') > before
+        finally:
+            router.shutdown()
+            for s in stubs:
+                s.stop()
+
+    def test_router_health_and_ready_endpoints(self):
+        stub = StubReplica(queue_depth=2, active=3)
+        router = _router([stub])
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                              timeout=10)
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            h = json.loads(resp.read())
+            assert resp.status == 200 and h["ready_replicas"] == 1
+            assert h["replicas"][0]["queue_depth"] == 2
+            conn.request("GET", "/readyz")
+            assert conn.getresponse().status == 200
+            stub.ready = False
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                conn.request("GET", "/readyz")
+                r = conn.getresponse()
+                r.read()
+                if r.status == 503:
+                    break
+                time.sleep(0.05)
+            assert r.status == 503
+        finally:
+            router.shutdown()
+            stub.stop()
+
+
+class TestServingFaultGrammar:
+    """The serving clauses of HOROVOD_TPU_FAULT_SPEC parse, repr and
+    window like the training ones (docs/adaptation.md)."""
+
+    def test_parse_serving_clauses(self):
+        from horovod_tpu.adaptation.faults import parse_spec
+        cs = parse_spec("rank=1:replica_crash_at=30:gen=0; "
+                        "rank=*:slow_decode=50ms:from_step=5; "
+                        "rank=2:slow_prefill=200ms; "
+                        "rank=0:drop_health:from_step=3:until_step=9")
+        assert cs[0].replica_crash_at == 30 and cs[0].gen == 0
+        assert cs[1].slow_decode_s == pytest.approx(0.05)
+        assert cs[1].rank is None and cs[1].from_step == 5
+        assert cs[2].slow_prefill_s == pytest.approx(0.2)
+        assert cs[3].drop_health and not cs[3].in_window(2)
+        assert cs[3].in_window(3) and not cs[3].in_window(9)
+        # round-trips through repr for the log line
+        assert "replica_crash_at=30" in repr(cs[0])
+        assert "slow_decode=50ms" in repr(cs[1])
+
+    def test_bad_serving_fields_fail_loudly(self):
+        from horovod_tpu.adaptation.faults import parse_spec
+        with pytest.raises(ValueError, match="drop_health"):
+            parse_spec("rank=0:drop_health=nope")
+        with pytest.raises(ValueError, match="unknown fault-spec"):
+            parse_spec("rank=0:replica_crash=5")
+
+    def test_replica_id_targets_injector_rank(self, monkeypatch):
+        from horovod_tpu.adaptation import faults
+        monkeypatch.setenv("HOROVOD_TPU_FAULT_SPEC",
+                           "rank=2:slow_decode=1ms")
+        monkeypatch.setenv("HOROVOD_TPU_REPLICA_ID", "2")
+        faults.reset()
+        try:
+            inj = faults.injector()
+            assert inj is not None and inj.rank == 2
+            monkeypatch.setenv("HOROVOD_TPU_REPLICA_ID", "1")
+            faults.reset()
+            assert faults.injector() is None   # targets replica 2 only
+        finally:
+            faults.reset()

@@ -7,6 +7,7 @@ SIGTERM drain live in test_serving_e2e.py (slow tier)."""
 import http.client
 import json
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -357,7 +358,10 @@ class TestSchedulerEdges:
         assert out == probe[:out.index(eos) + 1]
         assert out[-1] == eos and len(out) < 8
 
-    def test_drain_finishes_active_fails_queued(self, model, mesh1):
+    def test_drain_completes_accepted_rejects_new(self, model, mesh1):
+        """Acceptance is a promise: drain finishes BOTH the live slot
+        and the still-queued request (zero requests dropped by a
+        drain) and only refuses submissions made after it began."""
         cfg, params = model
         eng = _engine(params, cfg, mesh1, max_batch_slots=1)
         active = eng.submit([7] * 4, max_new_tokens=4)
@@ -365,7 +369,7 @@ class TestSchedulerEdges:
         eng.step()   # admit the first
         eng.drain()
         assert active.status == "completed" and len(active.result()) == 4
-        assert queued.status == "failed" and "draining" in queued.error
+        assert queued.status == "completed" and len(queued.result()) == 4
         with pytest.raises(DrainingError):
             eng.submit([9] * 4)
 
@@ -376,6 +380,158 @@ class TestSchedulerEdges:
             eng = _engine(params, cfg, mesh1, temperature=1.0, seed=3)
             outs.append(eng.generate([5, 6, 7], max_new_tokens=6))
         assert outs[0] == outs[1]   # same seed, same stream
+
+    def test_expired_deadline_fails_at_admission(self, model, mesh1):
+        """A queued request whose deadline already passed is failed
+        with DEADLINE_ERROR instead of being admitted (the router maps
+        this to 504 and never retries it)."""
+        from horovod_tpu.serving import DEADLINE_ERROR
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1)
+        live = eng.submit([1, 2, 3], max_new_tokens=2)
+        expired = eng.submit([4, 5, 6], deadline_s=-0.001)
+        eng.run_until_idle()
+        assert live.status == "completed"
+        assert expired.status == "failed" \
+            and expired.error == DEADLINE_ERROR
+        with pytest.raises(RuntimeError, match="deadline"):
+            expired.result()
+
+    def test_next_tokens_streams_incrementally(self, model, mesh1):
+        """The token-watch consumer sees every token, in order, across
+        prefill + decode steps — the primitive the streaming HTTP path
+        and the router's mid-stream resume are built on."""
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1)
+        req = eng.submit([3, 1, 4], max_new_tokens=5)
+        got = []
+        steps = 0
+        while not (req.done and len(got) == len(req.tokens)):
+            if not req.done:
+                eng.step()
+                steps += 1
+                assert steps < 100
+            got.extend(req.next_tokens(len(got), timeout=5.0))
+        assert got == req.result()
+        assert req.next_tokens(len(got), timeout=0.5) == []  # terminal
+
+    def test_retry_after_tracks_drain_rate(self, model, mesh1):
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1, max_queue=16)
+        assert eng.retry_after_s() == 1    # cold: no completions yet
+        for _ in range(4):
+            eng.generate([1, 2], max_new_tokens=2)
+        # 4 completions in the 10 s window → 0.4/s; no backlog → 1 s
+        assert eng.retry_after_s() == 1
+        for _ in range(8):                 # backlog, scheduler parked
+            eng.submit([1, 2], max_new_tokens=2)
+        # ceil(8 outstanding / 0.4 per s) = 20 s
+        assert eng.retry_after_s() == 20
+        eng.run_until_idle()
+
+
+class TestDrainPrefillRace:
+    def test_sigterm_during_slow_prefill_drains_accepted(
+            self, model, mesh1, monkeypatch):
+        """Regression (fleet PR): a drain beginning while an admitted
+        request is mid-PREFILL must also complete the request still
+        queued behind it — under the old fail-the-queue drain, whether
+        that second request survived depended on scheduler timing. The
+        slow_prefill fault pins the race window open
+        deterministically."""
+        from horovod_tpu.adaptation import faults
+        cfg, params = model
+        monkeypatch.setenv("HOROVOD_TPU_FAULT_SPEC",
+                           "rank=0:slow_prefill=300ms")
+        monkeypatch.setenv("HOROVOD_TPU_REPLICA_ID", "0")
+        faults.reset()
+        try:
+            eng = _engine(params, cfg, mesh1, max_batch_slots=1)
+            assert eng._inj is not None
+            r1 = eng.submit([7] * 4, max_new_tokens=4)
+            r2 = eng.submit([8] * 4, max_new_tokens=4)
+            stop = threading.Event()
+
+            def loop():   # the serving scheduler thread
+                while not stop.is_set():
+                    if not eng.step():
+                        time.sleep(0.005)
+
+            t = threading.Thread(target=loop, daemon=True)
+            t.start()
+            time.sleep(0.05)   # r1's 300 ms prefill is now in flight
+            eng.drain()        # "SIGTERM" lands mid-prefill
+            stop.set()
+            t.join(timeout=10)
+            assert r1.status == "completed" and len(r1.result()) == 4
+            assert r2.status == "completed" and len(r2.result()) == 4
+            snap = hvd.metrics_snapshot()
+            fired = snap["hvdtpu_fault_injections_total"]["values"].get(
+                'kind="slow_prefill"', 0)
+            assert fired >= 1   # the race window was genuinely open
+        finally:
+            faults.reset()
+
+
+class TestTorchServingPath:
+    def test_torch_checkpoint_serves_through_manifest(
+            self, tmp_path, model, mesh1):
+        """--framework torch wiring: a checkpoint committed by
+        torch.checkpoint_hook (model subtree + optimizer noise, arch
+        in the manifest extra) loads bit-exact through
+        load_params(key_prefix=TORCH_MODEL_PREFIX) and decodes
+        identically to the jax-native engine."""
+        torch = pytest.importorskip("torch")
+        import horovod_tpu.torch as hvd_torch
+        from horovod_tpu.serving import TORCH_MODEL_PREFIX
+
+        cfg, params = model
+        # A torch training job whose state dict mirrors the flagship
+        # tree (the documented contract, docs/serving.md#torch).
+        host = jax.tree_util.tree_map(
+            lambda x: torch.from_numpy(np.asarray(x).copy()), params)
+
+        class Model:
+            def state_dict(self):
+                return host
+
+        class Opt:
+            def state_dict(self):   # optimizer leaves must be skipped
+                return {"state": {"momentum":
+                                  torch.zeros(cfg.d_model)}}
+
+        save = hvd_torch.checkpoint_hook(
+            str(tmp_path), model=Model(), optimizer=Opt(), every=1,
+            extra=transformer_extra(cfg))
+        save(3, block=True)
+
+        man = CheckpointEngine(str(tmp_path)).restore_manifest()
+        assert man["step"] == 3
+        scfg = serving_config(config_from_manifest(man), mesh1)
+        loaded = load_params(str(tmp_path), scfg, mesh1,
+                             key_prefix=TORCH_MODEL_PREFIX)
+        for a, b in zip(jax.tree_util.tree_leaves(loaded),
+                        jax.tree_util.tree_leaves(params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        out = _engine(loaded, scfg, mesh1).generate(
+            [1, 2, 3], max_new_tokens=4)
+        ref = _engine(params, cfg, mesh1).generate(
+            [1, 2, 3], max_new_tokens=4)
+        assert out == ref
+
+    def test_unprefixed_load_still_rejects_unknown_leaves(
+            self, tmp_path, model, mesh1):
+        """The torch subtree-select must not weaken the jax path: a
+        checkpoint with foreign leaves and no prefix fails loudly."""
+        cfg, params = model
+        eng = CheckpointEngine(str(tmp_path), process_count=1,
+                               barrier=lambda n: None)
+        eng.save({"not_params": np.zeros(3)}, 1, block=True,
+                 extra=transformer_extra(cfg))
+        man = eng.restore_manifest()
+        scfg = serving_config(config_from_manifest(man), mesh1)
+        with pytest.raises(KeyError, match="param_specs"):
+            load_params(str(tmp_path), scfg, mesh1)
 
 
 class TestServingMetrics:
@@ -447,9 +603,10 @@ class TestServerHTTP:
         conn.request("GET", "/nothing")
         assert conn.getresponse().status == 404
 
-    def test_queue_full_is_429(self, model, mesh1):
+    def test_queue_full_is_429_with_retry_after(self, model, mesh1):
         """Saturate the bounded queue with the scheduler loop parked
-        (server never started) — the next HTTP submit must 429."""
+        (server never started) — the next HTTP submit must 429, with a
+        Retry-After hint derived from the queue drain rate."""
         from horovod_tpu.serving.server import ServingServer
         cfg, params = model
         eng = _engine(params, cfg, mesh1, max_queue=1)
@@ -457,12 +614,103 @@ class TestServerHTTP:
         srv._http_thread.start()   # HTTP only: no scheduler drains
         try:
             eng.submit([1, 2, 3])          # fills the queue
-            status, body = self._post(srv.port, {"tokens": [4, 5, 6]})
-            assert status == 429 and "queue full" in body["error"]
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=30)
+            conn.request("POST", "/generate",
+                         json.dumps({"tokens": [4, 5, 6]}),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+            assert resp.status == 429 and "queue full" in body["error"]
+            assert int(resp.getheader("Retry-After")) >= 1
             snap = hvd.metrics_snapshot()
             assert snap["hvdtpu_serving_http_requests_total"]["values"][
                 'code="429",route="generate"'] >= 1
         finally:
             eng.run_until_idle()
+            srv._httpd.shutdown()
+            srv._httpd.server_close()
+
+    def test_streaming_generate_matches_unary(self, served, model,
+                                              mesh1):
+        """"stream": true returns NDJSON token lines whose assembled
+        sequence equals the unary reply for the same prompt."""
+        cfg, params = model
+        eng, srv = served
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=120)
+        conn.request("POST", "/generate",
+                     json.dumps({"tokens": [2, 7, 1], "stream": True}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type") == "application/x-ndjson"
+        lines = [json.loads(ln) for ln in resp.read().splitlines()
+                 if ln.strip()]
+        assert "id" in lines[0]
+        done = lines[-1]
+        assert done["done"] and done["status"] == "completed"
+        toks = [ln["t"] for ln in lines[1:-1]]
+        assert done["n"] == len(toks)
+        status, unary = self._post(srv.port, {"tokens": [2, 7, 1]})
+        assert status == 200 and unary["tokens"] == toks
+
+    def test_expired_deadline_is_504(self, served):
+        _, srv = served
+        status, body = self._post(
+            srv.port, {"tokens": [1, 2], "deadline_ms": 0})
+        assert status == 504 and "deadline" in body["error"]
+
+    def test_readyz_flips_on_drain_healthz_stays_live(self, model,
+                                                      mesh1):
+        """Liveness/readiness split: once a drain is requested,
+        /readyz answers 503 (the router stops admitting) while
+        /healthz stays 200 — a supervisor must not shoot a replica
+        that is cleanly finishing promised work."""
+        from horovod_tpu.serving.server import ServingServer
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1)
+        srv = ServingServer(eng, port=0, host="127.0.0.1")
+        srv._http_thread.start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=30)
+            conn.request("GET", "/readyz")
+            r = conn.getresponse()
+            assert r.status == 200 and \
+                json.loads(r.read())["status"] == "ready"
+            srv._stop.set()                    # drain requested
+            conn.request("GET", "/readyz")
+            r = conn.getresponse()
+            assert r.status == 503
+            assert r.getheader("Connection") == "close"
+            assert json.loads(r.read())["status"] == "draining"
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=30)
+            conn.request("GET", "/healthz")
+            r = conn.getresponse()
+            assert r.status == 200             # alive, just draining
+            assert json.loads(r.read())["status"] == "draining"
+        finally:
+            srv._httpd.shutdown()
+            srv._httpd.server_close()
+
+    def test_draining_503_carries_connection_close(self, model, mesh1):
+        from horovod_tpu.serving.server import ServingServer
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1)
+        srv = ServingServer(eng, port=0, host="127.0.0.1")
+        srv._http_thread.start()
+        try:
+            eng._draining = True               # drain began
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=30)
+            conn.request("POST", "/generate",
+                         json.dumps({"tokens": [1, 2]}),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 503
+            assert resp.getheader("Connection") == "close"
+        finally:
             srv._httpd.shutdown()
             srv._httpd.server_close()
