@@ -345,7 +345,8 @@ def main(argv=None):
     rows = {}
     for name, (code, extra_env) in ARMS.items():
         if name in selected:
-            rows[name] = run_arm(code, extra_env)
+            rows[name] = dict(run_arm(code, extra_env))
+            rows[name].pop("carried_from_previous_run", None)
         elif name in prior:
             rows[name] = dict(prior[name], carried_from_previous_run=True)
 
@@ -353,6 +354,12 @@ def main(argv=None):
     tp = rows["torch_plain"]
     result = {
         "metric": "framework_shim_throughput",
+        # The re-measured / carried split, pinned at the top level so
+        # the contract test (tests/test_bench_shims_contract.py) can
+        # tell which rows describe THIS machine and which are stale
+        # history (e.g. chip rows carried on a CPU-only box).
+        "measured_arms": sorted(n for n in rows if n in selected),
+        "carried_arms": sorted(n for n in rows if n not in selected),
         "value": (round(k["tok_s"] / j["tok_s_per_call"], 3)
                   if j and k else None),
         "unit": "keras-fit / pure-jax-per-call tok rate",

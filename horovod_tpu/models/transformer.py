@@ -357,37 +357,103 @@ def apply(params, tokens, cfg: TransformerConfig):
 # Block 0 is reserved as a scratch block (serving/kv_cache.py never
 # hands it out): padded or inactive slots write their garbage K/V there,
 # where no live sequence can read it.
+#
+# Quantized pool (``kv_quant``): the same layout with the payload held
+# in int8 / fp8-e4m3 and fp32 absmax scales per channel block — the
+# wire format of quantization.py (EQuARX, arXiv 2506.17615) applied at
+# rest instead of in flight. Scales are per (block, token, head,
+# head_dim-chunk) with the chunk = ``channel_block(head_dim, 256)``, so
+# blocks never straddle heads and a tensor-parallel head shard
+# quantizes bit-identically to the same head at tp=1. Dequantization
+# happens on read, fused into the attention program; the block-table
+# indirection (and with it every allocator/eviction invariant) is
+# untouched.
 
 
-def init_cache(cfg: TransformerConfig, n_blocks: int, block_size: int):
+def _kv_spec(kv_quant):
+    from .. import quantization as q
+    return q.parse(kv_quant)
+
+
+def init_cache(cfg: TransformerConfig, n_blocks: int, block_size: int,
+               kv_quant=None):
     """Zeroed GLOBAL KV pool (shard via :func:`cache_specs`): per layer
     ``{"k", "v"}`` of [n_blocks, block_size, n_heads, head_dim] in the
-    activation dtype."""
+    activation dtype — or, with ``kv_quant`` ("int8"/"fp8"/a WireSpec),
+    the wire-dtype payload plus ``{"ks", "vs"}`` fp32 channel-block
+    scales."""
+    from .. import quantization as q
     hd = cfg.d_model // cfg.n_heads
     shape = (int(n_blocks), int(block_size), cfg.n_heads, hd)
-    return [{"k": jnp.zeros(shape, cfg.dtype),
-             "v": jnp.zeros(shape, cfg.dtype)}
+    spec = _kv_spec(kv_quant)
+    if spec is None:
+        return [{"k": jnp.zeros(shape, cfg.dtype),
+                 "v": jnp.zeros(shape, cfg.dtype)}
+                for _ in range(cfg.n_layers)]
+    qdt = getattr(jnp, spec.wire_dtype)
+    sshape = shape[:3] + (hd // q.channel_block(hd, spec.block_size),)
+    return [{"k": jnp.zeros(shape, qdt), "v": jnp.zeros(shape, qdt),
+             "ks": jnp.ones(sshape, jnp.float32),
+             "vs": jnp.ones(sshape, jnp.float32)}
             for _ in range(cfg.n_layers)]
 
 
-def cache_specs(cfg: TransformerConfig):
+def cache_specs(cfg: TransformerConfig, kv_quant=None):
     """PartitionSpecs for the KV pool — heads over 'tp' (the same axis
     the wq/wk/wv column splits produce the local heads on), block and
-    token dims replicated."""
+    token dims replicated. Quantized pools shard the scales on the same
+    head axis, so each shard's payload travels with its scales."""
     spec = P(None, None, cfg.tp_axis, None)
-    return [{"k": spec, "v": spec} for _ in range(cfg.n_layers)]
+    if _kv_spec(kv_quant) is None:
+        return [{"k": spec, "v": spec} for _ in range(cfg.n_layers)]
+    return [{"k": spec, "v": spec, "ks": spec, "vs": spec}
+            for _ in range(cfg.n_layers)]
 
 
-def _decode_block(params, x, kc, vc, tables, pos, cfg: TransformerConfig):
+def kv_bytes_per_block(cfg: TransformerConfig, block_size: int,
+                       kv_quant=None) -> int:
+    """Resident HBM bytes ONE pool block costs across all layers (K and
+    V, scales included) — what the engine's ``kv_bytes_resident`` gauge
+    multiplies in-use blocks by, and what the 4x-sequences-per-byte
+    claim of the quantized pool is measured against."""
+    from .. import quantization as q
+    hd = cfg.d_model // cfg.n_heads
+    elems = int(block_size) * cfg.n_heads * hd
+    spec = _kv_spec(kv_quant)
+    import numpy as _np
+    if spec is None:
+        per = elems * _np.dtype(cfg.dtype).itemsize
+    else:
+        scales = elems // q.channel_block(hd, spec.block_size)
+        per = elems * 1 + scales * 4
+    return 2 * per * cfg.n_layers
+
+
+def _decode_block(params, x, layer_cache, tables, pos,
+                  cfg: TransformerConfig, kv_spec=None,
+                  exact_chunk: bool = False):
     """One decoder block over the KV cache (shard_map-level, per-shard
-    views: under 'tp' the projections produce local heads and kc/vc hold
-    the matching head shard).
+    views: under 'tp' the projections produce local heads and the cache
+    holds the matching head shard).
 
     x: [B, Q, D] new-token activations; pos: [B, Q] absolute positions;
     tables: [B, T] block ids. Writes this chunk's K/V into the pool,
     then attends causally over everything cached so far (numerics mirror
     :func:`full_attention` so incremental logits match the full-context
-    ``apply`` bit-for-bit up to fp reassociation)."""
+    ``apply`` bit-for-bit up to fp reassociation).
+
+    With ``kv_spec`` the pool holds wire-dtype payload + fp32 channel
+    scales; the write quantizes, the read dequantizes inside this same
+    program. ``exact_chunk`` additionally overwrites THIS chunk's rows
+    of the gathered K/V with the exact pre-quantization values — the
+    prefill mode, making a from-empty prefill bit-identical to the fp32
+    pool (only *past* tokens ever pay quantization error). Decode and
+    speculative verification run with it OFF, so a [slots, k] verify
+    reads the chunk exactly as the [slots, 1] decode path would have
+    re-read it — the greedy token-identity guarantee between the two.
+    """
+    from .. import quantization as quant
+    kc, vc = layer_cache["k"], layer_cache["v"]
     d = cfg.d_model
     tp_n = _axis_size(cfg.tp_axis)
     if cfg.n_heads % tp_n:
@@ -409,20 +475,52 @@ def _decode_block(params, x, kc, vc, tables, pos, cfg: TransformerConfig):
     # (table[p // bs], p % bs). Distinct live sequences own disjoint
     # blocks (the allocator's invariant), so the scatter never collides
     # except on the shared scratch block 0 — whose content is never
-    # visible under the causal mask below.
-    blk = jnp.take_along_axis(tables, pos // bs, axis=1)        # [B, Q]
+    # visible under the causal mask below. Positions past the table
+    # (a speculative chunk overrunning the reserved region) divert to
+    # scratch instead of clobbering a neighbour's block.
+    T = tables.shape[1]
+    blk = jnp.take_along_axis(tables, jnp.minimum(pos // bs, T - 1),
+                              axis=1)                           # [B, Q]
+    blk = jnp.where(pos < T * bs, blk, 0)
     off = pos % bs
-    kc = kc.at[blk, off].set(k.astype(kc.dtype))
-    vc = vc.at[blk, off].set(v.astype(vc.dtype))
+    out_cache = {}
+    if kv_spec is None:
+        kc = kc.at[blk, off].set(k.astype(kc.dtype))
+        vc = vc.at[blk, off].set(v.astype(vc.dtype))
+    else:
+        qk, sk = quant.quantize_channels(k, kv_spec)
+        qv, sv = quant.quantize_channels(v, kv_spec)
+        kc = kc.at[blk, off].set(qk)
+        vc = vc.at[blk, off].set(qv)
+        ks = layer_cache["ks"].at[blk, off].set(sk)
+        vs = layer_cache["vs"].at[blk, off].set(sv)
+        out_cache["ks"], out_cache["vs"] = ks, vs
+    out_cache["k"], out_cache["v"] = kc, vc
 
     # Gather the sequence's pages back in table order — entry j covers
     # positions [j*bs, (j+1)*bs), so the flattened page axis IS the
     # absolute-position axis and the causal mask is a plain arange
     # comparison. Unwritten tail blocks are masked off (their positions
     # exceed every query position).
-    s_pad = tables.shape[1] * bs
-    keys = kc[tables].reshape(b, s_pad, h_local, hd)
-    vals = vc[tables].reshape(b, s_pad, h_local, hd)
+    s_pad = T * bs
+    if kv_spec is None:
+        keys = kc[tables].reshape(b, s_pad, h_local, hd)
+        vals = vc[tables].reshape(b, s_pad, h_local, hd)
+    else:
+        # Dequant-on-read, fused into this attention program: payload
+        # pages and their scales gather through the same table.
+        keys = quant.dequantize_channels(
+            kc[tables], ks[tables], kv_spec).reshape(
+            b, s_pad, h_local, hd).astype(dt)
+        vals = quant.dequantize_channels(
+            vc[tables], vs[tables], kv_spec).reshape(
+            b, s_pad, h_local, hd).astype(dt)
+        if exact_chunk:
+            # Prefill: this chunk's own rows attend at full precision
+            # (mode="drop" skips the scratch-diverted overrun rows).
+            rows = jnp.arange(b)[:, None]
+            keys = keys.at[rows, pos].set(k, mode="drop")
+            vals = vals.at[rows, pos].set(v, mode="drop")
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, keys.astype(q.dtype),
                         preferred_element_type=jnp.float32) * (hd ** -0.5)
     visible = (jnp.arange(s_pad)[None, None, None, :]
@@ -441,23 +539,29 @@ def _decode_block(params, x, kc, vc, tables, pos, cfg: TransformerConfig):
     m = hmid @ params["wo_mlp"].astype(dt)
     if cfg.tp_axis:
         m = lax.psum(m, cfg.tp_axis)
-    return x + m, kc, vc
+    return x + m, out_cache
 
 
 def apply_decode(params, tokens, starts, block_tables, cache,
-                 cfg: TransformerConfig):
+                 cfg: TransformerConfig, kv_quant=None,
+                 exact_chunk: bool = False):
     """Incremental forward through the block-sliced KV cache — the
     serving counterpart of :func:`apply`, sharing its weights and
     :func:`param_specs` (shard_map-level; wrap in shard_map over 'tp'
     for tensor-parallel decode, or call directly on one device).
 
     tokens: [B, Q] int32 — the NEW tokens only (a prompt chunk at
-    prefill, one token per live slot at decode); starts: [B] int32 —
-    absolute position of ``tokens[:, 0]`` per sequence; block_tables:
-    [B, T] int32 block ids (entry j covers positions [j*bs, (j+1)*bs));
-    cache: from :func:`init_cache`. Returns ``(logits, cache)`` with
-    logits [B, Q, vocab] fp32 — at prefill, row ``n-1`` is the
-    first-token distribution; at decode, row 0 is the next-token one.
+    prefill, one token per live slot at decode, the draft chunk at a
+    speculative verify); starts: [B] int32 — absolute position of
+    ``tokens[:, 0]`` per sequence; block_tables: [B, T] int32 block ids
+    (entry j covers positions [j*bs, (j+1)*bs)); cache: from
+    :func:`init_cache`. Returns ``(logits, cache)`` with logits
+    [B, Q, vocab] fp32 — at prefill, row ``n-1`` is the first-token
+    distribution; at decode, row 0 is the next-token one.
+
+    ``kv_quant`` must match the ``init_cache`` the pool was built with;
+    ``exact_chunk`` (prefill only — see :func:`_decode_block`) keeps a
+    from-empty quantized prefill bit-identical to the fp32 pool.
     """
     if cfg.sp_axis:
         raise ValueError(
@@ -468,15 +572,16 @@ def apply_decode(params, tokens, starts, block_tables, cache,
         raise ValueError(
             "apply_decode does not support MoE layers yet; serve a "
             "dense checkpoint (num_experts=0)")
+    kv_spec = _kv_spec(kv_quant)
     dt = cfg.dtype
     b, q_len = tokens.shape
     pos = starts[:, None] + jnp.arange(q_len)[None, :]
     x = params["embed"].astype(dt)[tokens] + params["pos"][pos].astype(dt)
     new_cache = []
     for i, layer in enumerate(params["layers"]):
-        x, kc, vc = _decode_block(layer, x, cache[i]["k"], cache[i]["v"],
-                                  block_tables, pos, cfg)
-        new_cache.append({"k": kc, "v": vc})
+        x, out = _decode_block(layer, x, cache[i], block_tables, pos,
+                               cfg, kv_spec, exact_chunk)
+        new_cache.append(out)
     h = _layernorm(x, params["ln_f"])
     return _project_logits(params, h, cfg), new_cache
 

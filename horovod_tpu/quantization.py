@@ -153,6 +153,51 @@ def local_roundtrip(x, spec: Union[str, WireSpec]):
     return out.reshape(x.shape).astype(dt)
 
 
+def channel_block(n: int, block: int) -> int:
+    """Largest quantization chunk that DIVIDES ``n`` without exceeding
+    ``block`` — the wire format's 256-element blocks clamped to a
+    channel dimension (a KV head's head_dim is usually 64/128, smaller
+    than the default wire block)."""
+    qb = min(int(block), int(n))
+    while n % qb:
+        qb -= 1
+    return qb
+
+
+def quantize_channels(x, spec: Union[str, WireSpec]):
+    """Blockwise absmax quantization along the LAST axis of ``x`` —
+    the KV-pool variant of :func:`quantize_blocks`: chunks of
+    ``channel_block(x.shape[-1], spec.block_size)`` elements, one fp32
+    scale each, so a tensor-parallel head shard quantizes exactly as
+    the same head does unsharded (blocks never straddle heads).
+
+    Returns ``(payload, scales)`` with payload in the wire dtype and
+    ``scales`` shaped ``x.shape[:-1] + (n_chunks,)``."""
+    spec = parse(spec)
+    n = x.shape[-1]
+    qb = channel_block(n, spec.block_size)
+    xb = x.astype(jnp.float32).reshape(*x.shape[:-1], n // qb, qb)
+    absmax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / spec.qmax,
+                      jnp.ones_like(absmax))
+    y = xb / scale
+    if spec.wire_dtype == "int8":
+        q = jnp.clip(jnp.round(y), -spec.qmax, spec.qmax).astype(jnp.int8)
+    else:
+        q = y.astype(jnp.float8_e4m3fn)
+    return q.reshape(x.shape), scale[..., 0]
+
+
+def dequantize_channels(q, scales, spec: Union[str, WireSpec]):
+    """Inverse of :func:`quantize_channels`: fp32 out, same shape as
+    the payload."""
+    parse(spec)   # validates; the math only needs the shapes
+    qb = q.shape[-1] // scales.shape[-1]
+    y = (q.astype(jnp.float32)
+          .reshape(*scales.shape, qb) * scales[..., None])
+    return y.reshape(q.shape)
+
+
 def allreduce_blocks(buf, axis_name: str, spec: WireSpec,
                      world: Optional[int] = None):
     """Dual block-quantized sum-allreduce of a flat fp32 buffer inside a

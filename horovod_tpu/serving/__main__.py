@@ -44,6 +44,13 @@ def _replica_argv(args) -> list:
         argv += ["--max-queue", str(args.max_queue)]
     if args.eos_id is not None:
         argv += ["--eos-id", str(args.eos_id)]
+    if args.kv_quant is not None:
+        argv += ["--kv-quant", args.kv_quant]
+    if args.prefix_cache:
+        argv += ["--prefix-cache"]
+    if args.draft_checkpoint_dir is not None:
+        argv += ["--draft-checkpoint-dir", args.draft_checkpoint_dir]
+        argv += ["--spec-tokens", str(args.spec_tokens)]
     return argv
 
 
@@ -151,6 +158,26 @@ def main(argv=None) -> int:
                         help="0 = greedy; > 0 = seeded sampling")
     parser.add_argument("--seed", type=int, default=0,
                         help="sampling PRNG seed")
+    parser.add_argument("--kv-quant", choices=("int8", "fp8"),
+                        default=None,
+                        help="quantize the KV pool (wire-format absmax "
+                             "blocks, ~4x resident sequences per HBM "
+                             "byte; docs/serving.md#speed-levers)")
+    parser.add_argument("--prefix-cache", action="store_true",
+                        help="share read-only KV blocks between "
+                             "requests with a common prompt prefix "
+                             "(system prompts prefill once per replica)")
+    parser.add_argument("--draft-checkpoint-dir", default=None,
+                        help="drafter checkpoint for speculative "
+                             "decoding (a shrunk transformer sharing "
+                             "the vocab; same manifest convention)")
+    parser.add_argument("--draft-step", type=int, default=None,
+                        help="drafter step to serve (default: LATEST)")
+    parser.add_argument("--spec-tokens", type=int, default=4,
+                        help="speculative verify width k: the drafter "
+                             "proposes k-1 tokens per step, the "
+                             "flagship verifies them in one [slots, k] "
+                             "program (needs --draft-checkpoint-dir)")
     args = parser.parse_args(argv)
 
     if args.fleet is not None:
@@ -206,14 +233,32 @@ def main(argv=None) -> int:
           f"vocab={cfg.vocab} tp={tp} framework={args.framework}",
           file=sys.stderr)
 
+    draft_params = draft_cfg = None
+    if args.draft_checkpoint_dir is not None:
+        deng = CheckpointEngine(args.draft_checkpoint_dir)
+        dman = deng.restore_manifest(args.draft_step)
+        draft_cfg = serving_config(config_from_manifest(dman), mesh)
+        draft_params = load_params(args.draft_checkpoint_dir, draft_cfg,
+                                   mesh, step=args.draft_step,
+                                   engine=deng)
+        print(f"[serving] drafter step {dman['step']}: "
+              f"d_model={draft_cfg.d_model} layers={draft_cfg.n_layers} "
+              f"(spec_tokens={args.spec_tokens})", file=sys.stderr)
+
     config = ServingConfig(
         block_size=args.block_size, kv_blocks=args.kv_blocks,
         max_batch_slots=args.slots,
         max_queue=args.max_queue if args.max_queue is not None
         else _env.serving_queue(),
         max_new_tokens=args.max_new_tokens, eos_id=args.eos_id,
-        temperature=args.temperature, seed=args.seed)
-    engine = InferenceEngine(params, cfg, mesh, config)
+        temperature=args.temperature, seed=args.seed,
+        kv_quant=args.kv_quant,
+        spec_tokens=(args.spec_tokens if draft_params is not None
+                     else 0),
+        prefix_cache=args.prefix_cache)
+    engine = InferenceEngine(params, cfg, mesh, config,
+                             draft_params=draft_params,
+                             draft_cfg=draft_cfg)
     server = ServingServer(engine, port=args.port, host=args.host)
     server.install_signal_handlers()
     server.start()

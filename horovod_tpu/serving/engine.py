@@ -18,6 +18,29 @@ A request therefore joins and leaves the batch mid-flight of everyone
 else's generation — no batch-boundary barrier, which is where the
 batched ≥ 2× sequential throughput in BENCH_SERVING.json comes from.
 
+Three raw-speed levers ride on top, each independently switchable
+(docs/serving.md#speed-levers, BENCH_SPEED.json):
+
+  - **Quantized KV blocks** (``kv_quant="int8"|"fp8"``): the pool holds
+    wire-dtype payload + fp32 channel-block scales (quantization.py's
+    absmax format at rest), ~4x the resident sequences per HBM byte;
+    dequant happens on read inside the attention program, and prefill
+    attends this chunk at full precision so a from-empty prefill is
+    bit-identical to the fp32 pool.
+  - **Speculative decoding** (``spec_tokens=k`` + a drafter model): a
+    small drafter proposes ``k-1`` greedy tokens per step; the flagship
+    verifies them in ONE batched ``[slots, k]`` decode program and
+    emits the accepted prefix plus its own correction — up to ``k``
+    tokens per flagship call, token-identical to non-speculative greedy
+    decode. Rollback of a rejected suffix is free: lengths rewind on
+    the host and the garbage K/V is overwritten before it is ever
+    visible (the next chunk's scatter covers it).
+  - **Shared prefix cache** (``prefix_cache=True``): full prompt blocks
+    are indexed by chained hash; a matching prefix reuses the resident
+    blocks (refcounted, read-only) and prefill runs only over the
+    suffix — a fleet-shared system prompt prefills once per replica,
+    not once per request.
+
 Compile discipline: there is exactly ONE jitted program per shape
 bucket — decode is always ``[slots, 1]`` (one program for the whole
 serve), prefill is ``[1, L]`` with L a power-of-two bucket — so
@@ -49,7 +72,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..models import transformer as tfm
 from ..observability import registry as _obs
 from ..utils.logging import get_logger
-from .kv_cache import SCRATCH_BLOCK, BlockAllocator, blocks_needed
+from .kv_cache import (SCRATCH_BLOCK, BlockAllocator, PrefixCache,
+                       blocks_needed, prefix_hashes)
 
 _log = get_logger("serving")
 
@@ -127,6 +151,25 @@ def _metrics():
         "qps": r.gauge(
             "hvdtpu_serving_requests_per_second",
             "Completed requests per second over the last 10 s").labels(),
+        "kv_bytes": r.gauge(
+            "hvdtpu_serving_kv_bytes_resident",
+            "KV-pool bytes held by live sequences and the prefix "
+            "cache (payload + scales, drafter pool included) — the "
+            "number the quantized pool divides by ~4").labels(),
+        "prefix_hits": r.counter(
+            "hvdtpu_serving_prefix_cache_hits_total",
+            "Prompt blocks served from the shared prefix cache "
+            "(each hit skips block_size prefill positions)"),
+        "prefix_misses": r.counter(
+            "hvdtpu_serving_prefix_cache_misses_total",
+            "Full prompt blocks that had no cached prefix entry"),
+        "draft_proposed": r.counter(
+            "hvdtpu_serving_draft_proposed_tokens_total",
+            "Tokens proposed by the speculative drafter"),
+        "draft_accepted": r.counter(
+            "hvdtpu_serving_draft_accepted_tokens_total",
+            "Drafter tokens accepted by the flagship's batched "
+            "verification (acceptance rate = accepted/proposed)"),
     }
 
 
@@ -145,6 +188,14 @@ class ServingConfig:
     max_blocks_per_seq: Optional[int] = None  # table width; None: from
     #                                           the model's max_seq
     min_prefill_bucket: int = 16  # smallest padded prompt length
+    # --- speed levers (docs/serving.md#speed-levers) ---
+    kv_quant: Optional[str] = None  # "int8"/"fp8": quantized KV pool
+    spec_tokens: int = 0          # speculative verify width k (the
+    #                               drafter proposes k-1 tokens/step);
+    #                               0 = off, requires a drafter model
+    prefix_cache: bool = False    # shared prompt-prefix block cache
+    prefix_cache_entries: Optional[int] = None  # LRU cap (None: pool-
+    #                                             pressure eviction only
 
 
 class Request:
@@ -177,6 +228,8 @@ class Request:
         self.t_done: Optional[float] = None
         self.slot: Optional[int] = None
         self.blocks: List[int] = []
+        self.cached_tokens = 0    # prompt tokens resident via shared
+        #                           prefix blocks (prefill skips them)
         self._done = threading.Event()
         self._progress = threading.Condition()
 
@@ -248,7 +301,9 @@ class InferenceEngine:
 
     def __init__(self, params: Any, cfg: tfm.TransformerConfig,
                  mesh: jax.sharding.Mesh,
-                 config: Optional[ServingConfig] = None):
+                 config: Optional[ServingConfig] = None,
+                 draft_params: Any = None,
+                 draft_cfg: Optional[tfm.TransformerConfig] = None):
         if cfg.sp_axis or cfg.ep_axis or cfg.num_experts:
             raise ValueError(
                 "serving supports dense tensor-parallel decode only; "
@@ -260,12 +315,45 @@ class InferenceEngine:
         bs = int(c.block_size)
         self._m = _metrics()
 
+        from .. import quantization as _q
+        self._kv_spec = _q.parse(c.kv_quant)
+
+        if (draft_params is None) != (draft_cfg is None):
+            raise ValueError(
+                "speculative decoding needs BOTH draft_params and "
+                "draft_cfg (a shrunk serving config sharing the vocab)")
+        if c.spec_tokens and draft_params is None:
+            raise ValueError(
+                "spec_tokens is set but no drafter model was given — "
+                "pass draft_params/draft_cfg (docs/serving.md)")
+        self._draft_params = draft_params
+        self._draft_cfg = draft_cfg
+        self._spec_k = 0
+        if draft_params is not None:
+            if draft_cfg.vocab != cfg.vocab:
+                raise ValueError(
+                    f"drafter vocab ({draft_cfg.vocab}) must equal the "
+                    f"flagship's ({cfg.vocab}) — they share the "
+                    "tokenizer")
+            if draft_cfg.tp_axis != cfg.tp_axis:
+                raise ValueError(
+                    "drafter and flagship must agree on tp_axis (both "
+                    "run under the engine's one mesh)")
+            self._spec_k = int(c.spec_tokens) if c.spec_tokens else 4
+            if self._spec_k < 2:
+                raise ValueError(
+                    f"spec_tokens ({self._spec_k}) must be >= 2: the "
+                    "verify chunk holds the last token plus at least "
+                    "one draft")
+
         slots = int(c.max_batch_slots)
         max_tab = c.max_blocks_per_seq if c.max_blocks_per_seq \
             else -(-cfg.max_seq // bs)
         self._tab_width = int(max_tab)
         self._slots = slots
         self._alloc = BlockAllocator(c.kv_blocks)
+        self._prefix = PrefixCache(self._alloc, c.prefix_cache_entries) \
+            if c.prefix_cache else None
         self._m["kv_total"].set(self._alloc.total)
         self._m["slots"].set(slots)
 
@@ -278,7 +366,15 @@ class InferenceEngine:
 
         self.params = params
         self._cache = self._put_cache(
-            tfm.init_cache(cfg, c.kv_blocks, bs))
+            tfm.init_cache(cfg, c.kv_blocks, bs, self._kv_spec), cfg)
+        self._bytes_per_block = tfm.kv_bytes_per_block(
+            cfg, bs, self._kv_spec)
+        if draft_params is not None:
+            self._draft_cache = self._put_cache(
+                tfm.init_cache(draft_cfg, c.kv_blocks, bs,
+                               self._kv_spec), draft_cfg)
+            self._bytes_per_block += tfm.kv_bytes_per_block(
+                draft_cfg, bs, self._kv_spec)
 
         # host mirrors of the device-side scheduling state
         self._tables = np.full((slots, self._tab_width), SCRATCH_BLOCK,
@@ -295,15 +391,17 @@ class InferenceEngine:
         self._rng = np.random.default_rng(c.seed)
         self._completions: deque = deque()  # perf_counter stamps
 
-        specs = tfm.param_specs(cfg)
-        cspecs = tfm.cache_specs(cfg)
-        fwd = jax.shard_map(
-            lambda p, kv, t, s, bt: tfm.apply_decode(p, t, s, bt, kv,
-                                                     cfg),
-            mesh=mesh, in_specs=(specs, cspecs, P(), P(), P()),
-            out_specs=(P(), cspecs), check_vma=False)
-        donate = () if jax.default_backend() == "cpu" else (1,)
-        self._fwd = jax.jit(fwd, donate_argnums=donate)
+        self._fwd = self._build_fwd(cfg, exact_chunk=False)
+        # Prefill reads this chunk at full precision (prefill-exact
+        # parity with the fp32 pool); without quantization the trace is
+        # identical, so the decode program is simply reused.
+        self._fwd_prefill = self._build_fwd(cfg, exact_chunk=True) \
+            if self._kv_spec is not None else self._fwd
+        if draft_params is not None:
+            self._dfwd = self._build_fwd(draft_cfg, exact_chunk=False)
+            self._dfwd_prefill = self._build_fwd(
+                draft_cfg, exact_chunk=True) \
+                if self._kv_spec is not None else self._dfwd
         self._buckets_seen: set = set()
 
     # ------------------------------------------------------- submission
@@ -455,8 +553,21 @@ class InferenceEngine:
 
     # -------------------------------------------------------- internals
 
-    def _put_cache(self, cache):
-        cspecs = tfm.cache_specs(self.cfg)
+    def _build_fwd(self, cfg: tfm.TransformerConfig, exact_chunk: bool):
+        specs = tfm.param_specs(cfg)
+        cspecs = tfm.cache_specs(cfg, self._kv_spec)
+        kvq = self._kv_spec
+        fwd = jax.shard_map(
+            lambda p, kv, t, s, bt: tfm.apply_decode(
+                p, t, s, bt, kv, cfg, kv_quant=kvq,
+                exact_chunk=exact_chunk),
+            mesh=self.mesh, in_specs=(specs, cspecs, P(), P(), P()),
+            out_specs=(P(), cspecs), check_vma=False)
+        donate = () if jax.default_backend() == "cpu" else (1,)
+        return jax.jit(fwd, donate_argnums=donate)
+
+    def _put_cache(self, cache, cfg: tfm.TransformerConfig):
+        cspecs = tfm.cache_specs(cfg, self._kv_spec)
         return jax.tree_util.tree_map(
             lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
             cache, cspecs, is_leaf=lambda x: isinstance(x, P))
@@ -479,19 +590,44 @@ class InferenceEngine:
                          if r is None), None)
             if slot is None:
                 break
+            bs = self.config.block_size
             need = blocks_needed(len(req.prompt), req.max_new_tokens,
-                                 self.config.block_size)
-            blocks = self._alloc.alloc(need)
-            if blocks is None:
+                                 bs)
+            # Prefix-cache probe: matching leading FULL prompt blocks
+            # are shared (incref'd, read-only) instead of re-prefilled.
+            hashes: List[bytes] = []
+            shared: List[int] = []
+            if self._prefix is not None:
+                hashes = prefix_hashes(req.prompt, bs)
+                shared = self._prefix.lookup(hashes)
+            fresh = self._alloc.alloc(need - len(shared))
+            while fresh is None and self._prefix is not None \
+                    and self._prefix.evict_one():
+                # Pool pressure: cached-but-idle prefix blocks yield to
+                # a live admission, LRU first.
+                fresh = self._alloc.alloc(need - len(shared))
+            if fresh is None:
+                for b in shared:       # roll the probe's holds back
+                    self._alloc.decref(b)
                 break    # pool exhausted: nothing admits, nothing evicts
+            if self._prefix is not None:
+                self._m["prefix_hits"].inc(len(shared))
+                self._m["prefix_misses"].inc(len(hashes) - len(shared))
             self._queue.popleft()
-            req.blocks = blocks
+            req.blocks = shared + fresh
+            req.cached_tokens = len(shared) * bs
             req.slot = slot
             req.status = "active"
             self._reqs[slot] = req
             self._tables[slot, :] = SCRATCH_BLOCK
-            self._tables[slot, :need] = blocks
+            self._tables[slot, :need] = req.blocks
             self._prefill(req)
+            # Index this prompt's freshly-prefilled full blocks so the
+            # NEXT matching prompt shares them (first writer wins).
+            if self._prefix is not None:
+                for j in range(len(shared), len(hashes)):
+                    self._prefix.insert(hashes[j],
+                                        int(self._tables[slot, j]))
             admitted += 1
         self._m["queue_depth"].set(len(self._queue))
         return admitted
@@ -510,28 +646,41 @@ class InferenceEngine:
             self._inj.on_serving_prefill()
         t0 = time.perf_counter()
         n = len(req.prompt)
-        L = self._bucket(n)
+        c = req.cached_tokens   # resident via shared prefix blocks
+        suffix = req.prompt[c:]
+        ns = len(suffix)
+        L = self._bucket(ns)
         self._record_bucket("prefill", L)
         toks = np.zeros((1, L), np.int32)
-        toks[0, :n] = req.prompt
-        logits, self._cache = self._fwd(
-            self.params, self._cache, jnp.asarray(toks),
-            jnp.zeros((1,), jnp.int32),
-            jnp.asarray(self._tables[req.slot:req.slot + 1]))
+        toks[0, :ns] = suffix
+        starts = jnp.full((1,), c, jnp.int32)
+        tabs = jnp.asarray(self._tables[req.slot:req.slot + 1])
+        logits, self._cache = self._fwd_prefill(
+            self.params, self._cache, jnp.asarray(toks), starts, tabs)
+        if self._draft_params is not None:
+            # The drafter's pool shares the block tables, so its prefix
+            # blocks are shared by the same admission decision.
+            self._record_bucket("draft_prefill", L)
+            _, self._draft_cache = self._dfwd_prefill(
+                self._draft_params, self._draft_cache,
+                jnp.asarray(toks), starts, tabs)
         slot = req.slot
         self._lengths[slot] = n
-        first = self._sample(np.asarray(logits[0, n - 1]), req)
+        first = self._sample(np.asarray(logits[0, ns - 1]), req)
         req.t_first_token = time.perf_counter()
         req.tokens.append(first)
         req._notify()
         self._last_tok[slot] = first
         self._m["prefill"].observe(time.perf_counter() - t0)
         self._m["ttft"].observe(req.t_first_token - req.t_submit)
-        self._m["tokens"].labels(kind="prompt").inc(n)
+        self._m["tokens"].labels(kind="prompt").inc(ns)
         self._m["tokens"].labels(kind="generated").inc()
         self._check_finished(req)
 
     def _decode_step(self) -> None:
+        if self._draft_params is not None:
+            self._spec_decode_step()
+            return
         if self._inj is not None:
             self._inj.on_serving_decode()
         t0 = time.perf_counter()
@@ -556,6 +705,93 @@ class InferenceEngine:
             self._last_tok[slot] = tok
             self._m["tpot"].observe(dt)
             self._m["tokens"].labels(kind="generated").inc()
+            self._check_finished(req)
+
+    def _spec_decode_step(self) -> None:
+        """Speculative decode step: the drafter proposes ``k-1`` greedy
+        tokens per slot (k-1 cheap ``[slots, 1]`` calls on its own
+        cache), the flagship verifies them in ONE batched ``[slots, k]``
+        program, and each slot advances by its accepted prefix plus the
+        flagship's correction token — between 1 and k tokens per
+        flagship call, greedy output token-identical to the
+        non-speculative path (the emitted tokens ARE the flagship's
+        argmaxes under the true prefix).
+
+        Rollback of a rejected suffix is host-side only: ``_lengths``
+        simply doesn't advance over it. The garbage K/V those positions
+        hold is overwritten by the next chunk's scatter before any
+        query can see it (chunks are a constant k wide and start where
+        the accepted prefix ended, so the rewritten span always covers
+        the stale one)."""
+        if self._inj is not None:
+            self._inj.on_serving_decode()
+        t0 = time.perf_counter()
+        k = self._spec_k
+        n_live = self.active_count
+        tabs = jnp.asarray(self._tables)
+
+        # Drafter proposals: greedy chain on the drafter's own pool,
+        # same block tables, same positions.
+        d_len = self._lengths.copy()
+        cur = self._last_tok.copy()
+        proposals = np.zeros((self._slots, k - 1), np.int32)
+        for i in range(k - 1):
+            self._record_bucket("draft", 1)
+            dlg, self._draft_cache = self._dfwd(
+                self._draft_params, self._draft_cache,
+                jnp.asarray(cur[:, None]), jnp.asarray(d_len), tabs)
+            cur = np.argmax(np.asarray(dlg[:, 0]), axis=-1) \
+                .astype(np.int32)
+            proposals[:, i] = cur
+            d_len += 1
+        self._m["draft_proposed"].inc((k - 1) * n_live)
+
+        # One batched verification: feed [last_tok, d_1..d_{k-1}]; row
+        # i of the logits is the flagship's next-token distribution
+        # after the first i+1 of those inputs.
+        feed = np.concatenate([self._last_tok[:, None], proposals],
+                              axis=1)
+        self._record_bucket("decode", (self._slots, k))
+        logits, self._cache = self._fwd(
+            self.params, self._cache, jnp.asarray(feed),
+            jnp.asarray(self._lengths), tabs)
+        lg = np.asarray(logits)           # [slots, k, vocab]
+        greedy = lg.argmax(axis=-1)       # [slots, k]
+        dt = time.perf_counter() - t0
+        self._m["decode_step"].observe(dt)
+        self._m["decode_steps"].inc()
+
+        for slot, req in enumerate(self._reqs):
+            if req is None:
+                continue
+            if req.temperature > 0.0:
+                # Sampled slots take one token from the true next-token
+                # logits (row 0) — the exact non-speculative
+                # distribution; drafts are ignored rather than biased.
+                emit = [self._sample(lg[slot, 0], req)]
+                accepted = 0
+            else:
+                d = proposals[slot]
+                g = greedy[slot]
+                accepted = 0
+                while accepted < k - 1 and d[accepted] == g[accepted]:
+                    accepted += 1
+                emit = [int(t) for t in g[:accepted + 1]]
+            self._m["draft_accepted"].inc(accepted)
+            # Truncate to the request's remaining budget / EOS — any
+            # truncation below finishes the request, so the cache-
+            # validity induction only ever continues on full chunks.
+            emit = emit[:req.max_new_tokens - len(req.tokens)]
+            eos = self.config.eos_id
+            if eos is not None and eos in emit:
+                emit = emit[:emit.index(eos) + 1]
+            self._lengths[slot] += len(emit)
+            self._last_tok[slot] = emit[-1]
+            for tok in emit:
+                req.tokens.append(int(tok))
+                self._m["tpot"].observe(dt)
+                self._m["tokens"].labels(kind="generated").inc()
+            req._notify()
             self._check_finished(req)
 
     def _sample(self, logits: np.ndarray, req: Request) -> int:
@@ -606,3 +842,5 @@ class InferenceEngine:
         self._m["active"].set(self.active_count)
         self._m["occupancy"].set(self.active_count / self._slots)
         self._m["kv_used"].set(self._alloc.in_use)
+        self._m["kv_bytes"].set(self._alloc.in_use
+                                * self._bytes_per_block)

@@ -20,11 +20,25 @@ force evicting one to disk (docs/serving.md).
 Block 0 is reserved as scratch and never handed out: padded prefill
 positions and inactive batch slots point their block tables at it, so
 their garbage K/V writes land where no live sequence reads.
+
+Blocks are *refcounted*: a plain allocation holds one reference, and
+the shared prefix cache (:class:`PrefixCache`) adds references so two
+sequences with the same system prompt can address the same read-only
+prefix blocks. A block returns to the free list only when its last
+holder lets go — ``release`` is a decref, not an unconditional free.
+Divergence past a shared prefix is copy-on-write at block granularity:
+writes only ever land in a sequence's privately-allocated blocks (a
+shareable block is by construction a FULL block of prompt tokens, and
+every later position falls in a later, private block), so the
+"divergent copy" is realized by writing fresh K/V into fresh blocks —
+shared blocks are never mutated.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional
+import hashlib
+from collections import OrderedDict
+from typing import List, Optional, Sequence
 
 SCRATCH_BLOCK = 0
 
@@ -45,7 +59,10 @@ def blocks_needed(prompt_len: int, max_new_tokens: int,
 
 
 class BlockAllocator:
-    """Free-list over pool blocks ``1..n_blocks-1`` (0 is scratch).
+    """Refcounted free-list over pool blocks ``1..n_blocks-1`` (0 is
+    scratch). Every operation is O(1) per block touched: ``alloc`` pops
+    off the free stack (no scan of the free set), ``release``/``decref``
+    push back the moment the count hits zero.
 
     Not thread-safe by itself — the engine serializes all scheduler
     state under its own lock.
@@ -57,10 +74,10 @@ class BlockAllocator:
                 f"the pool needs the scratch block plus at least one "
                 f"allocatable block; got n_blocks={n_blocks}")
         self.n_blocks = int(n_blocks)
-        # LIFO free-list, low ids first out — deterministic layouts for
-        # the seeded bench.
+        # LIFO free stack, low ids first out — deterministic layouts
+        # for the seeded bench.
         self._free: List[int] = list(range(self.n_blocks - 1, 0, -1))
-        self._held = [False] * self.n_blocks
+        self._refs = [0] * self.n_blocks
 
     @property
     def total(self) -> int:
@@ -75,27 +92,136 @@ class BlockAllocator:
     def in_use(self) -> int:
         return self.total - self.free
 
+    def refcount(self, block: int) -> int:
+        return self._refs[block]
+
     def alloc(self, n: int) -> Optional[List[int]]:
-        """``n`` blocks, all-or-nothing; None when the pool cannot cover
-        the request (the admission gate's signal to leave it queued)."""
+        """``n`` blocks at one reference each, all-or-nothing; None when
+        the pool cannot cover the request (the admission gate's signal
+        to leave it queued)."""
         if n < 0:
             raise ValueError(f"cannot allocate {n} blocks")
         if n > len(self._free):
             return None
         out = [self._free.pop() for _ in range(n)]
         for b in out:
-            self._held[b] = True
+            self._refs[b] = 1
         return out
 
+    def incref(self, block: int) -> None:
+        """Add a holder to a live block — how the prefix cache (and
+        through it a second sequence) shares a block already in use."""
+        if block == SCRATCH_BLOCK:
+            raise ValueError("block 0 is the scratch block; it is "
+                             "never allocated and never shared")
+        if self._refs[block] <= 0:
+            raise ValueError(
+                f"cannot incref free KV block {block} — only a held "
+                "block can gain holders")
+        self._refs[block] += 1
+
+    def decref(self, block: int) -> bool:
+        """Drop one holder; frees (returns True) when the last one
+        lets go. Over-release and scratch-release are hard errors —
+        both would hand one block to two live sequences and silently
+        corrupt their caches."""
+        if block == SCRATCH_BLOCK:
+            raise ValueError("block 0 is the scratch block; it is "
+                             "never allocated and never released")
+        if self._refs[block] <= 0:
+            raise ValueError(f"double free of KV block {block}")
+        self._refs[block] -= 1
+        if self._refs[block] == 0:
+            self._free.append(block)
+            return True
+        return False
+
     def release(self, blocks: List[int]) -> None:
-        """Return a finished sequence's blocks. Double-free and
-        scratch-release are hard errors — both would hand one block to
-        two live sequences and silently corrupt their caches."""
+        """Drop one reference on each of a finished sequence's blocks
+        (shared prefix blocks stay resident under the cache's ref)."""
         for b in blocks:
-            if b == SCRATCH_BLOCK:
-                raise ValueError("block 0 is the scratch block; it is "
-                                 "never allocated and never released")
-            if not self._held[b]:
-                raise ValueError(f"double free of KV block {b}")
-            self._held[b] = False
-            self._free.append(b)
+            self.decref(b)
+
+
+def prefix_hashes(tokens: Sequence[int], block_size: int) -> List[bytes]:
+    """Chained digest per FULL prompt block: entry ``j`` identifies
+    ``tokens[0:(j+1)*block_size]`` — a prefix, not a window, so two
+    prompts share entry ``j`` iff they agree on every token up to
+    there. Only blocks wholly inside ``tokens[:-1]`` are hashed: the
+    final prompt token is never shareable because admission always
+    needs at least one token to prefill (its forward produces the
+    first-token logits).
+
+    Deterministic across processes (hashlib, not Python's salted
+    ``hash``) — the fleet router hashes the same prompts with the same
+    function to score replica cache warmth."""
+    bs = int(block_size)
+    n_full = max(0, (len(tokens) - 1) // bs)
+    out: List[bytes] = []
+    h = b""
+    for j in range(n_full):
+        blk = ",".join(str(int(t)) for t in tokens[j * bs:(j + 1) * bs])
+        h = hashlib.blake2b(h + blk.encode(), digest_size=16).digest()
+        out.append(h)
+    return out
+
+
+class PrefixCache:
+    """LRU map from chained prompt-prefix hashes to resident pool
+    blocks — the host-side index behind shared-prefix prefill.
+
+    The cache holds ONE reference on every block it indexes (via
+    :meth:`BlockAllocator.incref`), so an indexed block outlives the
+    sequence that wrote it. ``lookup`` increfs each matched block for
+    the caller (the admitting sequence's own hold); ``evict_one`` pops
+    the least-recently-used entry and drops the cache's reference —
+    blocks still shared by live sequences are freed only when those
+    finish. Not thread-safe — engine-lock discipline, like the
+    allocator."""
+
+    def __init__(self, alloc: BlockAllocator,
+                 max_entries: Optional[int] = None):
+        self._alloc = alloc
+        self._map: "OrderedDict[bytes, int]" = OrderedDict()
+        self.max_entries = max_entries
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def lookup(self, hashes: Sequence[bytes]) -> List[int]:
+        """Longest cached prefix of ``hashes``; increfs every returned
+        block (the caller now holds them) and freshens their LRU
+        position."""
+        out: List[int] = []
+        for h in hashes:
+            b = self._map.get(h)
+            if b is None:
+                break
+            self._map.move_to_end(h)
+            self._alloc.incref(b)
+            out.append(b)
+        return out
+
+    def insert(self, h: bytes, block: int) -> bool:
+        """Index ``block`` (held by the caller) under ``h``; the cache
+        takes its own reference. No-op when the hash is already
+        indexed (first writer wins — both blocks hold identical K/V,
+        keeping one mapping makes sharing converge)."""
+        if h in self._map:
+            return False
+        self._alloc.incref(block)
+        self._map[h] = block
+        if self.max_entries is not None \
+                and len(self._map) > self.max_entries:
+            self.evict_one()
+        return True
+
+    def evict_one(self) -> bool:
+        """Drop the LRU entry's cache reference; True when an entry was
+        evicted. The engine calls this under pool pressure until the
+        pending admission fits (or the cache is empty)."""
+        if not self._map:
+            return False
+        _, block = self._map.popitem(last=False)
+        self._alloc.decref(block)
+        return True

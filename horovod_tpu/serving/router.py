@@ -9,7 +9,13 @@ for load), and for each ``POST /generate``:
   1. **admits** onto the least-loaded ready replica — score is
      ``(active + queue_depth) / batch_slots``, i.e. outstanding work
      per slot, so a draining or backed-up replica naturally repels
-     traffic before it starts rejecting it;
+     traffic before it starts rejecting it — minus a **cache-warmth
+     bonus**: the router hashes the prompt's block-aligned prefixes
+     with the SAME chained digest the engine's shared prefix cache
+     uses (kv_cache.prefix_hashes) and remembers which hashes it sent
+     where, so a request sharing a system prompt prefers the replica
+     whose prefix cache is already warm (its prefill touches only the
+     suffix) over a cold one with marginally less load;
   2. **streams** tokens from the replica (the replica-side NDJSON
      protocol, server.py) and relays them to the client;
   3. **fails over**: a replica that dies before the first token is
@@ -41,6 +47,7 @@ import http.client
 import json
 import threading
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence
 
 from ..observability import registry as _obs
@@ -48,6 +55,7 @@ from ..utils import env as _env
 from ..utils.logging import get_logger
 from .engine import DEADLINE_ERROR
 from .fleet import ReplicaEndpoint
+from .kv_cache import prefix_hashes
 
 _log = get_logger("serving.router")
 
@@ -62,6 +70,10 @@ _EXCLUDE_S = 2.0
 # ms) but finite, so a fully hung replica cannot wedge a client that
 # set no deadline.
 _STREAM_READ_S = 120.0
+# Prefix hashes remembered per replica for cache-warmth scoring (LRU;
+# roughly mirrors the replica-side prefix cache, which also evicts LRU
+# under pool pressure — an optimistic shadow, never load-bearing).
+_WARMTH_ENTRIES = 8192
 
 
 def _metrics():
@@ -97,6 +109,11 @@ def _metrics():
             "hvdtpu_fleet_replica_queue_depth",
             "Scraped hvdtpu_serving_queue_depth per replica index — "
             "the router's own view of the signal it balances on"),
+        "warmth": r.counter(
+            "hvdtpu_fleet_dispatch_warmth_total",
+            "Dispatches by prefix-cache warmth of the chosen replica: "
+            "warm (some prompt prefix previously routed there), cold "
+            "(none), or unhashed (prompt shorter than one block)"),
     }
 
 
@@ -111,11 +128,37 @@ class ReplicaView:
     active: float = 0.0
     slots: float = 1.0
     t_scraped: float = 0.0
+    block_size: Optional[int] = None   # scraped from /healthz; the
+    #                                    prefix-hash granularity
+    # Prefix hashes this router has routed here (bounded LRU) — the
+    # warmth estimate behind prefix-aware admission.
+    warm: "OrderedDict" = dataclasses.field(default_factory=OrderedDict)
 
     @property
     def score(self) -> float:
         """Outstanding work per decode slot — lower admits first."""
         return (self.active + self.queue_depth) / max(1.0, self.slots)
+
+    def warmth(self, hashes: Sequence[bytes]) -> float:
+        """Fraction of the prompt's prefix blocks previously routed to
+        this replica (longest-prefix, like the replica-side cache)."""
+        if not hashes:
+            return 0.0
+        n = 0
+        for h in hashes:
+            if h not in self.warm:
+                break
+            n += 1
+        return n / len(hashes)
+
+    def note_dispatch(self, hashes: Sequence[bytes]) -> None:
+        for h in hashes:
+            if h in self.warm:
+                self.warm.move_to_end(h)
+            else:
+                self.warm[h] = True
+        while len(self.warm) > _WARMTH_ENTRIES:
+            self.warm.popitem(last=False)
 
 
 class StaticBackends:
@@ -131,17 +174,28 @@ class StaticBackends:
 
 def pick_replica(views: Sequence[ReplicaView],
                  exclude: Optional[set] = None,
-                 rr: int = 0) -> Optional[ReplicaView]:
+                 rr: int = 0,
+                 warmth: Optional[Dict[int, float]] = None
+                 ) -> Optional[ReplicaView]:
     """The routing policy, isolated for unit testing: among ready,
-    scrape-confirmed, non-excluded replicas, the lowest load score;
-    ties broken round-robin by ``rr``. None when nobody can admit."""
+    scrape-confirmed, non-excluded replicas, the lowest *effective*
+    score — load score minus the replica's prefix-cache warmth for THIS
+    prompt (``warmth``: fraction of prefix blocks already routed there,
+    worth up to one slot's outstanding work) — ties broken round-robin
+    by ``rr``. None when nobody can admit. With no warmth map this is
+    exactly the pre-prefix-cache policy."""
     exclude = exclude or set()
+    warmth = warmth or {}
     ok = [v for v in views
           if v.ready and v.ok and v.endpoint.index not in exclude]
     if not ok:
         return None
-    best = min(v.score for v in ok)
-    tied = [v for v in ok if v.score == best]
+
+    def eff(v: ReplicaView) -> float:
+        return v.score - warmth.get(v.endpoint.index, 0.0)
+
+    best = min(eff(v) for v in ok)
+    tied = [v for v in ok if eff(v) == best]
     return tied[rr % len(tied)]
 
 
@@ -191,8 +245,10 @@ class Router:
         got = False
         if ep.metrics_port:
             got = self._scrape_metrics(view)
-        if not got:
-            got = self._scrape_healthz(view)
+        if not got or view.block_size is None:
+            # healthz also carries block_size (the prefix-hash
+            # granularity) — fetched at least once per view.
+            got = self._scrape_healthz(view) or got
         view.ok = got
         view.t_scraped = time.monotonic()
 
@@ -252,6 +308,8 @@ class Router:
         view.queue_depth = float(h.get("queue_depth", 0))
         view.active = float(h.get("active_requests", 0))
         view.slots = float(h.get("batch_slots", 1) or 1)
+        if h.get("block_size"):
+            view.block_size = int(h["block_size"])
         return True
 
     def _scrape_cycle(self) -> None:
@@ -282,13 +340,28 @@ class Router:
                 _log.warning("scrape cycle failed: %s", e)
             self._stop.wait(self._scrape_interval)
 
-    def _pick(self, exclude: Dict[int, float]) -> Optional[ReplicaView]:
+    def _pick(self, exclude: Dict[int, float],
+              prompt: Optional[List[int]] = None) -> Optional[ReplicaView]:
         now = time.monotonic()
         live = {i for i, until in exclude.items() if until > now}
         with self._views_lock:
             views = list(self._views.values())
+        warmth: Dict[int, float] = {}
+        if prompt:
+            for v in views:
+                hashes = prefix_hashes(prompt, v.block_size or 16)
+                warmth[v.endpoint.index] = v.warmth(hashes)
         self._rr += 1
-        return pick_replica(views, exclude=live, rr=self._rr)
+        view = pick_replica(views, exclude=live, rr=self._rr,
+                            warmth=warmth)
+        if view is not None and prompt:
+            hashes = prefix_hashes(prompt, view.block_size or 16)
+            state = ("unhashed" if not hashes else
+                     "warm" if warmth.get(view.endpoint.index) else
+                     "cold")
+            self._m["warmth"].labels(state=state).inc()
+            view.note_dispatch(hashes)
+        return view
 
     # ------------------------------------------------------ dispatch
 
@@ -335,7 +408,7 @@ class Router:
                         "error": f"no replica completed the request "
                                  f"after {attempts} attempts",
                         "retries": retries, "tokens": emitted}
-            view = self._pick(exclude)
+            view = self._pick(exclude, prompt)
             if view is None:
                 # Nobody ready right now (mass restart, all draining):
                 # wait out a scrape cycle rather than failing a

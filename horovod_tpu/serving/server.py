@@ -144,6 +144,10 @@ class ServingServer:
                         "batch_slots": eng.config.max_batch_slots,
                         "kv_blocks_free": eng._alloc.free,
                         "kv_blocks_total": eng._alloc.total,
+                        # the fleet router hashes prompt prefixes at
+                        # this granularity to score cache warmth
+                        "block_size": eng.config.block_size,
+                        "prefix_cache": eng._prefix is not None,
                     }, "healthz")
                     return
                 if path == "/readyz":

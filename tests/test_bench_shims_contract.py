@@ -24,6 +24,39 @@ def bench():
         return json.load(f)
 
 
+def test_carried_vs_measured_split_is_pinned(bench):
+    """Every row must be explicitly accounted for as re-measured or
+    carried (the --arms mechanism): the top-level lists partition the
+    rows, and the per-row carried_from_previous_run markers agree with
+    them — a regenerated file cannot silently present stale rows as
+    fresh measurements (the PR-9 chip rows are the case in point:
+    measured on a TPU box, carried ever since on CPU-only re-runs)."""
+    measured = set(bench["measured_arms"])
+    carried = set(bench["carried_arms"])
+    assert measured, "no arm was re-measured — arms list rot?"
+    assert not (measured & carried)
+    assert measured | carried == set(bench["rows"])
+    for name, row in bench["rows"].items():
+        if name in carried:
+            assert row.get("carried_from_previous_run"), (
+                f"{name} is listed carried but lacks the row marker")
+        else:
+            assert not row.get("carried_from_previous_run"), (
+                f"{name} is listed re-measured but still carries the "
+                "stale-row marker")
+
+
+def test_chip_rows_are_marked_carried_on_this_box(bench):
+    """The stale chip-backend rows (jax / keras_fit / bucketed ran on
+    TPU metal) must be explicitly carried, never silently mixed with
+    rows measured on this CPU-only container."""
+    for name, row in bench["rows"].items():
+        if row.get("backend") == "tpu":
+            assert name in bench["carried_arms"], (
+                f"{name} claims backend=tpu but is not marked carried "
+                "— re-measure it on metal or carry it explicitly")
+
+
 def test_retention_fields_present(bench):
     assert "torch_shim_retention_chip" in bench
     assert "torch_shim_retention_cpu" in bench

@@ -191,8 +191,91 @@ class TestRoutingPolicy:
                   for r in (0, 1)}
         assert picked == {0, 1}
 
+    def test_warmth_breaks_equal_load_toward_warm_replica(self):
+        """Prefix-aware admission: identical load, replica 1 has the
+        prompt's prefix cached — it wins regardless of rr."""
+        views = self._views((True, 2, 2, 8), (True, 2, 2, 8))
+        for r in (0, 1, 2):
+            assert pick_replica(views, rr=r,
+                                warmth={1: 1.0}).endpoint.index == 1
+
+    def test_warmth_cannot_override_heavy_load_gap(self):
+        """Warmth is worth at most one slot's outstanding work — a
+        fully-warm but backed-up replica still loses to an idle cold
+        one (a cache hit never justifies queueing behind a deep
+        backlog)."""
+        views = self._views((True, 16, 8, 8),   # score 3.0, warm
+                            (True, 0, 0, 8))    # score 0.0, cold
+        assert pick_replica(views,
+                            warmth={0: 1.0}).endpoint.index == 1
+
+    def test_no_warmth_map_is_the_legacy_policy(self):
+        views = self._views((True, 4, 8, 8), (True, 0, 2, 8))
+        assert pick_replica(views).endpoint.index == \
+            pick_replica(views, warmth={}).endpoint.index == 1
+
+
+class TestReplicaWarmthTracking:
+    def _view(self):
+        return ReplicaView(
+            endpoint=ReplicaEndpoint(index=0, host="h", port=1),
+            ready=True, ok=True)
+
+    def test_longest_prefix_fraction(self):
+        from horovod_tpu.serving import prefix_hashes
+        v = self._view()
+        h = prefix_hashes(list(range(33)), 8)    # 4 full blocks
+        v.note_dispatch(h[:2])
+        assert v.warmth(h) == 0.5                # blocks 0-1 warm
+        assert v.warmth(prefix_hashes([9] * 33, 8)) == 0.0
+        v.note_dispatch(h)
+        assert v.warmth(h) == 1.0
+        assert v.warmth([]) == 0.0               # unhashable prompt
+
+    def test_warmth_is_prefix_not_membership(self):
+        """A hash routed here only counts while every EARLIER block
+        matches too — mirroring the replica-side longest-prefix
+        lookup."""
+        from horovod_tpu.serving import prefix_hashes
+        v = self._view()
+        h = prefix_hashes(list(range(33)), 8)
+        v.note_dispatch([h[1]])                  # block 1 without 0
+        assert v.warmth(h) == 0.0
+
+    def test_lru_bound(self):
+        from horovod_tpu.serving import router as router_mod
+        v = self._view()
+        v.note_dispatch([bytes([i % 256, i // 256]) for i in
+                         range(router_mod._WARMTH_ENTRIES + 50)])
+        assert len(v.warm) == router_mod._WARMTH_ENTRIES
+
 
 class TestRouterHTTP:
+    def test_repeat_prompt_sticks_to_warm_replica(self):
+        """Prefix-aware routing over HTTP: with two equally-idle
+        replicas, the second request for the same (multi-block) prompt
+        lands on whichever replica served the first — its prefix cache
+        is warm — and the dispatch-warmth counter says so."""
+        stubs = [StubReplica(), StubReplica()]
+        router = _router(stubs)
+        try:
+            warm0 = _counter("hvdtpu_fleet_dispatch_warmth_total",
+                             'state="warm"')
+            prompt = list(range(40))         # 2 full 16-token blocks
+            for _ in range(3):
+                status, _ = _post(router.port,
+                                  {"tokens": prompt,
+                                   "max_new_tokens": 2})
+                assert status == 200
+            served = [len(s.requests) for s in stubs]
+            assert sorted(served) == [0, 3]  # all three stuck together
+            assert _counter("hvdtpu_fleet_dispatch_warmth_total",
+                            'state="warm"') - warm0 == 2
+        finally:
+            router.shutdown()
+            for s in stubs:
+                s.stop()
+
     def test_routes_to_least_loaded_and_completes(self):
         busy = StubReplica(queue_depth=6, active=8)
         idle = StubReplica(queue_depth=0, active=1)

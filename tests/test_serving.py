@@ -21,9 +21,10 @@ from horovod_tpu.checkpoint import CheckpointEngine
 from horovod_tpu.models import transformer as tfm
 from horovod_tpu.parallel.mesh import create_mesh
 from horovod_tpu.serving import (BlockAllocator, DrainingError,
-                                 InferenceEngine, QueueFullError,
-                                 ServingConfig, blocks_needed,
-                                 config_from_manifest, load_params,
+                                 InferenceEngine, PrefixCache,
+                                 QueueFullError, ServingConfig,
+                                 blocks_needed, config_from_manifest,
+                                 load_params, prefix_hashes,
                                  serving_config, transformer_extra)
 from horovod_tpu.serving.kv_cache import SCRATCH_BLOCK
 
@@ -50,8 +51,12 @@ def mesh1():
 def _engine(params, cfg, mesh, **over):
     kw = dict(block_size=4, kv_blocks=40, max_batch_slots=4,
               max_queue=8, max_new_tokens=8, min_prefill_bucket=8)
+    draft_params = over.pop("draft_params", None)
+    draft_cfg = over.pop("draft_cfg", None)
     kw.update(over)
-    return InferenceEngine(params, cfg, mesh, ServingConfig(**kw))
+    return InferenceEngine(params, cfg, mesh, ServingConfig(**kw),
+                           draft_params=draft_params,
+                           draft_cfg=draft_cfg)
 
 
 class TestBlockAllocator:
@@ -92,6 +97,99 @@ class TestBlockAllocator:
         assert blocks_needed(5, 8, 4) == 3
         with pytest.raises(ValueError):
             blocks_needed(0, 4, 4)
+
+    def test_refcount_shared_block_survives_first_release(self):
+        """The prefix-cache contract: a block with two holders returns
+        to the free list only when the LAST one lets go."""
+        a = BlockAllocator(4)
+        got = a.alloc(2)
+        a.incref(got[0])
+        assert a.refcount(got[0]) == 2
+        a.release(got)                 # first holder gone
+        assert a.free == 2             # got[1] freed, got[0] still held
+        assert a.refcount(got[0]) == 1
+        assert a.decref(got[0]) is True
+        assert a.free == 3
+
+    def test_incref_free_or_scratch_block_raises(self):
+        a = BlockAllocator(4)
+        with pytest.raises(ValueError, match="free"):
+            a.incref(1)                # never allocated
+        with pytest.raises(ValueError, match="scratch"):
+            a.incref(SCRATCH_BLOCK)
+        got = a.alloc(1)
+        a.release(got)
+        with pytest.raises(ValueError, match="free"):
+            a.incref(got[0])           # already returned to the pool
+
+
+class TestPrefixHashes:
+    def test_chained_and_deterministic(self):
+        toks = list(range(20))
+        h1 = prefix_hashes(toks, 4)
+        h2 = prefix_hashes(list(toks), 4)
+        assert h1 == h2                       # process-stable (hashlib)
+        assert len(h1) == 4                   # last token never hashed
+        # a prefix, not a window: same block content after a different
+        # prefix hashes differently
+        other = prefix_hashes([99] + toks[1:], 4)
+        assert other[0] != h1[0] and other[1] != h1[1]
+        # agreeing prompts share exactly their common full blocks
+        div = prefix_hashes(toks[:8] + [99] * 12, 4)
+        assert div[:2] == h1[:2] and div[2] != h1[2]
+
+    def test_short_prompt_has_no_shareable_blocks(self):
+        assert prefix_hashes([1, 2, 3, 4], 4) == []   # needs len > bs
+        assert len(prefix_hashes([1, 2, 3, 4, 5], 4)) == 1
+
+
+class TestPrefixCacheUnit:
+    def test_lookup_increfs_and_longest_prefix(self):
+        a = BlockAllocator(8)
+        pc = PrefixCache(a)
+        blocks = a.alloc(3)
+        h = prefix_hashes(list(range(13)), 4)
+        for hj, b in zip(h, blocks):
+            pc.insert(hj, b)
+        assert a.refcount(blocks[0]) == 2      # caller + cache
+        got = pc.lookup(h[:2] + [b"nope"])
+        assert got == blocks[:2]
+        assert a.refcount(blocks[0]) == 3      # + the lookup's hold
+        assert a.refcount(blocks[2]) == 2      # not matched past miss
+
+    def test_insert_first_writer_wins(self):
+        a = BlockAllocator(8)
+        pc = PrefixCache(a)
+        b1, b2 = a.alloc(2)
+        h = prefix_hashes(list(range(5)), 4)[0]
+        assert pc.insert(h, b1) is True
+        assert pc.insert(h, b2) is False       # no double-index
+        assert pc.lookup([h]) == [b1]
+
+    def test_evict_one_drops_lru_and_frees_idle_blocks(self):
+        a = BlockAllocator(8)
+        pc = PrefixCache(a)
+        blocks = a.alloc(2)
+        h = prefix_hashes(list(range(9)), 4)
+        pc.insert(h[0], blocks[0])
+        pc.insert(h[1], blocks[1])
+        a.release(blocks)                      # sequences finished
+        assert a.free == 5                     # cache still holds both
+        pc.lookup([h[0]])                      # freshen h[0]; +1 hold
+        assert pc.evict_one() is True          # drops h[1] (LRU)
+        assert a.free == 6
+        assert pc.lookup([h[1]]) == []
+        assert len(pc) == 1
+
+    def test_max_entries_bounds_the_index(self):
+        a = BlockAllocator(16)
+        pc = PrefixCache(a, max_entries=2)
+        blocks = a.alloc(3)
+        h = prefix_hashes(list(range(13)), 4)
+        for hj, b in zip(h, blocks):
+            pc.insert(hj, b)
+        assert len(pc) == 2
+        assert pc.lookup([h[0]]) == []         # the LRU entry fell out
 
 
 class TestDecodeParity:
@@ -209,6 +307,356 @@ class TestDecodeParityTP:
             params, jnp.concatenate([tok, nxt], axis=1), cfg))
         np.testing.assert_allclose(np.asarray(lg2[:, 0]), full[:, 7],
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestQuantizedKV:
+    """Quantized-KV parity matrices (docs/serving.md#speed-levers):
+    prefill is EXACT vs the fp32 pool (this chunk attends at full
+    precision; a from-empty prefill has no past to dequantize), and
+    incremental decode stays within wire tolerance — at tp=1 and under
+    tp=2 shard_map."""
+
+    # Per-format wire tolerance: e4m3's 3-bit mantissa (~6% per value)
+    # is an order coarser than int8's 1/127 step, and two layers of
+    # attention compound it.
+    TOL = {"int8": dict(rtol=5e-2, atol=5e-2),
+           "fp8": dict(rtol=1e-1, atol=5e-1)}
+
+    @pytest.mark.parametrize("kv", ["int8", "fp8"])
+    def test_prefill_exact_vs_fp32_pool(self, model, kv):
+        cfg, params = model
+        tok = jax.random.randint(jax.random.PRNGKey(11), (1, 12), 0, 64)
+        cache_f = tfm.init_cache(cfg, 10, 4)
+        cache_q = tfm.init_cache(cfg, 10, 4, kv)
+        tables = jnp.arange(1, 7, dtype=jnp.int32)[None, :]
+        ref, _ = tfm.apply_decode(params, tok, jnp.zeros((1,), jnp.int32),
+                                  tables, cache_f, cfg)
+        lg, _ = tfm.apply_decode(params, tok, jnp.zeros((1,), jnp.int32),
+                                 tables, cache_q, cfg, kv_quant=kv,
+                                 exact_chunk=True)
+        np.testing.assert_array_equal(np.asarray(lg), np.asarray(ref))
+
+    @pytest.mark.parametrize("kv", ["int8", "fp8"])
+    def test_incremental_decode_within_wire_tolerance(self, model, kv):
+        """Token-by-token decode re-reads PAST tokens quantized; the
+        logits track the fp32 pool within the wire format's error."""
+        cfg, params = model
+        tok = jax.random.randint(jax.random.PRNGKey(12), (1, 11), 0, 64)
+        ref = np.asarray(tfm.apply(params, tok, cfg))
+        cache = tfm.init_cache(cfg, 10, 4, kv)
+        tables = jnp.arange(1, 7, dtype=jnp.int32)[None, :]
+        for i in range(11):
+            lg, cache = tfm.apply_decode(
+                params, tok[:, i:i + 1], jnp.array([i], jnp.int32),
+                tables, cache, cfg, kv_quant=kv)
+            np.testing.assert_allclose(np.asarray(lg[:, 0]), ref[:, i],
+                                       **self.TOL[kv])
+
+    def test_tp2_shard_map_parity(self, model):
+        """The tp=2 leg of the matrix: head-sharded quantized decode —
+        scales travel with their heads, so the quantization blocks are
+        IDENTICAL to tp=1 and the only extra error is the psum's fp
+        reassociation."""
+        cfg, params = model
+        cfg_tp = _cfg(tp_axis="tp")
+        mesh = create_mesh(devices=jax.devices()[:2], tp=2)
+        specs = tfm.param_specs(cfg_tp)
+        cspecs = tfm.cache_specs(cfg_tp, "int8")
+
+        def put(tree, sp):
+            return jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+                tree, sp, is_leaf=lambda x: isinstance(x, P))
+
+        sp_params = put(params, specs)
+        sp_cache = put(tfm.init_cache(cfg_tp, 10, 4, "int8"), cspecs)
+
+        def fwd(exact):
+            return jax.jit(jax.shard_map(
+                lambda p, c, t, s, bt: tfm.apply_decode(
+                    p, t, s, bt, c, cfg_tp, kv_quant="int8",
+                    exact_chunk=exact),
+                mesh=mesh, in_specs=(specs, cspecs, P(), P(), P()),
+                out_specs=(P(), cspecs), check_vma=False))
+
+        tok = jax.random.randint(jax.random.PRNGKey(13), (2, 7), 0, 64)
+        tables = jnp.array([[1, 2, 3], [4, 5, 6]], jnp.int32)
+        lg, sp_cache = fwd(True)(sp_params, sp_cache, tok,
+                                 jnp.zeros((2,), jnp.int32), tables)
+        ref = np.asarray(tfm.apply(params, tok, cfg))
+        # prefill: fp-reassociation tolerance only (same as the fp32
+        # tp=2 parity test) — quantization contributes nothing
+        np.testing.assert_allclose(np.asarray(lg), ref, rtol=1e-4,
+                                   atol=1e-5)
+        nxt = jnp.array([[9], [17]], jnp.int32)
+        lg2, _ = fwd(False)(sp_params, sp_cache, nxt,
+                            jnp.full((2,), 7, jnp.int32), tables)
+        full = np.asarray(tfm.apply(
+            params, jnp.concatenate([tok, nxt], axis=1), cfg))
+        np.testing.assert_allclose(np.asarray(lg2[:, 0]), full[:, 7],
+                                   **self.TOL["int8"])
+
+    def test_engine_greedy_output_matches_fp32(self, model, mesh1):
+        cfg, params = model
+        rng = np.random.RandomState(21)
+        prompts = [list(rng.randint(0, 64, int(n)))
+                   for n in rng.randint(3, 12, 4)]
+        ref = [_engine(params, cfg, mesh1).generate(p) for p in prompts]
+        for kv in ("int8", "fp8"):
+            eng = _engine(params, cfg, mesh1, kv_quant=kv)
+            assert [eng.generate(p) for p in prompts] == ref
+
+    def test_quantized_pool_4x_sequences_at_fixed_hbm(self):
+        """The capacity claim: at one fixed byte budget, the int8 pool
+        admits ~4x the sequences of the fp32 pool (3.76x at head_dim
+        64 — the fp32 scales are the overhead)."""
+        cfg = tfm.TransformerConfig(
+            vocab=32, d_model=128, n_heads=2, n_layers=1, d_ff=64,
+            max_seq=32, dtype=jnp.float32, remat=False)
+        params = tfm.init_params(cfg, jax.random.PRNGKey(3))
+        mesh = create_mesh(devices=jax.devices()[:1], tp=1)
+        budget = 8 * tfm.kv_bytes_per_block(cfg, 8)   # 8 fp32 blocks
+        admitted = {}
+        bytes_at_admit = {}
+        for kv in (None, "int8"):
+            per = tfm.kv_bytes_per_block(cfg, 8, kv)
+            n_blocks = budget // per + 1          # + scratch
+            eng = InferenceEngine(params, cfg, mesh, ServingConfig(
+                block_size=8, kv_blocks=n_blocks, max_batch_slots=16,
+                max_queue=32, max_new_tokens=8, min_prefill_bucket=8,
+                kv_quant=kv))
+            for _ in range(16):
+                eng.submit([1] * 9, max_new_tokens=8)   # 2 blocks each
+            eng.step()
+            admitted[kv] = eng.active_count
+            bytes_at_admit[kv] = eng._alloc.in_use * per
+            eng.run_until_idle()
+        assert admitted[None] == 4
+        assert admitted["int8"] >= 15
+        assert admitted["int8"] / admitted[None] >= 3.5
+        # both pools genuinely sit under the same byte budget
+        assert max(bytes_at_admit.values()) <= budget
+
+    def test_kv_bytes_resident_gauge(self, model, mesh1):
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1, kv_quant="int8")
+        eng.submit([1] * 9, max_new_tokens=8)
+        eng.step()
+        snap = hvd.metrics_snapshot()
+        expect = eng._alloc.in_use * tfm.kv_bytes_per_block(
+            cfg, 4, "int8")
+        assert snap["hvdtpu_serving_kv_bytes_resident"]["values"][""] \
+            == expect
+        eng.run_until_idle()
+
+
+class TestSpeculativeDecode:
+    """Greedy speculative decode must be TOKEN-IDENTICAL to the
+    non-speculative engine — with a perfect drafter (the flagship
+    itself: exercises long accepted chains) and with a random tiny
+    drafter (exercises rejection + rollback on nearly every step)."""
+
+    @pytest.fixture(scope="class")
+    def drafter(self):
+        dcfg = tfm.TransformerConfig(
+            vocab=64, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+            max_seq=64, dtype=jnp.float32, remat=False)
+        return dcfg, tfm.init_params(dcfg, jax.random.PRNGKey(9))
+
+    def _prompts(self, seed=0, n=5):
+        rng = np.random.RandomState(seed)
+        return [list(rng.randint(0, 64, int(m)))
+                for m in rng.randint(3, 12, n)]
+
+    def test_self_drafter_token_identical(self, model, mesh1):
+        cfg, params = model
+        ref_eng = _engine(params, cfg, mesh1)
+        spec = _engine(params, cfg, mesh1, spec_tokens=4,
+                       draft_params=params, draft_cfg=cfg)
+        for p in self._prompts(31):
+            assert spec.generate(p) == ref_eng.generate(p)
+
+    def test_random_drafter_token_identical(self, model, mesh1,
+                                            drafter):
+        """A drafter that proposes mostly garbage still yields exactly
+        the flagship's greedy output — only slower. This is the
+        rollback correctness test."""
+        cfg, params = model
+        dcfg, dparams = drafter
+        ref_eng = _engine(params, cfg, mesh1)
+        spec = _engine(params, cfg, mesh1, spec_tokens=3,
+                       draft_params=dparams, draft_cfg=dcfg)
+        prompts = self._prompts(32)
+        reqs = [spec.submit(p, max_new_tokens=7) for p in prompts]
+        spec.run_until_idle()
+        batched = [r.result() for r in reqs]
+        assert batched == [ref_eng.generate(p, max_new_tokens=7)
+                           for p in prompts]
+
+    def test_draft_counters_and_bounds(self, model, mesh1, drafter):
+        cfg, params = model
+        dcfg, dparams = drafter
+        before = hvd.metrics_snapshot()
+        spec = _engine(params, cfg, mesh1, spec_tokens=4,
+                       draft_params=dparams, draft_cfg=dcfg)
+        out = spec.generate([5, 9, 2], max_new_tokens=6)
+        assert len(out) == 6            # budget-exact despite chunks
+        snap = hvd.metrics_snapshot()
+
+        def delta(name):
+            return (snap[name]["values"].get("", 0)
+                    - before.get(name, {"values": {}})["values"]
+                    .get("", 0))
+
+        prop = delta("hvdtpu_serving_draft_proposed_tokens_total")
+        acc = delta("hvdtpu_serving_draft_accepted_tokens_total")
+        assert prop > 0 and 0 <= acc <= prop
+
+    def test_eos_inside_accepted_chunk_truncates(self, model, mesh1):
+        cfg, params = model
+        probe = _engine(params, cfg, mesh1).generate([6] * 4,
+                                                     max_new_tokens=8)
+        eos = probe[1]
+        ref = _engine(params, cfg, mesh1, eos_id=eos).generate(
+            [6] * 4, max_new_tokens=8)
+        spec = _engine(params, cfg, mesh1, eos_id=eos, spec_tokens=4,
+                       draft_params=params, draft_cfg=cfg)
+        out = spec.generate([6] * 4, max_new_tokens=8)
+        assert out == ref and out[-1] == eos and len(out) < 8
+
+    def test_temperature_slot_samples_exact_distribution(self, model,
+                                                         mesh1):
+        """A sampled request under a speculative engine advances one
+        seeded draw per step from the true next-token logits — the
+        same stream the non-speculative engine consumes."""
+        cfg, params = model
+        ref = _engine(params, cfg, mesh1, temperature=1.0,
+                      seed=5).generate([5, 6, 7], max_new_tokens=6)
+        spec = _engine(params, cfg, mesh1, temperature=1.0, seed=5,
+                       spec_tokens=4, draft_params=params,
+                       draft_cfg=cfg)
+        assert spec.generate([5, 6, 7], max_new_tokens=6) == ref
+
+    def test_config_validation(self, model, mesh1, drafter):
+        cfg, params = model
+        dcfg, dparams = drafter
+        with pytest.raises(ValueError, match="drafter"):
+            _engine(params, cfg, mesh1, spec_tokens=4)
+        with pytest.raises(ValueError, match="BOTH"):
+            InferenceEngine(params, cfg, mesh1, ServingConfig(),
+                            draft_params=dparams)
+        with pytest.raises(ValueError, match="vocab"):
+            bad = tfm.TransformerConfig(
+                vocab=32, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+                max_seq=64, dtype=jnp.float32, remat=False)
+            _engine(params, cfg, mesh1, spec_tokens=4,
+                    draft_params=tfm.init_params(
+                        bad, jax.random.PRNGKey(0)), draft_cfg=bad)
+        with pytest.raises(ValueError, match=">= 2"):
+            _engine(params, cfg, mesh1, spec_tokens=1,
+                    draft_params=dparams, draft_cfg=dcfg)
+
+    def test_all_levers_compose(self, model, mesh1):
+        """all-on (quantized pool + drafter + prefix cache) still
+        produces the quantized engine's greedy outputs — speculation
+        and sharing are exact; only quantization may move logits."""
+        cfg, params = model
+        rng = np.random.RandomState(33)
+        system = [int(t) for t in rng.randint(0, 64, 9)]
+        prompts = [system + [int(t) for t in rng.randint(0, 64, 3)]
+                   for _ in range(3)]
+        quant = _engine(params, cfg, mesh1, kv_quant="int8")
+        ref = [quant.generate(p, max_new_tokens=6) for p in prompts]
+        allon = _engine(params, cfg, mesh1, kv_quant="int8",
+                        prefix_cache=True, spec_tokens=4,
+                        draft_params=params, draft_cfg=cfg)
+        assert [allon.generate(p, max_new_tokens=6)
+                for p in prompts] == ref
+
+
+class TestPrefixCacheEngine:
+    def test_second_request_hits_and_matches_uncached(self, model,
+                                                      mesh1):
+        cfg, params = model
+        ref = _engine(params, cfg, mesh1).generate([7] * 13,
+                                                   max_new_tokens=6)
+        eng = _engine(params, cfg, mesh1, prefix_cache=True)
+        before = hvd.metrics_snapshot()
+        assert eng.generate([7] * 13, max_new_tokens=6) == ref
+        mid = hvd.metrics_snapshot()
+        assert eng.generate([7] * 13, max_new_tokens=6) == ref
+        after = hvd.metrics_snapshot()
+
+        def hits(snap):
+            return snap["hvdtpu_serving_prefix_cache_hits_total"][
+                "values"].get("", 0)
+
+        # 13-token prompt at block 4: blocks 0..2 shareable (the last
+        # token is never shared); first pass misses, second hits all 3
+        assert hits(mid) - hits(before) == 0
+        assert hits(after) - hits(mid) == 3
+
+    def test_divergent_tail_shares_prefix_only(self, model, mesh1):
+        cfg, params = model
+        plain = _engine(params, cfg, mesh1)
+        eng = _engine(params, cfg, mesh1, prefix_cache=True)
+        system = [3] * 8                        # two full blocks
+        a, b = system + [1, 2, 3], system + [4, 5, 6]
+        assert eng.generate(a, max_new_tokens=5) == \
+            plain.generate(a, max_new_tokens=5)
+        before = hvd.metrics_snapshot()
+        assert eng.generate(b, max_new_tokens=5) == \
+            plain.generate(b, max_new_tokens=5)
+        snap = hvd.metrics_snapshot()
+        name = "hvdtpu_serving_prefix_cache_hits_total"
+        assert snap[name]["values"][""] \
+            - before[name]["values"].get("", 0) == 2
+
+    def test_sharing_reduces_resident_blocks(self, model, mesh1):
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1, prefix_cache=True,
+                      max_batch_slots=2)
+        r1 = eng.submit([9] * 13, max_new_tokens=4)   # 4 blocks
+        r2 = eng.submit([9] * 13, max_new_tokens=4)
+        eng.step()
+        # uncached: 8 blocks; shared: r2 reuses r1's 3 prefix blocks
+        assert eng._alloc.in_use == 5
+        eng.run_until_idle()
+        assert r1.result() == r2.result()
+        # finished sequences release their holds; the cache keeps the
+        # 3 indexed prefix blocks resident for the next request
+        assert eng._alloc.in_use == 3
+
+    def test_pool_pressure_evicts_cached_blocks(self, model, mesh1):
+        """A full pool reclaims idle cached prefix blocks (LRU) rather
+        than deferring admission forever."""
+        cfg, params = model
+        # pool of 6: one request needs 4 blocks, its prompt caches 3
+        eng = _engine(params, cfg, mesh1, kv_blocks=7,
+                      prefix_cache=True)
+        plain = _engine(params, cfg, mesh1)
+        a, b = [5] * 13, [6] * 13
+        assert eng.generate(a, max_new_tokens=4) == \
+            plain.generate(a, max_new_tokens=4)
+        assert len(eng._prefix) == 3 and eng._alloc.free == 3
+        # b also needs 4 blocks: exactly one of a's cached blocks (the
+        # LRU) must be evicted for the admission to fit
+        assert eng.generate(b, max_new_tokens=4) == \
+            plain.generate(b, max_new_tokens=4)
+        assert len(eng._prefix) == 5    # a1, a2 + b's three
+        assert eng._alloc.in_use == 5 and eng._alloc.free == 1
+
+    def test_short_prompt_never_shares(self, model, mesh1):
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1, prefix_cache=True)
+        before = hvd.metrics_snapshot()
+        eng.generate([2, 3, 4], max_new_tokens=3)   # < one full block
+        eng.generate([2, 3, 4], max_new_tokens=3)
+        snap = hvd.metrics_snapshot()
+        for name in ("hvdtpu_serving_prefix_cache_hits_total",
+                     "hvdtpu_serving_prefix_cache_misses_total"):
+            assert snap[name]["values"].get("", 0) \
+                == before[name]["values"].get("", 0)
 
 
 class TestLoader:
@@ -582,6 +1030,9 @@ class TestServerHTTP:
         health = json.loads(resp.read())
         assert resp.status == 200 and health["status"] == "serving"
         assert health["kv_blocks_total"] == 39
+        # the prefix-hash granularity the fleet router scrapes
+        assert health["block_size"] == 4
+        assert health["prefix_cache"] is False
 
         status, body = self._post(srv.port, {"tokens": [1, 2, 3]})
         assert status == 200

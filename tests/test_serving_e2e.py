@@ -222,3 +222,51 @@ class TestServingBenchReproducible:
         # the headline wall-clock claim, both runs
         for run in outs:
             assert run["batched_vs_sequential_ratio"] >= 2.0, run
+
+
+@pytest.mark.slow
+class TestBenchSpeedReproducible:
+    def test_bench_speed_determinism_and_headlines(self, tmp_path):
+        """bench_serving.py --speed regenerates BENCH_SPEED
+        reproducibly (the trained weights are seeded, decode is
+        greedy, so every count/checksum/counter is identical across
+        runs) and supports the speed-lever acceptance claims:
+        speculative decode is token-identical and faster, the prefix
+        cache skips most prefill work and cuts TTFT, the quantized
+        pool holds the same sequences in < 0.30x the bytes."""
+        outs = []
+        for i in range(2):
+            out = tmp_path / f"speed{i}.json"
+            proc = subprocess.run(
+                [sys.executable, os.path.join(ROOT, "bench_serving.py"),
+                 "--speed", "--out", str(out)],
+                capture_output=True, text=True, timeout=1800, cwd=ROOT)
+            assert proc.returncode == 0, (
+                f"--speed run {i} failed:\n{proc.stderr[-3000:]}")
+            outs.append(json.loads(out.read_text()))
+        a, b = outs
+        deterministic = ("generated_tokens", "prefill_tokens",
+                         "output_checksum", "decode_steps",
+                         "kv_bytes_resident", "prefix_hits",
+                         "prefix_misses", "draft_proposed",
+                         "draft_accepted")
+        for arm in a["arms"]:
+            for key in deterministic:
+                assert a["arms"][arm][key] == b["arms"][arm][key], \
+                    (arm, key)
+        for run in outs:
+            h = run["headlines"]
+            # exactness claims (seeded-deterministic)
+            assert h["speculative_outputs_equal_baseline"]
+            assert h["quantized_outputs_equal_fp32"]
+            assert h["all_on_outputs_equal_quantized"]
+            assert h["draft_acceptance"] >= 0.8
+            # the prefix cache provably skipped most prompt prefill
+            assert h["prefix_prefill_tokens_ratio"] <= 0.5
+            # byte accounting is exact: int8 payload + fp32 scales
+            assert h["quantized_kv_bytes_ratio"] <= 0.30
+            # wall-clock claims, held loosely here (the committed
+            # BENCH_SPEED.json records the measured 1.5x+ / 0.7x):
+            # a loaded CI box must not flake the guard
+            assert h["speculative_speedup"] >= 1.1, h
+            assert h["prefix_ttft_p50_ratio"] <= 1.0, h
