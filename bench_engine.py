@@ -1079,6 +1079,167 @@ def main_pipeline(out_path: str, microbatches: str = "4,8,16") -> dict:
     return result
 
 
+# --------------------------------------------------------------------------
+# Input-pipeline bench (--data): prefetch-to-device on/off step-time A/B on
+# a deliberately slow synthetic source, plus the exactly-once resume count
+# across a 2 -> 1 -> 2 world-size path — writes BENCH_DATA.json
+# (docs/data.md, docs/benchmarks.md). Seeded-deterministic fields: sample-id
+# checksums and every count; wall-clock fields are excluded from the
+# reproducibility compare (tests/test_data_e2e.py).
+# --------------------------------------------------------------------------
+
+DATA_STEPS = int(os.environ.get("HVD_BENCH_DATA_STEPS", 40))
+_DATA_BATCH = 32
+_DATA_N = 4096
+_DATA_SEED = 13
+_DATA_DELAY_S = 0.004     # per-batch source cost the prefetch must hide
+
+
+def _ids_checksum(ids) -> int:
+    import zlib
+
+    import numpy as _np
+    return zlib.crc32(_np.asarray(sorted(int(i) for i in ids),
+                                  dtype="<i8").tobytes())
+
+
+def run_data_arm(prefetch: bool, steps: int) -> dict:
+    """One arm: `steps` training steps drawing real batches through the
+    loader, source throttled by _DATA_DELAY_S per batch. Returns wall
+    stats + the delivered-id checksum (deterministic)."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as _np
+
+    from horovod_tpu import data
+
+    def slow(arrays):
+        _time.sleep(_DATA_DELAY_S)
+        return arrays
+
+    src = data.synthetic("image", n=_DATA_N, image_size=16,
+                         num_classes=10, seed=_DATA_SEED)
+    loader = data.build_loader(src, batch_size=_DATA_BATCH, rank=0,
+                               world_size=1, seed=_DATA_SEED,
+                               transform=slow)
+
+    # A two-layer MLP sized so the step's compute is comparable to the
+    # source delay — the regime where overlap actually pays (a trivial
+    # step would leave both arms producer-bound and flatten the A/B).
+    hidden = 1024
+
+    @jax.jit
+    def step(w, x, y):
+        onehot = jax.nn.one_hot(y, 10)
+
+        def loss(ws):
+            h = jax.nn.relu(x.reshape(x.shape[0], -1) @ ws["w1"])
+            return jnp.mean((h @ ws["w2"] - onehot) ** 2)
+
+        g = jax.grad(loss)(w)
+        return {k: w[k] - 0.01 * g[k] for k in w}
+
+    import numpy as _rngnp
+    rng = _rngnp.random.RandomState(_DATA_SEED)
+    w = {"w1": jnp.asarray(rng.randn(16 * 16 * 3, hidden).astype(
+            "float32") * 0.02),
+         "w2": jnp.asarray(rng.randn(hidden, 10).astype("float32")
+                           * 0.02)}
+    it = data.prefetch_to_device(loader, depth=2) if prefetch \
+        else iter(loader)
+    ids = []
+    # Warmup: one staged batch to compile the step outside the window.
+    b0 = next(it)
+    b0 = b0 if prefetch else data.stage(b0)
+    ids.extend(b0.ids.tolist())
+    w = step(w, b0.data[0], b0.data[1])
+    jax.block_until_ready(w)
+    t0 = _time.perf_counter()
+    for _ in range(steps):
+        b = next(it)
+        if not prefetch:
+            b = data.stage(b)
+        ids.extend(b.ids.tolist())
+        w = step(w, b.data[0], b.data[1])
+        jax.block_until_ready(w)
+    wall = _time.perf_counter() - t0
+    if prefetch:
+        it.close()
+    return {"ms_per_step": round(wall / steps * 1e3, 3),
+            "samples": len(ids),
+            "ids_checksum": _ids_checksum(ids),
+            "weights_sum": float(_np.asarray(jnp.sum(w["w2"])))}
+
+
+def run_data_exactly_once() -> dict:
+    """Exactly-once across a world-size change, in-process: 2 ranks
+    consume and commit, 1 rank resumes and commits, 2 ranks finish the
+    epoch — the multiset must be one clean epoch (docs/data.md)."""
+    from horovod_tpu import data
+
+    src = data.synthetic("image", n=_DATA_N, image_size=8,
+                         num_classes=10, seed=_DATA_SEED)
+    ds = data.ShardedDataset(src, batch_size=_DATA_BATCH,
+                             seed=_DATA_SEED)
+    consumed = []
+    l2 = [data.build_loader(src, batch_size=_DATA_BATCH, rank=r,
+                            world_size=2, seed=_DATA_SEED)
+          for r in range(2)]
+    for _ in range(20):
+        for ld in l2:
+            consumed.extend(next(ld).ids.tolist())
+    cur = l2[0].commit_cursor()
+    l1 = data.build_loader(src, batch_size=_DATA_BATCH, rank=0,
+                           world_size=1, seed=_DATA_SEED).restore(cur)
+    for _ in range(15):
+        consumed.extend(next(l1).ids.tolist())
+    cur = l1.commit_cursor()
+    l2b = [data.build_loader(src, batch_size=_DATA_BATCH, rank=r,
+                             world_size=2, seed=_DATA_SEED, epochs=1
+                             ).restore(cur) for r in range(2)]
+    for ld in l2b:
+        for b in ld:
+            consumed.extend(b.ids.tolist())
+    clean = sorted(ds.epoch_ids(0).tolist())
+    got = sorted(consumed)
+    dup = len(consumed) - len(set(consumed))
+    gaps = len(set(clean) - set(consumed))
+    return {"epoch_samples": ds.usable,
+            "consumed": len(consumed),
+            "duplicates": dup,
+            "gaps": gaps,
+            "world_path": [2, 1, 2],
+            "ids_match_clean_epoch": got == clean,
+            "ids_checksum": _ids_checksum(consumed),
+            "resume_skips": (20 * 2 + 15) * _DATA_BATCH}
+
+
+def main_data(steps: int, out_path: str) -> dict:
+    off = run_data_arm(prefetch=False, steps=steps)
+    on = run_data_arm(prefetch=True, steps=steps)
+    exactly = run_data_exactly_once()
+    out = {
+        "metric": "data_prefetch_step_ms_ratio",
+        "value": round(on["ms_per_step"] / off["ms_per_step"], 3),
+        "unit": "prefetch_on/prefetch_off (lower is better)",
+        "steps": steps,
+        "batch": _DATA_BATCH,
+        "source_delay_ms": _DATA_DELAY_S * 1e3,
+        "prefetch": {"off": off, "on": on},
+        "exactly_once": exactly,
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({k: out[k] for k in
+                      ("metric", "value", "unit")} |
+                     {"exactly_once_ok":
+                      exactly["ids_match_clean_epoch"]}))
+    return out
+
+
 def main():
     sweep = {}
     best = 0.0
@@ -1150,6 +1311,13 @@ if __name__ == "__main__":
     ap.add_argument("--pipeline-microbatches", default="4,8,16",
                     help="comma-separated microbatch counts for "
                          "--pipeline")
+    ap.add_argument("--data", action="store_true",
+                    help="run the input-pipeline bench (prefetch on/off "
+                         "step-time A/B on a throttled source + "
+                         "exactly-once resume counts) and write "
+                         "BENCH_DATA.json")
+    ap.add_argument("--data-steps", type=int, default=DATA_STEPS,
+                    help="training steps per arm for --data")
     ap.add_argument("--recorder-rounds", type=int,
                     default=RECORDER_ROUNDS,
                     help="alternating on/off rounds for --recorder")
@@ -1185,5 +1353,8 @@ if __name__ == "__main__":
         main_pipeline(args.out or os.path.join(here,
                                                "BENCH_PIPELINE.json"),
                       microbatches=args.pipeline_microbatches)
+    elif args.data:
+        main_data(args.data_steps, args.out or os.path.join(
+            here, "BENCH_DATA.json"))
     else:
         main()

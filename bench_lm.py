@@ -9,6 +9,11 @@ tunnels), per-config median over R timed windows, and all configs run
 INTERLEAVED in ONE process — absolute throughput on a shared chip
 drifts +-30% between runs, so only in-process A/B is trustworthy.
 
+Input: tokens flow through the real input pipeline (horovod_tpu/data
+sharded loader over a data.synthetic token source, docs/data.md), one
+loader batch per fori_loop step, pre-staged as a [K,B,S] device stack
+so per-step host dispatch stays off the timed path.
+
 MFU uses the MODEL-FLOPs convention (6·N·T + attention FLOPs), NOT
 XLA cost_analysis: with rematerialization the executed-FLOP count
 includes recomputation, which would inflate "utilization" for doing
@@ -173,20 +178,30 @@ def bench_config(name, overrides, seq, peak):
     params = tfm.init_params(cfg, jax.random.PRNGKey(0))
     n_params = sum(int(np.prod(l.shape))
                    for l in jax.tree_util.tree_leaves(params))
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
-                                32000)
-    targets = jnp.roll(tokens, -1, axis=1)
+    # Tokens come through the real input pipeline (horovod_tpu/data:
+    # synthetic source -> sharded loader), not a jax.random bypass —
+    # one loader batch per fori_loop step, staged as a [K,B,S] stack up
+    # front so the timed window keeps measuring the device, not host
+    # dispatch (docs/data.md). Seeded: every run draws the same stack.
+    from horovod_tpu import data as hvd_data
+    src = hvd_data.synthetic("tokens", n=max(K * batch, 256),
+                             seq_len=seq, vocab=base["vocab"], seed=1)
+    loader = hvd_data.build_loader(src, batch_size=batch, rank=0,
+                                   world_size=1, seed=1)
+    tokens_k = jnp.asarray(np.stack(
+        [next(loader).data[0] for _ in range(K)]))   # [K, B, S] int32
     opt = optax.adamw(3e-4, mu_dtype=jnp.bfloat16 if mu_bf16 else None)
     state = opt.init(params)
 
-    def loss_fn(p):
+    def loss_fn(p, tokens):
+        targets = jnp.roll(tokens, -1, axis=1)
         return tfm.loss_fn(p, tokens, targets, cfg)
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def train_k(p, s):
-        def body(_, carry):
+        def body(i, carry):
             p, s = carry
-            _, g = jax.value_and_grad(loss_fn)(p)
+            _, g = jax.value_and_grad(loss_fn)(p, tokens_k[i])
             up, s = opt.update(g, s, p)
             return optax.apply_updates(p, up), s
         return jax.lax.fori_loop(0, K, body, (p, s))

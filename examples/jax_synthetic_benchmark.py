@@ -4,8 +4,16 @@ equivalent of examples/tensorflow_synthetic_benchmark.py (120 LoC:
 Keras-applications model on random data, 10 warmup batches, 10x10 timed
 batches, img/sec mean +- 1.96 sigma).
 
+Input rides the real pipeline (docs/data.md): a ``data.synthetic()``
+image source through the sharded loader with prefetch-to-device — NOT a
+pre-staged device constant — so the run exercises (and the StepTimer +
+``tools/trace report`` attribute) the same input/h2d path a real
+dataset would. A deliberately slow source here flips the trace-report
+verdict to input-bound; prefetch hides it again.
+
     python examples/jax_synthetic_benchmark.py --model ResNet50
     python examples/jax_synthetic_benchmark.py --model VGG16 --batch-size 32
+    python examples/jax_synthetic_benchmark.py --no-prefetch  # staging A/B
 """
 
 import argparse
@@ -23,9 +31,8 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import horovod_tpu as hvd
+from horovod_tpu import data as hvd_data
 from horovod_tpu import models as zoo
-
-from _data import synthetic_imagenet  # noqa: E402
 
 
 def parse_args():
@@ -38,6 +45,13 @@ def parse_args():
     p.add_argument("--num-batches-per-iter", type=int, default=10)
     p.add_argument("--num-iters", type=int, default=10)
     p.add_argument("--image-size", type=int, default=None)
+    p.add_argument("--dataset-size", type=int, default=None,
+                   help="synthetic dataset size (default: enough for "
+                        "one run without epoch wrap)")
+    p.add_argument("--no-prefetch", action="store_true",
+                   help="stage batches synchronously instead of the "
+                        "double-buffered prefetch-to-device path")
+    p.add_argument("--prefetch-depth", type=int, default=2)
     return p.parse_args()
 
 
@@ -51,10 +65,24 @@ def main():
 
     model = getattr(zoo, args.model)(num_classes=1000)
     batch = args.batch_size * n
-    images_np, labels_np = synthetic_imagenet(batch, image_size)
+
+    # The real input path (docs/data.md): synthetic SOURCE -> sharded
+    # loader -> prefetch-to-device, with the StepTimer attributing
+    # input vs h2d vs compute per step.
+    n_samples = args.dataset_size or max(
+        batch * (args.num_warmup_batches
+                 + args.num_iters * args.num_batches_per_iter + 2),
+        4 * batch)
+    src = hvd_data.synthetic("image", n=n_samples,
+                             image_size=image_size, num_classes=1000,
+                             seed=1234)
+    loader = hvd_data.build_loader(src, batch_size=batch, rank=0,
+                                   world_size=1, seed=0)
+
     rng = jax.random.PRNGKey(0)
+    tmpl = src.take(np.arange(2))
     variables = model.init({"params": rng, "dropout": rng},
-                           jnp.asarray(images_np[:2]), train=False)
+                           jnp.asarray(tmpl[0]), train=False)
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
 
@@ -63,69 +91,69 @@ def main():
         optax.sgd(0.01 * n, momentum=0.9))
     opt_state = opt.init(params)
 
-    images = jnp.asarray(images_np)
-    labels = jnp.asarray(labels_np)
-    if n > 1:
-        images = jax.device_put(images, NamedSharding(mesh, P("dp")))
-        labels = jax.device_put(labels, NamedSharding(mesh, P("dp")))
-
     has_bn = bool(batch_stats)
+    sharding = NamedSharding(mesh, P("dp")) if n > 1 else None
 
     from functools import partial
 
-    # One jitted fori_loop per timed iteration (k optimizer steps, one
-    # dispatch) with donated state — same levers as bench.py; host
-    # latency stays out of the measured device time.
-    @partial(jax.jit, donate_argnums=(0, 1, 2), static_argnums=(5,))
-    def train_k(params, batch_stats, opt_state, x, y, k):
-        def body(i, carry):
-            params, batch_stats, opt_state = carry
-            r = jax.random.fold_in(rng, i)
+    from horovod_tpu.observability import StepTimer
 
-            def loss_fn(p):
-                var = {"params": p}
-                if has_bn:
-                    var["batch_stats"] = batch_stats
-                    logits, new = model.apply(var, x, train=True,
-                                              rngs={"dropout": r},
-                                              mutable=["batch_stats"])
-                    return (optax
-                            .softmax_cross_entropy_with_integer_labels(
-                                logits, y).mean(), new["batch_stats"])
-                logits = model.apply(var, x, train=True,
-                                     rngs={"dropout": r})
-                return (optax.softmax_cross_entropy_with_integer_labels(
-                    logits, y).mean(), batch_stats)
+    timer = StepTimer("jax_synthetic", batch_size=batch)
 
-            (_, new_bs), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params)
-            updates, new_opt = opt.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), new_bs, new_opt
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    def train_step(params, batch_stats, opt_state, x, y, i):
+        r = jax.random.fold_in(rng, i)
 
-        return jax.lax.fori_loop(0, k, body,
-                                 (params, batch_stats, opt_state))
+        def loss_fn(p):
+            var = {"params": p}
+            if has_bn:
+                var["batch_stats"] = batch_stats
+                logits, new = model.apply(var, x, train=True,
+                                          rngs={"dropout": r},
+                                          mutable=["batch_stats"])
+                return (optax
+                        .softmax_cross_entropy_with_integer_labels(
+                            logits, y).mean(), new["batch_stats"])
+            logits = model.apply(var, x, train=True,
+                                 rngs={"dropout": r})
+            return (optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean(), batch_stats)
+
+        (_, new_bs), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, new_opt = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_bs, new_opt
+
+    if args.no_prefetch:
+        it = iter(loader)
+    else:
+        it = hvd_data.prefetch_to_device(loader, sharding,
+                                         depth=args.prefetch_depth,
+                                         timer=timer)
+
+    step_idx = 0
 
     def run(k):
-        nonlocal params, batch_stats, opt_state
-        params, batch_stats, opt_state = train_k(
-            params, batch_stats, opt_state, images, labels, k)
-        # device-to-host read: the only reliable full sync
-        float(jnp.sum(jax.tree_util.tree_leaves(params)[0]))
+        nonlocal params, batch_stats, opt_state, step_idx
+        for _ in range(k):
+            b = next(it)
+            timer.begin()
+            if args.no_prefetch:
+                b = hvd_data.stage(b, sharding, timer=timer)
+            params, batch_stats, opt_state = train_step(
+                params, batch_stats, opt_state, b.data[0], b.data[1],
+                step_idx)
+            step_idx += 1
+            # device-to-host read: the only reliable full sync
+            float(jnp.sum(jax.tree_util.tree_leaves(params)[0]))
+            timer.end()
 
     if hvd.rank() == 0:
         print(f"Model: {args.model}, batch {args.batch_size}/chip x "
-              f"{n} chips")
-    # Warmup with the SAME static k as the timed iterations so the
-    # timed executable is compiled before measurement (a different k
-    # would be a separate trace+compile landing inside iter #0).
-    # --num-warmup-batches 0 measures cold-start compile; other values
-    # round UP to whole iterations (announced, not silent).
-    warmup_calls = -(-args.num_warmup_batches // args.num_batches_per_iter)
-    actual = warmup_calls * args.num_batches_per_iter
-    if hvd.rank() == 0 and actual != args.num_warmup_batches:
-        print(f"warmup rounded to {actual} batches "
-              f"({warmup_calls} x {args.num_batches_per_iter})")
-    for _ in range(warmup_calls):
+              f"{n} chips, dataset {n_samples} samples, prefetch "
+              f"{'off' if args.no_prefetch else args.prefetch_depth}")
+    for _ in range(-(-args.num_warmup_batches
+                     // args.num_batches_per_iter)):
         run(args.num_batches_per_iter)  # warmup (reference :88-92)
 
     img_secs = []
@@ -138,10 +166,16 @@ def main():
             print(f"Iter #{i}: {rate:.1f} img/sec total")
         img_secs.append(rate)
 
+    if not args.no_prefetch:
+        it.close()
     if hvd.rank() == 0:
         mean, conf = np.mean(img_secs), 1.96 * np.std(img_secs)
+        ph = timer.last_phases
         print(f"Img/sec total: {mean:.1f} +- {conf:.1f}  "
               f"({mean / n:.1f}/chip on {n} chips)")
+        print("Last-step attribution (hvdtpu_step_phase_seconds): "
+              + ", ".join(f"{p}={ph[p] * 1e3:.1f}ms" for p in
+                          ("input", "h2d", "compute", "collective")))
 
 
 if __name__ == "__main__":

@@ -43,6 +43,16 @@ def metrics_registry():
     registering application-level counters next to the framework's."""
     return get_registry()
 
+
+def __getattr__(name):
+    # The input-pipeline subsystem (docs/data.md) resolves lazily:
+    # `hvd.data.build_loader(...)` works without paying its import on
+    # every `import horovod_tpu`.
+    if name == "data":
+        import importlib
+        return importlib.import_module(".data", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 __version__ = "0.1.0"
 
 __all__ = [

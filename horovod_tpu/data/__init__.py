@@ -1,0 +1,47 @@
+"""Pod-scale input pipeline (docs/data.md, ISSUE 13).
+
+The subsystem that makes every training bench honest about where time
+goes: deterministic per-rank sharded loaders, double-buffered
+prefetch-to-device wired into the StepTimer attribution, elastic-aware
+exactly-once resumable cursors riding the checkpoint engine, and
+distributed batch norm for the conv zoo.
+
+    from horovod_tpu import data
+
+    src = data.synthetic("image", n=50_000, image_size=224,
+                         num_classes=1000, seed=0)
+    loader = data.build_loader(src, batch_size=32, seed=0)
+    for batch in data.prefetch_to_device(loader, hvd.mesh(), depth=2,
+                                         timer=step_timer):
+        ...
+
+``data.sync_bn`` (SyncBatchNorm) imports flax and is loaded lazily so
+the loader/prefetch layers stay usable without the model stack.
+"""
+
+from .loader import (Batch, ShardedDataset, ShardedLoader, build_loader)
+from .prefetch import DevicePrefetcher, prefetch_to_device, stage
+from .sharding import epoch_permutation, total_microbatches, \
+    usable_samples
+from .sources import (ArraySource, CallableSource, FileListSource,
+                      SyntheticSource, as_source, synthetic)
+
+__all__ = [
+    "ArraySource", "Batch", "CallableSource", "DevicePrefetcher",
+    "FileListSource", "ShardedDataset", "ShardedLoader",
+    "SyncBatchNorm", "SyntheticSource", "as_source", "build_loader",
+    "epoch_permutation", "prefetch_to_device", "stage", "sync_bn",
+    "synthetic", "sync_batch_norm", "total_microbatches",
+    "usable_samples",
+]
+
+
+def __getattr__(name):
+    # flax-dependent surface, resolved on first touch.
+    if name in ("SyncBatchNorm", "sync_batch_norm", "batch_moments",
+                "sync_bn"):
+        from . import sync_bn as _sbn
+        if name == "sync_bn":
+            return _sbn
+        return getattr(_sbn, name)
+    raise AttributeError(name)

@@ -1,0 +1,127 @@
+"""Distributed (cross-replica) batch normalization — the remaining
+large-batch technique from "Scale MLPerf-0.6 models on Google TPU-v3
+Pods" (arXiv 1909.09756) not yet carried (docs/data.md#sync-bn).
+
+At pod scale the per-replica batch shrinks until local batch statistics
+are too noisy to train on (MLPerf ResNet at batch 64/replica already
+trains on cross-replica stats). :func:`sync_batch_norm` computes the
+batch moments over the whole ``dp`` axis with **one** fused collective:
+the local sum and sum-of-squares vectors are concatenated into a single
+``[2C]`` buffer and psum'd together (one launch, one ring traversal —
+the same fusion argument as the engine's tensor fusion, applied inside
+the jitted program), then mean/var derive locally. The count is static
+(`local batch × axis size`), so nothing else crosses the wire.
+
+:class:`SyncBatchNorm` wraps it in the exact ``nn.BatchNorm`` layout —
+params ``scale``/``bias``, ``batch_stats`` collection ``mean``/``var``,
+biased fp32 moments, identical momentum update — so checkpoints are
+interchangeable with the local-BN models and the conv zoo adopts it by
+swapping the norm constructor (``ResNet50(bn_axis_name='dp')``).
+
+Parity contract (tests/test_data.py): under ``shard_map`` over
+``dp=K``, forward outputs and input/parameter gradients match a single
+device running ``nn.BatchNorm`` on the concatenated batch at
+rtol 1e-5.
+
+Outside any mapped context (``axis_name=None``) it degrades to local
+batch norm — the single-device path and the distributed path are one
+implementation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..parallel import collectives as _coll
+
+
+def batch_moments(x: jnp.ndarray, axis_name: Optional[str] = "dp"
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Biased mean/var over all axes but the last, across ``axis_name``
+    when given — one psum of the concatenated ``[sum, sum_sq]`` buffer
+    (the fused collective path). Returns fp32 ``(mean, var)`` of shape
+    ``[C]``."""
+    x32 = x.astype(jnp.float32)
+    axes = tuple(range(x32.ndim - 1))
+    s1 = jnp.sum(x32, axis=axes)
+    s2 = jnp.sum(x32 * x32, axis=axes)
+    count = 1
+    for d in axes:
+        count *= x.shape[d]
+    if axis_name is not None:
+        fused = _coll.psum(jnp.concatenate([s1, s2]), axis_name)
+        c = x.shape[-1]
+        s1, s2 = fused[:c], fused[c:]
+        count = count * _coll.axis_size(axis_name)
+    mean = s1 / count
+    var = s2 / count - mean * mean
+    return mean, var
+
+
+def sync_batch_norm(x: jnp.ndarray, scale: jnp.ndarray,
+                    bias: jnp.ndarray, *,
+                    axis_name: Optional[str] = "dp",
+                    epsilon: float = 1e-5,
+                    dtype: Optional[Any] = None
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Functional core: normalize ``x`` by the cross-replica batch
+    moments. Returns ``(y, mean, var)`` — the moments are what the
+    module folds into the running statistics."""
+    mean, var = batch_moments(x, axis_name)
+    y = _normalize(x, mean, var, scale, bias, epsilon, dtype)
+    return y, mean, var
+
+
+def _normalize(x, mean, var, scale, bias, epsilon, dtype):
+    x32 = x.astype(jnp.float32)
+    inv = jnp.reciprocal(jnp.sqrt(var + epsilon))
+    y = (x32 - mean) * inv * scale + bias
+    return y.astype(dtype if dtype is not None else x.dtype)
+
+
+class SyncBatchNorm(nn.Module):
+    """Drop-in ``nn.BatchNorm`` with cross-replica statistics (see
+    module docstring). Same parameter/stat layout as ``nn.BatchNorm``
+    and :class:`~horovod_tpu.models.resnet.FusedBNAct`, so the three
+    norm implementations share checkpoints."""
+
+    use_running_average: bool = False
+    axis_name: Optional[str] = "dp"
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Optional[Any] = None
+    scale_init: Callable = nn.initializers.ones
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        scale = self.param("scale", self.scale_init, (c,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (c,),
+                          jnp.float32)
+        ra_mean = self.variable(
+            "batch_stats", "mean",
+            lambda s: jnp.zeros(s, jnp.float32), (c,))
+        ra_var = self.variable(
+            "batch_stats", "var",
+            lambda s: jnp.ones(s, jnp.float32), (c,))
+        if self.use_running_average:
+            # Inference needs no collective: running stats are already
+            # replica-identical (they fold replica-identical batch
+            # moments).
+            return _normalize(x, ra_mean.value, ra_var.value, scale,
+                              bias, self.epsilon, self.dtype)
+        # Shape inference (init) commonly runs OUTSIDE the mapped
+        # context where the axis is unbound; the moments are discarded
+        # there, so local statistics are exactly as good.
+        axis = None if self.is_initializing() else self.axis_name
+        y, mean, var = sync_batch_norm(
+            x, scale, bias, axis_name=axis,
+            epsilon=self.epsilon, dtype=self.dtype)
+        if not self.is_initializing():
+            m = self.momentum
+            ra_mean.value = m * ra_mean.value + (1.0 - m) * mean
+            ra_var.value = m * ra_var.value + (1.0 - m) * var
+        return y

@@ -15,7 +15,7 @@ TPU-first choices: bf16 activations / fp32 params + fp32 BN, NHWC.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -27,14 +27,26 @@ class ConvBN(nn.Module):
     strides: tuple = (1, 1)
     padding: Any = "SAME"
     dtype: Any = jnp.bfloat16
+    # Distributed batch norm over the named mesh axis when set
+    # (docs/data.md#sync-bn) — same param/stat tree as nn.BatchNorm.
+    bn_axis_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         x = nn.Conv(self.filters, self.kernel, self.strides,
                     padding=self.padding, use_bias=False,
                     dtype=self.dtype)(x)
-        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         epsilon=1e-3, dtype=jnp.float32)(x)
+        if self.bn_axis_name is not None:
+            from ..data.sync_bn import SyncBatchNorm
+            # Pinned name: the local path's auto-generated module name,
+            # so local and sync-BN checkpoints stay interchangeable.
+            x = SyncBatchNorm(use_running_average=not train,
+                              axis_name=self.bn_axis_name, momentum=0.9,
+                              epsilon=1e-3, dtype=jnp.float32,
+                              name="BatchNorm_0")(x)
+        else:
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                             epsilon=1e-3, dtype=jnp.float32)(x)
         return nn.relu(x)
 
 
@@ -45,10 +57,12 @@ def _avgpool_same(x):
 class InceptionA(nn.Module):
     pool_features: int
     dtype: Any = jnp.bfloat16
+    bn_axis_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        cbn = partial(ConvBN, dtype=self.dtype)
+        cbn = partial(ConvBN, dtype=self.dtype,
+                      bn_axis_name=self.bn_axis_name)
         b1 = cbn(64, (1, 1))(x, train)
         b2 = cbn(48, (1, 1))(x, train)
         b2 = cbn(64, (5, 5))(b2, train)
@@ -63,10 +77,12 @@ class InceptionB(nn.Module):
     """Grid reduction 35x35 -> 17x17."""
 
     dtype: Any = jnp.bfloat16
+    bn_axis_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        cbn = partial(ConvBN, dtype=self.dtype)
+        cbn = partial(ConvBN, dtype=self.dtype,
+                      bn_axis_name=self.bn_axis_name)
         b1 = cbn(384, (3, 3), (2, 2), "VALID")(x, train)
         b2 = cbn(64, (1, 1))(x, train)
         b2 = cbn(96, (3, 3))(b2, train)
@@ -80,10 +96,12 @@ class InceptionC(nn.Module):
 
     channels_7x7: int
     dtype: Any = jnp.bfloat16
+    bn_axis_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        cbn = partial(ConvBN, dtype=self.dtype)
+        cbn = partial(ConvBN, dtype=self.dtype,
+                      bn_axis_name=self.bn_axis_name)
         c7 = self.channels_7x7
         b1 = cbn(192, (1, 1))(x, train)
         b2 = cbn(c7, (1, 1))(x, train)
@@ -102,10 +120,12 @@ class InceptionD(nn.Module):
     """Grid reduction 17x17 -> 8x8."""
 
     dtype: Any = jnp.bfloat16
+    bn_axis_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        cbn = partial(ConvBN, dtype=self.dtype)
+        cbn = partial(ConvBN, dtype=self.dtype,
+                      bn_axis_name=self.bn_axis_name)
         b1 = cbn(192, (1, 1))(x, train)
         b1 = cbn(320, (3, 3), (2, 2), "VALID")(b1, train)
         b2 = cbn(192, (1, 1))(x, train)
@@ -120,10 +140,12 @@ class InceptionE(nn.Module):
     """Expanded-filter-bank output blocks."""
 
     dtype: Any = jnp.bfloat16
+    bn_axis_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        cbn = partial(ConvBN, dtype=self.dtype)
+        cbn = partial(ConvBN, dtype=self.dtype,
+                      bn_axis_name=self.bn_axis_name)
         b1 = cbn(320, (1, 1))(x, train)
         b2 = cbn(384, (1, 1))(x, train)
         b2 = jnp.concatenate([cbn(384, (1, 3))(b2, train),
@@ -141,10 +163,12 @@ class InceptionV3(nn.Module):
 
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
+    bn_axis_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        cbn = partial(ConvBN, dtype=self.dtype)
+        cbn = partial(ConvBN, dtype=self.dtype,
+                      bn_axis_name=self.bn_axis_name)
         x = x.astype(self.dtype)
         # Stem
         x = cbn(32, (3, 3), (2, 2), "VALID")(x, train)
@@ -155,17 +179,28 @@ class InceptionV3(nn.Module):
         x = cbn(192, (3, 3), padding="VALID")(x, train)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
         # Inception stacks
-        x = InceptionA(32, dtype=self.dtype)(x, train)
-        x = InceptionA(64, dtype=self.dtype)(x, train)
-        x = InceptionA(64, dtype=self.dtype)(x, train)
-        x = InceptionB(dtype=self.dtype)(x, train)
-        x = InceptionC(128, dtype=self.dtype)(x, train)
-        x = InceptionC(160, dtype=self.dtype)(x, train)
-        x = InceptionC(160, dtype=self.dtype)(x, train)
-        x = InceptionC(192, dtype=self.dtype)(x, train)
-        x = InceptionD(dtype=self.dtype)(x, train)
-        x = InceptionE(dtype=self.dtype)(x, train)
-        x = InceptionE(dtype=self.dtype)(x, train)
+        x = InceptionA(32, dtype=self.dtype,
+                       bn_axis_name=self.bn_axis_name)(x, train)
+        x = InceptionA(64, dtype=self.dtype,
+                       bn_axis_name=self.bn_axis_name)(x, train)
+        x = InceptionA(64, dtype=self.dtype,
+                       bn_axis_name=self.bn_axis_name)(x, train)
+        x = InceptionB(dtype=self.dtype,
+                       bn_axis_name=self.bn_axis_name)(x, train)
+        x = InceptionC(128, dtype=self.dtype,
+                       bn_axis_name=self.bn_axis_name)(x, train)
+        x = InceptionC(160, dtype=self.dtype,
+                       bn_axis_name=self.bn_axis_name)(x, train)
+        x = InceptionC(160, dtype=self.dtype,
+                       bn_axis_name=self.bn_axis_name)(x, train)
+        x = InceptionC(192, dtype=self.dtype,
+                       bn_axis_name=self.bn_axis_name)(x, train)
+        x = InceptionD(dtype=self.dtype,
+                       bn_axis_name=self.bn_axis_name)(x, train)
+        x = InceptionE(dtype=self.dtype,
+                       bn_axis_name=self.bn_axis_name)(x, train)
+        x = InceptionE(dtype=self.dtype,
+                       bn_axis_name=self.bn_axis_name)(x, train)
         # Head
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dropout(0.5, deterministic=not train)(x)

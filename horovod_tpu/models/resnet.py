@@ -142,20 +142,41 @@ class ResNet(nn.Module):
     flax 2312 img/s vs hand-structured jnp VJP 1586 vs Pallas kernels
     1002): XLA's whole-graph fusion of the autodiff backward beats
     locally pass-optimal but fusion-opaque custom ops — see
-    docs/benchmarks.md for the full measurement ladder."""
+    docs/benchmarks.md for the full measurement ladder.
+
+    ``bn_axis_name`` enables distributed batch norm
+    (docs/data.md#sync-bn): batch statistics psum'd across the named
+    mesh axis — the large-batch technique of arXiv 1909.09756 — with
+    the same parameter/stat tree as the local paths. Requires the
+    model to run inside ``shard_map``/``pmap`` over that axis, and
+    ``bn_impl='flax'`` (the fused custom-VJP op computes its stats
+    internally)."""
 
     stage_sizes: Sequence[int]
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
     bn_impl: str = "flax"
+    bn_axis_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = partial(nn.Conv, dtype=self.dtype)
-        norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
-                       axis_name=None)
+        if self.bn_axis_name is not None:
+            if self.bn_impl != "flax":
+                raise ValueError(
+                    "bn_axis_name (distributed batch norm) requires "
+                    "bn_impl='flax': the fused bn op computes its "
+                    "statistics inside its custom VJP and cannot psum "
+                    "them (docs/data.md#sync-bn)")
+            from ..data.sync_bn import SyncBatchNorm
+            norm = partial(SyncBatchNorm, use_running_average=not train,
+                           axis_name=self.bn_axis_name, momentum=0.9,
+                           epsilon=1e-5, dtype=self.dtype)
+        else:
+            norm = partial(nn.BatchNorm, use_running_average=not train,
+                           momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                           axis_name=None)
         fused = None
         if self.bn_impl != "flax":
             fused = partial(FusedBNAct, use_running_average=not train,

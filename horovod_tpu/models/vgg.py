@@ -12,7 +12,7 @@ TPU-first choices: bf16 activations / fp32 params, NHWC, static shapes.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Sequence
+from typing import Any, Optional, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -30,20 +30,29 @@ class VGG(nn.Module):
     num_classes: int = 1000
     use_bn: bool = False
     dtype: Any = jnp.bfloat16
+    # Distributed batch norm over the named mesh axis
+    # (docs/data.md#sync-bn); needs use_bn=True and a shard_map/pmap
+    # context binding the axis. Same param/stat tree as the local BN.
+    bn_axis_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = partial(nn.Conv, kernel_size=(3, 3), padding=[(1, 1), (1, 1)],
                        dtype=self.dtype)
+        if self.bn_axis_name is not None:
+            from ..data.sync_bn import SyncBatchNorm
+            bn = partial(SyncBatchNorm, use_running_average=not train,
+                         axis_name=self.bn_axis_name, momentum=0.9,
+                         epsilon=1e-5, dtype=jnp.float32)
+        else:
+            bn = partial(nn.BatchNorm, use_running_average=not train,
+                         momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
         x = x.astype(self.dtype)
         for i, (n_layers, ch) in enumerate(self.cfg):
             for j in range(n_layers):
                 x = conv(ch, name=f"conv{i + 1}_{j + 1}")(x)
                 if self.use_bn:
-                    x = nn.BatchNorm(use_running_average=not train,
-                                     momentum=0.9, epsilon=1e-5,
-                                     dtype=jnp.float32,
-                                     name=f"bn{i + 1}_{j + 1}")(x)
+                    x = bn(name=f"bn{i + 1}_{j + 1}")(x)
                 x = nn.relu(x)
             x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))

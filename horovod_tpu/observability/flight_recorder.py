@@ -56,6 +56,10 @@ Event kinds (payload fields):
                     lifecycle: spawn/ready/crash/restart/drain/exit
   ``pipeline``      schedule, stages, microbatches, virtual, warmup,
                     steady, drain, bubble_share — pipeline program built
+  ``data``          event, epoch, offset, detail — input-pipeline
+                    lifecycle: epoch boundaries, cursor commits, resume
+                    (docs/data.md; the postmortem surfaces the last
+                    committed cursor per rank)
   ================  ========================================================
 """
 
@@ -99,6 +103,7 @@ _FIELDS = {
     "serving_replica": ("event", "replica", "detail"),
     "pipeline": ("schedule", "stages", "microbatches", "virtual",
                  "warmup", "steady", "drain", "bubble_share"),
+    "data": ("event", "epoch", "offset", "detail"),
 }
 
 # Recording lever — module-global single check like registry._enabled.

@@ -233,6 +233,7 @@ class StepTimer:
         self._t0: Optional[float] = None
         self._t_prev_end: Optional[float] = None
         self._h2d_mark: Optional[float] = None
+        self._h2d_credit = 0.0
         self._ar0 = 0.0
         self._neg0 = 0.0
         self._step_idx = 0
@@ -260,6 +261,17 @@ class StepTimer:
         of ``compute``."""
         if self._t0 is not None:
             self._h2d_mark = time.perf_counter()
+
+    def credit_h2d(self, seconds: float) -> None:
+        """Attribute ``seconds`` of the NEXT step's pre-step gap to
+        ``h2d`` instead of ``input``. The device prefetcher
+        (docs/data.md#prefetch) calls this when the consumer blocked on
+        a batch whose host→device copy was not fully overlapped: the
+        wait happened before ``begin()``, where only the input phase
+        could otherwise see it. Capped at the actual gap in ``end()``
+        — crediting more than was waited cannot mint h2d time."""
+        if seconds > 0:
+            self._h2d_credit += seconds
 
     def _timeline(self):
         """The engine's Python timeline writer, if one is live (never
@@ -333,6 +345,12 @@ class StepTimer:
         self._t_prev_end = t_end
         h2d_s = (max(0.0, self._h2d_mark - t0)
                  if self._h2d_mark is not None else 0.0)
+        # Prefetcher-credited staging time: part of the pre-step gap was
+        # an unoverlapped device copy, not the data source.
+        credit = min(self._h2d_credit, input_s)
+        self._h2d_credit = 0.0
+        input_s -= credit
+        h2d_s += credit
         exec_s = _collective_execute_seconds() - self._ar0
         neg_s = _negotiate_wait_seconds() - self._neg0
         collective_s = min(max(exec_s + neg_s, 0.0), dt)

@@ -203,6 +203,18 @@ def _death_phase(dump: RankDump) -> str:
     return f"unknown (empty dump, reason {dump.header.get('reason')})"
 
 
+def _data_cursor(dump: RankDump) -> Optional[dict]:
+    """The last committed input-pipeline cursor this rank recorded
+    (docs/data.md#exactly-once): where the loader will resume, and the
+    first thing to compare across ranks when a resumed job's samples
+    look wrong."""
+    for e in reversed(dump.events):
+        if e.get("kind") == "data" and \
+                str(e.get("event")) == "cursor_commit":
+            return {"epoch": e.get("epoch"), "offset": e.get("offset")}
+    return None
+
+
 def _blamed_ranks(dumps: List[RankDump]) -> Dict[int, int]:
     """Votes per rank from survivors' recorded failure events."""
     votes: Dict[int, int] = {}
@@ -238,6 +250,7 @@ def analyze(dumps: List[RankDump]) -> dict:
             "death_phase": _death_phase(d),
             "pipeline_schedule": (pipe.get("schedule")
                                   if pipe is not None else None),
+            "data_cursor": _data_cursor(d),
             "events": len(d.events),
             "truncated_dump": d.truncated,
             "clock_synced": d.clock_synced,
@@ -393,6 +406,13 @@ def format_report(report: dict) -> str:
         lines.append(
             f"No divergence recorded: every dumped rank stopped at "
             f"group seq {report['common_last_group_seq']}")
+    cursors = {r: row["data_cursor"]
+               for r, row in report["per_rank"].items()
+               if row.get("data_cursor")}
+    if cursors:
+        lines.append("Last committed data cursor per rank: " + "; ".join(
+            f"rank {r}: epoch {c['epoch']} offset {c['offset']}"
+            for r, c in sorted(cursors.items(), key=lambda kv: int(kv[0]))))
     ladder = report.get("adaptation_at_death")
     if ladder is not None:
         lines.append(
