@@ -47,6 +47,12 @@ greedy decode; prefix-cache TTFT p50 below baseline with the prefill
 token count to prove why; quantized pool < 0.30x resident KV bytes.
 Writes BENCH_SPEED.json.
 
+``--reqtrace`` A/Bs the per-request serving trace capture
+(docs/serving.md#request-tracing) on vs off under the same load —
+in-process toggle, alternating-order paired rounds, pooled per-request
+latencies, p25 (the BENCH_TRACE methodology) — and writes
+BENCH_REQTRACE.json; the slow-tier guard holds the overhead under 3%.
+
 Prints ONE JSON line and writes BENCH_SERVING.json with --out.
 """
 
@@ -453,6 +459,86 @@ print(json.dumps({
 }))
 """
 
+REQTRACE_WORKER = r"""
+import json, os, sys, tempfile, time
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from horovod_tpu.models import transformer as tfm
+from horovod_tpu.parallel.mesh import create_mesh
+from horovod_tpu.serving import InferenceEngine, ServingConfig
+from horovod_tpu.serving import reqtrace as _rt
+
+rounds = int(sys.argv[1])          # paired rounds (one on + one off)
+max_new = int(sys.argv[2])
+
+cfg = tfm.TransformerConfig(
+    vocab=256, d_model=64, n_heads=2, n_layers=2, d_ff=128,
+    max_seq=128, dtype=jnp.float32, remat=False)
+params = tfm.init_params(cfg, jax.random.PRNGKey(42))
+mesh = create_mesh(devices=jax.devices()[:1], tp=1)
+engine = InferenceEngine(params, cfg, mesh, ServingConfig(
+    block_size=8, kv_blocks=64, max_batch_slots=8,
+    max_queue=32, max_new_tokens=max_new, min_prefill_bucket=8))
+
+rng = np.random.RandomState(7)
+prompts = [list(rng.randint(0, 256, int(n)))
+           for n in rng.randint(8, 25, 8)]
+
+# Warmup compiles every bucket + decode once (BENCH_SERVING recipe).
+for L in sorted({max(8, 1 << (len(p) - 1).bit_length()) for p in prompts}):
+    engine.generate([1] * min(L, 24), max_new_tokens=2)
+
+# BENCH_TRACE methodology: tracing toggled IN-process, paired rounds in
+# alternating order (on/off, off/on, ...) so slow drift cancels; pooled
+# per-REQUEST latencies; 25th percentile (the steady-state floor,
+# robust to CI-box noise spikes).
+tdir = tempfile.mkdtemp(prefix="bench_reqtrace_")
+lat = {"on": [], "off": []}
+trace_files = 0
+
+def one_round(arm, i):
+    global trace_files
+    if arm == "on":
+        _rt.start(os.path.join(tdir, "r%d.trace.json" % i),
+                  rank=0, proc="bench")
+        trace_files += 1
+    reqs = [engine.submit(p) for p in prompts]
+    engine.run_until_idle()
+    for r in reqs:
+        r.result()
+        lat[arm].append(r.t_done - r.t_submit)
+    if arm == "on":
+        _rt.stop()
+
+i = 0
+for pair in range(rounds):
+    order = ("on", "off") if pair % 2 == 0 else ("off", "on")
+    for arm in order:
+        one_round(arm, i)
+        i += 1
+
+def p25(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 4]
+
+on, off = p25(lat["on"]), p25(lat["off"])
+print(json.dumps({
+    "rows": {
+        "tracing_on": {"request_p25_ms": round(on * 1e3, 3),
+                       "requests": len(lat["on"])},
+        "tracing_off": {"request_p25_ms": round(off * 1e3, 3),
+                        "requests": len(lat["off"])},
+    },
+    "trace_files": trace_files,
+    "overhead_frac": round(on / off - 1.0, 4),
+}))
+"""
+
+
 SPEED_ARMS = ("baseline", "quantized_kv", "speculative", "prefix_cache",
               "all_on")
 SPEED_REQUESTS = 8
@@ -547,6 +633,48 @@ def run_speed(out_path):
     print(json.dumps(result))
 
 
+def run_reqtrace(out_path, rounds=6):
+    """The --reqtrace A/B: request tracing on vs off under the
+    BENCH_SERVING load (8 slots, 8 concurrent requests), toggled
+    in-process with alternating-order paired rounds (the BENCH_TRACE
+    methodology). Headline: per-request latency overhead < 3%."""
+    env = dict(os.environ)
+    env.pop("HOROVOD_TPU_METRICS", None)
+    env.pop("HOROVOD_TPU_REQTRACE", None)   # the worker toggles itself
+    proc = subprocess.run(
+        [sys.executable, "-c", REQTRACE_WORKER, str(rounds),
+         str(MAX_NEW)],
+        env=env, capture_output=True, text=True, timeout=900,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"reqtrace bench worker failed:\n{proc.stderr[-3000:]}")
+    r = json.loads(proc.stdout.strip().splitlines()[-1])
+    result = {
+        "metric": "serving_reqtrace_overhead",
+        "model": {"d_model": 64, "n_layers": 2, "n_heads": 2,
+                  "vocab": 256, "dtype": "float32"},
+        "requests_per_round": 8,
+        "max_new_tokens": MAX_NEW,
+        "paired_rounds": rounds,
+        "note": ("Per-request serving trace capture "
+                 "(docs/serving.md#request-tracing) A/B'd on/off "
+                 "in-process under the BENCH_SERVING load: paired "
+                 "alternating-order rounds, pooled per-request "
+                 "latencies, p25 (the BENCH_TRACE methodology). "
+                 "Headline: overhead_frac < 0.03 — span emission is "
+                 "one tuple append per request-phase on the scheduler "
+                 "thread; formatting happens on the writer's drain "
+                 "thread."),
+        **r,
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+            f.write("\n")
+    print(json.dumps(result))
+
+
 def run_fleet(out_path):
     """The --fleet availability arm, in a fresh subprocess (its own
     registry, its own jit cache) like every other arm."""
@@ -612,6 +740,13 @@ def main() -> None:
                          "speculative decode / prefix cache) on the "
                          "trained bench pair; writes BENCH_SPEED.json "
                          "with --out")
+    ap.add_argument("--reqtrace", action="store_true",
+                    help="A/B per-request tracing on/off under the "
+                         "BENCH_SERVING load; writes "
+                         "BENCH_REQTRACE.json with --out")
+    ap.add_argument("--reqtrace-rounds", type=int, default=6,
+                    help="alternating on/off paired rounds for "
+                         "--reqtrace")
     args = ap.parse_args()
 
     if args.fleet:
@@ -619,6 +754,9 @@ def main() -> None:
         return
     if args.speed:
         run_speed(args.out)
+        return
+    if args.reqtrace:
+        run_reqtrace(args.out, rounds=args.reqtrace_rounds)
         return
 
     sweep = {}

@@ -52,6 +52,11 @@ Event kinds (payload fields):
   ``coord_error``   detail — coordinator client gave up (typed error)
   ``stall``         names, age_s — engine stall escalation
   ``serving``       event, active — serving drain began/finished
+  ``request``       event, trace, detail — serving request lifecycle:
+                    admit/first_token/evict/finish keyed by the
+                    request's trace id (docs/serving.md#request-tracing;
+                    the postmortem names the in-flight requests and
+                    their phase when a replica dies)
   ``serving_replica`` event, replica, detail — fleet supervisor
                     lifecycle: spawn/ready/crash/restart/drain/exit
   ``pipeline``      schedule, stages, microbatches, virtual, warmup,
@@ -100,6 +105,7 @@ _FIELDS = {
     "coord_error": ("detail",),
     "stall": ("names", "age_s"),
     "serving": ("event", "active"),
+    "request": ("event", "trace", "detail"),
     "serving_replica": ("event", "replica", "detail"),
     "pipeline": ("schedule", "stages", "microbatches", "virtual",
                  "warmup", "steady", "drain", "bubble_share"),
@@ -285,6 +291,20 @@ def reset() -> None:
     _recorder = FlightRecorder()
 
 
+_final_flush_hooks: list = []
+
+
+def register_final_flush(fn) -> None:
+    """Register a best-effort flush callback to run on every final-gasp
+    path (:func:`dump_on`) alongside the recorder dump and the metrics
+    flush. Used by writers whose buffered tail would otherwise die with
+    the process — the serving request-trace writer registers its
+    close() here so an injected SIGKILL leaves a complete trace.
+    Idempotent per callable."""
+    if fn not in _final_flush_hooks:
+        _final_flush_hooks.append(fn)
+
+
 def dump_on(reason: str, exc: Optional[BaseException] = None) -> None:
     """Final gasp, shared by every abnormal-exit path (excepthook,
     SIGTERM, stall escalation, worker-harness exception, injected
@@ -301,6 +321,11 @@ def dump_on(reason: str, exc: Optional[BaseException] = None) -> None:
         _export.final_metrics_flush()
     except Exception as e:  # pragma: no cover - defensive
         _log.warning("final metrics flush failed: %s", e)
+    for fn in list(_final_flush_hooks):
+        try:
+            fn()
+        except Exception as e:  # pragma: no cover - defensive
+            _log.warning("final flush hook failed: %s", e)
 
 
 # ---------------------------------------------------------------------------

@@ -43,6 +43,7 @@ from __future__ import annotations
 import bisect
 import math
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..utils import env as _env
@@ -50,6 +51,9 @@ from ..utils import env as _env
 # Resolved once at import (read-once env-knob semantics like every other
 # engine knob); set_enabled() flips it for the A/B overhead bench.
 _enabled = _env.metrics_enabled()
+# Exemplar replacement window (HOROVOD_TPU_EXEMPLAR_TTL), also
+# read-once — the per-histogram default for Histogram.exemplar.
+_exemplar_ttl = _env.exemplar_ttl_secs()
 
 
 def enabled() -> bool:
@@ -143,11 +147,23 @@ class Gauge:
 
 
 class Histogram:
-    """Log-bucketed histogram with Prometheus cumulative semantics."""
+    """Log-bucketed histogram with Prometheus cumulative semantics.
 
-    __slots__ = ("_lock", "_bounds", "_counts", "_sum", "_count")
+    Optionally carries one **exemplar** — the trace id of the *worst
+    recent* observation (docs/metrics.md#exemplars): an ``observe``
+    that passes ``exemplar=`` replaces the stored one when its value is
+    at least as large, or when the incumbent is older than
+    ``exemplar_ttl_s`` (default HOROVOD_TPU_EXEMPLAR_TTL, 60 s — a
+    stale champion must not pin the link forever: "worst recent", not
+    "worst ever"). This is what lets an aggregate p99 (TTFT, failover)
+    link to one concrete, inspectable request in the serving trace
+    plane (docs/serving.md#request-tracing)."""
 
-    def __init__(self, buckets: Sequence[float]):
+    __slots__ = ("_lock", "_bounds", "_counts", "_sum", "_count",
+                 "_ex_ttl", "_ex_value", "_ex_trace", "_ex_time")
+
+    def __init__(self, buckets: Sequence[float],
+                 exemplar_ttl_s: Optional[float] = None):
         self._lock = threading.Lock()
         self._bounds = sorted(float(b) for b in buckets)
         if not self._bounds:
@@ -156,8 +172,14 @@ class Histogram:
         self._counts = [0] * (len(self._bounds) + 1)
         self._sum = 0.0
         self._count = 0
+        self._ex_ttl = (_exemplar_ttl if exemplar_ttl_s is None
+                        else float(exemplar_ttl_s))
+        self._ex_value = 0.0
+        self._ex_trace: Optional[str] = None
+        self._ex_time = 0.0
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None,
+                now: Optional[float] = None) -> None:
         if not _enabled:
             return
         v = float(value)
@@ -166,6 +188,23 @@ class Histogram:
             self._counts[i] += 1
             self._sum += v
             self._count += 1
+            if exemplar is not None:
+                t = time.time() if now is None else float(now)
+                if (self._ex_trace is None or v >= self._ex_value
+                        or t - self._ex_time > self._ex_ttl):
+                    self._ex_value = v
+                    self._ex_trace = str(exemplar)
+                    self._ex_time = t
+
+    @property
+    def exemplar(self) -> Optional[dict]:
+        """``{"value", "trace_id", "time_unix"}`` of the worst recent
+        exemplar-carrying observation, or None."""
+        with self._lock:
+            if self._ex_trace is None:
+                return None
+            return {"value": self._ex_value, "trace_id": self._ex_trace,
+                    "time_unix": self._ex_time}
 
     @property
     def count(self) -> int:
@@ -177,17 +216,24 @@ class Histogram:
 
     def snapshot(self) -> dict:
         """``{"buckets": [[le, cumulative], ...], "sum", "count"}`` with
-        the +Inf bucket last and equal to ``count``."""
+        the +Inf bucket last and equal to ``count``; plus ``"exemplar"``
+        when one was recorded."""
         with self._lock:
             counts = list(self._counts)
             s, n = self._sum, self._count
+            ex = (None if self._ex_trace is None else
+                  {"value": self._ex_value, "trace_id": self._ex_trace,
+                   "time_unix": self._ex_time})
         out = []
         cum = 0
         for le, c in zip(self._bounds, counts[:-1]):
             cum += c
             out.append([le, cum])
         out.append([math.inf, cum + counts[-1]])
-        return {"buckets": out, "sum": s, "count": n}
+        snap = {"buckets": out, "sum": s, "count": n}
+        if ex is not None:
+            snap["exemplar"] = ex
+        return snap
 
 
 _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
@@ -229,8 +275,8 @@ class _Family:
     def set(self, value: float) -> None:
         self.labels().set(value)
 
-    def observe(self, value: float) -> None:
-        self.labels().observe(value)
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
+        self.labels().observe(value, exemplar=exemplar)
 
     @property
     def value(self) -> float:

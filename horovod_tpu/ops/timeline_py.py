@@ -58,13 +58,19 @@ def write_clock_sidecar(trace_path: str, meta: dict) -> None:
 class PyTimeline:
     """Chrome-trace writer with the reference's phase vocabulary."""
 
-    def __init__(self, path: str, rank: int = 0, world: int = 1):
+    def __init__(self, path: str, rank: int = 0, world: int = 1,
+                 proc: Optional[str] = None):
         self._path = path
         self._f = open(path, "w")
         self._f.write("[\n")
         self._start = time.monotonic()
         self.rank = rank
         self.world = world
+        # Human-readable process identity for non-rank writers (the
+        # serving request-trace plane names its files "router" /
+        # "replica1" — the merge tool displays this instead of
+        # "rank N" when present).
+        self.proc = proc
         self._pids = {}
         self._name_json = {}   # event name -> pre-escaped JSON string
         self._neg_cache = {}   # op name -> "NEGOTIATE_<OP>"
@@ -95,13 +101,16 @@ class PyTimeline:
 
     def _emit_clock_meta(self, offset_us: float, rtt_us: float,
                          synced: bool) -> None:
+        args = {"rank": self.rank, "world": self.world,
+                "start_mono_us": self.start_monotonic_us,
+                "offset_to_rank0_us": float(offset_us),
+                "rtt_us": float(rtt_us),
+                "clock_synced": bool(synced)}
+        if self.proc is not None:
+            args["proc"] = self.proc
         self._queue.append({
             "name": TRACE_META_EVENT, "ph": "M", "pid": 0, "tid": 0,
-            "args": {"rank": self.rank, "world": self.world,
-                     "start_mono_us": self.start_monotonic_us,
-                     "offset_to_rank0_us": float(offset_us),
-                     "rtt_us": float(rtt_us),
-                     "clock_synced": bool(synced)}})
+            "args": args})
         self._wake.set()
 
     def set_clock_meta(self, offset_s: float, rtt_s: float) -> None:
@@ -214,6 +223,18 @@ class PyTimeline:
         self._queue.append(
             ("X", int((t0 - self._start) * 1e6), self._pid(tensor),
              activity, args, max(0, int((t1 - t0) * 1e6))))
+
+    def request_span(self, row: str, name: str, t0: float, t1: float,
+                     args: Optional[dict] = None):
+        """One complete span on a NAMED row — the serving request-trace
+        plane's emitter (serving/reqtrace.py): ``row`` is the request's
+        trace id (each request renders as its own process row, exactly
+        like tensors do in the training capture), ``t0``/``t1`` are
+        monotonic seconds, ``args`` an optional small dict (formatted on
+        the drain thread, never here)."""
+        self._queue.append(
+            ("X", int((t0 - self._start) * 1e6), self._pid(row), name,
+             args, max(0, int((t1 - t0) * 1e6))))
 
     def mark_cycle(self):
         # Instant events need an explicit scope: without "s" Perfetto

@@ -60,6 +60,7 @@ def _run_fleet(args, parser) -> int:
     from ..observability import flight_recorder as _flight
     from ..observability.export import maybe_start_exporters
     from ..utils import env as _env
+    from . import reqtrace as _reqtrace
     from .fleet import Fleet
     from .router import Router
 
@@ -68,6 +69,10 @@ def _run_fleet(args, parser) -> int:
     # Supervisor blackbox identity: rank n (replicas are 0..n-1), so
     # its dump never collides with replica 0's in a shared dir.
     _flight.recorder().configure(rank=args.fleet, world=args.fleet + 1)
+    # Request tracing (docs/serving.md#request-tracing): the router
+    # writes its REQUEST/DISPATCH/FAILOVER spans here; replicas start
+    # their own writers from the inherited HOROVOD_TPU_REQTRACE.
+    _reqtrace.maybe_start(role="router")
 
     fleet = Fleet(args.fleet, _replica_argv(args))
     router = Router(fleet, port=(args.port if args.port is not None
@@ -212,6 +217,11 @@ def main(argv=None) -> int:
                                  "0") or 0)
         _flight.recorder().configure(rank=replica_id, world=0,
                                      generation=gen)
+
+    # Per-request tracing (docs/serving.md#request-tracing): one
+    # catapult file per replica incarnation under HOROVOD_TPU_REQTRACE.
+    from . import reqtrace as _reqtrace
+    _reqtrace.maybe_start()
 
     devices = jax.local_devices()
     tp = args.tp if args.tp is not None else len(devices)

@@ -58,6 +58,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 import threading
 import time
 from collections import deque
@@ -70,8 +71,10 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models import transformer as tfm
+from ..observability import flight_recorder as _flight
 from ..observability import registry as _obs
 from ..utils.logging import get_logger
+from . import reqtrace as _rt
 from .kv_cache import (SCRATCH_BLOCK, BlockAllocator, PrefixCache,
                        blocks_needed, prefix_hashes)
 
@@ -119,10 +122,16 @@ def _metrics():
             "hvdtpu_serving_tokens_total",
             "Tokens processed, kind=prompt (prefilled) or "
             "kind=generated"),
+        "queue_wait": r.histogram(
+            "hvdtpu_serving_queue_wait_seconds",
+            "Submit → admission wait — the queue share of the "
+            "per-request latency budget (exemplar: trace id of the "
+            "worst recent wait)", buckets=_obs.LATENCY_BUCKETS).labels(),
         "ttft": r.histogram(
             "hvdtpu_serving_ttft_seconds",
             "Time to first token: submit → first sampled token "
-            "(includes queue wait)", buckets=_obs.LATENCY_BUCKETS
+            "(includes queue wait; exemplar: trace id of the worst "
+            "recent request)", buckets=_obs.LATENCY_BUCKETS
         ).labels(),
         "tpot": r.histogram(
             "hvdtpu_serving_tpot_seconds",
@@ -214,8 +223,17 @@ class Request:
 
     def __init__(self, rid: int, prompt: Sequence[int],
                  max_new_tokens: int, temperature: float,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 trace_id: Optional[str] = None):
         self.id = rid
+        # One trace id end-to-end (docs/serving.md#request-tracing):
+        # the router mints it and ships it via X-Request-Id, so the
+        # same id names this request in the router, every replica it
+        # touches (failover re-dispatch included), the flight
+        # recorder, and the metric exemplars. Locally-submitted
+        # requests mint a pid-tagged one.
+        self.trace_id = str(trace_id) if trace_id else \
+            f"{os.getpid():x}.{rid:x}"
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
@@ -224,6 +242,7 @@ class Request:
         self.status = "queued"            # queued|active|completed|failed
         self.error: Optional[str] = None
         self.t_submit = time.perf_counter()
+        self.t_submit_m = time.monotonic()   # trace-clock twin
         self.t_first_token: Optional[float] = None
         self.t_done: Optional[float] = None
         self.slot: Optional[int] = None
@@ -409,7 +428,8 @@ class InferenceEngine:
     def submit(self, prompt: Sequence[int], *,
                max_new_tokens: Optional[int] = None,
                temperature: Optional[float] = None,
-               deadline_s: Optional[float] = None) -> Request:
+               deadline_s: Optional[float] = None,
+               trace_id: Optional[str] = None) -> Request:
         """Enqueue a request; returns immediately with its ticket.
         Raises :exc:`QueueFullError` past ``max_queue`` (the HTTP 429
         path) and :exc:`DrainingError` after drain began.
@@ -417,7 +437,9 @@ class InferenceEngine:
         ``deadline_s`` is a *relative* budget in seconds (the router
         propagates the client's remaining deadline per hop): a request
         still queued when it expires fails with ``DEADLINE_ERROR``
-        instead of occupying a slot."""
+        instead of occupying a slot. ``trace_id`` is the caller's
+        end-to-end request identity (the router's ``X-Request-Id``);
+        None mints a local one."""
         c = self.config
         max_new = int(max_new_tokens if max_new_tokens is not None
                       else c.max_new_tokens)
@@ -449,7 +471,7 @@ class InferenceEngine:
             deadline = None if deadline_s is None \
                 else time.monotonic() + float(deadline_s)
             req = Request(self._next_id, prompt, max_new, temp,
-                          deadline=deadline)
+                          deadline=deadline, trace_id=trace_id)
             self._next_id += 1
             self._queue.append(req)
             self._m["queue_depth"].set(len(self._queue))
@@ -538,7 +560,6 @@ class InferenceEngine:
         with self._lock:
             self._draining = True
             waiting = self.active_count + len(self._queue)
-        from ..observability import flight_recorder as _flight
         _flight.recorder().note("serving", ("drain", waiting))
         while True:
             with self._lock:
@@ -614,6 +635,10 @@ class InferenceEngine:
                 self._m["prefix_hits"].inc(len(shared))
                 self._m["prefix_misses"].inc(len(hashes) - len(shared))
             self._queue.popleft()
+            t_admit_m = time.monotonic()
+            self._m["queue_wait"].observe(
+                time.perf_counter() - req.t_submit,
+                exemplar=req.trace_id)
             req.blocks = shared + fresh
             req.cached_tokens = len(shared) * bs
             req.slot = slot
@@ -621,6 +646,18 @@ class InferenceEngine:
             self._reqs[slot] = req
             self._tables[slot, :] = SCRATCH_BLOCK
             self._tables[slot, :need] = req.blocks
+            _flight.recorder().note(
+                "request", ("admit", req.trace_id,
+                            f"slot={slot} blocks={need} "
+                            f"cached={req.cached_tokens}"))
+            w = _rt.writer()
+            if w is not None:
+                w.request_span(req.trace_id, "QUEUE_WAIT",
+                               req.t_submit_m, t_admit_m)
+                w.request_span(req.trace_id, "ADMIT", t_admit_m,
+                               time.monotonic(),
+                               {"blocks": need,
+                                "prefix_tokens": req.cached_tokens})
             self._prefill(req)
             # Index this prompt's freshly-prefilled full blocks so the
             # NEXT matching prompt shares them (first writer wins).
@@ -642,6 +679,10 @@ class InferenceEngine:
             self._m["compiles"].labels(phase=phase).inc()
 
     def _prefill(self, req: Request) -> None:
+        # Span epoch BEFORE the fault hook: an injected slow_prefill is
+        # latency the request experienced — it must land INSIDE the
+        # PREFILL span, or the budget report under-attributes.
+        t0m = time.monotonic()
         if self._inj is not None:
             self._inj.on_serving_prefill()
         t0 = time.perf_counter()
@@ -650,6 +691,7 @@ class InferenceEngine:
         suffix = req.prompt[c:]
         ns = len(suffix)
         L = self._bucket(ns)
+        compile_new = ("prefill", L) not in self._buckets_seen
         self._record_bucket("prefill", L)
         toks = np.zeros((1, L), np.int32)
         toks[0, :ns] = suffix
@@ -672,15 +714,27 @@ class InferenceEngine:
         req._notify()
         self._last_tok[slot] = first
         self._m["prefill"].observe(time.perf_counter() - t0)
-        self._m["ttft"].observe(req.t_first_token - req.t_submit)
+        self._m["ttft"].observe(req.t_first_token - req.t_submit,
+                                exemplar=req.trace_id)
         self._m["tokens"].labels(kind="prompt").inc(ns)
         self._m["tokens"].labels(kind="generated").inc()
+        _flight.recorder().note(
+            "request", ("first_token", req.trace_id,
+                        f"ttft_ms={round((req.t_first_token - req.t_submit) * 1e3, 1)}"))
+        w = _rt.writer()
+        if w is not None:
+            w.request_span(req.trace_id, "PREFILL", t0m,
+                           time.monotonic(),
+                           {"bucket": L, "tokens": ns, "cached": c,
+                            "compile": compile_new})
         self._check_finished(req)
 
     def _decode_step(self) -> None:
         if self._draft_params is not None:
             self._spec_decode_step()
             return
+        t0m = time.monotonic()   # before the fault hook (slow_decode
+        #                          belongs inside the DECODE span)
         if self._inj is not None:
             self._inj.on_serving_decode()
         t0 = time.perf_counter()
@@ -694,6 +748,7 @@ class InferenceEngine:
         dt = time.perf_counter() - t0
         self._m["decode_step"].observe(dt)
         self._m["decode_steps"].inc()
+        w = _rt.writer()
         for slot, req in enumerate(self._reqs):
             if req is None:
                 continue
@@ -703,8 +758,13 @@ class InferenceEngine:
             req.tokens.append(tok)
             req._notify()
             self._last_tok[slot] = tok
-            self._m["tpot"].observe(dt)
+            self._m["tpot"].observe(dt, exemplar=req.trace_id)
             self._m["tokens"].labels(kind="generated").inc()
+            if w is not None:
+                # The step wall as THIS request experienced it — the
+                # decode share of its latency budget.
+                w.request_span(req.trace_id, "DECODE", t0m,
+                               time.monotonic(), {"n": 1})
             self._check_finished(req)
 
     def _spec_decode_step(self) -> None:
@@ -723,6 +783,8 @@ class InferenceEngine:
         query can see it (chunks are a constant k wide and start where
         the accepted prefix ended, so the rewritten span always covers
         the stale one)."""
+        t0m = time.monotonic()   # before the fault hook, like
+        #                          _decode_step
         if self._inj is not None:
             self._inj.on_serving_decode()
         t0 = time.perf_counter()
@@ -761,6 +823,7 @@ class InferenceEngine:
         self._m["decode_step"].observe(dt)
         self._m["decode_steps"].inc()
 
+        w = _rt.writer()
         for slot, req in enumerate(self._reqs):
             if req is None:
                 continue
@@ -789,9 +852,14 @@ class InferenceEngine:
             self._last_tok[slot] = emit[-1]
             for tok in emit:
                 req.tokens.append(int(tok))
-                self._m["tpot"].observe(dt)
+                self._m["tpot"].observe(dt, exemplar=req.trace_id)
                 self._m["tokens"].labels(kind="generated").inc()
             req._notify()
+            if w is not None:
+                w.request_span(req.trace_id, "DECODE", t0m,
+                               time.monotonic(),
+                               {"n": len(emit), "proposed": k - 1,
+                                "accepted": accepted})
             self._check_finished(req)
 
     def _sample(self, logits: np.ndarray, req: Request) -> int:
@@ -821,6 +889,9 @@ class InferenceEngine:
         self._reqs[slot] = None
         self._alloc.release(req.blocks)
         req.blocks = []
+        _flight.recorder().note(
+            "request", ("evict", req.trace_id,
+                        f"{status} tokens={len(req.tokens)}"))
         self._finish(req, status, error=error)
 
     def _finish(self, req: Request, status: str,
@@ -828,6 +899,10 @@ class InferenceEngine:
         req.status = status
         req.error = error
         req.t_done = time.perf_counter()
+        _flight.recorder().note(
+            "request", ("finish", req.trace_id,
+                        status if error is None
+                        else f"{status}: {error}"[:200]))
         self._m["requests"].labels(status=status).inc()
         if status == "completed":
             now = req.t_done

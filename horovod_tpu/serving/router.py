@@ -38,6 +38,16 @@ The router deliberately holds NO generation state beyond the in-flight
 request's emitted tokens — replicas own KV; the router owns retry. That
 is what makes a replica process disposable (fleet.py can SIGKILL one at
 any time) without the serving tier as a whole dropping a request.
+
+Request identity and tracing (docs/serving.md#request-tracing): the
+router mints ONE trace id per client request (or accepts the client's
+via ``X-Request-Id`` / body ``request_id``) and ships it on every
+dispatch, retry, and failover re-dispatch — the same id names the
+request in every replica it touches, in the flight recorder, in metric
+exemplars, and in the per-process request-trace files
+(serving/reqtrace.py) where the router contributes the ``REQUEST``
+wall span, per-attempt ``DISPATCH`` spans, and the
+detection→resume ``FAILOVER`` span.
 """
 
 from __future__ import annotations
@@ -47,12 +57,14 @@ import http.client
 import json
 import threading
 import time
+import uuid
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence
 
 from ..observability import registry as _obs
 from ..utils import env as _env
 from ..utils.logging import get_logger
+from . import reqtrace as _rt
 from .engine import DEADLINE_ERROR
 from .fleet import ReplicaEndpoint
 from .kv_cache import prefix_hashes
@@ -96,7 +108,14 @@ def _metrics():
         "failover_s": r.histogram(
             "hvdtpu_fleet_failover_seconds",
             "Failure detection → first token from the replacement "
-            "replica", buckets=_obs.LATENCY_BUCKETS).labels(),
+            "replica (exemplar: trace id of the worst recent "
+            "failover)", buckets=_obs.LATENCY_BUCKETS).labels(),
+        "request_s": r.histogram(
+            "hvdtpu_fleet_request_seconds",
+            "End-to-end routed request wall (relay start → terminal "
+            "outcome) — the denominator of the per-request latency "
+            "budget (exemplar: trace id of the worst recent request)",
+            buckets=_obs.LATENCY_BUCKETS).labels(),
         "dispatch": r.counter(
             "hvdtpu_fleet_dispatch_total",
             "Dispatches by replica index (the admission policy, "
@@ -365,18 +384,41 @@ class Router:
 
     # ------------------------------------------------------ dispatch
 
-    def _relay(self, prompt: List[int], max_new: int,
+    def _relay(self, rid: str, prompt: List[int], max_new: int,
                temperature: Optional[float],
                deadline: Optional[float], emit) -> dict:
         """Drive one client request across the fleet until it
-        completes: pick → stream → (on death) fail over. ``emit(tok)``
-        is called once per generated token in order; returns the
-        terminal meta dict {"status": ..., "retries": N, ...}."""
+        completes (see :meth:`_relay_attempts`), timing the wall: the
+        ``REQUEST`` trace span and the ``hvdtpu_fleet_request_seconds``
+        histogram (exemplar: this trace id) cover relay start →
+        terminal outcome — the denominator every per-request budget
+        share divides by."""
+        t0m = time.monotonic()
+        meta = self._relay_attempts(rid, prompt, max_new, temperature,
+                                    deadline, emit)
+        t1m = time.monotonic()
+        self._m["request_s"].observe(t1m - t0m, exemplar=rid)
+        _rt.span(rid, "REQUEST", t0m, t1m,
+                 {"status": meta["status"], "retries": meta["retries"],
+                  "tokens": len(meta["tokens"])})
+        return meta
+
+    def _relay_attempts(self, rid: str, prompt: List[int],
+                        max_new: int, temperature: Optional[float],
+                        deadline: Optional[float], emit) -> dict:
+        """Pick → stream → (on death) fail over, until terminal.
+        ``emit(tok)`` is called once per generated token in order;
+        returns the terminal meta dict {"status": ..., "retries": N,
+        ...}. The SAME ``rid`` rides every dispatch — a failover
+        re-dispatch reuses the identity, never re-mints it."""
         emitted: List[int] = []
         exclude: Dict[int, float] = {}
         attempts = 0
         retries = 0
         t_fail: Optional[float] = None     # failover stopwatch
+        fail_phase: Optional[str] = None   # phase/origin at FIRST
+        fail_from: Optional[int] = None    # detection (span args)
+        cur_idx: Optional[int] = None      # replica of the live attempt
         n_backends = max(1, len(self.backends.endpoints()))
         max_attempts = self._max_attempts or max(6, 3 * n_backends)
 
@@ -394,8 +436,12 @@ class Router:
             # client's gap is measured from the FIRST detection).
             nonlocal t_fail
             if t_fail is not None:
-                self._m["failover_s"].observe(
-                    time.monotonic() - t_fail)
+                now = time.monotonic()
+                self._m["failover_s"].observe(now - t_fail,
+                                              exemplar=rid)
+                _rt.span(rid, "FAILOVER", t_fail, now,
+                         {"phase": fail_phase, "from": fail_from,
+                          "to": cur_idx})
                 t_fail = None
             emit(tok)
 
@@ -422,11 +468,15 @@ class Router:
                 continue
             attempts += 1
             idx = view.endpoint.index
+            cur_idx = idx
             self._m["dispatch"].labels(replica=str(idx)).inc()
+            t_att = time.monotonic()
             outcome = self._stream_from(
-                view.endpoint, prompt + emitted,
+                rid, view.endpoint, prompt + emitted,
                 max_new - len(emitted), temperature, deadline,
                 emitted, emit_observed)
+            _rt.span(rid, "DISPATCH", t_att, time.monotonic(),
+                     {"replica": idx, "outcome": outcome["kind"]})
             if outcome["kind"] == "done":
                 return {"status": "completed", "retries": retries,
                         "tokens": emitted, "replica": idx,
@@ -446,14 +496,16 @@ class Router:
                 self._m["failovers"].labels(phase=phase).inc()
                 if t_fail is None:
                     t_fail = time.monotonic()
+                    fail_phase, fail_from = phase, idx
                 _log.warning(
-                    "replica %d died %s request (%d tokens emitted) — "
-                    "failing over", idx,
+                    "replica %d died %s request %s (%d tokens emitted)"
+                    " — failing over", idx,
                     "mid-stream of" if emitted else "before first "
-                    "token of", len(emitted))
+                    "token of", rid, len(emitted))
 
-    def _stream_from(self, ep: ReplicaEndpoint, prompt: List[int],
-                     max_new: int, temperature: Optional[float],
+    def _stream_from(self, rid: str, ep: ReplicaEndpoint,
+                     prompt: List[int], max_new: int,
+                     temperature: Optional[float],
                      deadline: Optional[float], emitted: List[int],
                      emit) -> dict:
         """One dispatch attempt against one replica, streaming. Appends
@@ -479,7 +531,8 @@ class Router:
             try:
                 conn.request(
                     "POST", "/generate", json.dumps(body),
-                    {"Content-Type": "application/json"})
+                    {"Content-Type": "application/json",
+                     "X-Request-Id": rid})
                 resp = conn.getresponse()
                 if resp.status == 429:
                     resp.read()
@@ -617,7 +670,12 @@ class Router:
                         + float(deadline_ms) / 1e3
                 else:
                     deadline = time.monotonic() + ROUTER_TIMEOUT_S
-                rid = outer._request_id()
+                # The request's ONE trace id: the client's, if it
+                # brought one, else freshly minted — reused verbatim on
+                # every retry and failover hop from here on.
+                rid = str(self.headers.get("X-Request-Id")
+                          or body.get("request_id")
+                          or outer._request_id())
                 if stream:
                     self._do_stream(rid, tokens, max_new, temperature,
                                     deadline)
@@ -628,23 +686,31 @@ class Router:
             def _do_unary(self, rid, tokens, max_new, temperature,
                           deadline) -> None:
                 t0 = time.perf_counter()
-                meta = outer._relay(tokens, max_new, temperature,
+                meta = outer._relay(rid, tokens, max_new, temperature,
                                     deadline, emit=lambda t: None)
                 outer._count(meta["status"])
                 if meta["status"] == "completed":
+                    t_egress = time.monotonic()
                     self._reply(200, {
-                        "id": rid, "tokens": meta["tokens"],
+                        "id": rid, "trace_id": rid,
+                        "tokens": meta["tokens"],
                         "retries": meta["retries"],
                         "replica": meta.get("replica"),
                         "latency_ms": round(
                             (time.perf_counter() - t0) * 1e3, 3)})
+                    _rt.span(rid, "EGRESS", t_egress,
+                             time.monotonic(),
+                             {"tokens": len(meta["tokens"])})
                 elif meta["status"] == "expired":
                     self._reply(504, {"error": DEADLINE_ERROR,
+                                      "trace_id": rid,
                                       "retries": meta["retries"]})
                 elif meta["status"] == "bad_request":
-                    self._reply(400, {"error": meta["error"]})
+                    self._reply(400, {"error": meta["error"],
+                                      "trace_id": rid})
                 else:
                     self._reply(503, {"error": meta["error"],
+                                      "trace_id": rid,
                                       "retries": meta["retries"]},
                                 headers={"Retry-After": 1})
 
@@ -663,9 +729,9 @@ class Router:
                     self.wfile.flush()
 
                 try:
-                    line({"id": rid})
+                    line({"id": rid, "trace_id": rid})
                     meta = outer._relay(
-                        tokens, max_new, temperature, deadline,
+                        rid, tokens, max_new, temperature, deadline,
                         emit=lambda t: line({"t": t}))
                     outer._count(meta["status"])
                     done = {"done": True,
@@ -673,6 +739,7 @@ class Router:
                                        if meta["status"] == "completed"
                                        else "failed"),
                             "n": len(meta["tokens"]),
+                            "trace_id": rid,
                             "retries": meta["retries"]}
                     if meta["status"] != "completed":
                         done["error"] = meta.get("error")
@@ -690,11 +757,13 @@ class Router:
             target=self._httpd.serve_forever,
             name="hvd-tpu-fleet-router", daemon=True)
 
-    def _request_id(self) -> int:
+    def _request_id(self) -> str:
+        """Mint a trace id: globally unique (uuid) with a short local
+        sequence suffix for log readability."""
         with self._id_lock:
-            rid = self._next_id
+            n = self._next_id
             self._next_id += 1
-            return rid
+        return f"{uuid.uuid4().hex[:12]}-{n}"
 
     def _count(self, status: str) -> None:
         outcome = {"completed": "completed", "expired": "expired",

@@ -59,6 +59,7 @@ from typing import Optional
 from ..observability import registry as _obs
 from ..utils import env as _env
 from ..utils.logging import get_logger
+from . import reqtrace as _rt
 from .engine import (DEADLINE_ERROR, DrainingError, InferenceEngine,
                      QueueFullError)
 
@@ -196,12 +197,19 @@ class ServingServer:
                     self._reply(504, {"error": DEADLINE_ERROR},
                                 "generate")
                     return
+                # One request identity end-to-end: the router ships its
+                # trace id in X-Request-Id (body "request_id" for plain
+                # clients); absent, the engine mints one
+                # (docs/serving.md#request-tracing).
+                trace_id = self.headers.get("X-Request-Id") \
+                    or body.get("request_id")
                 try:
                     req = outer.engine.submit(
                         tokens,
                         max_new_tokens=body.get("max_new_tokens"),
                         temperature=body.get("temperature"),
-                        deadline_s=deadline_s)
+                        deadline_s=deadline_s,
+                        trace_id=trace_id)
                 except QueueFullError as e:
                     self._reply(429, {"error": str(e)}, "generate",
                                 headers={"Retry-After":
@@ -232,13 +240,17 @@ class ServingServer:
                     code = 504 if DEADLINE_ERROR in str(e) else 503
                     self._reply(code, {"error": str(e)}, "generate")
                     return
+                t_egress = time.monotonic()
                 self._reply(200, {
                     "id": req.id,
+                    "trace_id": req.trace_id,
                     "tokens": out,
                     "ttft_ms": round(req.ttft_s * 1e3, 3),
                     "latency_ms": round(
                         (req.t_done - req.t_submit) * 1e3, 3),
                 }, "generate")
+                _rt.span(req.trace_id, "EGRESS", t_egress,
+                         time.monotonic(), {"tokens": len(out)})
 
             def _stream(self, req, wait_s: float) -> None:
                 """NDJSON token stream: header line, one line per
@@ -257,7 +269,7 @@ class ServingServer:
 
                 t_end = time.monotonic() + wait_s
                 try:
-                    line({"id": req.id})
+                    line({"id": req.id, "trace_id": req.trace_id})
                     idx = 0
                     while True:
                         fresh = req.next_tokens(
@@ -269,14 +281,17 @@ class ServingServer:
                         if req.done and not fresh:
                             break
                     meta = {"done": True, "status": req.status,
-                            "n": idx}
+                            "n": idx, "trace_id": req.trace_id}
                     if req.status == "completed":
                         meta["ttft_ms"] = round(req.ttft_s * 1e3, 3)
                         meta["latency_ms"] = round(
                             (req.t_done - req.t_submit) * 1e3, 3)
                     else:
                         meta["error"] = req.error
+                    t_egress = time.monotonic()
                     line(meta)
+                    _rt.span(req.trace_id, "EGRESS", t_egress,
+                             time.monotonic(), {"tokens": idx})
                 except TimeoutError:
                     line({"done": True, "status": "failed",
                           "error": "stream timed out", "n": idx})

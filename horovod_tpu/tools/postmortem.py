@@ -203,6 +203,27 @@ def _death_phase(dump: RankDump) -> str:
     return f"unknown (empty dump, reason {dump.header.get('reason')})"
 
 
+def _inflight_requests(dump: RankDump) -> List[dict]:
+    """Serving requests this replica was holding when the dump fired
+    (docs/serving.md#request-tracing): replay the ``request`` lifecycle
+    events — admit opens a request (phase ``prefill``), first_token
+    moves it to ``decode``, evict/finish closes it. What remains open
+    at the tail is exactly what the replica took down with it — the
+    requests the router had to fail over."""
+    state: Dict[str, str] = {}
+    for e in dump.events:
+        if e.get("kind") != "request":
+            continue
+        ev, trace = str(e.get("event")), str(e.get("trace"))
+        if ev == "admit":
+            state[trace] = "prefill"
+        elif ev == "first_token":
+            state[trace] = "decode"
+        elif ev in ("evict", "finish"):
+            state.pop(trace, None)
+    return [{"trace": t, "phase": p} for t, p in state.items()]
+
+
 def _data_cursor(dump: RankDump) -> Optional[dict]:
     """The last committed input-pipeline cursor this rank recorded
     (docs/data.md#exactly-once): where the loader will resume, and the
@@ -251,6 +272,7 @@ def analyze(dumps: List[RankDump]) -> dict:
             "pipeline_schedule": (pipe.get("schedule")
                                   if pipe is not None else None),
             "data_cursor": _data_cursor(d),
+            "inflight_requests": _inflight_requests(d),
             "events": len(d.events),
             "truncated_dump": d.truncated,
             "clock_synced": d.clock_synced,
@@ -406,6 +428,13 @@ def format_report(report: dict) -> str:
         lines.append(
             f"No divergence recorded: every dumped rank stopped at "
             f"group seq {report['common_last_group_seq']}")
+    inflight = {r: row["inflight_requests"]
+                for r, row in report["per_rank"].items()
+                if row.get("inflight_requests")}
+    for r, reqs in sorted(inflight.items(), key=lambda kv: int(kv[0])):
+        lines.append(
+            f"In-flight requests on rank {r} at death: " + ", ".join(
+                f"{q['trace']} ({q['phase']})" for q in reqs))
     cursors = {r: row["data_cursor"]
                for r, row in report["per_rank"].items()
                if row.get("data_cursor")}

@@ -20,12 +20,23 @@ on one clock and answers the question the single-rank timeline cannot:
             table with the phase each rank loses time in
             (negotiate/queue/h2d/execute — or "upstream" when the skew
             originates before the collective path, i.e. compute/input).
+  serving — per-REQUEST latency-budget report over serving request
+            traces (docs/serving.md#request-tracing): the router and
+            every replica each write one catapult file
+            (serving/reqtrace.py) whose rows are trace ids; this
+            subcommand groups each request's spans across ALL the
+            processes it touched and reports where its latency went
+            (queue / prefill / decode / failover shares of the
+            measured wall), the slowest requests, and failover chains
+            with the re-prefill cost on the resume replica.
 
 Usage::
 
     python -m horovod_tpu.tools.trace merge /tmp/trace.{rank}.json \
         -o merged.json --report report.json
     python -m horovod_tpu.tools.trace report /tmp/trace.*.json
+    python -m horovod_tpu.tools.trace serving /tmp/reqtrace-dir \
+        --report budget.json
 
 Groups are keyed by the coordinator sequence number the Python writer
 records on each NEGOTIATE span — identical on every rank for the
@@ -38,6 +49,7 @@ contract the coordinator enforces anyway).
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 from typing import Dict, List, Optional, Tuple
@@ -87,6 +99,9 @@ class RankTrace:
         self.events = events
         self.meta = meta
         self.rank = meta.get("rank")
+        # Serving request-trace writers name their process ("router",
+        # "replica1/gen0") instead of speaking in ranks.
+        self.proc = meta.get("proc")
         self.tensor_of: Dict[int, str] = {
             e["pid"]: str(e["args"]["name"]) for e in events
             if e.get("ph") == "M" and e.get("name") == "process_name"
@@ -141,7 +156,15 @@ def load_rank_trace(path: str) -> RankTrace:
 
 def expand_inputs(paths: List[str]) -> List[str]:
     """A single ``{rank}`` template expands to consecutive existing
-    files starting at rank 0."""
+    files starting at rank 0; a single directory expands to its
+    ``*.trace.json`` captures (the serving request-trace layout —
+    one file per router/replica process)."""
+    if len(paths) == 1 and os.path.isdir(paths[0]):
+        out = sorted(glob.glob(os.path.join(paths[0], "*.trace.json")))
+        if not out:
+            raise FileNotFoundError(
+                f"no *.trace.json captures under {paths[0]}")
+        return out
     if len(paths) == 1 and "{rank}" in paths[0]:
         out = []
         rank = 0
@@ -195,7 +218,7 @@ def merge_traces(traces: List[RankTrace], out_path: str) -> str:
     merged: List[dict] = []
     for t in traces:
         merged.append({"name": "process_name", "ph": "M", "pid": t.rank,
-                       "args": {"name": f"rank {t.rank}"}})
+                       "args": {"name": t.proc or f"rank {t.rank}"}})
         merged.append({"name": "process_sort_index", "ph": "M",
                        "pid": t.rank, "args": {"sort_index": t.rank}})
         tids: Dict[int, int] = {}
@@ -500,6 +523,195 @@ def format_report(report: dict) -> str:
 
 
 # --------------------------------------------------------------------------
+# Serving request-trace analysis (docs/serving.md#request-tracing)
+# --------------------------------------------------------------------------
+
+# Span name → latency-budget phase. FAILOVER is handled separately: its
+# detection→resume window OVERLAPS the resume replica's queue/prefill
+# spans (the re-dispatch is what ends it), so only the time not already
+# attributed to a concrete phase counts as "failover" — the budget then
+# partitions instead of double-counting.
+_REQ_PHASE_OF = {"QUEUE_WAIT": "queue", "PREFILL": "prefill",
+                 "DECODE": "decode", "EGRESS": "egress"}
+REQ_PHASES = ("queue", "prefill", "decode", "failover", "egress")
+
+
+def _union(ivs: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
+    """Merge possibly-overlapping intervals into a sorted disjoint set."""
+    out: List[Tuple[float, float]] = []
+    for a, b in sorted(ivs):
+        if b <= a:
+            continue
+        if out and a <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], b))
+        else:
+            out.append((a, b))
+    return out
+
+
+def _total(ivs: List[Tuple[float, float]]) -> float:
+    return sum(b - a for a, b in ivs)
+
+
+def _subtract(ivs: List[Tuple[float, float]],
+              cover: List[Tuple[float, float]]
+              ) -> List[Tuple[float, float]]:
+    """``ivs`` minus ``cover`` (both disjoint-sorted)."""
+    out: List[Tuple[float, float]] = []
+    for a, b in ivs:
+        cur = a
+        for ca, cb in cover:
+            if cb <= cur or ca >= b:
+                continue
+            if ca > cur:
+                out.append((cur, ca))
+            cur = max(cur, cb)
+            if cur >= b:
+                break
+        if cur < b:
+            out.append((cur, b))
+    return out
+
+
+def serving_report(traces: List[RankTrace], top: int = 10) -> dict:
+    """Per-request latency-budget report over serving request traces:
+    each request's spans are gathered ACROSS processes by its trace id
+    (the row name every writer uses), aligned onto one clock, and
+    attributed to queue / prefill / decode / failover (/ egress)
+    phases. ``attributed_frac`` is the budget's share of the measured
+    wall — the acceptance bar is that it explains the wall to within
+    10% for a failed-over request."""
+    rows: Dict[str, dict] = {}
+    for t in traces:
+        shift = t.shift_us
+        pname = t.proc or f"rank {t.rank}"
+        for s in _spans(t.events):
+            tid = t.tensor_of.get(s["pid"])
+            if tid is None:
+                continue
+            rec = rows.setdefault(tid, {"spans": [], "procs": set()})
+            rec["procs"].add(pname)
+            rec["spans"].append({
+                "name": s["name"], "t0": s["ts"] + shift,
+                "t1": s["ts"] + shift + s["dur"], "dur": s["dur"],
+                "args": s["args"], "proc": pname})
+    requests: Dict[str, dict] = {}
+    for tid, rec in rows.items():
+        spans = sorted(rec["spans"], key=lambda x: x["t0"])
+        request = next((x for x in spans if x["name"] == "REQUEST"),
+                       None)
+        # The wall: the router's REQUEST span when present (the client-
+        # observed latency), span extremes otherwise (engine-only
+        # captures).
+        if request is not None:
+            t0, t1 = request["t0"], request["t1"]
+        else:
+            t0 = min(x["t0"] for x in spans)
+            t1 = max(x["t1"] for x in spans)
+        wall_us = max(0.0, t1 - t0)
+        ivs: Dict[str, List[Tuple[float, float]]] = {
+            p: [] for p in ("queue", "prefill", "decode", "egress")}
+        failover_spans = []
+        for x in spans:
+            ph = _REQ_PHASE_OF.get(x["name"])
+            if ph is not None:
+                ivs[ph].append((x["t0"], x["t1"]))
+            elif x["name"] == "FAILOVER":
+                failover_spans.append(x)
+        unions = {p: _union(v) for p, v in ivs.items()}
+        phase_us = {p: _total(u) for p, u in unions.items()}
+        covered = _union([iv for u in unions.values() for iv in u])
+        fo_union = _union([(x["t0"], x["t1"]) for x in failover_spans])
+        phase_us["failover"] = _total(_subtract(fo_union, covered))
+        attributed = sum(phase_us[p]
+                         for p in ("queue", "prefill", "decode",
+                                   "failover"))
+        failovers = []
+        for x in failover_spans:
+            # The failover chain: detection → resume, plus the
+            # re-prefill it forced on the replacement replica (the
+            # first PREFILL starting inside/after the window).
+            reprefill = next(
+                (p for p in spans if p["name"] == "PREFILL"
+                 and p["t0"] >= x["t0"]), None)
+            failovers.append({
+                "phase": x["args"].get("phase"),
+                "from_replica": x["args"].get("from"),
+                "to_replica": x["args"].get("to"),
+                "detect_to_resume_ms": round(x["dur"] / 1e3, 3),
+                "reprefill_ms": (round(reprefill["dur"] / 1e3, 3)
+                                 if reprefill else None),
+                "reprefill_tokens": (reprefill["args"].get("tokens")
+                                     if reprefill else None),
+                "reprefill_proc": (reprefill["proc"]
+                                   if reprefill else None),
+            })
+        requests[tid] = {
+            "wall_ms": round(wall_us / 1e3, 3),
+            "processes": sorted(rec["procs"]),
+            "spans": len(spans),
+            "phase_ms": {p: round(phase_us.get(p, 0.0) / 1e3, 3)
+                         for p in REQ_PHASES},
+            "phase_share": {p: (round(phase_us.get(p, 0.0) / wall_us, 4)
+                                if wall_us > 0 else 0.0)
+                            for p in REQ_PHASES},
+            "attributed_frac": (round(attributed / wall_us, 4)
+                                if wall_us > 0 else 0.0),
+            "failovers": failovers,
+        }
+    slowest = sorted(requests,
+                     key=lambda k: -requests[k]["wall_ms"])[:top]
+    return {
+        "n_requests": len(requests),
+        "processes": sorted({p for r in requests.values()
+                             for p in r["processes"]}),
+        "requests": requests,
+        "slowest": [{"trace": k, "wall_ms": requests[k]["wall_ms"],
+                     "phase_share": requests[k]["phase_share"],
+                     "failovers": len(requests[k]["failovers"])}
+                    for k in slowest],
+        "n_failovers": sum(len(r["failovers"])
+                           for r in requests.values()),
+    }
+
+
+def format_serving_report(report: dict) -> str:
+    """Human-readable per-request budget table, slowest first."""
+    lines = [
+        f"Serving request-trace report — {report['n_requests']} "
+        f"request(s) across {len(report['processes'])} process(es) "
+        f"({', '.join(report['processes'])}), "
+        f"{report['n_failovers']} failover(s)",
+        "",
+        f"{'trace id':<20}  {'wall':>9}  {'queue':>6} {'prefil':>6} "
+        f"{'decode':>6} {'failov':>6}  {'attrib':>6}  procs",
+    ]
+    for row in report["slowest"]:
+        r = report["requests"][row["trace"]]
+        sh = r["phase_share"]
+        lines.append(
+            f"{row['trace']:<20}  {r['wall_ms']:>7.1f}ms  "
+            f"{sh['queue']:>6.1%} {sh['prefill']:>6.1%} "
+            f"{sh['decode']:>6.1%} {sh['failover']:>6.1%}  "
+            f"{r['attributed_frac']:>6.1%}  "
+            f"{len(r['processes'])}"
+            + ("  [failover]" if r["failovers"] else ""))
+    chains = [(tid, f) for tid, r in report["requests"].items()
+              for f in r["failovers"]]
+    if chains:
+        lines.append("")
+        for tid, f in chains:
+            lines.append(
+                f"Failover: {tid} — {f['phase']} on replica "
+                f"{f['from_replica']} → {f['to_replica']}; detection→"
+                f"resume {f['detect_to_resume_ms']} ms"
+                + (f", re-prefill {f['reprefill_tokens']} tokens in "
+                   f"{f['reprefill_ms']} ms on {f['reprefill_proc']}"
+                   if f["reprefill_ms"] is not None else ""))
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
 # CLI
 # --------------------------------------------------------------------------
 
@@ -513,14 +725,20 @@ def _main(argv=None):
     p_merge = sub.add_parser(
         "merge", help="merge + analyze (writes the merged trace)")
     p_report = sub.add_parser("report", help="analyze only")
-    for p in (p_merge, p_report):
+    p_serving = sub.add_parser(
+        "serving", help="per-request latency-budget report over "
+                        "serving request traces "
+                        "(docs/serving.md#request-tracing)")
+    for p in (p_merge, p_report, p_serving):
         p.add_argument("traces", nargs="+",
-                       help="per-rank trace files, or ONE path template "
-                            "containing {rank}")
+                       help="per-process trace files, ONE path template "
+                            "containing {rank}, or ONE directory of "
+                            "*.trace.json captures")
         p.add_argument("--report", default=None,
                        help="also write the report JSON here")
         p.add_argument("--top", type=int, default=10,
-                       help="include the N worst groups in the JSON")
+                       help="include the N worst groups/requests in "
+                            "the JSON")
     p_merge.add_argument("-o", "--out", default=None,
                          help="merged trace path (default: "
                               "<first input>.merged.json)")
@@ -531,12 +749,17 @@ def _main(argv=None):
         out = args.out or expand_inputs(args.traces)[0] + ".merged.json"
         merge_traces(traces, out)
         print(f"merged trace: {out}")
-    report = analyze(traces, top=args.top)
+    if args.cmd == "serving":
+        report = serving_report(traces, top=args.top)
+        fmt = format_serving_report(report)
+    else:
+        report = analyze(traces, top=args.top)
+        fmt = format_report(report)
     if args.report:
         with open(args.report, "w") as f:
             json.dump(report, f, indent=1, sort_keys=True)
             f.write("\n")
-    print(format_report(report))
+    print(fmt)
 
 
 if __name__ == "__main__":  # pragma: no cover - thin CLI

@@ -248,6 +248,17 @@ def trace_clock_probes() -> int:
     return int(v)
 
 
+def exemplar_ttl_secs() -> float:
+    """How long a histogram exemplar (the trace id of the worst recent
+    observation, docs/metrics.md#exemplars) stays champion before ANY
+    newer exemplar-carrying observation may replace it regardless of
+    value — "worst recent", not "worst ever". Default 60 s."""
+    v = _get("EXEMPLAR_TTL")
+    if v in (None, ""):
+        return 60.0
+    return float(v)
+
+
 def metrics_enabled() -> bool:
     """Metrics registry recording (docs/metrics.md). Default ON — a
     guarded counter add is nanoseconds (the BENCH_METRICS overhead test
@@ -324,6 +335,19 @@ def serving_queue() -> int:
     if v in (None, ""):
         return 32
     return int(v)
+
+
+def reqtrace_dir() -> Optional[str]:
+    """Directory for per-process serving request traces
+    (docs/serving.md#request-tracing): when set, the fleet router
+    writes ``reqtrace-router.trace.json`` and every replica writes
+    ``reqtrace-replica{id}-gen{g}.trace.json`` there (one catapult file
+    per process, the PR 5 tuple-enqueue writer), merged and analyzed by
+    ``python -m horovod_tpu.tools.trace``. None/empty disables request
+    tracing entirely — the serving hot path then carries one ``is
+    None`` check per decode step."""
+    v = _get("REQTRACE")
+    return v or None
 
 
 def replica_id() -> Optional[int]:

@@ -193,6 +193,210 @@ class TestFleetFailoverE2E:
         assert "serving replica crash" in \
             report["died_first"]["phase"]
         assert "rank 1" in proc.stdout
+        # The flight recorder's request lifecycle events name exactly
+        # what the replica took down with it: the admitted-but-
+        # unfinished requests and their phase (the router failed these
+        # over — docs/serving.md#request-tracing).
+        infl = report["per_rank"]["1"]["inflight_requests"]
+        assert infl, "crashed replica must name its in-flight requests"
+        assert all(q["phase"] in ("prefill", "decode") for q in infl)
+        assert any(q["phase"] == "decode" for q in infl)
+        assert "In-flight requests on rank 1" in proc.stdout
+
+
+@pytest.mark.slow
+class TestRequestTraceE2E:
+    def test_merged_trace_budget_and_exemplar(self, tmp_path):
+        """Acceptance (docs/serving.md#request-tracing): a 3-replica
+        fleet with an injected ``replica_crash_at`` yields a merged
+        serving trace in which the failed request's spans cross all
+        three processes (router, dead replica, resume replica) under
+        ONE trace id; the report attributes its latency across
+        queue/prefill/decode/failover phases summing to the measured
+        wall within 10%; and the TTFT histogram's exemplar on the
+        resume replica links to that trace id."""
+        from horovod_tpu.serving import reqtrace
+
+        ckpt = str(tmp_path / "ckpt")
+        rt = str(tmp_path / "rt")
+        cfg, params = _write_checkpoint(ckpt)
+        max_new, n_req = 48, 6
+
+        # Uncontended reference FIRST — before the router-side trace
+        # writer exists, so the in-process reference engine cannot
+        # pollute the router's capture. (No faults in THIS process:
+        # the spec targets serving replica ranks via REPLICA_ID.)
+        mesh1 = create_mesh(devices=jax.devices()[:1], tp=1)
+        man = CheckpointEngine(ckpt).restore_manifest()
+        scfg = serving_config(config_from_manifest(man), mesh1)
+        ref_engine = InferenceEngine(
+            load_params(ckpt, scfg, mesh1), scfg, mesh1,
+            ServingConfig(block_size=4, kv_blocks=64,
+                          max_batch_slots=2, max_new_tokens=max_new))
+        rng = np.random.RandomState(23)
+        prompts = [[int(t) for t in rng.randint(0, 64, int(n))]
+                   for n in rng.randint(10, 15, n_req)]
+        expected = [ref_engine.generate(p) for p in prompts]
+
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "HOROVOD_TPU_REQTRACE": rt,
+            # slow_decode paces every replica's step to >= 25 ms so the
+            # whole 6-request load is placed BEFORE replica 1's crash
+            # at its decode tick 30, and the survivors' slots are still
+            # busy when the resumes arrive — the resumes then QUEUE for
+            # a deterministic, dominant wait (the exemplar mechanism
+            # below rests on it).
+            "HOROVOD_TPU_FAULT_SPEC":
+                "rank=*:slow_decode=25ms; "
+                "rank=1:replica_crash_at=35:gen=0",
+            # Short exemplar window so the warmup requests' compile-
+            # laden TTFTs expire before the measured load — the
+            # exemplar then links the load's own worst request.
+            "HOROVOD_TPU_EXEMPLAR_TTL": "3",
+        })
+        # slots=2 × 3 replicas == the 6-request load: every fresh
+        # request admits instantly (ms TTFT), while a resume must wait
+        # for a survivor's slot — the worst TTFT on any replica that
+        # served a resume IS that resume.
+        fleet = Fleet(3, ["--checkpoint-dir", ckpt, "--tp", "1",
+                          "--block-size", "4", "--kv-blocks", "64",
+                          "--slots", "2",
+                          "--max-new-tokens", str(max_new)],
+                      env=env)
+        router = Router(fleet, port=0, host="127.0.0.1",
+                        scrape_interval_s=0.1)
+        os.makedirs(rt, exist_ok=True)
+        reqtrace.start(os.path.join(rt, "reqtrace-router.trace.json"),
+                       rank=0, proc="router")
+        exemplars = {}
+        try:
+            fleet.start()
+            fleet.wait_ready(600.0)
+            router.start()
+
+            # Warm every replica across every prefill bucket the load
+            # (and its failover re-prefills) can touch — 16/32/64 —
+            # so no measured TTFT carries an XLA compile. Sequential
+            # unary warmups rotate round-robin over the tied fleet;
+            # the response names the serving replica, so coverage is
+            # asserted, not assumed.
+            for length in (10, 20, 40):
+                covered = set()
+                for j in range(24):
+                    # distinct prompts per attempt — identical ones
+                    # would stick to one replica via the router's
+                    # prefix-cache warmth bonus
+                    warm_prompt = [(7 * j + i) % 64
+                                   for i in range(length)]
+                    status, body = _post(
+                        router.port,
+                        {"tokens": warm_prompt, "max_new_tokens": 2})
+                    assert status == 200
+                    covered.add(body["replica"])
+                    if covered == {0, 1, 2}:
+                        break
+                assert covered == {0, 1, 2}, (length, covered)
+            time.sleep(3.5)   # let the warmup exemplars expire (TTL 3)
+
+            with ThreadPoolExecutor(max_workers=n_req) as pool:
+                futs = []
+                for i in range(n_req):
+                    futs.append(pool.submit(
+                        _post, router.port,
+                        {"tokens": prompts[i],
+                         "max_new_tokens": max_new}))
+                    time.sleep(0.08)   # staggered dispatch: clean
+                    #                    round-robin → 2/2/2 placement,
+                    #                    all placed before the crash
+                results = [f.result(timeout=600) for f in futs]
+            for i, (status, body) in enumerate(results):
+                assert status == 200, (i, status, body)
+                assert body["tokens"] == expected[i], i
+                assert body["trace_id"], i
+
+            # Scrape each live replica's registry endpoint BEFORE the
+            # teardown: the TTFT exemplar is the metrics↔traces link.
+            for rep in fleet.replicas:
+                if not (rep.up and rep.metrics_port):
+                    continue
+                try:
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", rep.metrics_port, timeout=30)
+                    conn.request("GET", "/metrics.json")
+                    snap = json.loads(conn.getresponse().read())
+                    conn.close()
+                except OSError:
+                    continue
+                ex = snap.get("hvdtpu_serving_ttft_seconds",
+                              {"values": {}})["values"].get(
+                    "", {}).get("exemplar")
+                if ex:
+                    exemplars[rep.index] = ex["trace_id"]
+        finally:
+            router.shutdown()
+            fleet.stop()
+            reqtrace.stop()
+
+        # --- the merged serving trace + per-request budget report
+        out = tmp_path / "serving_report.json"
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.tools.trace",
+             "serving", rt, "--report", str(out)],
+            capture_output=True, text=True, timeout=300, cwd=ROOT)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        report = json.loads(out.read_text())
+        assert report["n_requests"] >= n_req
+        failed = {tid: r for tid, r in report["requests"].items()
+                  if r["failovers"]}
+        assert failed, "the injected crash must have failed over at " \
+                       "least one in-flight request"
+
+        # The aggregate → concrete link: some replica's worst recent
+        # TTFT IS one of the failed-over requests (its resume
+        # re-prefill is the deterministically slowest first token).
+        assert exemplars, "no replica exposed a TTFT exemplar"
+        linked = [tid for tid in exemplars.values() if tid in failed]
+        assert linked, (exemplars, sorted(failed))
+        tid = linked[0]
+        row = failed[tid]
+
+        # ONE trace id crossing all three processes.
+        assert "router" in row["processes"]
+        assert len(row["processes"]) >= 3, row["processes"]
+        assert "replica1" in row["processes"]   # the crashed gen-0
+
+        # Latency budget: queue/prefill/decode/failover explain the
+        # measured wall within 10%.
+        assert 0.9 <= row["attributed_frac"] <= 1.1, row
+        assert row["phase_ms"]["decode"] > 0
+        assert row["phase_ms"]["prefill"] > 0
+
+        # Failover chain shows the re-prefill cost on the resume
+        # replica (prompt + emitted → the bigger bucket).
+        chain = row["failovers"][0]
+        assert chain["phase"] == "midstream"
+        assert chain["from_replica"] == 1
+        assert chain["reprefill_ms"] is not None
+        # the re-prefill covers prompt + emitted-so-far — strictly more
+        # than the prompt alone
+        assert chain["reprefill_tokens"] > min(len(p) for p in prompts)
+
+        # And the files merge into one Perfetto view with the failed
+        # request's row present in all three process lanes.
+        merged_path = tmp_path / "merged.json"
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.tools.trace", "merge",
+             rt, "-o", str(merged_path)],
+            capture_output=True, text=True, timeout=300, cwd=ROOT)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        merged = json.loads(merged_path.read_text())
+        row_pids = {e["pid"] for e in merged
+                    if e.get("name") == "thread_name"
+                    and e.get("args", {}).get("name") == tid}
+        assert len(row_pids) >= 3
 
 
 @pytest.mark.slow

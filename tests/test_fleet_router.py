@@ -46,6 +46,7 @@ class StubReplica:
         self.reject = reject            # HTTP code to refuse with
         self.token_delay_s = token_delay_s
         self.requests = []              # bodies of /generate calls
+        self.request_ids = []           # X-Request-Id header per call
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -80,6 +81,8 @@ class StubReplica:
                 n = int(self.headers.get("Content-Length", "0"))
                 body = json.loads(self.rfile.read(n) or b"{}")
                 outer.requests.append(body)
+                outer.request_ids.append(
+                    self.headers.get("X-Request-Id"))
                 if outer.reject:
                     self._json(outer.reject,
                                {"error": f"stub {outer.reject}"},
@@ -390,6 +393,52 @@ class TestRouterHTTP:
             assert resume["max_new_tokens"] == 5
             assert _counter("hvdtpu_fleet_failovers_total",
                             'phase="midstream"') >= before + 1
+        finally:
+            router.shutdown()
+            flaky.stop()
+            backup.stop()
+
+    def test_stable_request_id_across_midstream_failover(self):
+        """ONE request identity end-to-end
+        (docs/serving.md#request-tracing): the id the router ships in
+        X-Request-Id on the first dispatch is REUSED — not re-minted —
+        on the failover re-dispatch, and comes back to the client in
+        the response body. A client-supplied X-Request-Id is honored
+        verbatim."""
+        flaky = StubReplica(die_after=3)               # preferred: idle
+        backup = StubReplica(queue_depth=2, active=4)
+        router = _router([flaky, backup])
+        try:
+            # Router-minted id: same on both hops, returned to client.
+            status, body = _post(router.port,
+                                 {"tokens": [1, 2, 3],
+                                  "max_new_tokens": 8})
+            assert status == 200
+            assert body["trace_id"]
+            assert flaky.request_ids[-1] == body["trace_id"]
+            assert backup.request_ids[-1] == body["trace_id"]
+
+            # Client-supplied id: honored verbatim across the failover.
+            flaky.die_after = 2
+            conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                              timeout=30)
+            conn.request("POST", "/generate",
+                         json.dumps({"tokens": [5, 6],
+                                     "max_new_tokens": 6,
+                                     "stream": True}),
+                         {"Content-Type": "application/json",
+                          "X-Request-Id": "client-chose-this"})
+            resp = conn.getresponse()
+            lines = [json.loads(ln) for ln in resp.read().splitlines()
+                     if ln.strip()]
+            assert lines[0]["trace_id"] == "client-chose-this"
+            assert lines[-1]["done"] and \
+                lines[-1]["trace_id"] == "client-chose-this"
+            assert [ln["t"] for ln in lines[1:-1]] == stub_tokens(2, 6)
+            # Whichever replicas this hop touched (flaky may still sit
+            # in the first failover's exclusion window) saw the
+            # client's id, never a re-minted one.
+            assert backup.request_ids[-1] == "client-chose-this"
         finally:
             router.shutdown()
             flaky.stop()

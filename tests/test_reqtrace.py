@@ -1,0 +1,444 @@
+"""Per-request serving trace plane (docs/serving.md#request-tracing):
+histogram exemplars in the registry, the reqtrace writer + engine/server
+span emission under one stable trace id, flight-recorder request
+lifecycle events feeding the postmortem's in-flight listing, and the
+``tools/trace serving`` latency-budget report (multi-process failover
+chains included, via synthetic writers). The full-fleet acceptance e2e
+(real replicas, injected crash, merged trace + exemplar link) is
+test_fleet_e2e.py (slow tier)."""
+
+import http.client
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+from horovod_tpu.models import transformer as tfm
+from horovod_tpu.observability import flight_recorder as _flight
+from horovod_tpu.observability.registry import (LATENCY_BUCKETS,
+                                                Histogram, registry,
+                                                set_enabled)
+from horovod_tpu.parallel.mesh import create_mesh
+from horovod_tpu.serving import InferenceEngine, ServingConfig
+from horovod_tpu.serving import reqtrace
+from horovod_tpu.serving.server import ServingServer
+from horovod_tpu.tools import postmortem
+from horovod_tpu.tools.trace import (expand_inputs, format_serving_report,
+                                     load_rank_trace, load_traces,
+                                     merge_traces, serving_report)
+
+
+def _cfg(**over):
+    kw = dict(vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+              max_seq=64, dtype=jnp.float32, remat=False)
+    kw.update(over)
+    return tfm.TransformerConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    return create_mesh(devices=jax.devices()[:1], tp=1)
+
+
+def _engine(params, cfg, mesh, **over):
+    kw = dict(block_size=4, kv_blocks=40, max_batch_slots=4,
+              max_queue=8, max_new_tokens=8, min_prefill_bucket=8)
+    kw.update(over)
+    return InferenceEngine(params, cfg, mesh, ServingConfig(**kw))
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_writer():
+    yield
+    reqtrace.stop()
+
+
+# ---------------------------------------------------------------------------
+# Histogram exemplars (registry)
+# ---------------------------------------------------------------------------
+
+class TestHistogramExemplars:
+    def test_none_until_an_exemplar_observation(self):
+        h = Histogram(LATENCY_BUCKETS)
+        h.observe(0.5)
+        assert h.exemplar is None
+        assert "exemplar" not in h.snapshot()
+
+    def test_worst_observation_wins(self):
+        h = Histogram(LATENCY_BUCKETS)
+        h.observe(0.2, exemplar="small", now=100.0)
+        h.observe(0.9, exemplar="big", now=101.0)
+        h.observe(0.4, exemplar="mid", now=102.0)
+        ex = h.exemplar
+        assert ex["trace_id"] == "big" and ex["value"] == 0.9
+        snap = h.snapshot()
+        assert snap["exemplar"]["trace_id"] == "big"
+        # equal value also replaces (most recent worst is freshest link)
+        h.observe(0.9, exemplar="big2", now=103.0)
+        assert h.exemplar["trace_id"] == "big2"
+
+    def test_stale_champion_expires(self):
+        """'Worst RECENT': past the TTL any exemplar-carrying
+        observation replaces the old champion, so the link never pins
+        a request from an hour ago."""
+        h = Histogram(LATENCY_BUCKETS, exemplar_ttl_s=10.0)
+        h.observe(5.0, exemplar="ancient", now=100.0)
+        h.observe(0.1, exemplar="later-smaller", now=105.0)
+        assert h.exemplar["trace_id"] == "ancient"   # within TTL
+        h.observe(0.1, exemplar="fresh", now=111.0)  # past TTL
+        assert h.exemplar["trace_id"] == "fresh"
+        assert h.exemplar["value"] == 0.1
+
+    def test_zero_cost_when_metrics_disabled(self):
+        h = Histogram(LATENCY_BUCKETS)
+        set_enabled(False)
+        try:
+            h.observe(9.0, exemplar="never")
+        finally:
+            set_enabled(True)
+        assert h.count == 0 and h.exemplar is None
+
+    def test_family_passthrough_and_snapshot_surface(self):
+        fam = registry().histogram(
+            "hvdtpu_test_exemplar_seconds", "test only",
+            buckets=LATENCY_BUCKETS)
+        fam.observe(0.25, exemplar="req-xyz")
+        snap = hvd.metrics_snapshot()
+        val = snap["hvdtpu_test_exemplar_seconds"]["values"][""]
+        assert val["exemplar"]["trace_id"] == "req-xyz"
+        # strict-JSON export keeps it (the /metrics.json surface)
+        from horovod_tpu.observability.export import json_safe_snapshot
+        js = json_safe_snapshot()
+        ex = js["hvdtpu_test_exemplar_seconds"]["values"][""]["exemplar"]
+        json.dumps(ex)   # json-safe
+        assert ex["trace_id"] == "req-xyz"
+
+
+# ---------------------------------------------------------------------------
+# Writer + engine span emission
+# ---------------------------------------------------------------------------
+
+def _rows_and_spans(path):
+    """trace-id row name → list of span dicts, from one capture."""
+    t = load_rank_trace(path)
+    from horovod_tpu.tools.trace import _spans
+    out = {}
+    for s in _spans(t.events):
+        row = t.tensor_of.get(s["pid"])
+        out.setdefault(row, []).append(s)
+    return t, out
+
+
+class TestWriterAndEngineSpans:
+    def test_writer_meta_and_span_roundtrip(self, tmp_path):
+        path = str(tmp_path / "w.trace.json")
+        reqtrace.start(path, rank=7, proc="replica7")
+        t0 = time.monotonic()
+        reqtrace.span("req-1", "PREFILL", t0, t0 + 0.01,
+                      {"bucket": 16, "tokens": 9})
+        reqtrace.stop()
+        t, rows = _rows_and_spans(path)
+        assert t.rank == 7 and t.proc == "replica7"
+        assert t.meta.get("clock_synced") is True
+        (s,) = rows["req-1"]
+        assert s["name"] == "PREFILL"
+        assert s["args"] == {"bucket": 16, "tokens": 9}
+        assert 9000 <= s["dur"] <= 11000   # ~10ms in µs
+
+    def test_engine_emits_request_lifecycle_spans(self, model, mesh1,
+                                                  tmp_path):
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1)
+        path = str(tmp_path / "eng.trace.json")
+        reqtrace.start(path, rank=1, proc="replica0")
+        r1 = eng.submit([1, 2, 3, 4, 5], trace_id="trace-a")
+        r2 = eng.submit([9, 8, 7], max_new_tokens=4)
+        eng.run_until_idle()
+        r1.result(), r2.result()
+        reqtrace.stop()
+        _, rows = _rows_and_spans(path)
+        assert set(rows) >= {"trace-a", r2.trace_id}
+        names_a = [s["name"] for s in rows["trace-a"]]
+        assert names_a.count("QUEUE_WAIT") == 1
+        assert names_a.count("ADMIT") == 1
+        assert names_a.count("PREFILL") == 1
+        # 8 tokens total, first from prefill → 7 decode chunks
+        assert names_a.count("DECODE") == 7
+        pre = next(s for s in rows["trace-a"] if s["name"] == "PREFILL")
+        assert pre["args"]["bucket"] == 8 and pre["args"]["tokens"] == 5
+        adm = next(s for s in rows["trace-a"] if s["name"] == "ADMIT")
+        assert adm["args"]["blocks"] > 0
+
+    def test_budget_report_attributes_engine_wall(self, model, mesh1,
+                                                  tmp_path):
+        """Single-process capture: queue+prefill+decode explain the
+        span-extremes wall almost completely (the report's budget
+        machinery, before any router/failover enters)."""
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1, max_batch_slots=2)
+        path = str(tmp_path / "budget.trace.json")
+        reqtrace.start(path, rank=1, proc="replica0")
+        reqs = [eng.submit([i + 1] * 6) for i in range(4)]
+        eng.run_until_idle()
+        for r in reqs:
+            r.result()
+        reqtrace.stop()
+        report = serving_report(load_traces([path]))
+        assert report["n_requests"] == 4
+        for tid, row in report["requests"].items():
+            assert row["wall_ms"] > 0
+            assert 0.7 <= row["attributed_frac"] <= 1.02, (tid, row)
+            # 2 slots, 4 requests: the late pair queued — its queue
+            # share must be visible in the budget
+        waited = [r for r in report["requests"].values()
+                  if r["phase_share"]["queue"] > 0.2]
+        assert len(waited) >= 2
+        # slowest ranking covers all and is sorted
+        walls = [r["wall_ms"] for r in
+                 (report["requests"][s["trace"]]
+                  for s in report["slowest"])]
+        assert walls == sorted(walls, reverse=True)
+        assert format_serving_report(report)   # renders
+
+    def test_ttft_exemplar_links_to_a_traced_request(self, model,
+                                                     mesh1):
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1)
+        r = eng.submit(list(range(1, 9)), trace_id="exemplar-probe")
+        eng.run_until_idle()
+        r.result()
+        snap = hvd.metrics_snapshot()
+        ex = snap["hvdtpu_serving_ttft_seconds"]["values"][""].get(
+            "exemplar")
+        assert ex is not None
+        # the worst recent TTFT belongs to SOME engine request id; this
+        # request just ran, so at minimum the id format links back
+        assert isinstance(ex["trace_id"], str) and ex["trace_id"]
+        qw = snap["hvdtpu_serving_queue_wait_seconds"]["values"][""]
+        assert qw["count"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# One identity through the HTTP front
+# ---------------------------------------------------------------------------
+
+class TestServerRequestId:
+    def _server(self, model, mesh1):
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1)
+        srv = ServingServer(eng, port=0, host="127.0.0.1")
+        srv.start()
+        return srv
+
+    def _post(self, port, body, headers=None):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        h = {"Content-Type": "application/json"}
+        h.update(headers or {})
+        conn.request("POST", "/generate", json.dumps(body), h)
+        resp = conn.getresponse()
+        raw = resp.read()
+        return resp.status, raw
+
+    def test_x_request_id_rides_into_engine_and_back(self, model,
+                                                     mesh1):
+        srv = self._server(model, mesh1)
+        try:
+            status, raw = self._post(
+                srv.port, {"tokens": [1, 2, 3], "max_new_tokens": 3},
+                headers={"X-Request-Id": "router-id-42"})
+            assert status == 200
+            body = json.loads(raw)
+            assert body["trace_id"] == "router-id-42"
+
+            # NDJSON: header and done lines both carry it
+            status, raw = self._post(
+                srv.port, {"tokens": [4, 5], "max_new_tokens": 3,
+                           "stream": True},
+                headers={"X-Request-Id": "router-id-43"})
+            lines = [json.loads(ln) for ln in raw.splitlines()
+                     if ln.strip()]
+            assert lines[0]["trace_id"] == "router-id-43"
+            assert lines[-1]["done"] and \
+                lines[-1]["trace_id"] == "router-id-43"
+
+            # absent header → engine mints one
+            status, raw = self._post(
+                srv.port, {"tokens": [6], "max_new_tokens": 2})
+            assert json.loads(raw)["trace_id"]
+        finally:
+            srv.request_stop()
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder request events → postmortem in-flight listing
+# ---------------------------------------------------------------------------
+
+class TestRequestEventsAndPostmortem:
+    def test_engine_notes_request_lifecycle(self, model, mesh1):
+        cfg, params = model
+        _flight.reset()
+        eng = _engine(params, cfg, mesh1)
+        r = eng.submit([3, 1, 4], max_new_tokens=3,
+                       trace_id="flight-req")
+        eng.run_until_idle()
+        r.result()
+        events = [(kind, payload) for _, kind, payload
+                  in _flight.recorder()._ring if kind == "request"]
+        kinds = [p[0] for _, p in events
+                 if p[1] == "flight-req"]
+        assert kinds == ["admit", "first_token", "evict", "finish"]
+
+    def test_postmortem_names_inflight_requests(self, model, mesh1,
+                                                tmp_path):
+        """A dump taken mid-generation (what a crashed replica leaves)
+        lists the admitted-but-unfinished requests and their phase."""
+        cfg, params = model
+        _flight.reset()
+        _flight.recorder().configure(rank=1, world=0)
+        eng = _engine(params, cfg, mesh1)
+        done = eng.submit([5, 5], max_new_tokens=2, trace_id="done-req")
+        eng.run_until_idle()
+        done.result()
+        live = eng.submit([1, 2, 3, 4], max_new_tokens=8,
+                          trace_id="live-req")
+        eng.step()   # admit + prefill + first decode — then "crash"
+        assert not live.done
+        path = _flight.recorder().dump("fault_crash",
+                                       directory=str(tmp_path))
+        dump = postmortem.load_dump(path)
+        report = postmortem.analyze([dump])
+        infl = report["per_rank"]["1"]["inflight_requests"]
+        assert infl == [{"trace": "live-req", "phase": "decode"}]
+        text = postmortem.format_report(report)
+        assert "In-flight requests on rank 1" in text
+        assert "live-req (decode)" in text
+        _flight.reset()
+
+
+# ---------------------------------------------------------------------------
+# Multi-process report: failover chains, merge, discovery
+# ---------------------------------------------------------------------------
+
+def _synthetic_fleet_capture(d):
+    """Hand-build the three captures a failed-over request leaves:
+    router (REQUEST/DISPATCH/FAILOVER), the replica that died, and the
+    resume replica (re-prefill + remaining decode). Times in seconds on
+    the shared monotonic clock."""
+    t = time.monotonic()
+    rt = reqtrace.start(os.path.join(d, "reqtrace-router.trace.json"),
+                        rank=0, proc="router")
+    rt.request_span("req-f", "REQUEST", t, t + 1.0,
+                    {"status": "completed", "retries": 1})
+    rt.request_span("req-f", "DISPATCH", t, t + 0.4,
+                    {"replica": 1, "outcome": "crash"})
+    rt.request_span("req-f", "FAILOVER", t + 0.4, t + 0.62,
+                    {"phase": "midstream", "from": 1, "to": 2})
+    rt.request_span("req-f", "DISPATCH", t + 0.41, t + 1.0,
+                    {"replica": 2, "outcome": "done"})
+    rt.request_span("req-ok", "REQUEST", t, t + 0.5,
+                    {"status": "completed", "retries": 0})
+    reqtrace.stop()
+
+    r1 = reqtrace.start(
+        os.path.join(d, "reqtrace-replica1-gen0.trace.json"),
+        rank=101, proc="replica1")
+    r1.request_span("req-f", "QUEUE_WAIT", t + 0.01, t + 0.02)
+    r1.request_span("req-f", "PREFILL", t + 0.02, t + 0.10,
+                    {"bucket": 16, "tokens": 12, "cached": 0,
+                     "compile": False})
+    r1.request_span("req-f", "DECODE", t + 0.10, t + 0.40, {"n": 1})
+    reqtrace.stop()
+
+    r2 = reqtrace.start(
+        os.path.join(d, "reqtrace-replica2-gen0.trace.json"),
+        rank=201, proc="replica2")
+    # resume: re-prefill of prompt+emitted inside the failover window
+    r2.request_span("req-f", "QUEUE_WAIT", t + 0.42, t + 0.44)
+    r2.request_span("req-f", "PREFILL", t + 0.44, t + 0.60,
+                    {"bucket": 32, "tokens": 24, "cached": 0,
+                     "compile": False})
+    r2.request_span("req-f", "DECODE", t + 0.60, t + 0.98, {"n": 1})
+    r2.request_span("req-ok", "QUEUE_WAIT", t, t + 0.01)
+    r2.request_span("req-ok", "PREFILL", t + 0.01, t + 0.09,
+                    {"bucket": 16, "tokens": 10, "cached": 0,
+                     "compile": False})
+    r2.request_span("req-ok", "DECODE", t + 0.09, t + 0.5, {"n": 1})
+    reqtrace.stop()
+
+
+class TestServingReportMultiProcess:
+    def test_failover_chain_budget_and_merge(self, tmp_path):
+        d = str(tmp_path)
+        _synthetic_fleet_capture(d)
+        paths = expand_inputs([d])   # directory discovery
+        assert len(paths) == 3
+        traces = load_traces(paths)
+        report = serving_report(traces)
+
+        req = report["requests"]["req-f"]
+        # spans cross all three processes under ONE trace id
+        assert req["processes"] == ["replica1", "replica2", "router"]
+        assert abs(req["wall_ms"] - 1000.0) < 1.0
+        ph = req["phase_ms"]
+        # queue 10+20ms, prefill 80+160ms, decode 300+380ms; failover
+        # window 220ms minus the overlapped resume queue(20) +
+        # prefill(160) + first decode slice(20) = 20 — only the true
+        # detection/re-dispatch dead time counts as failover
+        assert abs(ph["queue"] - 30.0) < 2.0
+        assert abs(ph["prefill"] - 240.0) < 2.0
+        assert abs(ph["decode"] - 680.0) < 2.0
+        assert abs(ph["failover"] - 20.0) < 2.0
+        assert 0.95 <= req["attributed_frac"] <= 1.01
+        (chain,) = req["failovers"]
+        assert chain["phase"] == "midstream"
+        assert chain["from_replica"] == 1 and chain["to_replica"] == 2
+        assert abs(chain["detect_to_resume_ms"] - 220.0) < 1.0
+        assert abs(chain["reprefill_ms"] - 160.0) < 1.0
+        assert chain["reprefill_tokens"] == 24
+        assert chain["reprefill_proc"] == "replica2"
+        # slowest-first ranking puts the failed-over request on top
+        assert report["slowest"][0]["trace"] == "req-f"
+        assert report["n_failovers"] == 1
+
+        # the merged catapult view names processes, not ranks, and the
+        # failed request's row appears under all three
+        out = os.path.join(d, "merged.json")
+        merge_traces(traces, out)
+        merged = json.load(open(out))
+        procs = {e["args"]["name"] for e in merged
+                 if e.get("name") == "process_name"}
+        assert {"router", "replica1", "replica2"} <= procs
+        row_pids = {e["pid"] for e in merged
+                    if e.get("name") == "thread_name"
+                    and e.get("args", {}).get("name") == "req-f"}
+        assert len(row_pids) == 3
+
+    def test_cli_serving_subcommand(self, tmp_path):
+        d = str(tmp_path)
+        _synthetic_fleet_capture(d)
+        import subprocess
+        import sys
+        out = tmp_path / "report.json"
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.tools.trace", "serving",
+             d, "--report", str(out)],
+            capture_output=True, text=True, timeout=120, cwd=root)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "req-f" in proc.stdout and "Failover:" in proc.stdout
+        report = json.loads(out.read_text())
+        assert report["requests"]["req-f"]["failovers"]

@@ -1,0 +1,55 @@
+"""Per-request serving-trace overhead guard (slow tier) — the request
+trace capture must stay out of the decode hot path: ``bench_serving.py
+--reqtrace`` A/Bs the BENCH_SERVING load (8 slots, 8 concurrent
+requests) with tracing toggled IN-process in paired alternating-order
+rounds (the BENCH_TRACE methodology: separate jobs differ by ±5%
+job-to-job, swamping the budget; pooled per-request latencies, 25th
+percentile) and this guard holds the per-request latency overhead under
+3%, regenerating ``BENCH_REQTRACE.json``.
+
+One re-measure is allowed before failing — a shared CI box can stay
+saturated through one window (the BENCH_METRICS precedent)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+BUDGET = 0.03
+
+
+def _run_bench(out_path: str, rounds: int) -> dict:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "bench_serving.py"),
+         "--reqtrace", "--reqtrace-rounds", str(rounds),
+         "--out", out_path],
+        capture_output=True, text=True, timeout=900, cwd=root)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return json.loads(open(out_path).read())
+
+
+def test_reqtrace_overhead_under_3_percent(tmp_path):
+    out = tmp_path / "bench_reqtrace.json"
+    result = _run_bench(str(out), rounds=6)
+    if result["overhead_frac"] >= BUDGET:   # one re-measure
+        result = _run_bench(str(out), rounds=6)
+
+    # Regenerate the committed artifact from the accepted run.
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "BENCH_REQTRACE.json"), "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+    assert result["rows"]["tracing_on"]["request_p25_ms"] > 0
+    assert result["trace_files"] == 6
+    assert result["overhead_frac"] < BUDGET, (
+        f"request tracing cost {result['overhead_frac']:.2%} of the "
+        f"per-request serving latency (on "
+        f"{result['rows']['tracing_on']['request_p25_ms']} ms vs off "
+        f"{result['rows']['tracing_off']['request_p25_ms']} ms; "
+        f"budget {BUDGET:.0%})")
