@@ -66,6 +66,7 @@ class AdaptationConfig:
     interval_s: float = 1.0        # evaluation cadence
     deescalate_ratio: float = 0.5  # low band = threshold * ratio
     shrink_factor: int = 4         # fusion-threshold divisor for 'shrink'
+    alert_hold_s: float = 30.0     # how long a health alert keeps pressure
     tiers: Tuple[str, ...] = DEFAULT_TIERS
 
     @classmethod
@@ -76,6 +77,7 @@ class AdaptationConfig:
             sustain_s=_env.adapt_sustain_s(),
             cooldown_s=_env.adapt_cooldown_s(),
             interval_s=_env.adapt_interval_s(),
+            alert_hold_s=_env.adapt_alert_hold_s(),
             tiers=tuple(t.strip() for t in tiers.split(",") if t.strip())
             if tiers else DEFAULT_TIERS)
 
@@ -123,9 +125,18 @@ class AdaptationPolicy:
         self._m_evictions = r.counter(
             "hvdtpu_adaptation_evictions_total",
             "Slow-rank evictions requested by the policy, by rank")
+        self._m_alert_inputs = r.counter(
+            "hvdtpu_adaptation_alert_inputs_total",
+            "Health alerts consumed as ladder inputs, by alert kind "
+            "(docs/health.md#adaptation)")
         self._m_tier.set(0)
         self._m_straggler.set(-1)
         self._set_wire_gauge()
+        # Health-alert pressure (docs/health.md#adaptation): a
+        # regression/leak alert keeps the named rank's effective
+        # lateness at the threshold for alert_hold_s — it can START
+        # the sustain clock but never bypass the hysteresis.
+        self._alert_until: Dict[Tuple[str, int], float] = {}
 
     # ----------------------------------------------------------- derived
 
@@ -148,6 +159,39 @@ class AdaptationPolicy:
         self._m_wire.clear()
         self._m_wire.labels(spec=self.wire_spec() or "raw").set(1)
 
+    # ------------------------------------------------------------- alerts
+
+    def note_alert(self, kind: str, rank: int, now: float) -> None:
+        """Record one health alert (docs/health.md#adaptation) as
+        ladder pressure: for ``alert_hold_s`` after this call the named
+        rank's effective lateness is clamped to at least
+        ``threshold_s``, so a sustained regression/leak walks the same
+        hysteresis-guarded ladder as measured negotiate lateness — and
+        a one-off alert that is not renewed decays without ever
+        escalating. Unknown kinds are accepted (forward compat) but
+        only regression/leak kinds are ever forwarded here."""
+        self._alert_until[(str(kind), int(rank))] = \
+            now + self.config.alert_hold_s
+        self._m_alert_inputs.labels(kind=str(kind)).inc()
+        _log.warning(
+            "adaptation_event action=alert_input kind=%s rank=%d",
+            kind, rank)
+        _flight.recorder().note("adapt", (
+            "alert_input", self.tier, str(kind), int(rank), 0.0))
+
+    def _alert_pressure(self, now: float) -> Dict[int, float]:
+        """Per-rank synthetic lateness from alerts still inside their
+        hold window (expired entries are pruned)."""
+        expired = [k for k, until in self._alert_until.items()
+                   if until < now]
+        for k in expired:
+            del self._alert_until[k]
+        out: Dict[int, float] = {}
+        for (_, rank), _until in self._alert_until.items():
+            if rank >= 0:
+                out[rank] = self.config.threshold_s
+        return out
+
     # ------------------------------------------------------------- clock
 
     def observe(self, lateness_by_rank: Dict[int, float],
@@ -157,7 +201,10 @@ class AdaptationPolicy:
         never more than one per call — one hysteresis window per
         step keeps the escalation rate bounded and observable)."""
         cfg = self.config
-        live = {r: v for r, v in lateness_by_rank.items()
+        merged = dict(lateness_by_rank)
+        for rank, floor in self._alert_pressure(now).items():
+            merged[rank] = max(merged.get(rank, 0.0), floor)
+        live = {r: v for r, v in merged.items()
                 if r not in self.evicted}
         worst_rank = max(live, key=live.get) if live else -1
         lateness = live.get(worst_rank, 0.0)

@@ -32,12 +32,19 @@ from .step_metrics import StepTimer, flops_of_lowered
 # :func:`get_flight_recorder`.
 from .flight_recorder import FlightRecorder
 from .flight_recorder import recorder as get_flight_recorder
+# NOTE: ``history``/``health``/``ticker`` likewise stay submodule names
+# (the fleet, tools and tests import them as modules); the telemetry
+# history + anomaly-detection plane's classes are exported directly.
+from .health import Alert, HealthMonitor
+from .history import HistorySampler, HistoryWriter, load_history
 
 __all__ = [
-    "Counter", "FlightRecorder", "Gauge", "Histogram", "MetricsRegistry",
+    "Alert", "Counter", "FlightRecorder", "Gauge", "HealthMonitor",
+    "Histogram", "HistorySampler", "HistoryWriter", "MetricsRegistry",
     "MetricsServer", "StepTimer", "enabled", "final_metrics_flush",
     "flight_recorder", "flops_of_lowered", "get_flight_recorder",
-    "get_registry", "histogram_percentiles", "maybe_start_exporters",
-    "metrics_snapshot", "prometheus_text", "registry", "set_enabled",
-    "stop_exporters", "with_percentiles", "write_json_snapshot",
+    "get_registry", "health", "histogram_percentiles", "history",
+    "load_history", "maybe_start_exporters", "metrics_snapshot",
+    "prometheus_text", "registry", "set_enabled", "stop_exporters",
+    "ticker", "with_percentiles", "write_json_snapshot",
 ]

@@ -55,11 +55,44 @@ def _fmt(v: float) -> str:
     return repr(f)
 
 
-def prometheus_text(snap: Optional[dict] = None) -> str:
+def _exemplar_suffix(val: dict, le, prev_le) -> str:
+    """OpenMetrics exemplar annotation for one ``_bucket`` line — the
+    exemplar belongs on the bucket *containing* its value (spec: an
+    exemplar's value must lie within the bucket's range). Empty string
+    when this bucket doesn't own it."""
+    ex = val.get("exemplar")
+    if not ex:
+        return ""
+    v = ex["value"]
+    hi = math.inf if isinstance(le, str) else float(le)
+    lo = -math.inf if prev_le is None else (
+        math.inf if isinstance(prev_le, str) else float(prev_le))
+    if not (lo < v <= hi or (math.isinf(hi) and v > lo)):
+        return ""
+    tid = str(ex["trace_id"]).replace("\\", "\\\\").replace('"', '\\"')
+    return (f' # {{trace_id="{tid}"}} {_fmt(v)}'
+            f' {_fmt(round(ex.get("time_unix", 0.0), 3))}')
+
+
+def prometheus_text(snap: Optional[dict] = None, *,
+                    exemplars: bool = False,
+                    percentiles: bool = True) -> str:
     """Render a registry snapshot as Prometheus text exposition format
     (version 0.0.4): ``# HELP`` / ``# TYPE`` headers, ``_bucket`` series
     with cumulative ``le`` labels ending at ``+Inf``, ``_sum`` and
-    ``_count`` per histogram."""
+    ``_count`` per histogram.
+
+    ``percentiles=True`` (default) additionally emits ``{name}_p50`` /
+    ``{name}_p90`` / ``{name}_p99`` gauge series per histogram — the
+    same log-bucket estimate ``/metrics.json`` already serves, so
+    scrape-only consumers (dashboards with no recording rules) see the
+    percentile view too.
+
+    ``exemplars=True`` appends each histogram's worst-recent exemplar
+    (docs/metrics.md#exemplars) to the ``_bucket`` line containing its
+    value, in OpenMetrics syntax (``# {trace_id="..."} value ts``) —
+    the endpoint enables this when the scraper negotiates
+    ``application/openmetrics-text`` (v0.0.4 has no exemplar syntax)."""
     snap = snap if snap is not None else _reg.snapshot()
     lines = []
     for name in sorted(snap):
@@ -68,19 +101,34 @@ def prometheus_text(snap: Optional[dict] = None) -> str:
             esc = fam["help"].replace("\\", "\\\\").replace("\n", "\\n")
             lines.append(f"# HELP {name} {esc}")
         lines.append(f"# TYPE {name} {fam['type']}")
+        pct_lines = {q: [] for q in ("p50", "p90", "p99")}
         for label_key in sorted(fam["values"]):
             val = fam["values"][label_key]
             if fam["type"] == "histogram":
+                prev_le = None
                 for le, cum in val["buckets"]:
                     lab = (label_key + "," if label_key else "") \
                         + f'le="{_fmt(le)}"'
-                    lines.append(f"{name}_bucket{{{lab}}} {cum}")
+                    ex = (_exemplar_suffix(val, le, prev_le)
+                          if exemplars else "")
+                    lines.append(f"{name}_bucket{{{lab}}} {cum}{ex}")
+                    prev_le = le
                 block = f"{{{label_key}}}" if label_key else ""
                 lines.append(f"{name}_sum{block} {_fmt(val['sum'])}")
                 lines.append(f"{name}_count{block} {val['count']}")
+                if percentiles:
+                    pct = histogram_percentiles(val, (0.5, 0.9, 0.99))
+                    for q, v in pct.items():
+                        pct_lines[q].append(
+                            f"{name}_{q}{block} {_fmt(v)}")
             else:
                 block = f"{{{label_key}}}" if label_key else ""
                 lines.append(f"{name}{block} {_fmt(val)}")
+        for q in ("p50", "p90", "p99"):
+            if pct_lines[q]:
+                lines.append(
+                    f"# TYPE {name}_{q} gauge")
+                lines.extend(pct_lines[q])
     return "\n".join(lines) + "\n"
 
 
@@ -152,11 +200,14 @@ def with_percentiles(snap: dict, qs=(0.5, 0.9, 0.99)) -> dict:
 # JSON snapshot file
 # --------------------------------------------------------------------------
 
-def json_safe_snapshot() -> dict:
+def json_safe_snapshot(prefix: Optional[str] = None) -> dict:
     """Registry snapshot with ``inf`` bucket bounds replaced by the
     string "+Inf" — strict JSON (``json.dumps`` would emit the invalid
-    bare ``Infinity`` literal otherwise)."""
-    snap = _reg.snapshot()
+    bare ``Infinity`` literal otherwise). ``prefix=`` filters families
+    like :func:`registry.snapshot` — per-tick consumers (the fleet
+    history sampler scraping ``/metrics.json?prefix=hvdtpu_serving_``)
+    should never serialize the whole registry."""
+    snap = _reg.snapshot(prefix=prefix)
     for fam in snap.values():
         if fam["type"] != "histogram":
             continue
@@ -195,19 +246,17 @@ def _process_index() -> int:
 
 
 class _JsonWriter:
+    """Periodic JSON snapshot writes, scheduled on the ONE shared
+    telemetry timer thread (observability/ticker.py) — this class used
+    to own its own daemon thread, and the history sampler would have
+    spawned a second; the regression test in tests/test_history.py
+    pins the single-thread consolidation."""
+
     def __init__(self, path: str, interval_s: float):
         self._path = path
-        self._interval = max(0.05, interval_s)
-        self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._loop,
-                                        name="hvd-tpu-metrics-file",
-                                        daemon=True)
-        self._thread.start()
-
-    def _loop(self):
-        while not self._stop.wait(self._interval):
-            self._write()
-        self._write()  # final flush on stop
+        from . import ticker as _ticker
+        self._handle = _ticker.ticker().add(
+            "metrics-file", interval_s, self._write, final=self._write)
 
     def _write(self):
         try:
@@ -216,8 +265,8 @@ class _JsonWriter:
             _log.warning("metrics snapshot write failed: %s", e)
 
     def stop(self):
-        self._stop.set()
-        self._thread.join(timeout=5.0)
+        from . import ticker as _ticker
+        _ticker.ticker().remove(self._handle)  # runs the final flush
 
 
 # --------------------------------------------------------------------------
@@ -237,12 +286,31 @@ class MetricsServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
-                if self.path.split("?")[0] == "/metrics":
-                    body = prometheus_text().encode()
-                    ctype = "text/plain; version=0.0.4; charset=utf-8"
-                elif self.path.split("?")[0] == "/metrics.json":
-                    body = json.dumps(with_percentiles(json_safe_snapshot()),
-                                      sort_keys=True).encode()
+                route, _, query = self.path.partition("?")
+                params = dict(
+                    kv.split("=", 1) for kv in query.split("&")
+                    if "=" in kv)
+                prefix = params.get("prefix") or None
+                if route == "/metrics":
+                    # Content negotiation: a scraper that asks for
+                    # OpenMetrics gets exemplars (# {trace_id=...}
+                    # syntax) and the EOF marker; v0.0.4 text has no
+                    # exemplar syntax, so the default stays clean.
+                    accept = self.headers.get("Accept", "")
+                    om = "openmetrics" in accept
+                    text = prometheus_text(
+                        _reg.snapshot(prefix=prefix), exemplars=om)
+                    if om:
+                        body = (text + "# EOF\n").encode()
+                        ctype = ("application/openmetrics-text; "
+                                 "version=1.0.0; charset=utf-8")
+                    else:
+                        body = text.encode()
+                        ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif route == "/metrics.json":
+                    body = json.dumps(
+                        with_percentiles(json_safe_snapshot(prefix)),
+                        sort_keys=True).encode()
                     ctype = "application/json"
                 else:
                     self.send_error(404)

@@ -65,6 +65,10 @@ Event kinds (payload fields):
                     lifecycle: epoch boundaries, cursor commits, resume
                     (docs/data.md; the postmortem surfaces the last
                     committed cursor per rank)
+  ``alert``         alert, severity, series, who, value, baseline —
+                    health-detector alert fired (docs/health.md; the
+                    dump shows what the anomaly plane saw before a
+                    death)
   ================  ========================================================
 """
 
@@ -110,6 +114,7 @@ _FIELDS = {
     "pipeline": ("schedule", "stages", "microbatches", "virtual",
                  "warmup", "steady", "drain", "bubble_share"),
     "data": ("event", "epoch", "offset", "detail"),
+    "alert": ("alert", "severity", "series", "who", "value", "baseline"),
 }
 
 # Recording lever — module-global single check like registry._enabled.
@@ -367,7 +372,10 @@ def maybe_install_hooks() -> None:
     global _hooks_installed, _periodic_thread
     if _hooks_installed:
         return
-    if not (_env.blackbox_dir() or _env.metrics_file()):
+    if not (_env.blackbox_dir() or _env.metrics_file()
+            or _env.history_dir()):
+        # history_dir counts: its sampler registers a final-gasp flush
+        # (the last window before a death must reach the history file).
         return
     _hooks_installed = True
 

@@ -326,11 +326,19 @@ class MetricsRegistry:
                   buckets: Optional[Sequence[float]] = None) -> _Family:
         return self._family(name, "histogram", help_text, buckets)
 
-    def snapshot(self) -> dict:
-        """Plain-dict snapshot of every family (see module docstring)."""
+    def snapshot(self, prefix: Optional[str] = None) -> dict:
+        """Plain-dict snapshot of every family (see module docstring).
+
+        ``prefix`` (a string, or a tuple of strings) restricts the
+        snapshot to families whose name starts with it — the cheap
+        form for per-tick consumers (the fleet sampler, shim callbacks)
+        that only ever read one corner of the registry and were
+        deep-copying all of it every tick."""
         out: Dict[str, dict] = {}
         with self._lock:
             fams = list(self._families.values())
+        if prefix is not None:
+            fams = [f for f in fams if f.name.startswith(prefix)]
         for fam in fams:
             values = {}
             for key, child in fam.items():
@@ -351,13 +359,15 @@ def registry() -> MetricsRegistry:
     return _registry
 
 
-def snapshot() -> dict:
+def snapshot(prefix: Optional[str] = None) -> dict:
     """``horovod_tpu.metrics_snapshot()`` — one coherent dict of every
     metric (counters/gauges as floats, histograms with monotone
     cumulative bucket sums). Safe to call from any thread at any time.
+    ``prefix=`` (string or tuple) restricts to matching family names —
+    use it in per-tick consumers instead of snapshotting everything.
 
     There is deliberately NO reset: registry totals survive engine and
     executor resets (the reason the ad-hoc per-instance counters moved
     here), and hot paths cache child handles that a swap would orphan.
     Consumers wanting per-window numbers diff two snapshots."""
-    return _registry.snapshot()
+    return _registry.snapshot(prefix=prefix)

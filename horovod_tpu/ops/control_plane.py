@@ -149,6 +149,22 @@ class ClockProbeResponse:
         self.t_mono_us = t_mono_us
 
 
+class AlertNoteRequest:
+    """One health alert forwarded to the rank-0 coordinator as an
+    adaptation-ladder input (docs/health.md#adaptation): a remote
+    rank's detector saw a step-time regression or an HBM leak that the
+    coordinator's own lateness signal may not reflect (a leak is not
+    late until it OOMs). Best-effort, fire-and-forget — alerting must
+    never stall a worker."""
+
+    def __init__(self, rank: int, kind: str, severity: str = "warning",
+                 value: float = 0.0):
+        self.rank = rank
+        self.kind = kind
+        self.severity = severity
+        self.value = value
+
+
 class FetchRequest:
     """Long-poll for response groups after ``after_seq`` — the response
     list Bcast of the reference (operations.cc:2282-2287)."""
@@ -409,6 +425,10 @@ class CoordinatorService(BasicService):
         self._m_announces = r.counter(
             "hvdtpu_coordinator_announces_total",
             "Announce RPCs processed").labels()
+        self._m_alert_notes = r.counter(
+            "hvdtpu_coordinator_alert_notes_total",
+            "Health alerts forwarded by remote ranks as adaptation "
+            "ladder inputs, by alert kind (docs/health.md#adaptation)")
         self._groups_seen = 0
         self._failures_reported: set = set()
         # Live skew telemetry (docs/tracing.md): per-rank announce
@@ -504,6 +524,15 @@ class CoordinatorService(BasicService):
             # close to the reply as possible — the worker halves the
             # round trip around this reading (min-RTT sample wins).
             return ClockProbeResponse(int(time.monotonic() * 1e6))
+        if isinstance(req, AlertNoteRequest):
+            # Remote detector alert → ladder pressure on the policy
+            # (docs/health.md#adaptation). Accepted (and counted) even
+            # without a policy so the sender's path stays uniform.
+            self._m_alert_notes.labels(kind=str(req.kind)).inc()
+            if self._policy is not None:
+                self._policy.note_alert(req.kind, req.rank,
+                                        time.monotonic())
+            return AnnounceResponse()
         return super()._handle(req, client_address)
 
     def _announce(self, req: AnnounceRequest) -> AnnounceResponse:
@@ -798,6 +827,15 @@ class CoordinatorService(BasicService):
         if now - self._last_policy_tick < self._policy.config.interval_s:
             return
         self._last_policy_tick = now
+        # Health alerts fired in THIS process (rank 0's own detector
+        # plane) feed the ladder directly; remote ranks arrive via
+        # AlertNoteRequest (docs/health.md#adaptation).
+        try:
+            from ..observability import health as _health
+            for a in _health.drain_policy_alerts():
+                self._policy.note_alert(a["kind"], a["rank"], now)
+        except Exception:  # never fail planning over telemetry
+            pass
         prev_wire = self._policy.wire_spec()
         events = self._policy.observe(
             self._skew.recent_lateness_by_rank(), now)
@@ -1190,6 +1228,19 @@ class CoordinatorClient:
                 best_rtt, best_offset = rtt, offset
         return {"offset_s": best_offset, "rtt_s": best_rtt,
                 "probes": int(probes)}
+
+    def note_alert(self, kind: str, rank: Optional[int] = None,
+                   severity: str = "warning", value: float = 0.0) -> None:
+        """Forward one health alert to the coordinator as an adaptation
+        ladder input (docs/health.md#adaptation). ONE attempt, errors
+        swallowed — alerting is advisory; the retry/backoff machinery
+        exists for the collective path, not telemetry."""
+        try:
+            self._client.request(AlertNoteRequest(
+                self._rank if rank is None else int(rank), str(kind),
+                str(severity), float(value)))
+        except Exception:
+            pass
 
     def announce_shutdown(self) -> None:
         try:

@@ -92,6 +92,16 @@ def main(argv=None) -> int:
                              ".jsonl here for `python -m "
                              "horovod_tpu.tools.postmortem`; exported "
                              "as HOROVOD_TPU_BLACKBOX")
+    parser.add_argument("--history-dir", default=None,
+                        help="telemetry history directory "
+                             "(docs/health.md): each rank appends "
+                             "windowed registry deltas to "
+                             "history-rank{rank}.jsonl here every "
+                             "HOROVOD_TPU_HISTORY_INTERVAL (5 s) and "
+                             "the online health detectors run over "
+                             "the live window; read with `python -m "
+                             "horovod_tpu.tools.health`; exported as "
+                             "HOROVOD_TPU_HISTORY")
     parser.add_argument("--serve", action="store_true",
                         help="serving mode (docs/serving.md): the "
                              "worker command becomes `python -m "
@@ -148,6 +158,8 @@ def main(argv=None) -> int:
         extra_env["HOROVOD_TPU_TIMELINE"] = args.timeline
     if args.blackbox_dir:
         extra_env["HOROVOD_TPU_BLACKBOX"] = args.blackbox_dir
+    if args.history_dir:
+        extra_env["HOROVOD_TPU_HISTORY"] = args.history_dir
 
     provider = None
     hosts = args.hosts

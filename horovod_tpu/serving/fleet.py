@@ -52,6 +52,10 @@ from ..utils.logging import get_logger
 
 _log = get_logger("serving.fleet")
 
+# Families the replica history sampler scrapes — the serving signal
+# plane, not the whole registry (docs/health.md#fleet).
+_REPLICA_HISTORY_PREFIX = "hvdtpu_serving_"
+
 # The replica's announce line (serving/__main__.py). The leading
 # ``ready on :PORT`` phrase is load-bearing API — tests and the
 # pre-fleet tooling grep for it.
@@ -150,6 +154,15 @@ class Fleet:
         self._lock = threading.Lock()
         self._stopping = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Telemetry history (docs/health.md#fleet): the SUPERVISOR
+        # samples each replica's scraped serving metrics into its own
+        # history-replica{i}.jsonl — replica trends survive replica
+        # death (the replica's own process-local history would die
+        # with it), and restarts appear as counter resets, which the
+        # delta reduction handles. Plus one history-fleet.jsonl over
+        # the supervisor's own registry (restart/probe counters) so
+        # the restart-spike detector has a durable signal.
+        self._history: list = []
 
     # ----------------------------------------------------------- spawn
 
@@ -229,8 +242,58 @@ class Fleet:
         self._thread = threading.Thread(
             target=self._supervise, name="hvd-tpu-fleet", daemon=True)
         self._thread.start()
+        self._maybe_start_history()
         if ready_timeout_s is not None:
             self.wait_ready(ready_timeout_s)
+
+    def _scrape_snapshot(self, rep: Replica) -> dict:
+        """One replica's serving-metric snapshot — the prefix-filtered
+        ``/metrics.json`` view (never the full registry; the prefix=
+        query keeps the per-tick payload to the serving families)."""
+        import urllib.request
+        port = rep.metrics_port
+        if not rep.up or port is None:
+            raise ConnectionError(f"replica {rep.index} has no metrics "
+                                  "endpoint (down or not ready)")
+        with urllib.request.urlopen(
+                f"http://{self.host}:{port}/metrics.json"
+                f"?prefix={_REPLICA_HISTORY_PREFIX}",
+                timeout=max(1.0, self._probe_interval * 4)) as resp:
+            import json as _json
+            return _json.loads(resp.read())
+
+    def _maybe_start_history(self) -> None:
+        """Arm the fleet history plane when HOROVOD_TPU_HISTORY is set:
+        one sampler per replica (scraped, so trends survive replica
+        death) plus one over the supervisor's own fleet registry, all
+        sharing the telemetry timer thread. The supervisor owns the
+        alert webhook for serving alerts — replicas never POST."""
+        directory = _env.history_dir()
+        if not directory or not _obs.enabled():
+            return
+        from ..observability import health as _health
+        from ..observability import history as _history
+        detectors = _env.health_detectors_enabled()
+        url = _env.alert_url()
+        for rep in self.replicas:
+            monitor = _health.HealthMonitor(
+                replica=rep.index, webhook_url=url) if detectors else None
+            self._history.append(_history.HistorySampler(
+                directory, f"replica{rep.index}",
+                source=(lambda r=rep: self._scrape_snapshot(r)),
+                monitor=monitor,
+                meta=lambda r=rep: {"replica": r.index,
+                                    "generation": r.generation,
+                                    "role": "serving_replica"},
+            ).start())
+        fleet_monitor = _health.HealthMonitor(
+            webhook_url=url) if detectors else None
+        self._history.append(_history.HistorySampler(
+            directory, "fleet",
+            prefix="hvdtpu_fleet_",
+            monitor=fleet_monitor,
+            meta=lambda: {"role": "fleet_supervisor"},
+        ).start())
 
     def wait_ready(self, timeout_s: float) -> None:
         deadline = time.monotonic() + timeout_s
@@ -342,6 +405,9 @@ class Fleet:
         """Tear the fleet down: stop restarting, SIGTERM every replica
         (graceful drain), escalate to SIGKILL past the timeout."""
         self._stopping.set()
+        for sampler in self._history:
+            sampler.stop()   # final flush — the last window survives
+        self._history = []
         if self._thread is not None:
             self._thread.join(timeout=self._probe_interval * 4 + 1)
         for rep in self.replicas:

@@ -280,7 +280,10 @@ class Router:
                 ep.host, ep.metrics_port, timeout=max(
                     1.0, self._scrape_interval * 4))
             try:
-                conn.request("GET", "/metrics.json")
+                # prefix= keeps the per-scrape payload to the serving
+                # families — the replica never serializes (and the
+                # router never parses) the whole registry per tick.
+                conn.request("GET", "/metrics.json?prefix=hvdtpu_serving_")
                 resp = conn.getresponse()
                 if resp.status != 200:
                     return False

@@ -249,6 +249,16 @@ def init(*, coordinator_address: Optional[str] = None,
     except Exception as e:  # never fail init over telemetry
         from .utils.logging import get_logger
         get_logger("topology").warning("flight recorder not armed: %s", e)
+    # Telemetry history + health detectors (docs/health.md): env-driven
+    # (HOROVOD_TPU_HISTORY), idempotent, rides the shared telemetry
+    # timer thread — the trend-aware plane the live gauges cannot be.
+    try:
+        from .observability import history as _history
+        _history.maybe_start_sampler()
+    except Exception as e:  # never fail init over telemetry
+        from .utils.logging import get_logger
+        get_logger("topology").warning("history sampler not started: %s",
+                                       e)
     return _topology
 
 

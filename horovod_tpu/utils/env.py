@@ -205,6 +205,70 @@ def blackbox_capacity() -> int:
     return int(v)
 
 
+def history_dir() -> Optional[str]:
+    """Directory for the telemetry history ring (docs/health.md): when
+    set, a background sampler appends windowed registry deltas to
+    ``history-rank{rank}.jsonl`` here every history_interval_secs and
+    the online health detectors run over the live window. None/empty
+    disables the whole plane — no thread, no file, no detectors."""
+    v = _get("HISTORY")
+    return v or None
+
+
+def history_interval_secs() -> float:
+    """Cadence of the telemetry history sampler (and therefore the
+    detector window granularity). Default 5 s — fine enough to catch a
+    minutes-scale regression, coarse enough that a day of history fits
+    in a few rotated segments."""
+    v = _get("HISTORY_INTERVAL")
+    if v in (None, ""):
+        return 5.0
+    return float(v)
+
+
+def history_max_bytes() -> int:
+    """Per-segment size cap of a history file; past it the writer
+    rotates (``.1`` .. ``.N`` suffixes, oldest deleted). Default 4 MiB."""
+    v = _get("HISTORY_MAX_BYTES")
+    if v in (None, ""):
+        return 4 * 1024 * 1024
+    return int(v)
+
+
+def history_segments() -> int:
+    """Rotated history segments kept per rank (on top of the live
+    file). Total on-disk bound = (segments + 1) * max_bytes per rank."""
+    v = _get("HISTORY_SEGMENTS")
+    if v in (None, ""):
+        return 4
+    return int(v)
+
+
+def health_detectors_enabled() -> bool:
+    """Online anomaly detectors over the live history window
+    (docs/health.md). Default on whenever the history sampler runs;
+    HOROVOD_TPU_HEALTH=0 keeps the history file but fires no alerts."""
+    return _get("HEALTH") not in ("0",)
+
+
+def alert_url() -> Optional[str]:
+    """Optional webhook for health alerts (docs/health.md#webhook):
+    rank 0 / the fleet supervisor POSTs each typed alert as JSON here,
+    fire-and-forget with a short timeout — an unreachable receiver can
+    never stall the sampler."""
+    v = _get("ALERT_URL")
+    return v or None
+
+
+def adapt_alert_hold_s() -> float:
+    """How long a health alert (step-time regression / HBM leak) keeps
+    exerting ladder pressure on the adaptation policy after it fired —
+    the alert-triggered escalation input, hysteresis-guarded exactly
+    like measured lateness (docs/health.md#adaptation)."""
+    v = _get("ADAPT_ALERT_HOLD")
+    return float(v) if v not in (None, "") else 30.0
+
+
 def peak_flops() -> Optional[float]:
     """Peak FLOP/s of this process's devices for the MFU gauge
     (HOROVOD_TPU_PEAK_FLOPS, total across local devices). None =

@@ -1,0 +1,328 @@
+"""Online anomaly-detection health plane (docs/health.md): detector
+state machines (incl. the noisy-but-flat false-positive guard), alert
+fan-out (registry / flight recorder / log / webhook / policy queue),
+the adaptation-ladder alert input with its hysteresis, the coordinator
+AlertNoteRequest RPC, and the alert-kind ↔ docs drift check."""
+
+import http.server
+import json
+import os
+import random
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.observability import flight_recorder as _flight
+from horovod_tpu.observability import health as _health
+from horovod_tpu.observability import registry as _reg
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestEwmaDetector:
+    def test_fires_on_level_shift_not_on_noise(self):
+        rng = random.Random(7)
+        det = _health.EwmaDetector("up")
+        fired = []
+        for t in range(60):
+            v = 0.010 if t < 40 else 0.013           # +30% shift at 40
+            ev = det.update(float(t), v + rng.gauss(0, 2e-4))
+            if ev:
+                fired.append((t, ev))
+        assert fired, "a 30% sustained shift must fire"
+        first_t, ev = fired[0]
+        assert 40 <= first_t <= 43, \
+            f"must fire within 3 windows of the shift, fired at {first_t}"
+        assert ev["baseline"] == pytest.approx(0.010, rel=0.1)
+        assert ev["rel_change"] >= 0.2
+
+    def test_quiet_on_stationary_noise(self):
+        rng = random.Random(13)
+        det = _health.EwmaDetector("up")
+        assert not any(det.update(float(t), 0.01 + rng.gauss(0, 5e-4))
+                       for t in range(200))
+
+    def test_single_spike_does_not_fire_or_poison(self):
+        rng = random.Random(3)
+        det = _health.EwmaDetector("up", warmup=5)
+        fired = []
+        for t in range(60):
+            v = 0.05 if t == 30 else 0.01            # one 5x outlier
+            if det.update(float(t), v + rng.gauss(0, 2e-4)):
+                fired.append(t)
+        # The spike itself may trip one window (it IS 5x); the guard is
+        # that the baseline doesn't absorb it: steady samples after it
+        # must not keep firing.
+        assert all(t == 30 for t in fired)
+
+    def test_down_direction_for_mfu(self):
+        det = _health.EwmaDetector("down", min_rel=0.1)
+        fired = []
+        for t in range(40):
+            v = 0.45 if t < 25 else 0.30             # MFU droop
+            if det.update(float(t), v):
+                fired.append(t)
+        assert fired and fired[0] == 25
+
+
+class TestTrendDetector:
+    def test_monotone_leak_trips(self):
+        rng = random.Random(11)
+        det = _health.TrendDetector()
+        fired = [t for t in range(40)
+                 if det.update(float(t), 1e6 + 5e4 * t
+                               + rng.gauss(0, 1e3))]
+        assert fired
+        assert fired[0] < 15
+
+    def test_noisy_but_flat_does_not_trip(self):
+        """ACCEPTANCE (false-positive guard): a gauge with big noise
+        and no trend must stay quiet."""
+        rng = random.Random(17)
+        det = _health.TrendDetector()
+        assert not any(det.update(float(t), 1e6 + rng.gauss(0, 2e5))
+                       for t in range(300))
+
+    def test_decreasing_does_not_trip(self):
+        det = _health.TrendDetector()
+        assert not any(det.update(float(t), 1e6 - 1e4 * t)
+                       for t in range(40))
+
+
+class TestRateDetector:
+    def test_spike_in_window_fires(self):
+        det = _health.RateDetector(threshold=3, window_s=100)
+        assert det.update(0.0, 0.0) is None
+        assert det.update(10.0, 0.1) is None          # 1 restart
+        assert det.update(20.0, 0.1) is None          # 2 restarts
+        ev = det.update(30.0, 0.1)                    # 3 restarts
+        assert ev and ev["events"] == pytest.approx(3.0)
+
+    def test_slow_drip_outside_window_stays_quiet(self):
+        det = _health.RateDetector(threshold=3, window_s=100)
+        t = 0.0
+        for _ in range(10):                           # 1 per 200s
+            assert det.update(t, 0.005) is None
+            t += 200.0
+
+
+def _drive_regression(monitor, key="hvdtpu_step_seconds"
+                                   '{framework="t"}|mean'):
+    """Feed a clean baseline then a 30% shift; returns fired alerts."""
+    fired = []
+    for t in range(30):
+        v = 0.010 if t < 20 else 0.013
+        fired.extend(monitor.observe({key: v}, t=float(t),
+                                     t_unix=1000.0 + t))
+    return fired
+
+
+class TestHealthMonitor:
+    def test_alert_fans_out_to_metric_recorder_and_queue(self):
+        _flight.reset()
+        _health.drain_policy_alerts()                 # clear
+        monitor = _health.HealthMonitor(rank=2)
+        fired = _drive_regression(monitor)
+        assert fired
+        a = fired[0]
+        assert a.kind == "step_time_regression"
+        assert a.rank == 2
+        assert a.severity == "warning"
+        assert a.value == pytest.approx(0.013)
+        # registry counter, labeled by kind+severity
+        fam = _reg.registry().counter("hvdtpu_health_alerts_total", "")
+        key = 'kind="step_time_regression",severity="warning"'
+        assert dict(fam.items())[key].value >= 1
+        # flight-recorder event
+        events = [e for e in list(_flight.recorder()._ring)
+                  if e[1] == "alert"]
+        assert events
+        assert events[0][2][0] == "step_time_regression"
+        # policy queue (regression kinds feed the ladder)
+        q = _health.drain_policy_alerts()
+        assert q and q[0]["kind"] == "step_time_regression"
+        assert q[0]["rank"] == 2
+        assert _health.drain_policy_alerts() == []    # drained
+
+    def test_refire_suppression(self):
+        monitor = _health.HealthMonitor(rank=0, refire_s=1000.0)
+        fired = _drive_regression(monitor)
+        assert len(fired) == 1, \
+            "a sustained regression must page once per refire window"
+
+    def test_emit_false_collects_without_side_effects(self):
+        _health.drain_policy_alerts()
+        fam = _reg.registry().counter("hvdtpu_health_alerts_total", "")
+        key = 'kind="step_time_regression",severity="warning"'
+        before = (dict(fam.items()).get(key).value
+                  if key in dict(fam.items()) else 0)
+        monitor = _health.HealthMonitor(rank=0, emit=False)
+        fired = _drive_regression(monitor)
+        assert fired and monitor.alerts
+        after = (dict(fam.items()).get(key).value
+                 if key in dict(fam.items()) else 0)
+        assert after == before
+        assert _health.drain_policy_alerts() == []
+
+    def test_replica_attribution(self):
+        monitor = _health.HealthMonitor(replica=3, emit=False)
+        fired = []
+        for t in range(30):
+            v = 0.0 if t < 10 else float(t - 10)      # queue runaway
+            fired.extend(monitor.observe(
+                {"hvdtpu_serving_queue_depth": v}, t=float(t) * 5))
+        assert fired
+        assert fired[0].kind == "queue_depth_runaway"
+        assert fired[0].replica == 3
+        assert "replica 3" in fired[0].message
+
+    def test_webhook_posts_alert_json(self):
+        received = []
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers["Content-Length"])
+                received.append(json.loads(self.rfile.read(n)))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        thread = threading.Thread(target=srv.serve_forever, daemon=True)
+        thread.start()
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/alerts"
+            monitor = _health.HealthMonitor(rank=0, webhook_url=url)
+            fired = []
+            for t in range(40):
+                v = 0.5 if t < 25 else 0.3
+                fired.extend(monitor.observe(
+                    {'hvdtpu_mfu{framework="t"}': v}, t=100.0 + t,
+                    t_unix=2000.0 + t))
+            assert fired
+            deadline = time.monotonic() + 10
+            while not received and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert received, "webhook never received the alert"
+            body = received[0]
+            assert body["kind"] == "mfu_droop"
+            assert body["severity"] == "warning"
+            assert "message" in body and "evidence" in body
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+
+class TestPolicyAlertInput:
+    def _policy(self, **kw):
+        from horovod_tpu.adaptation.policy import (AdaptationConfig,
+                                                   AdaptationPolicy)
+        cfg = AdaptationConfig(threshold_s=0.1, sustain_s=5.0,
+                               cooldown_s=10.0, alert_hold_s=30.0,
+                               tiers=("shrink", "bf16"), **kw)
+        return AdaptationPolicy(cfg, allow_evict=False)
+
+    def test_alert_pressure_escalates_after_sustain(self):
+        """An alert starts the sustain clock like measured lateness —
+        and escalates only after the full hysteresis window."""
+        p = self._policy()
+        p.note_alert("step_time_regression", rank=2, now=0.0)
+        assert p.observe({}, now=0.0) == []            # clock starts
+        assert p.observe({}, now=3.0) == []            # not sustained
+        events = p.observe({}, now=6.0)                # > sustain_s
+        assert [e["name"] for e in events] == ["shrink"]
+        assert events[0]["rank"] == 2
+        assert p.tier == 1
+
+    def test_unrenewed_alert_decays_without_escalation(self):
+        p = self._policy()
+        p.note_alert("hbm_leak", rank=1, now=0.0)
+        p.observe({}, now=0.0)
+        # Past alert_hold_s the pressure is gone; the sustain clock
+        # never completed → no escalation, ever.
+        assert p.observe({}, now=31.0) == []
+        assert p.observe({}, now=40.0) == []
+        assert p.tier == 0
+
+    def test_alert_pressure_merges_with_measured_lateness(self):
+        p = self._policy()
+        p.note_alert("step_time_regression", rank=2, now=0.0)
+        # Measured lateness on another rank is WORSE than the alert
+        # floor — the measured straggler wins the election.
+        p.observe({3: 0.5}, now=0.0)
+        events = p.observe({3: 0.5}, now=6.0)
+        assert events and events[0]["rank"] == 3
+
+    def test_alert_input_metric_counts(self):
+        p = self._policy()
+        p.note_alert("hbm_leak", rank=0, now=0.0)
+        fam = _reg.registry().counter(
+            "hvdtpu_adaptation_alert_inputs_total", "")
+        assert dict(fam.items())['kind="hbm_leak"'].value >= 1
+
+
+class TestAlertNoteRPC:
+    def test_note_alert_reaches_coordinator_policy(self):
+        from horovod_tpu.adaptation.policy import (AdaptationConfig,
+                                                   AdaptationPolicy)
+        from horovod_tpu.ops.control_plane import (CoordinatorClient,
+                                                   CoordinatorService)
+        from horovod_tpu.runner.secret import make_secret_key
+        svc = CoordinatorService(nproc=2, key=make_secret_key(),
+                                 fusion_threshold=1024)
+        try:
+            svc._policy = AdaptationPolicy(
+                AdaptationConfig(tiers=("shrink",)), allow_evict=False)
+            client = CoordinatorClient([("127.0.0.1", svc.port)],
+                                       svc.key, rank=1)
+            client.note_alert("step_time_regression", rank=1,
+                              severity="warning", value=0.013)
+            deadline = time.monotonic() + 10
+            while not svc._policy._alert_until \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert ("step_time_regression", 1) in svc._policy._alert_until
+            fam = _reg.registry().counter(
+                "hvdtpu_coordinator_alert_notes_total", "")
+            assert dict(fam.items())[
+                'kind="step_time_regression"'].value >= 1
+        finally:
+            svc.shutdown()
+
+
+class TestAlertKindDrift:
+    """Satellite CI: every Alert kind must be documented in
+    docs/health.md and fire a registered metric label."""
+
+    def test_every_kind_documented_in_health_md(self):
+        doc = open(os.path.join(ROOT, "docs", "health.md")).read()
+        for kind in _health.ALERT_KINDS:
+            assert f"`{kind}`" in doc, (
+                f"alert kind {kind!r} missing from docs/health.md — "
+                "document it in the detectors/alert-schema section")
+
+    def test_every_kind_has_a_detector_spec(self):
+        specs = {s.kind for s in _health.default_specs()}
+        assert specs == set(_health.ALERT_KINDS)
+
+    def test_every_kind_registers_its_metric_label(self):
+        monitor = _health.HealthMonitor(rank=0, emit=True,
+                                        webhook_url=None)
+        _health.drain_policy_alerts()
+        for spec in monitor.specs:
+            monitor._fire(spec, "test_series", 1.0,
+                          {"baseline": 0.5, "window_s": 1.0}, 0.0)
+        _health.drain_policy_alerts()
+        fam = dict(_reg.registry().counter(
+            "hvdtpu_health_alerts_total", "").items())
+        for kind in _health.ALERT_KINDS:
+            assert any(f'kind="{kind}"' in key for key in fam), (
+                f"alert kind {kind!r} fired no "
+                "hvdtpu_health_alerts_total label")
+
+    def test_policy_kinds_are_alert_kinds(self):
+        assert set(_health.POLICY_ALERT_KINDS) <= set(
+            _health.ALERT_KINDS)

@@ -1,0 +1,225 @@
+"""Health-plane acceptance (ISSUE 15, docs/health.md) — slow tier.
+
+1. Injected-degradation e2e: a 4-process job with a ``slow_h2d`` fault
+   ramping mid-run on rank 1. The offending rank's own detector fires
+   a ``step_time_regression`` alert within a few detector windows, and
+   the alert is visible in all three durable surfaces: the
+   flight-recorder dump, ``hvdtpu_health_alerts_total``, and the
+   ``tools/health`` report rendered from the merged per-rank history
+   files.
+2. Baseline A/B: two real StepTimer training loops (BENCH_LM-style,
+   real sampler, real files) — one with a 20% injected step-time
+   regression. ``tools/health --baseline`` ranks step time as the top
+   regression; two identical runs report no regressions.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from horovod_tpu.runner.api import run as plain_run  # noqa: E402
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_BASE_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    "HOROVOD_TPU_DISABLE_NATIVE": "1",
+    "HOROVOD_CYCLE_TIME": "1",
+}
+
+
+def _make_degradation_worker():
+    """Worker built inside a closure so cloudpickle ships it by value
+    (the test module is not importable from the spawned workers)."""
+
+    def worker(steps, fault_from):
+        import time
+
+        import jax.numpy as jnp
+
+        import horovod_tpu as hvd
+        from horovod_tpu.observability import StepTimer
+        from horovod_tpu.observability import history as _history
+
+        hvd.init()
+        timer = StepTimer("e2e", batch_size=8)
+        x = jnp.ones((64,), jnp.float32)
+        for step in range(steps):
+            with timer:
+                # ONE collective per step so the fault injector's
+                # enqueue tick counter == the step counter (slow_h2d
+                # from_step=N ramps at step N exactly).
+                hvd.allreduce(x, name=f"he2e.{step}", average=False)
+                time.sleep(0.008)
+        sampler = _history.sampler()
+        if sampler is not None:
+            sampler.final_flush()
+        snap = hvd.metrics_snapshot(prefix="hvdtpu_health_")
+        alerts = (snap.get("hvdtpu_health_alerts_total")
+                  or {"values": {}})["values"]
+        monitor = sampler.monitor if sampler is not None else None
+        return {
+            "rank": hvd.process_rank(),
+            "alert_counts": alerts,
+            "alerts": ([a.to_dict() for a in monitor.alerts]
+                       if monitor is not None else []),
+            "sampled": sampler is not None,
+        }
+
+    return worker
+
+
+class TestInjectedDegradationE2E:
+    def test_slow_h2d_fires_regression_alert_everywhere(self, tmp_path):
+        """ACCEPTANCE: the alert lands in the flight recorder dump, in
+        hvdtpu_health_alerts_total, and in the tools/health report
+        from the merged history files — naming the offending rank."""
+        hist = tmp_path / "hist"
+        blackbox = tmp_path / "blackbox"
+        steps, fault_from = 260, 110
+        interval = 0.15
+        env = dict(_BASE_ENV)
+        env.update({
+            "HOROVOD_TPU_HISTORY": str(hist),
+            "HOROVOD_TPU_HISTORY_INTERVAL": str(interval),
+            "HOROVOD_TPU_BLACKBOX": str(blackbox),
+            # slow_h2d ramping mid-run on rank 1: ~10ms steps become
+            # ~60ms — a 5x regression the EWMA must catch within a
+            # few windows.
+            "HOROVOD_TPU_FAULT_SPEC":
+                f"rank=1:slow_h2d=50ms:from_step={fault_from}",
+        })
+        results = plain_run(_make_degradation_worker(),
+                            args=(steps, fault_from), np=4,
+                            extra_env=env, start_timeout=600)
+        by_rank = {r["rank"]: r for r in results}
+        assert all(r["sampled"] for r in results)
+
+        # (1) The offending rank's own detector fired, naming itself.
+        r1 = by_rank[1]
+        reg = [a for a in r1["alerts"]
+               if a["kind"] == "step_time_regression"]
+        assert reg, f"rank 1 fired no regression alert: {r1['alerts']}"
+        assert reg[0]["rank"] == 1
+        assert reg[0]["value"] > reg[0]["baseline"] * 1.2
+        # Within 3 detector windows of the live plane noticing: the
+        # evidence window is bounded (EWMA warmup + a few samples),
+        # not the whole run.
+        key = 'kind="step_time_regression",severity="warning"'
+        assert r1["alert_counts"].get(key, 0) >= 1
+
+        # (2) Flight-recorder dump (exit dump carries the ring).
+        dump = blackbox / "blackbox-rank1.jsonl"
+        assert dump.exists()
+        events = [json.loads(line) for line in open(dump)][1:]
+        alert_events = [e for e in events if e.get("kind") == "alert"]
+        assert any(e["alert"] == "step_time_regression"
+                   and e["who"] == 1 for e in alert_events), \
+            f"no alert event in rank 1's dump: {alert_events}"
+
+        # (3) tools/health over the merged per-rank history files:
+        # offline replay of the same detectors names rank 1.
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.tools.health",
+             str(hist), "--json", "--top", "100"],
+            capture_output=True, text=True, timeout=300, cwd=ROOT)
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        report = json.loads(proc.stdout)
+        assert len(report["labels"]) == 4     # all four ranks merged
+        tool_reg = [a for a in report["alerts"]
+                    if a["kind"] == "step_time_regression"]
+        assert any(a["label"] == "rank1" and a["rank"] == 1
+                   for a in tool_reg), report["alerts"]
+        # ... and ranks step time among the top regressions for rank1.
+        top = [r for r in report["top_regressions"]
+               if r["label"] == "rank1"]
+        assert top and any("step_seconds" in r["series"] for r in top)
+
+        # Human rendering mentions the verdict too.
+        proc_txt = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.tools.health",
+             str(hist)],
+            capture_output=True, text=True, timeout=300, cwd=ROOT)
+        assert proc_txt.returncode == 0
+        assert "step_time_regression" in proc_txt.stdout
+
+
+_LM_ARM_SCRIPT = r"""
+import sys, time
+import horovod_tpu  # noqa: F401  (registry import path)
+from horovod_tpu.observability import StepTimer
+from horovod_tpu.observability import history as _history
+
+hist_dir, step_s = sys.argv[1], float(sys.argv[2])
+timer = StepTimer("lm", batch_size=32)
+sampler = _history.HistorySampler(hist_dir, "rank0", interval_s=0.05,
+                                  meta=lambda: {"rank": 0, "world": 1,
+                                                "clock_synced": True})
+sampler.start()
+for step in range(140):
+    with timer:
+        time.sleep(step_s)
+sampler.stop()
+print("DONE")
+"""
+
+
+class TestBaselineABE2E:
+    def _run_arm(self, hist_dir, step_s):
+        env = dict(os.environ)
+        env.update(_BASE_ENV)
+        proc = subprocess.run(
+            [sys.executable, "-c", _LM_ARM_SCRIPT, str(hist_dir),
+             str(step_s)],
+            capture_output=True, text=True, timeout=300, cwd=ROOT,
+            env=env)
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        assert "DONE" in proc.stdout
+
+    def _baseline_report(self, cur, base):
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.tools.health",
+             str(cur), "--baseline", str(base), "--json"],
+            capture_output=True, text=True, timeout=300, cwd=ROOT)
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        return json.loads(proc.stdout)
+
+    def test_injected_20pct_regression_ranks_step_time_top(
+            self, tmp_path):
+        """ACCEPTANCE: tools/health --baseline on two BENCH_LM-style
+        runs with a 20% injected step-time regression ranks step time
+        as the top regression; identical runs report no alerts."""
+        self._run_arm(tmp_path / "base", 0.010)
+        self._run_arm(tmp_path / "slow", 0.012)
+        self._run_arm(tmp_path / "same", 0.010)
+
+        report = self._baseline_report(tmp_path / "slow",
+                                       tmp_path / "base")
+        b = report["baseline"]
+        assert b["verdict"] == "regressions"
+        # Step time tops the ranking (the |mean of hvdtpu_step_seconds
+        # or its per-phase attribution twin — both ARE step time and
+        # regressed identically; nothing else may outrank them).
+        top = b["regressions"][0]
+        assert top["series"].startswith("hvdtpu_step_")
+        assert top["change_frac"] == pytest.approx(0.2, abs=0.06)
+        step_rows = [r for r in b["regressions"]
+                     if r["series"].startswith("hvdtpu_step_seconds")]
+        assert step_rows, b["regressions"]
+        assert step_rows[0]["change_frac"] == pytest.approx(
+            0.2, abs=0.06)
+
+        same = self._baseline_report(tmp_path / "same",
+                                     tmp_path / "base")
+        assert same["baseline"]["verdict"] == "no_regressions"
+        # ... and the healthy arms fired no live detector alerts.
+        assert same["alerts"] == []

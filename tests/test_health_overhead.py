@@ -1,0 +1,65 @@
+"""History-sampler + detector overhead guard (slow tier) — the health
+plane runs entirely off the hot path, so its step cost must be
+invisible: ``bench_engine.py --health`` A/Bs a 2-process
+fused-allreduce + StepTimer loop with the sampler ticking at a 100 ms
+cadence (50x the production default) vs disabled (the BENCH_METRICS
+in-process interleaved method, p25 of pooled per-step wall times), and
+this guard holds the overhead under 1%, regenerating
+``BENCH_HEALTH.json``.
+
+One re-measure is allowed before failing — a shared CI box can stay
+saturated through one window (the BENCH_METRICS precedent)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+BUDGET = 0.01
+
+
+def _run_bench(out_path: str, rounds: int) -> dict:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "bench_engine.py"),
+         "--health", "--health-rounds", str(rounds),
+         "--out", out_path],
+        capture_output=True, text=True, timeout=600, cwd=root)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return json.loads(open(out_path).read())
+
+
+def test_health_overhead_under_1_percent(tmp_path):
+    out = tmp_path / "bench_health.json"
+    result = _run_bench(str(out), rounds=6)
+    if result["overhead_frac"] >= BUDGET:   # one re-measure
+        result = _run_bench(str(out), rounds=6)
+
+    # Regenerate the committed artifact from the accepted run.
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "BENCH_HEALTH.json"), "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+    assert result["rows"]["health_on"]["step_time_ms"] > 0
+    assert result["history_samples_written"] > 0, \
+        "the on-arm sampler never wrote a sample — the A/B measured nothing"
+    assert result["overhead_frac"] < BUDGET, (
+        f"history sampler + detectors cost "
+        f"{result['overhead_frac']:.2%} of the 2-process step time "
+        f"(on {result['rows']['health_on']['step_time_ms']} ms vs off "
+        f"{result['rows']['health_off']['step_time_ms']} ms; "
+        f"budget {BUDGET:.0%})")
+
+    # The seeded detector smoke is deterministic: the leak trips, the
+    # noisy flat gauge does not, the 20% shift fires promptly.
+    smoke = result["detector_smoke"]
+    assert smoke["leak_windows_fired"] > 0
+    assert smoke["noisy_flat_windows_fired"] == 0
+    assert smoke["regression_first_fired_at_sample"] is not None
+    assert (smoke["regression_first_fired_at_sample"]
+            - smoke["regression_onset_sample"]) <= 3

@@ -1,0 +1,156 @@
+"""`python -m horovod_tpu.tools.health` (docs/health.md): merged
+per-rank reports — sparklines, offline detector verdicts, the
+top-regressions ranking, torn-tail tolerance, and the --baseline A/B
+mode (identical runs quiet; an injected regression ranks on top)."""
+
+import json
+import subprocess
+import sys
+import os
+
+import pytest
+
+from horovod_tpu.observability import history as _history
+from horovod_tpu.tools import health as _tool
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_run(directory, *, step_s=0.010, leak=False, ranks=2,
+               samples=40, shift_at=None):
+    """Synthesize a run's history dir: per-rank files with a step-time
+    |mean series (optionally shifting at sample `shift_at`), an HBM
+    gauge (optionally leaking), and a throughput counter rate."""
+    for rank in range(ranks):
+        w = _history.HistoryWriter(
+            str(directory), f"rank{rank}",
+            meta=lambda r=rank: {"rank": r, "world": ranks,
+                                 "offset_to_rank0_us": 0.0,
+                                 "clock_synced": True})
+        for i in range(samples):
+            v = step_s
+            if shift_at is not None and i >= shift_at:
+                v = step_s * 1.3
+            hbm = 1e6 + (5e4 * i if leak else 0.0)
+            w.append({"t_us": 1_000_000 + i * 100_000,
+                      "u": 1000.0 + i, "dt_s": 0.1,
+                      "s": {'hvdtpu_step_seconds{framework="t"}|mean': v,
+                            'hvdtpu_hbm_bytes_in_use{device="host"}':
+                                hbm,
+                            "hvdtpu_samples_total": 320.0}})
+        w.close()
+
+
+class TestAnalyze:
+    def test_healthy_run_reports_no_alerts(self, tmp_path):
+        _write_run(tmp_path)
+        report = _tool.analyze(_history.load_history([str(tmp_path)]))
+        assert len(report["labels"]) == 2
+        assert report["alerts"] == []
+        assert report["top_regressions"] == []
+        text = _tool.format_report(report)
+        assert "healthy" in text
+
+    def test_regression_fires_verdict_and_ranks_top(self, tmp_path):
+        _write_run(tmp_path, shift_at=25)
+        report = _tool.analyze(_history.load_history([str(tmp_path)]))
+        kinds = {a["kind"] for a in report["alerts"]}
+        assert kinds == {"step_time_regression"}
+        assert {a["label"] for a in report["alerts"]} == {"rank0",
+                                                         "rank1"}
+        top = report["top_regressions"][0]
+        assert "step_seconds" in top["series"]
+        assert top["change_frac"] == pytest.approx(0.3, abs=0.05)
+
+    def test_leak_verdict_names_offender_and_window(self, tmp_path):
+        _write_run(tmp_path, leak=True, ranks=1)
+        report = _tool.analyze(_history.load_history([str(tmp_path)]))
+        leaks = [a for a in report["alerts"] if a["kind"] == "hbm_leak"]
+        assert leaks
+        assert leaks[0]["rank"] == 0
+        assert leaks[0]["window_s"] > 0
+        # The leaking series gets a sparkline even though HBM is a
+        # headline family anyway; check the spark rendering shape.
+        rows = report["sparklines"]["rank0"]
+        key = 'hvdtpu_hbm_bytes_in_use{device="host"}'
+        assert key in rows
+        assert set(rows[key]["spark"]) <= set(_tool.SPARK_BLOCKS)
+
+    def test_sparkline_resamples_long_series(self):
+        assert len(_tool.sparkline(list(range(1000)), width=40)) == 40
+        assert _tool.sparkline([1.0, 1.0, 1.0]) == "▁▁▁"
+        assert _tool.sparkline([]) == ""
+
+
+class TestBaseline:
+    def test_identical_runs_report_no_regressions(self, tmp_path):
+        _write_run(tmp_path / "a")
+        _write_run(tmp_path / "b")
+        cur = _history.load_history([str(tmp_path / "a")])
+        base = _history.load_history([str(tmp_path / "b")])
+        b = _tool.compare_baseline(cur, base)
+        assert b["verdict"] == "no_regressions"
+        assert b["regressions"] == []
+        assert b["series_compared"] > 0
+
+    def test_injected_regression_ranks_step_time_top(self, tmp_path):
+        """ACCEPTANCE: a 20% step-time regression vs baseline ranks
+        step time as the top regression."""
+        _write_run(tmp_path / "base", step_s=0.010)
+        _write_run(tmp_path / "cur", step_s=0.012)
+        cur = _history.load_history([str(tmp_path / "cur")])
+        base = _history.load_history([str(tmp_path / "base")])
+        b = _tool.compare_baseline(cur, base)
+        assert b["verdict"] == "regressions"
+        top = b["regressions"][0]
+        assert "step_seconds" in top["series"]
+        assert top["change_frac"] == pytest.approx(0.2, abs=0.02)
+
+    def test_improvement_is_not_a_regression(self, tmp_path):
+        _write_run(tmp_path / "base", step_s=0.012)
+        _write_run(tmp_path / "cur", step_s=0.010)
+        cur = _history.load_history([str(tmp_path / "cur")])
+        base = _history.load_history([str(tmp_path / "base")])
+        b = _tool.compare_baseline(cur, base)
+        assert b["verdict"] == "no_regressions"
+        assert b["improvements"]
+
+
+class TestCLI:
+    def _run(self, *argv):
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.tools.health",
+             *argv], capture_output=True, text=True, timeout=120,
+            cwd=ROOT)
+        return proc
+
+    def test_cli_json_end_to_end(self, tmp_path):
+        _write_run(tmp_path, shift_at=25)
+        proc = self._run(str(tmp_path), "--json")
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        report = json.loads(proc.stdout)
+        assert report["alerts"]
+        assert report["top_regressions"]
+
+    def test_cli_baseline_text(self, tmp_path):
+        _write_run(tmp_path / "base", step_s=0.010)
+        _write_run(tmp_path / "cur", step_s=0.012)
+        proc = self._run(str(tmp_path / "cur"), "--baseline",
+                         str(tmp_path / "base"))
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "REGRESSED" in proc.stdout
+        assert "step_seconds" in proc.stdout
+
+    def test_cli_missing_dir_exits_2(self, tmp_path):
+        proc = self._run(str(tmp_path / "nope"))
+        assert proc.returncode == 2
+        assert "no history files" in proc.stderr
+
+    def test_cli_tolerates_torn_tail(self, tmp_path):
+        _write_run(tmp_path, ranks=1)
+        with open(tmp_path / "history-rank0.jsonl", "a") as f:
+            f.write('{"t_us": 99, "s": {"torn')
+        proc = self._run(str(tmp_path), "--json")
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        report = json.loads(proc.stdout)
+        assert report["labels"][0]["samples"] == 40
