@@ -539,6 +539,128 @@ print(json.dumps({
 """
 
 
+SPEC_ADAPT_WORKER = r"""
+import json, os, sys, time
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import horovod_tpu as hvd
+from horovod_tpu.checkpoint import CheckpointEngine
+from horovod_tpu.observability import flight_recorder as _fr
+from horovod_tpu.parallel.mesh import create_mesh
+from horovod_tpu.serving import (InferenceEngine, ServingConfig,
+                                 config_from_manifest, load_params,
+                                 serving_config)
+
+ckpt_root = sys.argv[1]
+arm = sys.argv[2]                       # "adaptive" | "static"
+n_requests = int(sys.argv[3])
+spec_k = int(sys.argv[4])
+
+mesh = create_mesh(devices=jax.devices()[:1], tp=1)
+
+def load(sub):
+    d = os.path.join(ckpt_root, sub)
+    man = CheckpointEngine(d).restore_manifest()
+    cfg = serving_config(config_from_manifest(man), mesh)
+    return cfg, load_params(d, cfg, mesh)
+
+cfg, params = load("flagship")
+draft_cfg, draft_params = load("drafter")
+
+engine = InferenceEngine(
+    params, cfg, mesh,
+    ServingConfig(block_size=16, kv_blocks=96, max_batch_slots=8,
+                  max_queue=32, max_new_tokens=64,
+                  min_prefill_bucket=16, spec_tokens=spec_k,
+                  spec_adapt=(arm == "adaptive")),
+    draft_params=draft_params, draft_cfg=draft_cfg)
+
+good_draft = engine._draft_params
+# The deliberately degraded drafter: zeroed weights give all-zero
+# logits (argmax = token 0 at every position), so proposals essentially
+# never match the flagship — a deterministic worst-case acceptance
+# rate, which is what the controller must survive.
+bad_draft = jax.tree_util.tree_map(lambda x: x * 0.0, good_draft)
+
+VOCAB = cfg.vocab
+
+def prompts(base):
+    return [[(base + 16 * j + i) % VOCAB for i in range(16)]
+            for j in range(n_requests)]
+
+# Warmup compiles (prefill bucket + the k-wide verify + plain decode).
+engine.generate([1] * 16, max_new_tokens=2)
+
+def cnt(snap0, snap, name, labels=""):
+    v1 = snap.get(name, {"values": {}})["values"].get(labels, 0)
+    v0 = snap0.get(name, {"values": {}})["values"].get(labels, 0)
+    return v1 - v0
+
+def slots_backed_off_to_1():
+    # Flight-recorder evidence: per-slot spec_backoff notes that landed
+    # at k=1 (docs/autotune.md#serving).
+    hit = set()
+    for _, kind, p in _fr.recorder()._snapshot():
+        if kind == "autotune" and p[0] == "spec_backoff" and p[2] == "1":
+            hit.add(p[5])
+    return len(hit)
+
+def run_phase(name, base, max_new):
+    snap0 = hvd.metrics_snapshot()
+    t0 = time.perf_counter()
+    reqs = [engine.submit(p, max_new_tokens=max_new)
+            for p in prompts(base)]
+    engine.run_until_idle()
+    wall = time.perf_counter() - t0
+    outputs = [r.result() for r in reqs]
+    snap = hvd.metrics_snapshot()
+    ctl = engine._spec_ctl
+    ks = sorted(s.k_eff for s in ctl._slots.values()) if ctl else None
+    proposed = cnt(snap0, snap,
+                   "hvdtpu_serving_draft_proposed_tokens_total")
+    accepted = cnt(snap0, snap,
+                   "hvdtpu_serving_draft_accepted_tokens_total")
+    return {
+        "phase": name,
+        "wall_ms": round(wall * 1e3, 3),
+        "generated_tokens": sum(len(o) for o in outputs),
+        "decode_steps": int(cnt(snap0, snap,
+                                "hvdtpu_serving_decode_steps_total")),
+        "draft_proposed": int(proposed),
+        "draft_accepted": int(accepted),
+        "acceptance": round(accepted / proposed, 4) if proposed else None,
+        "k_slots_end": ks,
+        "spec_moves": {d: int(cnt(snap0, snap,
+                                  "hvdtpu_autotune_spec_moves_total",
+                                  'direction="%s"' % d))
+                       for d in ("down", "up", "probe")},
+        "output_checksum": int(sum((i + 1) * t for o in outputs
+                               for i, t in enumerate(o)) % (1 << 31)),
+        "outputs": outputs,
+    }
+
+# healthy -> degraded (drafter swapped mid-run) -> recovered (restored;
+# the longer budget gives the k=1 probe clock room to climb back).
+phases = []
+phases.append(run_phase("healthy", 250, 32))
+engine._draft_params = bad_draft
+phases.append(run_phase("degraded", 1000, 32))
+engine._draft_params = good_draft
+phases.append(run_phase("recovered", 2000, 64))
+
+print(json.dumps({
+    "arm": arm,
+    "spec_tokens_cap": spec_k,
+    "phases": phases,
+    "slots_backed_off_to_1": slots_backed_off_to_1(),
+}))
+"""
+
+
 SPEED_ARMS = ("baseline", "quantized_kv", "speculative", "prefix_cache",
               "all_on")
 SPEED_REQUESTS = 8
@@ -631,6 +753,90 @@ def run_speed(out_path):
             json.dump(result, f, indent=2, sort_keys=True)
             f.write("\n")
     print(json.dumps(result))
+
+
+def run_spec_adapt(out_path):
+    """The --spec-adapt A/B: per-slot adaptive spec_tokens
+    (docs/autotune.md#serving) vs the static k, on the trained bench
+    pair, with the drafter deliberately degraded mid-run (zeroed
+    weights) and then restored. The adaptive arm must back every slot
+    off to k=1 under the cold drafter and climb back after the probe
+    rediscovers it; both arms stay token-identical throughout (every
+    emitted token is the flagship's own argmax). Writes/updates the
+    ``spec_adapt`` row in BENCH_SPEED.json."""
+    env = dict(os.environ)
+    env.pop("HOROVOD_TPU_METRICS", None)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.TemporaryDirectory(prefix="bench_specadapt_") as tmp:
+        prep = subprocess.run(
+            [sys.executable, "-c", SPEED_PREP, tmp], env=env,
+            capture_output=True, text=True, timeout=900, cwd=repo)
+        if prep.returncode != 0:
+            raise RuntimeError(
+                f"spec-adapt bench prep failed:\n{prep.stderr[-3000:]}")
+        arms = {}
+        for arm in ("adaptive", "static"):
+            proc = subprocess.run(
+                [sys.executable, "-c", SPEC_ADAPT_WORKER, tmp, arm,
+                 str(SPEED_REQUESTS), str(SPEC_TOKENS)],
+                env=env, capture_output=True, text=True, timeout=900,
+                cwd=repo)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"spec-adapt bench arm {arm} failed:\n"
+                    f"{proc.stderr[-3000:]}")
+            arms[arm] = json.loads(proc.stdout.strip().splitlines()[-1])
+
+    outputs = {a: [p.pop("outputs") for p in arms[a]["phases"]]
+               for a in arms}
+    ph = {a: {p["phase"]: p for p in arms[a]["phases"]} for a in arms}
+    ad, st = ph["adaptive"], ph["static"]
+    headlines = {
+        "adaptive_backed_off_to_1":
+            arms["adaptive"]["slots_backed_off_to_1"] >= SPEED_REQUESTS,
+        "degraded_k_slots_end": ad["degraded"]["k_slots_end"],
+        "adaptive_recovered_k": max(ad["recovered"]["k_slots_end"]),
+        "adaptive_recovered": (max(ad["recovered"]["k_slots_end"])
+                               >= SPEC_TOKENS // 2),
+        # Wasted draft work the backoff saves while the drafter is cold.
+        "degraded_proposed_ratio": round(
+            ad["degraded"]["draft_proposed"]
+            / max(1, st["degraded"]["draft_proposed"]), 3),
+        "outputs_equal_static": outputs["adaptive"] == outputs["static"],
+    }
+    row = {
+        "spec_tokens_cap": SPEC_TOKENS,
+        "requests_per_phase": SPEED_REQUESTS,
+        "arms": arms,
+        "headlines": headlines,
+        "note": ("adaptive (spec_adapt=True) vs static spec_tokens, "
+                 "three phases: trained drafter, zero-weight drafter "
+                 "swapped in mid-run, trained drafter restored. "
+                 "Counters, k timelines and checksums are seeded-"
+                 "deterministic (greedy decode, deterministic "
+                 "scheduler); *_ms are wall-clock. Headlines: every "
+                 "slot backs off to k=1 under the cold drafter "
+                 "(flight-recorder spec_backoff evidence), climbs "
+                 "back to >= cap/2 after restore via the k=1 probe, "
+                 "proposes a fraction of the static arm's draft "
+                 "tokens while degraded, and stays token-identical "
+                 "with the static arm in every phase."),
+    }
+    result = None
+    if out_path and os.path.exists(out_path):
+        # Ride along in BENCH_SPEED.json next to the other levers.
+        with open(out_path) as f:
+            result = json.load(f)
+        if result.get("metric") != "serving_speed_levers":
+            result = None
+    if result is None:
+        result = {"metric": "serving_speed_levers"}
+    result["spec_adapt"] = row
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+            f.write("\n")
+    print(json.dumps({"spec_adapt_headlines": headlines}))
 
 
 def run_reqtrace(out_path, rounds=6):
@@ -740,6 +946,11 @@ def main() -> None:
                          "speculative decode / prefix cache) on the "
                          "trained bench pair; writes BENCH_SPEED.json "
                          "with --out")
+    ap.add_argument("--spec-adapt", action="store_true",
+                    help="A/B per-slot adaptive spec_tokens vs static "
+                         "k with the drafter degraded mid-run; "
+                         "writes/updates the spec_adapt row in "
+                         "BENCH_SPEED.json (--out)")
     ap.add_argument("--reqtrace", action="store_true",
                     help="A/B per-request tracing on/off under the "
                          "BENCH_SERVING load; writes "
@@ -754,6 +965,9 @@ def main() -> None:
         return
     if args.speed:
         run_speed(args.out)
+        return
+    if args.spec_adapt:
+        run_spec_adapt(args.out)
         return
     if args.reqtrace:
         run_reqtrace(args.out, rounds=args.reqtrace_rounds)

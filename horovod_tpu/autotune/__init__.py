@@ -1,0 +1,38 @@
+"""Global online autotuner (docs/autotune.md).
+
+One search space over every perf knob — wire spec, fusion threshold,
+torch bucket size, pipeline schedule/microbatches, serving spec_tokens,
+engine cycle time — scored on measured step time from the history
+plane, applied through safe per-knob mechanisms, and guarded by the
+health plane's step-time regression detector with automatic rollback.
+
+    knobs.py       typed knob registry (domain / apply / safety class)
+    search.py      successive halving over the discrete space
+    gp.py          numpy GP seeded from the legacy Bayesian tuner's log
+    apply.py       the safe online apply plane (injected mechanisms)
+    driver.py      the AutoTuner: baseline -> move -> score -> guard
+    spec_adapt.py  per-slot adaptive speculative draft length
+
+Enable on a training job with ``--autotune`` on the runner or
+``HOROVOD_TPU_AUTOTUNE=1`` (env.autotune_global); the legacy eager-path
+tuner keeps its own ``HOROVOD_AUTOTUNE`` switch.
+"""
+
+from .apply import ApplyPlane
+from .driver import AutoTuner, Move, WindowedStepTime
+from .gp import GaussianProcess, seed_gp_for_cycle_time, \
+    seed_points_from_legacy_log
+from .knobs import APPLY_VIAS, KINDS, SAFETY_CLASSES, Knob, \
+    KnobRegistry, default_registry
+from .search import Trial, enumerate_configs, rungs_for, \
+    successive_halving
+from .spec_adapt import SpecTokensController
+
+__all__ = [
+    "APPLY_VIAS", "KINDS", "SAFETY_CLASSES",
+    "ApplyPlane", "AutoTuner", "GaussianProcess", "Knob",
+    "KnobRegistry", "Move", "SpecTokensController", "Trial",
+    "WindowedStepTime", "default_registry", "enumerate_configs",
+    "rungs_for", "seed_gp_for_cycle_time",
+    "seed_points_from_legacy_log", "successive_halving",
+]

@@ -115,6 +115,7 @@ _FIELDS = {
                  "warmup", "steady", "drain", "bubble_share"),
     "data": ("event", "epoch", "offset", "detail"),
     "alert": ("alert", "severity", "series", "who", "value", "baseline"),
+    "autotune": ("event", "knob", "value", "score", "baseline", "detail"),
 }
 
 # Recording lever — module-global single check like registry._enabled.

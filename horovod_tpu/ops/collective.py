@@ -439,6 +439,13 @@ class CollectiveEngine:
         # execute with spec ('' = raw). Seq-keyed so every process flips
         # at the same group boundary (docs/adaptation.md).
         self._wire_epochs: List = []
+        # Fusion-threshold epochs from the same side-channel:
+        # [(from_seq, threshold_bytes)] stamped by the coordinator's
+        # wire-epoch arbiter when the global autotuner re-caps the
+        # fusion buffer (docs/autotune.md). The coordinator's planner is
+        # the authority on grouping; this mirror exists so every
+        # process's flight recorder shows the same seq-stamped move.
+        self._fusion_epochs: List = []
         # Delivered-group counter for the native MP path (group
         # callbacks arrive in coordinator-seq order but carry no seq on
         # the wire) — mirrors the fallback path's group['seq'].
@@ -951,6 +958,15 @@ class CollectiveEngine:
                 core = self._native_core
                 if core is not None:
                     core.cycle_time_ms = cyc
+            fe = params.get("fusion_epochs")
+            if fe:
+                fepochs = [(int(s), int(t)) for s, t in fe]
+                if fepochs != self._fusion_epochs:
+                    _flight.recorder().note("autotune", (
+                        "fusion_epoch", "fusion_threshold_mb",
+                        str(fepochs[-1][1] >> 20), None, None,
+                        ";".join(f"{s}:{t >> 20}" for s, t in fepochs)))
+                self._fusion_epochs = fepochs
             ft = params.get("fusion_threshold")
             if ft:
                 self.fusion_threshold = int(ft)

@@ -165,6 +165,29 @@ class AlertNoteRequest:
         self.value = value
 
 
+class TunerMoveRequest:
+    """One global-autotuner move proposal (docs/autotune.md): the tuner
+    asks the rank-0 coordinator to stamp a knob change — a wire spec or
+    fusion threshold as an epoch ``(from_seq, value)``, a cycle time
+    live. The coordinator's :class:`WireEpochArbiter` serializes these
+    against the adaptation ladder's own epochs so two planes can never
+    stamp conflicting values for the same group seq; the response says
+    whether the move landed and from which seq it takes effect."""
+
+    def __init__(self, rank: int, knob: str, value):
+        self.rank = rank
+        self.knob = knob
+        self.value = value
+
+
+class TunerMoveResponse:
+    def __init__(self, accepted: bool, from_seq: int = -1,
+                 reason: str = ""):
+        self.accepted = accepted
+        self.from_seq = from_seq
+        self.reason = reason
+
+
 class FetchRequest:
     """Long-poll for response groups after ``after_seq`` — the response
     list Bcast of the reference (operations.cc:2282-2287)."""
@@ -331,6 +354,76 @@ class _SkewTracker:
             del self._pending[n]
 
 
+class WireEpochArbiter:
+    """The single serialization point for epoch-stamped knob changes.
+
+    Two planes retune the collective wire: the adaptation ladder
+    (``adaptation.policy``, reacting to health alerts) and the global
+    autotuner (``autotune.driver``, searching for speed). Both express
+    a change the same way — an epoch ``(from_seq, value)`` declaring
+    that groups planned from ``from_seq`` on use the new value. If each
+    appended to the epoch list independently, both could stamp the SAME
+    from_seq with different values inside one planning gap, and ranks
+    would disagree on the program for that seq (the exact hazard the
+    wire-epoch mechanism exists to prevent). Every producer therefore
+    proposes through this arbiter, which holds the coordinator's
+    planning lock while it reads the next seq and appends, with
+    deterministic precedence when the two planes collide in one gap:
+
+      - one producer re-stamping the same pending from_seq appends
+        (every fetch ships the whole list; later entries win, so a
+        ladder escalating through its tiers — or a tuner rolling back
+        its own move — stays deterministic on every rank);
+      - the ladder REPLACES a pending tuner move at the same from_seq
+        (a health reaction outranks an optimization);
+      - a tuner move against a pending ladder epoch is REJECTED.
+    """
+
+    def __init__(self, mu, next_seq):
+        self._mu = mu                # the coordinator's planning lock
+        self._next_seq = next_seq    # () -> first not-yet-planned seq
+        self.wire_epochs: List[Tuple[int, str]] = []
+        self.fusion_epochs: List[Tuple[int, int]] = []
+        self._wire_src: List[str] = []
+        self._fusion_src: List[str] = []
+
+    def _propose(self, epochs, srcs, source: str, value, initial):
+        seq = int(self._next_seq())
+        current = epochs[-1][1] if epochs else initial
+        if value == current:
+            return {"accepted": False, "from_seq": seq, "reason": "noop"}
+        if epochs and epochs[-1][0] == seq:
+            pending = {s for (fs, _), s in zip(epochs, srcs) if fs == seq}
+            if source == "tuner" and "ladder" in pending:
+                return {"accepted": False, "from_seq": seq,
+                        "reason": "conflict_with_ladder"}
+            if source == "ladder" and "tuner" in pending:
+                # No group at from_seq has been planned yet (we hold
+                # the planning lock), so no rank has seen the tuner's
+                # entries — drop them and stamp the ladder's value.
+                kept = [(e, s) for e, s in zip(epochs, srcs)
+                        if not (e[0] == seq and s == "tuner")]
+                epochs[:] = [e for e, _ in kept]
+                srcs[:] = [s for _, s in kept]
+                epochs.append((seq, value))
+                srcs.append(source)
+                return {"accepted": True, "from_seq": seq,
+                        "reason": "replaced_tuner"}
+        epochs.append((seq, value))
+        srcs.append(source)
+        return {"accepted": True, "from_seq": seq, "reason": "ok"}
+
+    def propose_wire(self, source: str, spec: Optional[str]) -> dict:
+        with self._mu:
+            return self._propose(self.wire_epochs, self._wire_src,
+                                 source, spec or "", "")
+
+    def propose_fusion(self, source: str, threshold_bytes: int) -> dict:
+        with self._mu:
+            return self._propose(self.fusion_epochs, self._fusion_src,
+                                 source, int(threshold_bytes), None)
+
+
 class CoordinatorService(BasicService):
     """Rank-0 coordinator: counts announcements, validates, plans fusion,
     serves the ordered group sequence.
@@ -457,8 +550,18 @@ class CoordinatorService(BasicService):
         # shipped whole in every fetch's params, so every process maps
         # seq → spec identically (the agreement that makes a mid-run
         # wire switch safe: a group quantized on one rank and raw on
-        # another would be two different SPMD programs).
-        self._wire_epochs: List[Tuple[int, str]] = []
+        # another would be two different SPMD programs). Both producers
+        # — the adaptation ladder and the global autotuner — stamp
+        # epochs through ONE arbiter so they can never disagree on the
+        # value for a seq (docs/autotune.md#arbitration).
+        self._arbiter = WireEpochArbiter(self._mu, self._next_plan_seq)
+        # Cycle-time override from a tuner move (None until one lands);
+        # overlaid on params so it reaches engines on both planner paths.
+        self._tuner_cycle_ms: Optional[float] = None
+        self._m_tuner_moves = r.counter(
+            "hvdtpu_autotune_coord_moves_total",
+            "Global-autotuner move proposals arbitrated by the "
+            "coordinator, by knob and verdict (docs/autotune.md)")
         if _envmod.adaptation_enabled():
             from ..adaptation.policy import (AdaptationConfig,
                                              AdaptationPolicy)
@@ -533,6 +636,8 @@ class CoordinatorService(BasicService):
                 self._policy.note_alert(req.kind, req.rank,
                                         time.monotonic())
             return AnnounceResponse()
+        if isinstance(req, TunerMoveRequest):
+            return self._tuner_move(req)
         return super()._handle(req, client_address)
 
     def _announce(self, req: AnnounceRequest) -> AnnounceResponse:
@@ -802,18 +907,74 @@ class CoordinatorService(BasicService):
 
     # ----------------------------------------------------------- adaptation
 
-    def _publish_wire_epoch(self, spec: Optional[str]) -> None:
+    def _next_plan_seq(self) -> int:
+        """First not-yet-planned group seq (caller holds ``_mu``)."""
+        if self._ctl is not None:
+            return self._ctl.group_count()
+        return len(self._groups) + self._base_seq
+
+    @property
+    def _wire_epochs(self) -> List[Tuple[int, str]]:
+        return self._arbiter.wire_epochs
+
+    @property
+    def _fusion_epochs(self) -> List[Tuple[int, int]]:
+        return self._arbiter.fusion_epochs
+
+    def _publish_wire_epoch(self, spec: Optional[str],
+                            source: str = "ladder") -> dict:
         """Record that groups planned from NOW on use ``spec`` ("" =
-        raw). Taken under ``_mu`` so the epoch boundary is ordered
+        raw). The arbiter takes ``_mu`` so the epoch boundary is ordered
         against planning: any group with seq >= from_seq is planned
         after the epoch exists, hence every fetch serving it also
-        carries the epoch in params — all processes agree."""
-        with self._mu:
-            if self._ctl is not None:
-                from_seq = self._ctl.group_count()
-            else:
-                from_seq = len(self._groups) + self._base_seq
-            self._wire_epochs.append((from_seq, spec or ""))
+        carries the epoch in params — all processes agree. Returns the
+        arbiter verdict ({"accepted", "from_seq", "reason"})."""
+        return self._arbiter.propose_wire(source, spec)
+
+    def _tuner_move(self, req: TunerMoveRequest) -> TunerMoveResponse:
+        """Arbitrate one global-autotuner move (docs/autotune.md): wire
+        and fusion knobs stamp epochs through the same arbiter the
+        adaptation ladder uses; cycle time applies live. Anything the
+        arbiter rejects (ladder already owns the pending seq, no-op,
+        unknown knob) reports as a rejected move — the driver treats
+        that as "knob unavailable", never as an error."""
+        knob, value = str(req.knob), req.value
+        if knob == "dcn_wire_spec":
+            res = self._arbiter.propose_wire("tuner", str(value or ""))
+        elif knob == "fusion_threshold_mb":
+            nbytes = int(float(value) * (1 << 20))
+            res = self._arbiter.propose_fusion("tuner", nbytes)
+            if res["accepted"]:
+                # The planner cuts future groups with the tuned cap;
+                # the ladder's shrink (a safety reaction) still scales
+                # whatever base the tuner picked.
+                self._base_fusion_threshold = nbytes
+                shrink = (self._policy is not None
+                          and self._policy.shrink_active())
+                self.fusion_threshold = (
+                    nbytes // self._policy.config.shrink_factor
+                    if shrink else nbytes)
+                if self._ctl is not None:
+                    self._ctl.set_fusion_threshold(self.fusion_threshold)
+        elif knob == "cycle_time_ms":
+            self._tuner_cycle_ms = float(value)
+            self.cycle_time_ms = float(value)
+            res = {"accepted": True, "from_seq": -1, "reason": "live"}
+        else:
+            res = {"accepted": False, "from_seq": -1,
+                   "reason": "unknown_knob"}
+        self._m_tuner_moves.labels(
+            knob=knob, verdict=("accepted" if res["accepted"]
+                                else res["reason"])).inc()
+        try:
+            from ..observability import flight_recorder as _flight
+            _flight.recorder().note("autotune", (
+                "coord_move", knob, str(value), None, None,
+                f"{res['reason']} from_seq={res['from_seq']}"))
+        except Exception:
+            pass
+        return TunerMoveResponse(res["accepted"], res["from_seq"],
+                                 res["reason"])
 
     def _maybe_adapt(self) -> None:
         """One policy evaluation (time-gated to interval_s), applied to
@@ -845,6 +1006,8 @@ class CoordinatorService(BasicService):
         self.fusion_threshold = (
             self._base_fusion_threshold // self._policy.config.shrink_factor
             if shrink else self._base_fusion_threshold)
+        if self._ctl is not None:
+            self._ctl.set_fusion_threshold(self.fusion_threshold)
         wire = self._policy.wire_spec()
         if wire != prev_wire:
             self._publish_wire_epoch(wire)
@@ -864,16 +1027,23 @@ class CoordinatorService(BasicService):
         """Overlay the policy's knobs on a params dict (either planner's):
         the shrunk fusion threshold and the wire-epoch list every engine
         needs to map group seq → wire spec."""
-        if self._policy is None and not self._wire_epochs:
+        if (self._policy is None and not self._wire_epochs
+                and not self._fusion_epochs
+                and self._tuner_cycle_ms is None):
             return params
         params = dict(params)
         params["fusion_threshold"] = self.fusion_threshold
+        if self._tuner_cycle_ms is not None:
+            params["cycle_time_ms"] = self._tuner_cycle_ms
         if self._wire_epochs:
             # No lock (the fallback fetch path already holds _mu via its
             # condition when building params): list appends are atomic,
             # and any epoch relevant to a served group was fully
             # appended — under _mu — before that group was planned.
             params["wire_epochs"] = [list(e) for e in self._wire_epochs]
+        if self._fusion_epochs:
+            params["fusion_epochs"] = [list(e) for e in
+                                       self._fusion_epochs]
         return params
 
     def _fetch(self, req: FetchRequest) -> FetchResponse:
@@ -1241,6 +1411,22 @@ class CoordinatorClient:
                 str(severity), float(value)))
         except Exception:
             pass
+
+    def tuner_move(self, knob: str, value) -> dict:
+        """Propose one global-autotuner move to the coordinator-side
+        arbiter (docs/autotune.md). Returns the verdict dict
+        ``{"accepted", "from_seq", "reason"}``; an unreachable
+        coordinator reports as a rejected move — the tuner skips the
+        knob rather than stalling the job over an optimization."""
+        try:
+            resp = self._rpc(TunerMoveRequest(self._rank, str(knob),
+                                              value))
+            return {"accepted": bool(resp.accepted),
+                    "from_seq": int(resp.from_seq),
+                    "reason": str(resp.reason)}
+        except Exception:
+            return {"accepted": False, "from_seq": -1,
+                    "reason": "unreachable"}
 
     def announce_shutdown(self) -> None:
         try:

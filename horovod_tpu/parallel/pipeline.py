@@ -36,6 +36,24 @@ single-SPMD-program collective-permute pattern):
     to ``m·V`` chunk computes: budget ``(mV+n-1)·(cF+cB)/V``, bubble
     ``(n-1)/(mV+n-1)`` — the gpipe/1f1b bubble shrunk by ~1/V.
 
+``zb-h1``
+    Zero-bubble H1 (the ZB-H1 point of arXiv 2412.14374): the backward is
+    SPLIT into an input-grad tick (Bx, cost cBx) that unblocks the
+    upstream stage immediately, and a weight-grad tick (W, cost cBw) that
+    has no inter-stage dependency and is pushed into what would otherwise
+    be drain bubble. F and Bx keep the 1f1b tiling (``F_j`` at
+    ``j + idx``, ``Bx_j`` at ``j + 2n - 2 - idx``); ``W_j`` runs at the
+    UNIFORM tick ``2n - 2 + j`` on every rank — by then rank ``idx``'s
+    cotangent for microbatch ``j`` arrived at its Bx tick
+    ``2n - 2 + j - idx ≤ 2n - 2 + j``, so no W slot is ever masked.
+    Budget ``(m+n-1)·(cF+cBx) + m·cBw`` with bubble cost
+    ``(n-1)·(cF+cBx)`` — strictly below 1f1b's ``(n-1)·(cF+cB)`` because
+    only the input-grad half of the backward stays on the critical fill
+    path. Needs ``m >= n`` (W ticks start only once every rank is in
+    steady state). The cotangents awaiting their W tick live in an
+    ``n``-slot ring keyed ``j mod n`` next to the usual ``2n - 1``-slot
+    residual ring.
+
 Bubble accounting is STATIC (``PipelineSchedule.bubble_share``): every tick
 of the scan costs real wall time on every rank (masked computes are wasted
 work, not idle time, in SPMD), so the bubble share is the exact fraction of
@@ -52,7 +70,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-SCHEDULES = ("gpipe", "1f1b", "interleaved")
+SCHEDULES = ("gpipe", "1f1b", "interleaved", "zb-h1")
 
 
 # ---------------------------------------------------------------------------
@@ -83,10 +101,14 @@ class PipelineSchedule:
     def ticks(self) -> dict:
         """Scan trip counts per phase. gpipe phases are its two sweeps
         (warmup = forward sweep, steady = 0, drain = backward sweep);
-        1f1b/interleaved are warmup/steady/drain of the fused schedule."""
+        1f1b/interleaved are warmup/steady/drain of the fused schedule;
+        zb-h1's steady merges its F+Bx and F+Bx+W spans (m ticks) and
+        its drain is the Bx+W tail."""
         n, m, v = self.num_stages, self.num_microbatches, self.num_virtual
         if self.name == "gpipe":
             return {"warmup": m + n - 1, "steady": 0, "drain": m + n - 1}
+        if self.name == "zb-h1":
+            return {"warmup": n - 1, "steady": m, "drain": n - 1}
         warmup = n * v - 1
         steady = (m - n) * v + n
         drain = n * v - 1
@@ -100,6 +122,11 @@ class PipelineSchedule:
             # Forward sweep at cF a tick; backward sweep re-linearizes
             # from the activation stash (recompute), cF + cB a tick.
             return (m + n - 1) * cf + (m + n - 1) * (cf + cb)
+        if self.name == "zb-h1":
+            # Backward split cB = cBx + cBw (even halves by convention):
+            # only cBx rides the fill/drain skew, cBw fills the bubble.
+            cbx = cbw = cb / 2.0
+            return (m + n - 1) * (cf + cbx) + m * cbw
         t = self.ticks
         per = 1.0 / v
         return (t["warmup"] * cf * per + t["steady"] * (cf + cb) * per
@@ -144,6 +171,11 @@ def _validate(schedule: str, n: int, m: int, v: int) -> None:
                 f"multiple of the stage count ({n}) at least as large "
                 "as it — the circular schedule streams microbatches in "
                 "rounds of one per stage")
+    if schedule == "zb-h1" and m < n:
+        raise ValueError(
+            f"zb-h1 needs num_microbatches ({m}) >= num_stages ({n}): "
+            "the uniform weight-grad tick W_j = 2n-2+j assumes every "
+            "rank reached steady state before the first W fires")
 
 
 # ---------------------------------------------------------------------------
@@ -319,6 +351,21 @@ def _tree_add(a, b):
     return jax.tree_util.tree_map(lambda x, y: x + y, a, b)
 
 
+def _pipeline_result(loss, grads, lp_grads, xg, axis_name, want_lp,
+                     want_xg):
+    """Assemble the (loss, grads[, extras]) return: extras appear only
+    when asked for, so the legacy 2-tuple contract is untouched."""
+    if not (want_lp or want_xg):
+        return loss, grads
+    extras = {}
+    if want_lp:
+        extras["loss_params_grads"] = lp_grads
+    if want_xg:
+        # Only stage 0 wrote its slots; everyone else holds zeros.
+        extras["input_grads"] = lax.psum(xg, axis_name)
+    return loss, grads, extras
+
+
 def _vjp_template(stage_fn, params, x0):
     """Residual-stash plumbing: capture the TREEDEF and leaf avals of
     ``jax.vjp(stage_fn, params, x)`` via ``eval_shape`` (no FLOPs
@@ -333,11 +380,30 @@ def _vjp_template(stage_fn, params, x0):
     return leaves, treedef
 
 
+def _make_loss_caller(loss_fn, loss_aux):
+    """Normalize the loss call across the aux/params variants: returns
+    ``call(lp, y, jc) -> scalar`` where ``lp`` (trainable loss params)
+    may be None and ``jc`` indexes the microbatch axis of ``loss_aux``
+    (per-microbatch targets), when given."""
+    def call(lp, y, jc):
+        args = [] if lp is None else [lp]
+        args.append(y)
+        if loss_aux is not None:
+            args.append(jax.tree_util.tree_map(
+                lambda l: lax.dynamic_index_in_dim(l, jc, 0,
+                                                   keepdims=False),
+                loss_aux))
+        return loss_fn(*args)
+    return call
+
+
 def pipeline_value_and_grad(stage_fn: Callable, loss_fn: Callable, params,
                             x_microbatches, *, axis_name: str = "pp",
                             schedule: str = "1f1b",
                             num_virtual: int = 1,
-                            cost_backward: float = 2.0):
+                            cost_backward: float = 2.0,
+                            loss_aux=None, loss_params=None,
+                            return_input_grads: bool = False):
     """Pipelined loss AND stage-parameter gradients inside shard_map.
 
     The pipelined model is the composition of every rank's
@@ -348,19 +414,35 @@ def pipeline_value_and_grad(stage_fn: Callable, loss_fn: Callable, params,
 
     Args:
       stage_fn: ``stage_fn(params, x) -> y``, ``y.shape == x.shape``.
-      loss_fn: ``loss_fn(y) -> scalar`` per microbatch output.
+      loss_fn: ``loss_fn(y) -> scalar`` per microbatch output. With
+        ``loss_params`` the signature becomes ``loss_fn(lp, y)``; with
+        ``loss_aux`` the microbatch's aux slice is appended as the last
+        positional arg.
       params: this rank's stage parameters. For ``interleaved``, a pytree
         whose leaves carry a leading ``num_virtual`` axis — chunk slot
         ``v`` on rank ``r`` is chunk-stage ``v·n + r``.
       x_microbatches: [num_micro, micro_batch, ...], read on stage 0.
-      schedule: ``"gpipe"`` | ``"1f1b"`` | ``"interleaved"``
+      schedule: ``"gpipe"`` | ``"1f1b"`` | ``"interleaved"`` | ``"zb-h1"``
         (docs/pipeline.md: memory/bubble tradeoffs).
       num_virtual: chunk count V for ``interleaved`` (ignored otherwise).
       cost_backward: backward:forward cost ratio used for the static
         bubble accounting only (never changes the program).
+      loss_aux: optional pytree of per-microbatch loss inputs, leaves
+        ``[num_micro, ...]`` (e.g. next-token targets), replicated over
+        'pp'.
+      loss_params: optional pytree of TRAINABLE loss-side parameters
+        (e.g. a final layernorm + tied softmax head), replicated over
+        'pp'; their gradient is accumulated at the last stage and psum'd.
+      return_input_grads: also return ``d loss / d x_microbatches``
+        (collected at stage 0's backward ticks and psum'd) — the hook an
+        outer embedding pullback needs.
 
-    Returns ``(loss, grads)``: the scalar total loss (replicated) and the
-    gradient of it w.r.t. THIS rank's ``params`` (same structure).
+    Returns ``(loss, grads)`` — the scalar total loss (replicated) and
+    the gradient w.r.t. THIS rank's ``params`` — or, when
+    ``loss_params``/``return_input_grads`` are used,
+    ``(loss, grads, extras)`` with ``extras`` holding
+    ``"loss_params_grads"`` and/or ``"input_grads"`` (both replicated
+    over 'pp').
     """
     n = lax.axis_size(axis_name)
     m = x_microbatches.shape[0]
@@ -373,12 +455,23 @@ def pipeline_value_and_grad(stage_fn: Callable, loss_fn: Callable, params,
     _record_schedule(sched)
     if schedule == "gpipe":
         return _gpipe_value_and_grad(stage_fn, loss_fn, params,
-                                     x_microbatches, axis_name)
+                                     x_microbatches, axis_name,
+                                     loss_aux, loss_params,
+                                     return_input_grads)
+    if schedule == "zb-h1":
+        return _zb_value_and_grad(stage_fn, loss_fn, params,
+                                  x_microbatches, axis_name,
+                                  loss_aux, loss_params,
+                                  return_input_grads)
     return _fused_value_and_grad(stage_fn, loss_fn, params,
-                                 x_microbatches, axis_name, v)
+                                 x_microbatches, axis_name, v,
+                                 loss_aux, loss_params,
+                                 return_input_grads)
 
 
-def _gpipe_value_and_grad(stage_fn, loss_fn, params, x_mb, axis_name):
+def _gpipe_value_and_grad(stage_fn, loss_fn, params, x_mb, axis_name,
+                          loss_aux=None, loss_params=None,
+                          return_input_grads=False):
     """Forward sweep + backward sweep with full flush. The stash holds
     only each microbatch's stage INPUT; the backward sweep re-linearizes
     (recomputes) the stage — GPipe's rematerialization, which is what
@@ -417,16 +510,31 @@ def _gpipe_value_and_grad(stage_fn, loss_fn, params, x_mb, axis_name):
 
     # Per-microbatch losses + cotangent seeds, all on the last stage
     # (other ranks compute on garbage outs; every use below is masked).
-    def total_loss(o):
-        return jnp.mean(jax.vmap(loss_fn)(o))
+    def total_loss(lp, o):
+        if loss_aux is None:
+            per_mb = loss_fn if lp is None else (lambda y: loss_fn(lp, y))
+            return jnp.mean(jax.vmap(per_mb)(o))
+        per_mb = (loss_fn if lp is None
+                  else (lambda y, a: loss_fn(lp, y, a)))
+        return jnp.mean(jax.vmap(per_mb)(o, loss_aux))
 
-    loss_local, loss_vjp = jax.vjp(total_loss, outs)
-    (seeds,) = loss_vjp(jnp.ones((), loss_local.dtype))
+    if loss_params is None:
+        loss_local, loss_vjp = jax.vjp(lambda o: total_loss(None, o),
+                                       outs)
+        (seeds,) = loss_vjp(jnp.ones((), loss_local.dtype))
+        lp_grads = None
+    else:
+        loss_local, loss_vjp = jax.vjp(total_loss, loss_params, outs)
+        d_lp, seeds = loss_vjp(jnp.ones((), loss_local.dtype))
+        lp_grads = jax.tree_util.tree_map(
+            lambda d: lax.psum(jnp.where(idx == n - 1, d, 0), axis_name),
+            d_lp)
 
     grad0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+    xg0 = jnp.zeros_like(x_mb) if return_input_grads else None
 
     def bwd_tick(carry, u):
-        g_state, gacc = carry
+        g_state, gacc, xg = carry
         j = u - (n - 1 - idx)
         valid = jnp.logical_and(j >= 0, j < m)
         jc = jnp.clip(j, 0, m - 1)
@@ -437,16 +545,24 @@ def _gpipe_value_and_grad(stage_fn, loss_fn, params, x_mb, axis_name):
         _, vjp_fn = jax.vjp(stage_fn, params, stash[jc])
         dp, dx = vjp_fn(g_in)
         gacc = _tree_add(gacc, dp)   # masked ticks contribute exact zeros
+        if xg is not None:
+            take = jnp.logical_and(valid, idx == 0)
+            xg = lax.dynamic_update_index_in_dim(
+                xg, jnp.where(take, dx.astype(xg.dtype), xg[jc]), jc, 0)
         g_state = lax.ppermute(dx, axis_name, rev_perm)
-        return (g_state, gacc), None
+        return (g_state, gacc, xg), None
 
-    (_, grads), _ = lax.scan(bwd_tick, (jnp.zeros_like(x_mb[0]), grad0),
-                             jnp.arange(m + n - 1))
+    (_, grads, xg), _ = lax.scan(
+        bwd_tick, (jnp.zeros_like(x_mb[0]), grad0, xg0),
+        jnp.arange(m + n - 1))
     loss = lax.psum(jnp.where(idx == n - 1, loss_local, 0.0), axis_name)
-    return loss, grads
+    return _pipeline_result(loss, grads, lp_grads, xg, axis_name,
+                            loss_params is not None, return_input_grads)
 
 
-def _fused_value_and_grad(stage_fn, loss_fn, params, x_mb, axis_name, V):
+def _fused_value_and_grad(stage_fn, loss_fn, params, x_mb, axis_name, V,
+                          loss_aux=None, loss_params=None,
+                          return_input_grads=False):
     """The 1F1B engine (V = 1) and its interleaved generalization
     (V >= 2): warmup / steady / drain scans over global tick indices.
 
@@ -486,6 +602,7 @@ def _fused_value_and_grad(stage_fn, loss_fn, params, x_mb, axis_name, V):
     res_avals, res_treedef = _vjp_template(
         stage_fn, chunk_params(jnp.int32(0)), x_mb[0])
     ring0 = [jnp.zeros((W,) + tuple(a.shape), a.dtype) for a in res_avals]
+    loss_call = _make_loss_caller(loss_fn, loss_aux)
 
     def f_sched(t):
         """(valid, j, v) of this rank's forward work at tick t."""
@@ -509,7 +626,7 @@ def _fused_value_and_grad(stage_fn, loss_fn, params, x_mb, axis_name, V):
         valid = jnp.logical_and(q >= 0, j < m)
         return valid, j, vv
 
-    def f_part(t, fwd_state, ring, loss_acc, with_loss):
+    def f_part(t, fwd_state, ring, loss_acc, lp_acc, with_loss):
         validF, jF, vF = f_sched(t)
         jc = jnp.clip(jF, 0, m - 1)
         vc = jnp.clip(vF, 0, V - 1)
@@ -528,20 +645,29 @@ def _fused_value_and_grad(stage_fn, loss_fn, params, x_mb, axis_name, V):
         if with_loss:
             # Per-microbatch loss + cotangent seed at the last
             # chunk-stage, in the same tick as its forward.
-            mb_loss, loss_vjp = jax.vjp(loss_fn, y)
-            (seed,) = loss_vjp(jnp.ones((), mb_loss.dtype) / m)
             last = jnp.logical_and(idx == n - 1, vF == V - 1)
+            if loss_params is None:
+                mb_loss, loss_vjp = jax.vjp(
+                    lambda yy: loss_call(None, yy, jc), y)
+                (seed,) = loss_vjp(jnp.ones((), mb_loss.dtype) / m)
+            else:
+                mb_loss, loss_vjp = jax.vjp(
+                    lambda lp, yy: loss_call(lp, yy, jc), loss_params, y)
+                d_lp, seed = loss_vjp(jnp.ones((), mb_loss.dtype) / m)
+                use = jnp.logical_and(validF, last)
+                lp_acc = jax.tree_util.tree_map(
+                    lambda a, d: a + jnp.where(use, d, 0), lp_acc, d_lp)
             loss_acc = loss_acc + jnp.where(
                 jnp.logical_and(validF, last),
                 mb_loss.astype(loss_acc.dtype), 0.0)
         fwd_state = lax.ppermute(y, axis_name, fwd_perm)
-        return fwd_state, ring, loss_acc, seed
+        return fwd_state, ring, loss_acc, lp_acc, seed
 
     def g_tF(j, vv):
         """Forward tick of (microbatch j, chunk slot vv) on THIS rank."""
         return (j // n) * nV + vv * n + idx + (j % n)
 
-    def b_part(t, bwd_state, ring, gacc, seed):
+    def b_part(t, bwd_state, ring, gacc, xg, seed):
         validB, jB, vB = b_sched(t)
         jc = jnp.clip(jB, 0, m - 1)
         vc = jnp.clip(vB, 0, V - 1)
@@ -558,37 +684,49 @@ def _fused_value_and_grad(stage_fn, loss_fn, params, x_mb, axis_name, V):
                 a, lax.dynamic_index_in_dim(a, vc, 0, keepdims=False) + d,
                 vc, 0),
             gacc, dp)
+        if xg is not None:
+            take = jnp.logical_and(
+                validB, jnp.logical_and(idx == 0, vB == 0))
+            xg = lax.dynamic_update_index_in_dim(
+                xg, jnp.where(take, dx.astype(xg.dtype), xg[jc]), jc, 0)
         bwd_state = lax.ppermute(dx, axis_name, rev_perm)
-        return bwd_state, gacc
+        return bwd_state, gacc, xg
 
     grad0 = jax.tree_util.tree_map(jnp.zeros_like, p_stacked)
     fwd0 = jnp.zeros_like(x_mb[0])
     bwd0 = jnp.zeros_like(x_mb[0])
+    lp0 = (None if loss_params is None
+           else jax.tree_util.tree_map(jnp.zeros_like, loss_params))
+    xg0 = jnp.zeros_like(x_mb) if return_input_grads else None
 
     def warmup_tick(carry, t):
-        fwd_state, bwd_state, ring, gacc, loss_acc = carry
-        fwd_state, ring, loss_acc, _ = f_part(
-            t, fwd_state, ring, loss_acc, with_loss=False)
-        return (fwd_state, bwd_state, ring, gacc, loss_acc), None
+        fwd_state, bwd_state, ring, gacc, loss_acc, lp_acc, xg = carry
+        fwd_state, ring, loss_acc, lp_acc, _ = f_part(
+            t, fwd_state, ring, loss_acc, lp_acc, with_loss=False)
+        return (fwd_state, bwd_state, ring, gacc, loss_acc, lp_acc,
+                xg), None
 
     def steady_tick(carry, t):
-        fwd_state, bwd_state, ring, gacc, loss_acc = carry
-        fwd_state, ring, loss_acc, seed = f_part(
-            t, fwd_state, ring, loss_acc, with_loss=True)
-        bwd_state, gacc = b_part(t, bwd_state, ring, gacc, seed)
-        return (fwd_state, bwd_state, ring, gacc, loss_acc), None
+        fwd_state, bwd_state, ring, gacc, loss_acc, lp_acc, xg = carry
+        fwd_state, ring, loss_acc, lp_acc, seed = f_part(
+            t, fwd_state, ring, loss_acc, lp_acc, with_loss=True)
+        bwd_state, gacc, xg = b_part(t, bwd_state, ring, gacc, xg, seed)
+        return (fwd_state, bwd_state, ring, gacc, loss_acc, lp_acc,
+                xg), None
 
     def drain_tick(carry, t):
-        fwd_state, bwd_state, ring, gacc, loss_acc = carry
-        bwd_state, gacc = b_part(t, bwd_state, ring, gacc,
-                                 jnp.zeros_like(bwd_state))
-        return (fwd_state, bwd_state, ring, gacc, loss_acc), None
+        fwd_state, bwd_state, ring, gacc, loss_acc, lp_acc, xg = carry
+        bwd_state, gacc, xg = b_part(t, bwd_state, ring, gacc, xg,
+                                     jnp.zeros_like(bwd_state))
+        return (fwd_state, bwd_state, ring, gacc, loss_acc, lp_acc,
+                xg), None
 
     warmup = nV - 1
     steady_end = m * V + n - 1          # one past the last F tick
     drain_end = steady_end + nV - 1     # one past the last B tick
 
-    carry = (fwd0, bwd0, ring0, grad0, jnp.zeros((), jnp.float32))
+    carry = (fwd0, bwd0, ring0, grad0, jnp.zeros((), jnp.float32),
+             lp0, xg0)
     if warmup:
         carry, _ = lax.scan(warmup_tick, carry, jnp.arange(warmup))
     carry, _ = lax.scan(steady_tick, carry,
@@ -596,8 +734,191 @@ def _fused_value_and_grad(stage_fn, loss_fn, params, x_mb, axis_name, V):
     if nV > 1:
         carry, _ = lax.scan(drain_tick, carry,
                             jnp.arange(steady_end, drain_end))
-    _, _, _, grads, loss_acc = carry
+    _, _, _, grads, loss_acc, lp_acc, xg = carry
     loss = lax.psum(jnp.where(idx == n - 1, loss_acc / m, 0.0), axis_name)
     if not stacked:
         grads = jax.tree_util.tree_map(lambda l: l[0], grads)
-    return loss, grads
+    lp_grads = (None if lp_acc is None else jax.tree_util.tree_map(
+        lambda d: lax.psum(d, axis_name), lp_acc))
+    return _pipeline_result(loss, grads, lp_grads, xg, axis_name,
+                            loss_params is not None, return_input_grads)
+
+
+def _zb_value_and_grad(stage_fn, loss_fn, params, x_mb, axis_name,
+                       loss_aux=None, loss_params=None,
+                       return_input_grads=False):
+    """The ZB-H1 engine (V = 1, m >= n): 1f1b's F/B tiling with the
+    backward split into an input-grad tick (Bx) and a weight-grad tick
+    (W) — arXiv 2412.14374's zero-bubble H1 point recast onto the
+    single-SPMD-program collective-permute pattern.
+
+    Tick map on rank ``idx`` (global tick t, microbatch j):
+
+        F_j   at  t = j + idx                (same as 1f1b)
+        Bx_j  at  t = j + 2n - 2 - idx       (same slot as 1f1b's B)
+        W_j   at  t = 2n - 2 + j             (UNIFORM across ranks)
+
+    Bx rebuilds the stage VJP from the residual ring (``2n - 1`` slots
+    keyed ``(j + idx) mod W``, exactly as 1f1b), emits only ``dx`` down
+    the reverse ring, and stashes its incoming cotangent into an n-slot
+    COTANGENT ring keyed ``j mod n``. W rebuilds the same VJP later and
+    emits only ``dp``. Because ``W_j``'s tick ``2n-2+j`` is at or after
+    every rank's ``Bx_j`` tick ``2n-2+j-idx``, no W slot is ever masked:
+    the four scans are warmup (F), steady-A (F+Bx), steady-B (F+Bx+W)
+    and drain (Bx+W), and every steady-B/drain tick does useful W work.
+
+    Ring safety: the residual slot of ``W_j`` (``(j+idx) mod (2n-1)``)
+    is next overwritten by ``F_{j+2n-1}`` at tick ``j+idx+2n-1``, after
+    W's read at ``2n-2+j``; a same-tick F write collides with the W read
+    only at ``idx = 2n-2`` (impossible) or n = 1 (same microbatch —
+    f-before-w ordering makes the read correct). The cotangent slot
+    ``j mod n`` is next overwritten by ``Bx_{j+n}`` at tick
+    ``j+3n-2-idx > 2n-2+j``; rank 0's same-tick Bx_j -> W_j handoff is
+    ordered bx-before-w.
+
+    In this SPMD emulation both Bx and W stage the full ``vjp_fn`` call;
+    the unused half of each (``dp`` at Bx, ``dx`` at W) is dead code for
+    XLA to eliminate. Numerics are exactly the microbatch-summed VJP
+    either way — only the static cost model asserts the cBx/cBw split.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    m = x_mb.shape[0]
+    W = 2 * n - 1
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+    rev_perm = [((i + 1) % n, i) for i in range(n)]
+
+    res_avals, res_treedef = _vjp_template(stage_fn, params, x_mb[0])
+    ring0 = [jnp.zeros((W,) + tuple(a.shape), a.dtype) for a in res_avals]
+    cring0 = jnp.zeros((n,) + x_mb.shape[1:], x_mb.dtype)
+    loss_call = _make_loss_caller(loss_fn, loss_aux)
+
+    def rebuild_vjp(jc):
+        slot = (jc + idx) % W
+        stashed = [lax.dynamic_index_in_dim(r, slot, 0, keepdims=False)
+                   for r in ring_ref[0]]
+        return jax.tree_util.tree_unflatten(res_treedef, stashed)
+
+    # rebuild_vjp closes over a one-element list so f/bx/w parts all see
+    # the CURRENT ring of the tick being traced.
+    ring_ref = [ring0]
+
+    def f_part(t, fwd_state, ring, loss_acc, lp_acc, with_loss):
+        j = t - idx
+        validF = jnp.logical_and(j >= 0, j < m)
+        jc = jnp.clip(j, 0, m - 1)
+        inp = jnp.where(idx == 0, x_mb[jc], fwd_state)
+        y, vjp_fn = jax.vjp(stage_fn, params, inp)
+        slot = (jc + idx) % W
+        leaves = jax.tree_util.tree_leaves(vjp_fn)
+        ring = [lax.dynamic_update_index_in_dim(
+                    r, jnp.where(validF, l,
+                                 lax.dynamic_index_in_dim(
+                                     r, slot, 0, keepdims=False)),
+                    slot, 0)
+                for r, l in zip(ring, leaves)]
+        seed = jnp.zeros_like(y)
+        if with_loss:
+            last = idx == n - 1
+            if loss_params is None:
+                mb_loss, loss_vjp = jax.vjp(
+                    lambda yy: loss_call(None, yy, jc), y)
+                (seed,) = loss_vjp(jnp.ones((), mb_loss.dtype) / m)
+            else:
+                mb_loss, loss_vjp = jax.vjp(
+                    lambda lp, yy: loss_call(lp, yy, jc), loss_params, y)
+                d_lp, seed = loss_vjp(jnp.ones((), mb_loss.dtype) / m)
+                use = jnp.logical_and(validF, last)
+                lp_acc = jax.tree_util.tree_map(
+                    lambda a, d: a + jnp.where(use, d, 0), lp_acc, d_lp)
+            loss_acc = loss_acc + jnp.where(
+                jnp.logical_and(validF, last),
+                mb_loss.astype(loss_acc.dtype), 0.0)
+        fwd_state = lax.ppermute(y, axis_name, fwd_perm)
+        return fwd_state, ring, loss_acc, lp_acc, seed
+
+    def bx_part(t, bwd_state, cring, xg, seed):
+        j = t - (2 * n - 2) + idx
+        validB = jnp.logical_and(j >= 0, j < m)
+        jc = jnp.clip(j, 0, m - 1)
+        vjp_fn = rebuild_vjp(jc)
+        g_in = jnp.where(idx == n - 1, seed, bwd_state)
+        g_in = jnp.where(validB, g_in, jnp.zeros_like(g_in))
+        # Park the cotangent for this microbatch's deferred W tick.
+        cslot = jc % n
+        cring = lax.dynamic_update_index_in_dim(
+            cring,
+            jnp.where(validB, g_in.astype(cring.dtype),
+                      lax.dynamic_index_in_dim(cring, cslot, 0,
+                                               keepdims=False)),
+            cslot, 0)
+        dp, dx = vjp_fn(g_in)   # dp is the W tick's job — dead here
+        if xg is not None:
+            take = jnp.logical_and(validB, idx == 0)
+            xg = lax.dynamic_update_index_in_dim(
+                xg, jnp.where(take, dx.astype(xg.dtype), xg[jc]), jc, 0)
+        bwd_state = lax.ppermute(dx, axis_name, rev_perm)
+        return bwd_state, cring, xg
+
+    def w_part(t, cring, gacc):
+        # W_j at the uniform tick 2n-2+j: always a valid microbatch in
+        # the steady-B/drain spans (that is the zero-bubble property).
+        jc = jnp.clip(t - (2 * n - 2), 0, m - 1)
+        vjp_fn = rebuild_vjp(jc)
+        g = lax.dynamic_index_in_dim(cring, jc % n, 0, keepdims=False)
+        dp, dx = vjp_fn(g)      # dx already shipped at the Bx tick
+        return _tree_add(gacc, dp)
+
+    grad0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+    lp0 = (None if loss_params is None
+           else jax.tree_util.tree_map(jnp.zeros_like, loss_params))
+    xg0 = jnp.zeros_like(x_mb) if return_input_grads else None
+    fwd0 = jnp.zeros_like(x_mb[0])
+    bwd0 = jnp.zeros_like(x_mb[0])
+
+    def tick(carry, t, *, do_f, do_bx, do_w):
+        (fwd_state, bwd_state, ring, cring, gacc, loss_acc, lp_acc,
+         xg) = carry
+        ring_ref[0] = ring
+        seed = jnp.zeros_like(bwd_state)
+        if do_f:
+            fwd_state, ring, loss_acc, lp_acc, seed = f_part(
+                t, fwd_state, ring, loss_acc, lp_acc,
+                with_loss=do_bx)
+            ring_ref[0] = ring
+        if do_bx:
+            bwd_state, cring, xg = bx_part(t, bwd_state, cring, xg, seed)
+        if do_w:
+            gacc = w_part(t, cring, gacc)
+        return (fwd_state, bwd_state, ring, cring, gacc, loss_acc,
+                lp_acc, xg), None
+
+    def warmup_tick(c, t):
+        return tick(c, t, do_f=True, do_bx=False, do_w=False)
+
+    def steady_a_tick(c, t):
+        return tick(c, t, do_f=True, do_bx=True, do_w=False)
+
+    def steady_b_tick(c, t):
+        return tick(c, t, do_f=True, do_bx=True, do_w=True)
+
+    def drain_tick(c, t):
+        return tick(c, t, do_f=False, do_bx=True, do_w=True)
+
+    carry = (fwd0, bwd0, ring0, cring0, grad0,
+             jnp.zeros((), jnp.float32), lp0, xg0)
+    if n > 1:
+        carry, _ = lax.scan(warmup_tick, carry, jnp.arange(n - 1))
+        carry, _ = lax.scan(steady_a_tick, carry,
+                            jnp.arange(n - 1, 2 * n - 2))
+    carry, _ = lax.scan(steady_b_tick, carry,
+                        jnp.arange(2 * n - 2, m + n - 1))
+    if n > 1:
+        carry, _ = lax.scan(drain_tick, carry,
+                            jnp.arange(m + n - 1, m + 2 * n - 2))
+    _, _, _, _, grads, loss_acc, lp_acc, xg = carry
+    loss = lax.psum(jnp.where(idx == n - 1, loss_acc / m, 0.0), axis_name)
+    lp_grads = (None if lp_acc is None else jax.tree_util.tree_map(
+        lambda d: lax.psum(d, axis_name), lp_acc))
+    return _pipeline_result(loss, grads, lp_grads, xg, axis_name,
+                            loss_params is not None, return_input_grads)

@@ -239,3 +239,193 @@ def _put_tree(tree, specs, mesh: Mesh):
     out = [jax.device_put(x, NamedSharding(mesh, s))
            for x, s in zip(flat_t, flat_s)]
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# --------------------------------------------------------------------------
+# Pipeline-parallel training step — cuts the flagship transformer into
+# stage_fns over 'pp' automatically (docs/pipeline.md, docs/autotune.md).
+# --------------------------------------------------------------------------
+
+_DENSE_LAYER_KEYS = ("ln1", "ln2", "wq", "wk", "wv", "wo", "wi", "wo_mlp")
+
+
+def _check_pipeline_cfg(cfg: tfm.TransformerConfig, mesh: Mesh,
+                        num_virtual: int) -> int:
+    if "pp" not in mesh.axis_names:
+        raise ValueError("build_pipeline_train_step needs a 'pp' mesh "
+                         f"axis (axes: {sorted(mesh.axis_names)})")
+    for ax, name in ((cfg.tp_axis, "tp"), (cfg.sp_axis, "sp"),
+                     (cfg.ep_axis, "ep")):
+        if ax:
+            raise ValueError(
+                f"pipeline train step does not compose with {name} "
+                "parallelism yet; build the config with "
+                f"{name}_axis=None")
+    if cfg.num_experts:
+        raise ValueError("pipeline train step supports dense layers "
+                         "only (num_experts=0): MoE layer dicts are not "
+                         "homogeneous across the stage stack")
+    n = int(mesh.shape["pp"])
+    extra = [a for a in mesh.axis_names
+             if a != "pp" and int(mesh.shape[a]) > 1]
+    if extra:
+        raise ValueError("pipeline train step shards over 'pp' only; "
+                         f"fold or drop mesh axes {extra}")
+    if cfg.n_layers % (n * num_virtual):
+        raise ValueError(
+            f"n_layers ({cfg.n_layers}) must divide evenly into "
+            f"pp ({n}) x num_virtual ({num_virtual}) stage chunks")
+    return n
+
+
+def to_pipeline_params(cfg: tfm.TransformerConfig, params, num_stages: int,
+                       num_virtual: int = 1):
+    """Re-pack ``init_params`` layout into the pipeline layout:
+    ``{"embed", "pos", "ln_f", "stages"}`` where each stages leaf is
+    ``[n_pp, V, layers_per_chunk, ...]`` — slot ``[r, v]`` holds
+    chunk-stage ``v·n + r``'s layers in order (the interleaved
+    chunk-stage convention; V=1 collapses to contiguous stages)."""
+    nV = num_stages * num_virtual
+    lpc = cfg.n_layers // nV
+    layers = params["layers"]
+    chunks = [jax.tree_util.tree_map(
+                  lambda *ls: jnp.stack(ls), *layers[c * lpc:(c + 1) * lpc])
+              for c in range(nV)]
+    stages = jax.tree_util.tree_map(
+        lambda *cs: jnp.stack(cs).reshape(
+            (num_virtual, num_stages) + cs[0].shape).swapaxes(0, 1),
+        *chunks)
+    return {"embed": params["embed"], "pos": params["pos"],
+            "ln_f": params["ln_f"], "stages": stages}
+
+
+def from_pipeline_params(cfg: tfm.TransformerConfig, pparams,
+                         num_stages: int, num_virtual: int = 1):
+    """Inverse of :func:`to_pipeline_params` (checkpoint interop)."""
+    nV = num_stages * num_virtual
+    lpc = cfg.n_layers // nV
+    flat = jax.tree_util.tree_map(
+        lambda l: l.swapaxes(0, 1).reshape((nV * lpc,) + l.shape[3:]),
+        pparams["stages"])
+    layers = [jax.tree_util.tree_map(lambda l: l[i], flat)
+              for i in range(nV * lpc)]
+    return {"embed": pparams["embed"], "pos": pparams["pos"],
+            "ln_f": pparams["ln_f"], "layers": layers}
+
+
+def pipeline_param_specs(cfg: tfm.TransformerConfig):
+    """PartitionSpecs for the pipeline layout: stage stacks shard their
+    leading n_pp axis over 'pp'; embed/pos/ln_f replicate (they are the
+    loss head + embedding, applied on every rank)."""
+    stage_spec = {k: P("pp") for k in _DENSE_LAYER_KEYS}
+    return {"embed": P(), "pos": P(), "ln_f": P(), "stages": stage_spec}
+
+
+def build_pipeline_train_step(cfg: tfm.TransformerConfig, mesh: Mesh,
+                              optimizer, *, schedule: str = "1f1b",
+                              num_virtual: int = 1,
+                              cost_backward: float = 2.0):
+    """Returns ``(make, shard_params, shard_batch)`` for a
+    pipeline-parallel train step over a 'pp' mesh.
+
+    ``step(params, opt_state, tokens_mb, targets_mb) ->
+    (params, opt_state, loss)`` where ``tokens_mb``/``targets_mb`` are
+    ``[num_micro, micro_batch, S]`` int32 (replicated — 'pp' shards
+    layers, not data) and ``params`` is the
+    :func:`to_pipeline_params` layout. The flagship transformer is cut
+    automatically: every rank's stage_fn scans its
+    ``n_layers / (pp · V)`` decoder blocks, the embedding runs
+    replicated on every rank with its gradient recovered from the
+    pipeline's stage-0 input grads, and the final layernorm + tied
+    softmax head ride the schedule's ``loss_params`` channel. The
+    microbatch count is whatever leading axis the batch carries — the
+    autotuner varies it (and ``schedule``) per trial by rebuilding this
+    step (docs/autotune.md)."""
+    from ..models.transformer import _block, _layernorm, _project_logits
+    from .pipeline import pipeline_value_and_grad
+
+    n = _check_pipeline_cfg(cfg, mesh, num_virtual)
+    interleaved = schedule == "interleaved"
+    if interleaved and num_virtual < 2:
+        raise ValueError("interleaved needs num_virtual >= 2")
+    if not interleaved and num_virtual != 1:
+        raise ValueError(f"schedule {schedule!r} uses num_virtual=1")
+    specs = pipeline_param_specs(cfg)
+    dt = cfg.dtype
+
+    block = _block
+    if cfg.remat:
+        policy = None
+        if cfg.remat_policy == "dots":
+            policy = (jax.checkpoint_policies
+                      .checkpoint_dots_with_no_batch_dims)
+        block = jax.checkpoint(_block, static_argnums=(2, 3),
+                               policy=policy)
+
+    def stage_fn(p, x):
+        def body(h, layer):
+            return block(layer, h, cfg, 0), None
+        h, _ = lax.scan(body, x, p)
+        return h
+
+    def embed_all(ep, tokens_mb):
+        s = tokens_mb.shape[-1]
+        pos = ep["pos"][jnp.arange(s)]
+        return (ep["embed"].astype(dt)[tokens_mb]
+                + pos.astype(dt)[None, None])
+
+    def head_loss(lp, y, targets):
+        h = _layernorm(y, lp["ln_f"])
+        logits = _project_logits({"embed": lp["embed"]}, h, cfg)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return -ll.mean()
+
+    def per_shard_step(params, opt_state, tokens_mb, targets_mb):
+        ep = {"embed": params["embed"], "pos": params["pos"]}
+        x_mb, emb_vjp = jax.vjp(lambda e: embed_all(e, tokens_mb), ep)
+        lp = {"ln_f": params["ln_f"], "embed": params["embed"]}
+        # Local stage stack [1, V, lpc, ...] -> the engine's view.
+        p_stage = jax.tree_util.tree_map(lambda l: l[0],
+                                         params["stages"])
+        if not interleaved:
+            p_stage = jax.tree_util.tree_map(lambda l: l[0], p_stage)
+        loss, g_stage, extras = pipeline_value_and_grad(
+            stage_fn, head_loss, p_stage, x_mb, axis_name="pp",
+            schedule=schedule, num_virtual=num_virtual,
+            cost_backward=cost_backward, loss_aux=targets_mb,
+            loss_params=lp, return_input_grads=True)
+        (d_ep,) = emb_vjp(extras["input_grads"])
+        lp_g = extras["loss_params_grads"]
+        if not interleaved:
+            g_stage = jax.tree_util.tree_map(lambda l: l[None], g_stage)
+        grads = {
+            # Tied embedding: input-path pullback + softmax-head path.
+            "embed": d_ep["embed"] + lp_g["embed"],
+            "pos": d_ep["pos"],
+            "ln_f": lp_g["ln_f"],
+            "stages": jax.tree_util.tree_map(lambda l: l[None], g_stage),
+        }
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        import optax
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def make(params, opt_state):
+        from .zero import state_specs_by_structure
+        opt_specs = state_specs_by_structure(opt_state, params, specs)
+        data_spec = P()
+        step = jax.jit(jax.shard_map(
+            per_shard_step, mesh=mesh,
+            in_specs=(specs, opt_specs, data_spec, data_spec),
+            out_specs=(specs, opt_specs, P()),
+            check_vma=False))
+        return step, opt_specs
+
+    def shard_params(params):
+        return _put_tree(params, specs, mesh)
+
+    def shard_batch(batch):
+        return jax.device_put(batch, NamedSharding(mesh, P()))
+
+    return make, shard_params, shard_batch

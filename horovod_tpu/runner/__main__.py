@@ -84,6 +84,16 @@ def main(argv=None) -> int:
                              "escalate wire compression, and (with "
                              "--elastic) evict the slow rank; exported "
                              "as HOROVOD_TPU_ADAPTATION=1")
+    parser.add_argument("--autotune", action="store_true",
+                        help="arm the GLOBAL online autotuner "
+                             "(docs/autotune.md): one search space "
+                             "over every perf knob, scored on "
+                             "measured step time, each move guarded "
+                             "by the health plane's step-time "
+                             "regression detector with automatic "
+                             "rollback; exported as "
+                             "HOROVOD_TPU_AUTOTUNE=1 (distinct from "
+                             "the legacy HOROVOD_AUTOTUNE tuner)")
     parser.add_argument("--blackbox-dir", default=None,
                         help="flight-recorder crash-dump directory "
                              "(docs/postmortem.md): on a crash, "
@@ -150,6 +160,8 @@ def main(argv=None) -> int:
         extra_env["HOROVOD_TPU_FAULT_SPEC"] = args.fault_spec
     if args.adaptation:
         extra_env["HOROVOD_TPU_ADAPTATION"] = "1"
+    if args.autotune:
+        extra_env["HOROVOD_TPU_AUTOTUNE"] = "1"
     if args.timeline:
         # Propagated UNEXPANDED: each worker resolves its own {rank}
         # (utils/env.resolved_timeline_path), so the same value serves

@@ -126,6 +126,8 @@ class NativeCore:
         lib.hvdtpu_ctl_stalled.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64]
         lib.hvdtpu_ctl_stalled.restype = ctypes.c_int64
+        lib.hvdtpu_ctl_set_fusion_threshold.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64]
         lib.hvdtpu_set_fusion_threshold.argtypes = [ctypes.c_int64]
         lib.hvdtpu_get_fusion_threshold.restype = ctypes.c_int64
         lib.hvdtpu_set_cycle_time_ms.argtypes = [ctypes.c_double]
@@ -487,6 +489,11 @@ class NativeController:
         tensor is partially announced (no quiet-window wait). Returns
         the total group count."""
         return int(self._lib.hvdtpu_ctl_plan_ready(self._h))
+
+    def set_fusion_threshold(self, nbytes: int) -> None:
+        """Push a tuner-arbitrated fusion cap into the native planner
+        (docs/autotune.md) — future groups are cut with the new cap."""
+        self._lib.hvdtpu_ctl_set_fusion_threshold(self._h, int(nbytes))
 
     def params(self) -> dict:
         fusion = ctypes.c_int64()

@@ -319,6 +319,16 @@ void hvdtpu_ctl_tick(void* h) {
   }
 }
 
+// Global-autotuner fusion move: the coordinator-side arbiter accepted a
+// new cap, so this planner must cut future groups with it (the Python
+// fallback planner reads CoordinatorService.fusion_threshold directly;
+// the native planner's copy lives behind this handle).
+void hvdtpu_ctl_set_fusion_threshold(void* h, int64_t bytes) {
+  auto* c = static_cast<Controller*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  c->fusion_threshold = bytes;
+}
+
 // Current (possibly tuned) knobs, served to workers in the fetch RPC so
 // every process flips scalar knobs in lockstep (SyncParams,
 // parameter_manager.cc:213-246).

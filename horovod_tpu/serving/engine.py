@@ -202,6 +202,11 @@ class ServingConfig:
     spec_tokens: int = 0          # speculative verify width k (the
     #                               drafter proposes k-1 tokens/step);
     #                               0 = off, requires a drafter model
+    spec_adapt: bool = False      # adapt k per slot from the live
+    #                               draft-acceptance rate (AIMD,
+    #                               autotune.spec_adapt); spec_tokens
+    #                               becomes the CAP, and a cold drafter
+    #                               backs off to k=1 (plain decode)
     prefix_cache: bool = False    # shared prompt-prefix block cache
     prefix_cache_entries: Optional[int] = None  # LRU cap (None: pool-
     #                                             pressure eviction only
@@ -364,6 +369,17 @@ class InferenceEngine:
                     f"spec_tokens ({self._spec_k}) must be >= 2: the "
                     "verify chunk holds the last token plus at least "
                     "one draft")
+        # Per-slot adaptive draft length (docs/autotune.md#serving):
+        # spec_tokens is the cap, each slot's effective k follows its
+        # own live acceptance rate.
+        self._spec_ctl = None
+        if c.spec_adapt:
+            if draft_params is None:
+                raise ValueError(
+                    "spec_adapt requires a drafter model (it adapts "
+                    "the speculative draft length)")
+            from ..autotune.spec_adapt import SpecTokensController
+            self._spec_ctl = SpecTokensController(self._spec_k)
 
         slots = int(c.max_batch_slots)
         max_tab = c.max_blocks_per_seq if c.max_blocks_per_seq \
@@ -731,8 +747,31 @@ class InferenceEngine:
 
     def _decode_step(self) -> None:
         if self._draft_params is not None:
-            self._spec_decode_step()
-            return
+            ctl = self._spec_ctl
+            if ctl is None:
+                self._spec_decode_step()
+                return
+            live = [s for s, r in enumerate(self._reqs)
+                    if r is not None]
+            width = ctl.width(live) if live else 1
+            if width > 1:
+                # Verify at the widest live slot's k; narrower slots
+                # cap their accepted run at their own k_eff below.
+                self._spec_decode_step(width)
+                return
+            # Every live slot backed off to k=1: take the plain decode
+            # path (no verify-width tax), but keep the drafter's KV
+            # cache in step with the true context — one cheap [slots,1]
+            # drafter call — so a probe step's proposals are grounded,
+            # and tick each slot's probe clock.
+            self._record_bucket("draft", 1)
+            _, self._draft_cache = self._dfwd(
+                self._draft_params, self._draft_cache,
+                jnp.asarray(self._last_tok[:, None]),
+                jnp.asarray(self._lengths),
+                jnp.asarray(self._tables))
+            for s in live:
+                ctl.note_plain_step(s)
         t0m = time.monotonic()   # before the fault hook (slow_decode
         #                          belongs inside the DECODE span)
         if self._inj is not None:
@@ -767,7 +806,7 @@ class InferenceEngine:
                                time.monotonic(), {"n": 1})
             self._check_finished(req)
 
-    def _spec_decode_step(self) -> None:
+    def _spec_decode_step(self, k: Optional[int] = None) -> None:
         """Speculative decode step: the drafter proposes ``k-1`` greedy
         tokens per slot (k-1 cheap ``[slots, 1]`` calls on its own
         cache), the flagship verifies them in ONE batched ``[slots, k]``
@@ -782,13 +821,22 @@ class InferenceEngine:
         hold is overwritten by the next chunk's scatter before any
         query can see it (chunks are a constant k wide and start where
         the accepted prefix ended, so the rewritten span always covers
-        the stale one)."""
+        the stale one; with spec_adapt the width can shrink between
+        steps, which is equally safe — each chunk writes contiguously
+        from the current length, and causal queries never read past
+        their own chunk).
+
+        With spec_adapt, ``k`` is the widest live slot's adaptive
+        width; each slot caps its ACCEPTED run at its own k_eff and
+        feeds its raw (uncapped) acceptance back to the controller."""
         t0m = time.monotonic()   # before the fault hook, like
         #                          _decode_step
         if self._inj is not None:
             self._inj.on_serving_decode()
         t0 = time.perf_counter()
-        k = self._spec_k
+        if k is None:
+            k = self._spec_k
+        ctl = self._spec_ctl
         n_live = self.active_count
         tabs = jnp.asarray(self._tables)
 
@@ -836,9 +884,18 @@ class InferenceEngine:
             else:
                 d = proposals[slot]
                 g = greedy[slot]
-                accepted = 0
-                while accepted < k - 1 and d[accepted] == g[accepted]:
-                    accepted += 1
+                raw = 0
+                while raw < k - 1 and d[raw] == g[raw]:
+                    raw += 1
+                accepted = raw
+                if ctl is not None:
+                    # Cap the accepted run at THIS slot's adaptive k
+                    # (still token-identical: every emitted token is
+                    # the flagship's argmax under the true prefix),
+                    # but feed the controller the raw acceptance so a
+                    # recovered drafter can climb back without a probe.
+                    accepted = min(raw, max(ctl.slot_k(slot) - 1, 0))
+                    ctl.observe(slot, k - 1, raw)
                 emit = [int(t) for t in g[:accepted + 1]]
             self._m["draft_accepted"].inc(accepted)
             # Truncate to the request's remaining budget / EOS — any

@@ -415,6 +415,13 @@ def _main(argv=None) -> int:
         print(json.dumps(report, indent=1, sort_keys=True))
     else:
         print(format_report(report))
+    if (args.baseline
+            and report["baseline"]["verdict"] == "regressions"):
+        # CI-shaped contract (docs/health.md#baseline): a baseline diff
+        # that found regressions exits nonzero so a perf gate can be
+        # one `health --baseline` invocation; 3 keeps it distinct from
+        # argparse's 2 and the missing-input 2 above.
+        return 3
     return 0
 
 

@@ -296,6 +296,58 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             for p in members:
                 self._param_bucket[id(p)] = b
 
+    def set_bucket_cap_mb(self, bucket_cap_mb: float) -> None:
+        """Re-partition the gradient buckets under a new size cap — the
+        global autotuner's ``torch_bucket_mb`` knob (docs/autotune.md),
+        safety class ``boundary``: legal only at a step boundary, while
+        no bucket collective is in flight. The hooks installed at wrap
+        time look their bucket up per call (``_param_bucket[id(p)]``),
+        so rebuilding the partition re-targets them without touching
+        autograd; grad-view aliases are re-established against the new
+        flat buffers. Compression error-feedback residuals are bucket-
+        shaped and reset (one step of feedback is lost — the same cost
+        as a restart, which this move exists to avoid).
+
+        Only positive-cap -> positive-cap moves are supported: a
+        bucketless optimizer chose per-parameter hooks at wrap time."""
+        if self._handles:
+            raise RuntimeError(
+                "set_bucket_cap_mb while bucket collectives are in "
+                "flight; call synchronize()/step() first — the knob's "
+                "safety class is 'boundary' (docs/autotune.md)")
+        if not self._buckets or bucket_cap_mb <= 0:
+            raise ValueError(
+                "set_bucket_cap_mb supports re-partitioning an already "
+                "bucketed optimizer to a positive cap (hook shape is "
+                "chosen at wrap time)")
+        had_views = bool(self._grad_views)
+        # Clone aliased grads out of the old flat buffers first: the
+        # new partition allocates fresh buffers, and a grad left
+        # aliasing retired storage would silently detach from the wire.
+        with torch.no_grad():
+            for b in self._buckets:
+                for p in b.params:
+                    if p.grad is not None and id(p) in self._grad_views:
+                        p.grad = p.grad.detach().clone()
+        old_n = len(self._buckets)
+        self._buckets = []
+        self._param_bucket = {}
+        self._bucket_residuals = {}
+        self._grad_views = {}
+        self._build_buckets(float(bucket_cap_mb) * 2 ** 20)
+        if had_views and self._buckets:
+            self._install_grad_views()
+        self._metrics.buckets.set(len(self._buckets))
+        self._metrics.view_params.set(len(self._grad_views))
+        try:
+            from ..observability import flight_recorder as _flight
+            _flight.recorder().note("autotune", (
+                "bucket_repartition", "torch_bucket_mb",
+                str(bucket_cap_mb), None, None,
+                f"buckets {old_n} -> {len(self._buckets)}"))
+        except Exception:
+            pass
+
     def _install_grad_views(self) -> None:
         """gradient_as_bucket_view (docs/torch.md): alias every eligible
         ``p.grad`` into its bucket's flat buffer at wrap time, so

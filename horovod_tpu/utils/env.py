@@ -512,11 +512,38 @@ def hierarchical_allgather() -> bool:
 
 
 def autotune() -> bool:
-    return _get("AUTOTUNE") not in (None, "", "0")
+    """The LEGACY eager-path Bayesian tuner (parameter_manager parity).
+    Reads ONLY ``HOROVOD_AUTOTUNE`` — deliberately not the usual
+    ``HOROVOD_TPU_`` override chain, because ``HOROVOD_TPU_AUTOTUNE``
+    enables the GLOBAL online tuner (:func:`autotune_global`,
+    docs/autotune.md) and the two switches must not alias."""
+    return os.environ.get("HOROVOD_AUTOTUNE") not in (None, "", "0")
+
+
+def autotune_global() -> bool:
+    """The global online autotuner (docs/autotune.md):
+    ``HOROVOD_TPU_AUTOTUNE=1`` (or the runner's ``--autotune``) turns
+    on the knob-registry driver guarded by the health plane."""
+    return os.environ.get("HOROVOD_TPU_AUTOTUNE") not in (None, "", "0")
 
 
 def autotune_log() -> Optional[str]:
     return _get("AUTOTUNE_LOG")
+
+
+def autotune_guard_rel() -> float:
+    """Rollback guard threshold for global-tuner moves: a post-move
+    window worse than the pre-move baseline by more than this fraction
+    rolls the move back (docs/autotune.md). Default matches the
+    ``tools/health --baseline`` regression threshold."""
+    v = _get("AUTOTUNE_GUARD_REL")
+    return float(v) if v is not None else 0.10
+
+
+def autotune_trial_budget() -> int:
+    """Measurement windows the global tuner scores each candidate on."""
+    v = _get("AUTOTUNE_TRIAL_BUDGET")
+    return int(v) if v is not None else 2
 
 
 def log_level() -> str:

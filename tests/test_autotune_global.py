@@ -1,0 +1,573 @@
+"""Global-autotuner unit tests (docs/autotune.md).
+
+Fast-tier coverage of the new subsystem: the typed knob space, the
+deterministic successive-halving + GP search, the guarded online driver
+(keep / revert / rollback against a stubbed measurement), the safe
+apply plane's refusal contract, the per-slot spec_tokens AIMD
+controller, the windowed step-time reader over fabricated history
+files, and — the regression this PR must never reintroduce — the
+wire-epoch arbiter serializing the adaptation ladder and the tuner on
+ONE epoch list, exercised both directly and over the coordinator RPC.
+
+The slow tier complements this file: tests/test_autotune_e2e.py runs
+the cold-start search on the real bench workload and the multiprocess
+fusion-flip test drives a mid-run tuner move through live engines.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from horovod_tpu.autotune import (ApplyPlane, AutoTuner, GaussianProcess,
+                                  Knob, KnobRegistry, SpecTokensController,
+                                  WindowedStepTime, default_registry,
+                                  enumerate_configs, rungs_for,
+                                  seed_gp_for_cycle_time,
+                                  seed_points_from_legacy_log,
+                                  successive_halving)
+from horovod_tpu.observability import flight_recorder as _fr
+
+
+def _autotune_events():
+    return [e[2] for e in _fr.recorder()._snapshot() if e[1] == "autotune"]
+
+
+# --------------------------------------------------------------------------
+# Knob space
+# --------------------------------------------------------------------------
+
+
+class TestKnobs:
+    def test_stock_registry_covers_every_subsystem(self):
+        reg = default_registry()
+        assert set(reg.names()) == {
+            "dcn_wire_spec", "fusion_threshold_mb", "torch_bucket_mb",
+            "pipeline_schedule", "num_microbatches", "spec_tokens",
+            "cycle_time_ms"}
+        # zb-h1 is in the schedule domain — the point the search should
+        # find at scale.
+        assert "zb-h1" in reg.get("pipeline_schedule").domain
+        assert reg.get("pipeline_schedule").safety == "rebuild"
+        assert reg.get("spec_tokens").safety == "slot"
+        assert [k.name for k in reg.continuous()] == ["cycle_time_ms"]
+        assert len(reg.discrete()) == 6
+        defaults = reg.defaults()
+        assert defaults["pipeline_schedule"] == "1f1b"
+        assert defaults["fusion_threshold_mb"] == 64
+
+    def test_include_filters(self):
+        reg = default_registry(include=("fusion_threshold_mb",))
+        assert reg.names() == ["fusion_threshold_mb"]
+        assert "dcn_wire_spec" not in reg
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="kind"):
+            Knob("k", "fuzzy", (1, 2), 1, "live", "engine_param")
+        with pytest.raises(ValueError, match="safety"):
+            Knob("k", "discrete", (1, 2), 1, "yolo", "engine_param")
+        with pytest.raises(ValueError, match="apply_via"):
+            Knob("k", "discrete", (1, 2), 1, "live", "side_door")
+        with pytest.raises(ValueError, match="lo < hi"):
+            Knob("k", "continuous", (5.0, 1.0), 2.0, "live",
+                 "engine_param")
+        with pytest.raises(ValueError, match="empty domain"):
+            Knob("k", "discrete", (), 1, "live", "engine_param")
+        with pytest.raises(ValueError, match="outside its domain"):
+            Knob("k", "discrete", (1, 2), 3, "live", "engine_param")
+
+    def test_clamp(self):
+        cont = Knob("c", "continuous", (1.0, 10.0), 5.0, "live",
+                    "engine_param")
+        assert cont.clamp(0.0) == 1.0
+        assert cont.clamp(99.0) == 10.0
+        assert cont.clamp(3.5) == 3.5
+        disc = Knob("d", "discrete", (8, 16), 8, "live", "engine_param")
+        assert disc.clamp(16) == 16
+        with pytest.raises(ValueError, match="domain"):
+            disc.clamp(12)
+
+    def test_duplicate_registration_rejected(self):
+        reg = KnobRegistry()
+        k = Knob("d", "discrete", (8, 16), 8, "live", "engine_param")
+        reg.register(k)
+        with pytest.raises(ValueError, match="already registered"):
+            reg.register(k)
+
+
+# --------------------------------------------------------------------------
+# Search: successive halving + config enumeration
+# --------------------------------------------------------------------------
+
+
+class TestSearch:
+    def test_enumerate_is_deterministic_domain_order(self):
+        a = Knob("a", "discrete", (1, 2), 1, "live", "engine_param")
+        b = Knob("b", "discrete", ("x", "y"), "x", "live",
+                 "engine_param")
+        cfgs = enumerate_configs([a, b])
+        assert cfgs == [{"a": 1, "b": "x"}, {"a": 1, "b": "y"},
+                        {"a": 2, "b": "x"}, {"a": 2, "b": "y"}]
+
+    def test_enumerate_constraint(self):
+        a = Knob("a", "discrete", (1, 2, 3), 1, "live", "engine_param")
+        cfgs = enumerate_configs([a], constraint=lambda c: c["a"] != 2)
+        assert [c["a"] for c in cfgs] == [1, 3]
+
+    def test_halving_rung_structure(self):
+        cands = [{"x": i} for i in range(16)]
+        best, trials = successive_halving(
+            cands, lambda cfg, budget: float(cfg["x"]), eta=2,
+            base_budget=2)
+        assert best == {"x": 15}
+        per_rung = {}
+        budgets = {}
+        for t in trials:
+            per_rung[t.rung] = per_rung.get(t.rung, 0) + 1
+            budgets[t.rung] = t.budget
+        assert per_rung == {0: 16, 1: 8, 2: 4, 3: 2, 4: 1}
+        assert budgets == {0: 2, 1: 4, 2: 8, 3: 16, 4: 32}
+        assert rungs_for(16) == 5
+
+    def test_halving_tie_breaks_keep_candidate_order(self):
+        cands = [{"x": i} for i in range(4)]
+        best, _ = successive_halving(cands, lambda cfg, budget: 1.0)
+        assert best == {"x": 0}
+
+    def test_halving_rejects_bad_inputs(self):
+        with pytest.raises(ValueError, match="at least one"):
+            successive_halving([], lambda c, b: 0.0)
+        with pytest.raises(ValueError, match="eta"):
+            successive_halving([{"x": 1}], lambda c, b: 0.0, eta=1)
+
+
+# --------------------------------------------------------------------------
+# GP + legacy-log seeding
+# --------------------------------------------------------------------------
+
+
+class TestGaussianProcess:
+    def test_interpolates_observations(self):
+        gp = GaussianProcess([(0.0, 100.0)])
+        gp.observe([50.0], -1.0)
+        mean, _ = gp.predict([50.0])
+        assert mean == pytest.approx(-1.0, abs=1e-3)
+
+    def test_suggest_is_deterministic(self):
+        gp = GaussianProcess([(1.0, 100.0)])
+        gp.observe([10.0], -2.0)
+        gp.observe([90.0], -1.0)
+        a, b = gp.suggest(), gp.suggest()
+        assert a == b
+        assert 1.0 <= a[0] <= 100.0
+
+    def test_empty_gp_has_infinite_ei(self):
+        gp = GaussianProcess([(0.0, 1.0)])
+        assert gp.expected_improvement([0.5]) == float("inf")
+
+    def test_legacy_log_parses_and_seeds(self, tmp_path):
+        log = tmp_path / "autotune.csv"
+        log.write_text(
+            "fusion_mb,cycle_ms,hier_allreduce,hier_allgather,score\n"
+            "64,10.0,1,0,-0.5\n"
+            "garbage,row\n"
+            "32,20.0,0,1,-0.8\n")
+        pts = seed_points_from_legacy_log(str(log))
+        assert len(pts) == 2
+        assert pts[0][0]["cycle_time_ms"] == 10.0
+        assert pts[0][1] == -0.5
+        gp = GaussianProcess([(1.0, 100.0)])
+        assert seed_gp_for_cycle_time(gp, str(log)) == 2
+        assert len(gp) == 2
+
+    def test_legacy_log_missing_or_foreign_is_cold_start(self, tmp_path):
+        assert seed_points_from_legacy_log(
+            str(tmp_path / "nope.csv")) == []
+        bad = tmp_path / "bad.csv"
+        bad.write_text("time,loss\n1,2\n")
+        assert seed_points_from_legacy_log(str(bad)) == []
+
+
+# --------------------------------------------------------------------------
+# Apply plane: the safety contract
+# --------------------------------------------------------------------------
+
+
+class TestApplyPlane:
+    def test_refuses_serving_slot_and_rebuild_even_when_injected(self):
+        reg = default_registry()
+        plane = ApplyPlane(rebuild=lambda cfg: None,
+                           set_engine_param=lambda n, v: None)
+        assert not plane.supports(reg.get("pipeline_schedule"))
+        assert not plane.supports(reg.get("spec_tokens"))
+        with pytest.raises(ValueError, match="rebuild"):
+            plane.apply(reg.get("pipeline_schedule"), "zb-h1")
+        with pytest.raises(ValueError, match="serving slot"):
+            plane.apply(reg.get("spec_tokens"), 2)
+
+    def test_missing_hook_is_unsupported_not_guessed(self):
+        reg = default_registry()
+        plane = ApplyPlane()
+        assert not plane.supports(reg.get("dcn_wire_spec"))
+        with pytest.raises(ValueError, match="no mechanism injected"):
+            plane.apply(reg.get("dcn_wire_spec"), "bf16")
+
+    def test_routes_by_apply_via(self):
+        reg = default_registry()
+        calls = []
+        plane = ApplyPlane(
+            set_wire=lambda v: calls.append(("wire", v)),
+            set_fusion=lambda v: calls.append(("fusion", v)),
+            set_bucket_mb=lambda v: calls.append(("bucket", v)),
+            set_engine_param=lambda n, v: calls.append(("engine", n, v)))
+        plane.apply(reg.get("dcn_wire_spec"), "bf16")
+        plane.apply(reg.get("fusion_threshold_mb"), 32)
+        plane.apply(reg.get("torch_bucket_mb"), 16)
+        plane.apply(reg.get("cycle_time_ms"), 5.0)
+        assert calls == [("wire", "bf16"), ("fusion", 32),
+                         ("bucket", 16), ("engine", "cycle_time_ms", 5.0)]
+
+
+# --------------------------------------------------------------------------
+# The guarded online driver
+# --------------------------------------------------------------------------
+
+
+def _tuner(measurements, **kw):
+    """AutoTuner over the fusion knob with a scripted measurement and a
+    recording fusion hook; returns (tuner, applied_values)."""
+    applied = []
+    it = iter(measurements)
+    ticks = iter(range(10_000))
+    kw.setdefault("registry", default_registry(
+        include=("fusion_threshold_mb",)))
+    t = AutoTuner(plane=ApplyPlane(set_fusion=applied.append),
+                  measure=lambda budget: next(it),
+                  clock=lambda: float(next(ticks)), **kw)
+    return t, applied
+
+
+class TestAutoTunerMoves:
+    def test_clear_win_is_kept(self):
+        t, applied = _tuner([1.0, 0.80])
+        move = t.try_move("fusion_threshold_mb", 32)
+        assert move.outcome == "kept"
+        assert t.current["fusion_threshold_mb"] == 32
+        assert applied == [32]
+        events = [p for p in _autotune_events() if p[1] ==
+                  "fusion_threshold_mb"]
+        assert [p[0] for p in events[-2:]] == ["move", "keep"]
+
+    def test_no_gain_is_reverted_through_the_same_mechanism(self):
+        t, applied = _tuner([1.0, 0.999])
+        move = t.try_move("fusion_threshold_mb", 32)
+        assert move.outcome == "reverted" and move.detail == "no_gain"
+        assert t.current["fusion_threshold_mb"] == 64
+        assert applied == [32, 64]
+
+    def test_regression_rolls_back(self):
+        t, applied = _tuner([1.0, 2.0])
+        move = t.try_move("fusion_threshold_mb", 32)
+        assert move.outcome == "rolled_back"
+        # Restored the pre-move value through the same injected hook.
+        assert t.current["fusion_threshold_mb"] == 64
+        assert applied == [32, 64]
+        events = [p for p in _autotune_events()
+                  if p[1] == "fusion_threshold_mb" and p[0] == "rollback"]
+        assert events, "rollback must land in the flight recorder"
+
+    def test_blind_move_is_not_kept(self):
+        # No measurement at all (history plane absent): never keep.
+        t, applied = _tuner([None, None])
+        move = t.try_move("fusion_threshold_mb", 32)
+        assert move.outcome == "reverted"
+        assert t.current["fusion_threshold_mb"] == 64
+
+    def test_run_sweeps_domain_and_skips_current(self):
+        # Constant step time: every candidate reverts, but the sweep
+        # still visits every non-current domain value exactly once.
+        t, applied = _tuner([1.0] * 100)
+        moves = t.run()
+        assert [m.new for m in moves] == [16, 32, 128]
+        assert all(m.outcome == "reverted" for m in moves)
+        assert applied == [16, 64, 32, 64, 128, 64]
+        assert _autotune_events()[-1][0] == "pass_done"
+
+    def test_run_skips_unsupported_knobs(self):
+        it = iter([1.0] * 100)
+        t = AutoTuner(plane=ApplyPlane(),
+                      measure=lambda b: next(it))
+        assert t.run() == []
+
+    def test_run_continuous_knob_takes_gp_suggestion(self):
+        applied = []
+        it = iter([1.0] * 10)
+        t = AutoTuner(registry=default_registry(
+                          include=("cycle_time_ms",)),
+                      plane=ApplyPlane(set_engine_param=lambda n, v:
+                                       applied.append((n, v))),
+                      measure=lambda b: next(it))
+        moves = t.run()
+        assert len(moves) == 1
+        assert applied[0][0] == "cycle_time_ms"
+        assert 1.0 <= applied[0][1] <= 100.0
+        assert len(t._gp) == 1  # the measurement fed the posterior
+
+    def test_seed_log_warm_starts_continuous_knob(self, tmp_path):
+        log = tmp_path / "legacy.csv"
+        log.write_text(
+            "fusion_mb,cycle_ms,hier_allreduce,hier_allgather,score\n"
+            "64,10.0,1,0,-0.5\n64,40.0,1,0,-0.9\n")
+        t = AutoTuner(registry=default_registry(
+                          include=("cycle_time_ms",)),
+                      seed_log=str(log))
+        assert len(t._gp) == 2
+
+
+class TestTuneRebuild:
+    def test_converges_to_best_config_under_constraint(self):
+        t = AutoTuner(registry=default_registry(
+            include=("pipeline_schedule", "num_microbatches")))
+
+        def score(cfg, budget):
+            base = 1.0 if cfg["pipeline_schedule"] == "zb-h1" else 0.0
+            return base + cfg["num_microbatches"] / 100.0
+
+        best, trials = t.tune_rebuild(
+            score, constraint=lambda c: c["num_microbatches"] >= 8)
+        assert best == {"pipeline_schedule": "zb-h1",
+                        "num_microbatches": 32}
+        assert t.current["pipeline_schedule"] == "zb-h1"
+        assert t.current["num_microbatches"] == 32
+        # 12 constrained candidates -> 12 + 6 + 3 + 1 scored trials.
+        assert len(trials) == 22
+        assert trials[-1].budget > trials[0].budget
+        events = _autotune_events()
+        assert events[-1][0] == "converged"
+        assert any(p[0] == "trial" for p in events)
+
+
+# --------------------------------------------------------------------------
+# Windowed step time over the history plane
+# --------------------------------------------------------------------------
+
+
+def _write_history(directory, rank, values):
+    path = os.path.join(directory, f"history-rank{rank}.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"history": 1, "label": f"rank{rank}",
+                            "rank": rank, "world": 2}) + "\n")
+        for i, v in enumerate(values):
+            f.write(json.dumps({
+                "t_us": (i + 1) * 1_000_000,
+                "s": {'hvdtpu_step_seconds{framework="jax"}|mean': v,
+                      'hvdtpu_allreduce_seconds|mean': 99.0}}) + "\n")
+    return path
+
+
+class TestWindowedStepTime:
+    def test_means_last_window_across_ranks(self, tmp_path):
+        _write_history(str(tmp_path), 0, [9.0, 1.0, 2.0])
+        _write_history(str(tmp_path), 1, [9.0, 3.0, 4.0])
+        src = WindowedStepTime([str(tmp_path)], window=2)
+        # Last 2 samples of each rank; the allreduce series is ignored.
+        assert src.read() == pytest.approx((1 + 2 + 3 + 4) / 4)
+
+    def test_missing_history_reads_none(self, tmp_path):
+        assert WindowedStepTime([str(tmp_path)]).read() is None
+
+    def test_foreign_series_only_reads_none(self, tmp_path):
+        path = os.path.join(str(tmp_path), "history-rank0.jsonl")
+        with open(path, "w") as f:
+            f.write(json.dumps({"history": 1, "rank": 0}) + "\n")
+            f.write(json.dumps({
+                "t_us": 1_000_000,
+                "s": {"hvdtpu_allreduce_seconds|mean": 1.0}}) + "\n")
+        assert WindowedStepTime([path]).read() is None
+
+
+# --------------------------------------------------------------------------
+# Per-slot spec_tokens AIMD controller
+# --------------------------------------------------------------------------
+
+
+class TestSpecTokensController:
+    def test_optimistic_start_then_multiplicative_backoff(self):
+        c = SpecTokensController(4)
+        assert c.slot_k(7) == 4  # optimistic start at the cap
+        ks = [c.observe(7, proposed=4, accepted=0) for _ in range(4)]
+        # EWMA decays 1.0 -> .5 -> .25 -> .125 -> .0625: halves twice.
+        assert ks == [4, 4, 2, 1]
+        events = [p for p in _autotune_events() if p[0] == "spec_backoff"]
+        assert len(events) >= 2
+
+    def test_additive_raise_after_recovery(self):
+        c = SpecTokensController(4)
+        for _ in range(4):
+            c.observe(0, 4, 0)
+        assert c.slot_k(0) == 1
+        ks = [c.observe(0, 1, 1) for _ in range(6)]
+        # AIMD: +1 per good step once the EWMA clears the raise bar.
+        assert ks[-1] == 4
+        assert sorted(set(ks)) == list(range(ks[0], 5))
+
+    def test_plain_step_probe(self):
+        c = SpecTokensController(8, probe_every=16)
+        for _ in range(8):
+            c.observe(3, 8, 0)
+        assert c.slot_k(3) == 1
+        for i in range(15):
+            assert c.note_plain_step(3) == 1
+        assert c.note_plain_step(3) == 2  # 16th plain step probes
+        st = c._slots[3]
+        assert st.ewma >= 0.5 and st.plain_steps == 0
+        assert any(p[0] == "spec_probe" for p in _autotune_events())
+
+    def test_note_plain_step_noop_above_k1(self):
+        c = SpecTokensController(4, probe_every=2)
+        for _ in range(5):
+            assert c.note_plain_step(0) == 4
+
+    def test_width_is_batch_max(self):
+        c = SpecTokensController(6)
+        for _ in range(8):
+            c.observe(0, 6, 0)
+        assert c.slot_k(0) == 1 and c.slot_k(1) == 6
+        assert c.width([0, 1]) == 6
+        assert c.width([0]) == 1
+        assert c.width([]) == 6
+        c.reset(1)
+        assert 1 not in c._slots
+
+    def test_k_max_validation(self):
+        with pytest.raises(ValueError, match="k_max"):
+            SpecTokensController(0)
+
+
+# --------------------------------------------------------------------------
+# Satellite: the wire-epoch arbiter serializes ladder + tuner
+# --------------------------------------------------------------------------
+
+
+class TestWireEpochArbiter:
+    def _arb(self):
+        from horovod_tpu.ops.control_plane import WireEpochArbiter
+        seq = {"v": 0}
+        arb = WireEpochArbiter(threading.Lock(), lambda: seq["v"])
+        return arb, seq
+
+    def test_noop_rejected(self):
+        arb, _ = self._arb()
+        assert arb.propose_wire("tuner", "") == {
+            "accepted": False, "from_seq": 0, "reason": "noop"}
+        arb.propose_wire("tuner", "bf16")
+        assert arb.propose_wire("ladder", "bf16")["reason"] == "noop"
+
+    def test_tuner_rejected_against_pending_ladder(self):
+        arb, _ = self._arb()
+        assert arb.propose_wire("ladder", "bf16")["accepted"]
+        res = arb.propose_wire("tuner", "int8x256")
+        assert res == {"accepted": False, "from_seq": 0,
+                       "reason": "conflict_with_ladder"}
+        assert arb.wire_epochs == [(0, "bf16")]
+
+    def test_ladder_replaces_pending_tuner(self):
+        arb, _ = self._arb()
+        assert arb.propose_wire("tuner", "bf16")["accepted"]
+        res = arb.propose_wire("ladder", "int8x256")
+        assert res == {"accepted": True, "from_seq": 0,
+                       "reason": "replaced_tuner"}
+        # The tuner's unplanned entry is GONE, not shadowed: ranks must
+        # never see two values stamped at one seq.
+        assert arb.wire_epochs == [(0, "int8x256")]
+        assert arb._wire_src == ["ladder"]
+
+    def test_same_source_restamps(self):
+        arb, _ = self._arb()
+        arb.propose_wire("ladder", "bf16")
+        res = arb.propose_wire("ladder", "int8x256")
+        assert res["accepted"] and res["reason"] == "ok"
+        assert arb.wire_epochs == [(0, "bf16"), (0, "int8x256")]
+
+    def test_planned_seq_frees_the_next_epoch(self):
+        arb, seq = self._arb()
+        arb.propose_wire("ladder", "bf16")
+        seq["v"] = 3  # groups got planned; the pending seq moved on
+        res = arb.propose_wire("tuner", "int8x256")
+        assert res == {"accepted": True, "from_seq": 3, "reason": "ok"}
+        assert arb.wire_epochs == [(0, "bf16"), (3, "int8x256")]
+
+    def test_fusion_list_is_independent(self):
+        arb, _ = self._arb()
+        assert arb.propose_wire("ladder", "bf16")["accepted"]
+        res = arb.propose_fusion("tuner", 1 << 20)
+        assert res["accepted"] and res["reason"] == "ok"
+        assert arb.fusion_epochs == [(0, 1 << 20)]
+
+
+class TestCoordinatorTunerMoves:
+    """Satellite regression: both planes live on one coordinator — the
+    ladder and the tuner must serialize through the arbiter, and every
+    rank's fetched params must carry ONE consistent epoch list."""
+
+    @pytest.fixture
+    def svc(self):
+        from horovod_tpu.ops.control_plane import CoordinatorService
+        from horovod_tpu.runner.secret import make_secret_key
+        s = CoordinatorService(nproc=2, key=make_secret_key(),
+                               fusion_threshold=1024, native=False)
+        yield s
+        s.shutdown()
+
+    def _clients(self, svc):
+        from horovod_tpu.ops.control_plane import CoordinatorClient
+        return (CoordinatorClient([("127.0.0.1", svc.port)], svc.key, 0),
+                CoordinatorClient([("127.0.0.1", svc.port)], svc.key, 1))
+
+    def _plan_one(self, svc, c0, c1, name):
+        req = {"name": name, "op": 0, "dtype": "float32", "shape": (4,),
+               "root_rank": -1}
+        c0.announce([req])
+        c1.announce([req])
+        assert c0.fetch(wait_s=2.0).groups
+        return c1.fetch(wait_s=2.0)
+
+    def test_rpc_moves_arbitrate_against_the_ladder(self, svc):
+        c0, c1 = self._clients(svc)
+        # Tuner stamps a fusion epoch: fractional MB lands in bytes.
+        res = c0.tuner_move("fusion_threshold_mb", 0.0005)
+        assert res["accepted"] and res["from_seq"] == 0
+        assert svc.fusion_threshold == int(0.0005 * (1 << 20))
+        # Tuner stamps a wire epoch, then the ladder reacts in the SAME
+        # planning gap: health outranks optimization.
+        assert c0.tuner_move("dcn_wire_spec", "bf16")["accepted"]
+        lad = svc._publish_wire_epoch("int8x256")
+        assert lad["reason"] == "replaced_tuner"
+        # And the tuner cannot take the seq back...
+        res = c0.tuner_move("dcn_wire_spec", "fp8x256")
+        assert res == {"accepted": False, "from_seq": 0,
+                       "reason": "conflict_with_ladder"}
+        # ...nor restamp the ladder's value as its own (noop).
+        assert c0.tuner_move(
+            "dcn_wire_spec", "int8x256")["reason"] == "noop"
+        assert c0.tuner_move("warp_speed", 9)["reason"] == "unknown_knob"
+        # Planning a group moves the pending seq; the tuner is free
+        # again at the NEXT epoch boundary.
+        resp = self._plan_one(svc, c0, c1, "t0")
+        res = c0.tuner_move("dcn_wire_spec", "bf16")
+        assert res["accepted"] and res["from_seq"] == 1
+        # Every rank's fetch now ships the one arbitrated history.
+        resp0 = self._plan_one(svc, c0, c1, "t1")
+        assert resp0.params["wire_epochs"] == [[0, "int8x256"],
+                                               [1, "bf16"]]
+        assert resp0.params["fusion_epochs"] == [[0, 524]]
+        assert resp0.params["fusion_threshold"] == 524
+
+    def test_cycle_time_moves_apply_live(self, svc):
+        c0, c1 = self._clients(svc)
+        res = c0.tuner_move("cycle_time_ms", 7.5)
+        assert res == {"accepted": True, "from_seq": -1,
+                       "reason": "live"}
+        resp = self._plan_one(svc, c0, c1, "u0")
+        assert resp.params["cycle_time_ms"] == 7.5

@@ -137,9 +137,33 @@ class TestCLI:
         _write_run(tmp_path / "cur", step_s=0.012)
         proc = self._run(str(tmp_path / "cur"), "--baseline",
                          str(tmp_path / "base"))
-        assert proc.returncode == 0, proc.stderr[-2000:]
+        # A baseline diff that FOUND regressions exits 3 — the CI-gate
+        # contract (docs/health.md#baseline).
+        assert proc.returncode == 3, proc.stderr[-2000:]
         assert "REGRESSED" in proc.stdout
         assert "step_seconds" in proc.stdout
+
+    def test_cli_baseline_clean_exits_0(self, tmp_path):
+        """ACCEPTANCE (CI shape): two identical benches diffed with
+        --baseline --json exit 0 with verdict no_regressions — the
+        exact invocation a perf gate runs."""
+        _write_run(tmp_path / "a", step_s=0.010)
+        _write_run(tmp_path / "b", step_s=0.010)
+        proc = self._run(str(tmp_path / "a"), "--baseline",
+                         str(tmp_path / "b"), "--json")
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        report = json.loads(proc.stdout)
+        assert report["baseline"]["verdict"] == "no_regressions"
+        assert report["baseline"]["regressions"] == []
+
+    def test_cli_baseline_regressed_json_exits_3(self, tmp_path):
+        _write_run(tmp_path / "base", step_s=0.010)
+        _write_run(tmp_path / "cur", step_s=0.013)
+        proc = self._run(str(tmp_path / "cur"), "--baseline",
+                         str(tmp_path / "base"), "--json")
+        assert proc.returncode == 3
+        report = json.loads(proc.stdout)
+        assert report["baseline"]["verdict"] == "regressions"
 
     def test_cli_missing_dir_exits_2(self, tmp_path):
         proc = self._run(str(tmp_path / "nope"))

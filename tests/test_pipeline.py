@@ -122,10 +122,30 @@ class TestScheduleInfo:
 
     def test_bubble_shrinks_with_microbatches(self):
         for sched, kw in [("gpipe", {}), ("1f1b", {}),
-                          ("interleaved", {"num_virtual": 2})]:
+                          ("interleaved", {"num_virtual": 2}),
+                          ("zb-h1", {})]:
             shares = [schedule_info(sched, 4, m, **kw).bubble_share
                       for m in (4, 8, 16, 32)]
             assert shares == sorted(shares, reverse=True), (sched, shares)
+
+    def test_zb_h1_closed_form(self):
+        # Backward split cB = cBx + cBw (even halves): only cBx rides
+        # the fill/drain skew, so with cB=2 the bubble is
+        # 2(n-1)/(3m + 2(n-1)) — 1/3 at n=m=4 vs 1f1b's 3/7.
+        s = schedule_info("zb-h1", 4, 4)
+        assert s.bubble_share == pytest.approx(1 / 3)
+        assert s.ticks == {"warmup": 3, "steady": 4, "drain": 3}
+        assert schedule_info("zb-h1", 4, 16).bubble_share == \
+            pytest.approx(6 / 54)
+
+    @pytest.mark.parametrize("n", [2, 4])
+    @pytest.mark.parametrize("m", [4, 8, 16])
+    def test_zb_h1_strictly_below_1f1b(self, n, m):
+        # The acceptance bar: at equal microbatch counts the static
+        # bubble is STRICTLY below 1f1b's for every n > 1.
+        zb = schedule_info("zb-h1", n, m).bubble_share
+        o = schedule_info("1f1b", n, m).bubble_share
+        assert zb < o, (n, m, zb, o)
 
     def test_1f1b_closed_form(self):
         # Residual stashing removes the recompute: bubble is exactly
@@ -145,7 +165,9 @@ class TestScheduleInfo:
 
     def test_validation(self):
         with pytest.raises(ValueError, match="unknown"):
-            schedule_info("zb-h1", 4, 8)
+            schedule_info("dualpipe", 4, 8)
+        with pytest.raises(ValueError, match="zb-h1"):
+            schedule_info("zb-h1", 4, 2)
         with pytest.raises(ValueError, match="multiple"):
             schedule_info("interleaved", 4, 6, num_virtual=2)
         with pytest.raises(ValueError, match="multiple"):
@@ -220,7 +242,7 @@ class TestScheduleParity:
     """The flagship guarantee: every schedule's loss and per-stage
     gradients equal the single-program reference at rtol 1e-5."""
 
-    @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+    @pytest.mark.parametrize("schedule", ["gpipe", "1f1b", "zb-h1"])
     @pytest.mark.parametrize("n,m", [(2, 4), (2, 8), (4, 4), (4, 8)])
     def test_matches_single_program(self, schedule, n, m):
         loss, grads, ref_loss, ref_grads, _ = _run_pipeline(
@@ -244,14 +266,21 @@ class TestScheduleParity:
         assert _grad_errs(grads, ref_grads, 4, 1) < 1e-5
 
     def test_schedules_agree_with_each_other(self):
-        """gpipe and 1f1b are the same math on different schedules —
-        they must agree with each other as tightly as with the oracle."""
+        """gpipe, 1f1b and zb-h1 are the same math on different
+        schedules — they must agree with each other as tightly as with
+        the oracle (zb-h1's Bx and W come from the same VJP closure the
+        fused backward calls)."""
         l1, g1, _, _, _ = _run_pipeline("gpipe", 4, 8, seed=5)
-        l2, g2, _, _, _ = _run_pipeline("1f1b", 4, 8, seed=5)
-        assert abs(float(l1) - float(l2)) < 1e-6
-        for a, b in zip(jax.tree_util.tree_leaves(g1),
-                        jax.tree_util.tree_leaves(g2)):
-            assert float(jnp.max(jnp.abs(a - b))) < 1e-6
+        for sched in ("1f1b", "zb-h1"):
+            l2, g2, _, _, _ = _run_pipeline(sched, 4, 8, seed=5)
+            assert abs(float(l1) - float(l2)) < 1e-6, sched
+            for a, b in zip(jax.tree_util.tree_leaves(g1),
+                            jax.tree_util.tree_leaves(g2)):
+                assert float(jnp.max(jnp.abs(a - b))) < 1e-6, sched
+
+    def test_zb_h1_needs_enough_microbatches(self):
+        with pytest.raises(ValueError, match="zb-h1"):
+            _run_pipeline("zb-h1", 4, 3)
 
     def test_unknown_schedule_rejected(self):
         mesh = create_mesh(devices=jax.devices()[:2], pp=2)
@@ -262,6 +291,150 @@ class TestScheduleParity:
                     schedule="dualpipe"),
                 mesh=mesh, in_specs=(P(),), out_specs=(P(), P()),
                 check_vma=False))(jnp.ones((2, 2, 2)))
+
+
+def _head_loss(lp, y, tgt):
+    return jnp.mean((y @ lp["w"] - tgt) ** 2)
+
+
+def _run_pipeline_heads(schedule, n, m, d=4, mb=2, seed=0):
+    """Pipeline run with the loss-head extensions armed: trainable
+    loss_params, per-microbatch loss_aux targets, and input grads."""
+    mesh = create_mesh(devices=jax.devices()[:n], pp=n)
+    stages = _make_stages(n, d, seed)
+    rng = np.random.RandomState(200 + seed)
+    x = jnp.asarray(rng.randn(m, mb, d), jnp.float32)
+    tgt = jnp.asarray(rng.randn(m, mb, d), jnp.float32)
+    lp = {"w": jnp.asarray(rng.randn(d, d), jnp.float32) * 0.3}
+    packed = _pack_stages(stages, n, 1)
+
+    def run(p_local, lp, x, tgt):
+        p = jax.tree_util.tree_map(lambda l: l[0], p_local)
+        loss, g, extras = pipeline_value_and_grad(
+            _stage_fn, _head_loss, p, x, axis_name="pp",
+            schedule=schedule, loss_aux=tgt, loss_params=lp,
+            return_input_grads=True)
+        return (loss, jax.tree_util.tree_map(lambda l: l[None], g),
+                extras["loss_params_grads"], extras["input_grads"])
+
+    f = jax.jit(jax.shard_map(
+        run, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), packed),
+                  P(), P(), P()),
+        out_specs=(P(), P("pp"), P(), P()), check_vma=False))
+    loss, grads, lp_g, x_g = f(packed, lp, x, tgt)
+
+    def total(stages, lp, x):
+        losses = []
+        for j in range(m):
+            h = x[j]
+            for p in stages:
+                h = _stage_fn(p, h)
+            losses.append(_head_loss(lp, h, tgt[j]))
+        return jnp.mean(jnp.asarray(losses))
+
+    ref_loss, (ref_g, ref_lp_g, ref_x_g) = jax.value_and_grad(
+        total, argnums=(0, 1, 2))(stages, lp, x)
+    return (loss, grads, lp_g, x_g), (ref_loss, ref_g, ref_lp_g, ref_x_g)
+
+
+class TestPipelineLossHeads:
+    """The loss-head extensions (docs/pipeline.md): trainable
+    loss_params gradients psum'd from the last stage, per-microbatch
+    loss_aux, and stage-0 input grads — on the fused AND the
+    split-backward (zb-h1) schedules."""
+
+    @pytest.mark.parametrize("schedule", ["gpipe", "1f1b", "zb-h1"])
+    def test_heads_match_oracle(self, schedule):
+        n, m = 4, 8
+        (loss, grads, lp_g, x_g), (ref_loss, ref_g, ref_lp_g, ref_x_g) \
+            = _run_pipeline_heads(schedule, n, m)
+        assert abs(float(loss) - float(ref_loss)) <= \
+            1e-5 * max(abs(float(ref_loss)), 1e-9)
+        assert _grad_errs(grads, ref_g, n, 1) < 1e-5
+        for a, b in zip(jax.tree_util.tree_leaves(lp_g),
+                        jax.tree_util.tree_leaves(ref_lp_g)):
+            denom = max(float(jnp.max(jnp.abs(b))), 1e-9)
+            assert float(jnp.max(jnp.abs(a - b))) / denom < 1e-5
+        denom = max(float(jnp.max(jnp.abs(ref_x_g))), 1e-9)
+        assert float(jnp.max(jnp.abs(x_g - ref_x_g))) / denom < 1e-5
+
+
+class TestPipelineTrainStep:
+    """build_pipeline_train_step cuts the flagship transformer over
+    'pp' automatically; one optimizer step must match the unsharded
+    single-program step (tied embedding: input-path pullback + softmax
+    head) at rtol 1e-5."""
+
+    def _cfg(self):
+        return tfm.TransformerConfig(
+            vocab=64, d_model=16, n_heads=2, n_layers=4, d_ff=32,
+            max_seq=8, dtype=jnp.float32, use_flash=False, remat=False)
+
+    def _parity(self, schedule, n, V=1, m=4):
+        import optax
+        from horovod_tpu.parallel.train import (build_pipeline_train_step,
+                                                from_pipeline_params,
+                                                to_pipeline_params)
+        cfg = self._cfg()
+        B, S = 8, cfg.max_seq
+        rng = np.random.RandomState(11)
+        tokens = jnp.asarray(rng.randint(0, cfg.vocab, (B, S)), jnp.int32)
+        targets = jnp.asarray(rng.randint(0, cfg.vocab, (B, S)),
+                              jnp.int32)
+        params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+        opt = optax.sgd(0.05)
+
+        # Single-program oracle: one SGD step on the flat layout.
+        loss_ref, grads_ref = jax.value_and_grad(
+            lambda p: tfm.loss_fn(p, tokens, targets, cfg))(params)
+        updates, _ = opt.update(grads_ref, opt.init(params), params)
+        params_ref = optax.apply_updates(params, updates)
+
+        mesh = create_mesh(devices=jax.devices()[:n], pp=n)
+        make, shard_params, shard_batch = build_pipeline_train_step(
+            cfg, mesh, opt, schedule=schedule, num_virtual=V)
+        pparams = to_pipeline_params(cfg, params, n, V)
+        opt_state = opt.init(pparams)
+        step, _ = make(pparams, opt_state)
+        pparams = shard_params(pparams)
+        tok_mb = shard_batch(tokens.reshape(m, B // m, S))
+        tgt_mb = shard_batch(targets.reshape(m, B // m, S))
+        pparams, opt_state, loss = step(pparams, opt_state, tok_mb,
+                                        tgt_mb)
+        assert abs(float(loss) - float(loss_ref)) <= \
+            1e-5 * max(abs(float(loss_ref)), 1e-9), schedule
+        back = from_pipeline_params(cfg, jax.device_get(pparams), n, V)
+        flat_a = jax.tree_util.tree_leaves(back)
+        flat_b = jax.tree_util.tree_leaves(params_ref)
+        for a, b in zip(flat_a, flat_b):
+            denom = max(float(jnp.max(jnp.abs(b))), 1e-9)
+            assert float(jnp.max(jnp.abs(a - b))) / denom < 1e-5, schedule
+
+    @pytest.mark.parametrize("schedule", ["1f1b", "zb-h1"])
+    def test_flagship_step_matches_single_program(self, schedule):
+        self._parity(schedule, n=4)
+
+    def test_interleaved_flagship_step(self):
+        self._parity("interleaved", n=2, V=2)
+
+    def test_rejects_non_pp_mesh(self):
+        import optax
+        from horovod_tpu.parallel.train import build_pipeline_train_step
+        cfg = self._cfg()
+        mesh = create_mesh(devices=jax.devices()[:4], dp=4)
+        with pytest.raises(ValueError, match="pp"):
+            build_pipeline_train_step(cfg, mesh, optax.sgd(0.1))
+
+    def test_rejects_indivisible_layers(self):
+        import optax
+        from horovod_tpu.parallel.train import build_pipeline_train_step
+        cfg = tfm.TransformerConfig(
+            vocab=64, d_model=16, n_heads=2, n_layers=6, d_ff=32,
+            max_seq=8, dtype=jnp.float32, use_flash=False, remat=False)
+        mesh = create_mesh(devices=jax.devices()[:4], pp=4)
+        with pytest.raises(ValueError, match="divide"):
+            build_pipeline_train_step(cfg, mesh, optax.sgd(0.1))
 
 
 class TestPipelineWithDataParallel:

@@ -1165,3 +1165,83 @@ class TestServerHTTP:
         finally:
             srv._httpd.shutdown()
             srv._httpd.server_close()
+
+
+class TestSpecAdapt:
+    """Per-slot adaptive draft length (spec_adapt=True, docs/autotune.md):
+    the engine's AIMD controller backs a hopeless drafter off to k=1 —
+    falling back to the plain decode path — while keeping greedy output
+    TOKEN-IDENTICAL to the non-adaptive engine, and probes back up on
+    the plain-step clock."""
+
+    @pytest.fixture(scope="class")
+    def zero_drafter(self):
+        # All-zero weights: argmax token 0 every position — proposals
+        # essentially never match the flagship, the deterministic
+        # worst-case acceptance the controller must survive.
+        dcfg = tfm.TransformerConfig(
+            vocab=64, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+            max_seq=64, dtype=jnp.float32, remat=False)
+        dparams = jax.tree_util.tree_map(
+            lambda x: x * 0.0, tfm.init_params(dcfg, jax.random.PRNGKey(9)))
+        return dcfg, dparams
+
+    def test_requires_drafter(self, model, mesh1):
+        cfg, params = model
+        with pytest.raises(ValueError, match="drafter"):
+            _engine(params, cfg, mesh1, spec_tokens=4, spec_adapt=True)
+
+    def test_backs_off_to_plain_decode_token_identical(
+            self, model, mesh1, zero_drafter):
+        from horovod_tpu.observability import flight_recorder as _fr
+        cfg, params = model
+        dcfg, dparams = zero_drafter
+        ref_eng = _engine(params, cfg, mesh1, max_new_tokens=24)
+        eng = _engine(params, cfg, mesh1, spec_tokens=4, spec_adapt=True,
+                      max_new_tokens=24, draft_params=dparams,
+                      draft_cfg=dcfg)
+        n0 = len(_fr.recorder()._snapshot())
+        prompts = [[7, 3, 11], [2] * 5, [40, 1]]
+        reqs = [eng.submit(p, max_new_tokens=24) for p in prompts]
+        eng.run_until_idle()
+        out = [r.result() for r in reqs]
+        # Adaptation changes THROUGHPUT, never tokens.
+        assert out == [ref_eng.generate(p, max_new_tokens=24)
+                       for p in prompts]
+        # Every slot's k collapsed to the floor (a later probe may have
+        # lifted it back to 2 — never beyond under zero acceptance).
+        ctl = eng._spec_ctl
+        ks = [s.k_eff for s in ctl._slots.values()]
+        assert ks and max(ks) <= 2
+        events = [p for _, kind, p in _fr.recorder()._snapshot()[n0:]
+                  if kind == "autotune"]
+        floors = [p for p in events
+                  if p[0] == "spec_backoff" and p[2] == "1"]
+        assert len({p[5] for p in floors}) == len(prompts)
+
+    def test_probe_fires_on_the_plain_step_clock(
+            self, model, mesh1, zero_drafter):
+        from horovod_tpu.observability import flight_recorder as _fr
+        cfg, params = model
+        dcfg, dparams = zero_drafter
+        eng = _engine(params, cfg, mesh1, spec_tokens=4, spec_adapt=True,
+                      max_new_tokens=64, draft_params=dparams,
+                      draft_cfg=dcfg)
+        n0 = len(_fr.recorder()._snapshot())
+        # One long request: back off (~4 spec steps), then enough plain
+        # steps to trip the probe_every=16 clock at least once.
+        eng.generate([5, 9, 2], max_new_tokens=40)
+        events = [p for _, kind, p in _fr.recorder()._snapshot()[n0:]
+                  if kind == "autotune"]
+        assert any(p[0] == "spec_probe" for p in events)
+
+    def test_adaptive_self_drafter_keeps_full_width(self, model, mesh1):
+        # A perfect drafter (the flagship itself) never backs off: the
+        # controller's optimistic k sticks at the cap.
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1, spec_tokens=4, spec_adapt=True,
+                      draft_params=params, draft_cfg=cfg)
+        ref_eng = _engine(params, cfg, mesh1)
+        p = [1, 2, 3]
+        assert eng.generate(p) == ref_eng.generate(p)
+        assert all(s.k_eff == 4 for s in eng._spec_ctl._slots.values())

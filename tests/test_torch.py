@@ -708,3 +708,112 @@ class TestResultAliasing:
         out = hvd_torch.allreduce_(t, average=False)
         assert out is t
         assert torch.allclose(t, torch.full((8,), float(hvd.size())))
+
+
+class TestBucketRepartition:
+    """Online bucket re-partition (``set_bucket_cap_mb``) — the global
+    autotuner's ``torch_bucket_mb`` knob, safety class ``boundary``
+    (docs/torch.md, docs/autotune.md): gradients after a mid-run
+    re-partition must equal a fresh optimizer built with the new cap
+    from the start; the move must refuse to run while bucket
+    collectives are in flight; grad views must re-alias into the new
+    flat buffers."""
+
+    def _model(self, seed=0):
+        torch.manual_seed(seed)
+        return torch.nn.Sequential(
+            torch.nn.Linear(16, 32), torch.nn.Tanh(),
+            torch.nn.Linear(32, 32), torch.nn.Tanh(),
+            torch.nn.Linear(32, 4))
+
+    def _wrap(self, model, **kw):
+        return hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.0),
+            named_parameters=model.named_parameters(), **kw)
+
+    def test_repartition_equals_fresh_static_cap(self):
+        model = self._model()
+        opt = self._wrap(model, bucket_cap_mb=0.001)
+        torch.manual_seed(7)
+        model(torch.rand(8, 16)).sum().backward()
+        opt.step()
+        assert len(opt._buckets) > 1
+        opt.zero_grad()
+        opt.set_bucket_cap_mb(64)
+        assert len(opt._buckets) == 1  # tiny model, one 64 MB bucket
+        torch.manual_seed(7)
+        model(torch.rand(8, 16)).sum().backward()
+        opt.synchronize()
+        moved = {n: p.grad.detach().clone()
+                 for n, p in model.named_parameters()}
+
+        fresh_model = self._model()
+        fresh = self._wrap(fresh_model, bucket_cap_mb=64)
+        torch.manual_seed(7)
+        fresh_model(torch.rand(8, 16)).sum().backward()
+        fresh.synchronize()
+        for n, p in fresh_model.named_parameters():
+            assert torch.equal(moved[n], p.grad), n
+
+    def test_in_flight_collectives_refuse_the_move(self):
+        model = self._model()
+        opt = self._wrap(model, bucket_cap_mb=0.001)
+        model(torch.rand(8, 16)).sum().backward()
+        assert opt._handles  # bucket allreduces launched by the hooks
+        with pytest.raises(RuntimeError, match="in flight"):
+            opt.set_bucket_cap_mb(32)
+        opt.synchronize()
+        opt.set_bucket_cap_mb(32)  # boundary reached: now legal
+
+    def test_bucketless_optimizer_rejects_repartition(self):
+        model = self._model()
+        opt = self._wrap(model, bucket_cap_mb=0)
+        with pytest.raises(ValueError, match="already"):
+            opt.set_bucket_cap_mb(32)
+        opt2 = self._wrap(self._model(), bucket_cap_mb=0.001)
+        with pytest.raises(ValueError, match="positive"):
+            opt2.set_bucket_cap_mb(0)
+
+    def test_grad_views_realias_and_content_survives(self):
+        model = self._model()
+        opt = self._wrap(model, bucket_cap_mb=0.001,
+                         gradient_as_bucket_view=True)
+        torch.manual_seed(7)
+        model(torch.rand(8, 16)).sum().backward()
+        opt.step()
+        before = {n: p.grad.detach().clone()
+                  for n, p in model.named_parameters()}
+        old_buffers = [b.buffer.data_ptr() for b in opt._buckets]
+        opt.set_bucket_cap_mb(64)
+        new_buffers = {b.buffer.data_ptr() for b in opt._buckets}
+        assert not new_buffers & set(old_buffers)
+        for n, p in model.named_parameters():
+            # Aliased into the NEW flat buffer, content preserved (the
+            # move clones grads out of the dying storage first).
+            assert opt._grad_is_view(p), n
+            assert torch.equal(p.grad, before[n]), n
+        # The re-targeted hooks keep training: next step bitwise-matches
+        # a fresh static-cap optimizer with views.
+        opt.zero_grad()
+        torch.manual_seed(11)
+        model(torch.rand(8, 16)).sum().backward()
+        opt.synchronize()
+        fresh_model = self._model()
+        fresh = self._wrap(fresh_model, bucket_cap_mb=64,
+                           gradient_as_bucket_view=True)
+        torch.manual_seed(11)
+        fresh_model(torch.rand(8, 16)).sum().backward()
+        fresh.synchronize()
+        for (n, p), (_, q) in zip(model.named_parameters(),
+                                  fresh_model.named_parameters()):
+            assert torch.equal(p.grad, q.grad), n
+
+    def test_repartition_leaves_flight_note(self):
+        from horovod_tpu.observability import flight_recorder as _fr
+        model = self._model()
+        opt = self._wrap(model, bucket_cap_mb=0.001)
+        n0 = len(_fr.recorder()._snapshot())
+        opt.set_bucket_cap_mb(16)
+        notes = [p for _, kind, p in _fr.recorder()._snapshot()[n0:]
+                 if kind == "autotune" and p[0] == "bucket_repartition"]
+        assert notes and notes[0][1] == "torch_bucket_mb"
