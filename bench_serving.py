@@ -661,11 +661,213 @@ print(json.dumps({
 """
 
 
+CHUNKED_WORKER = r"""
+import json, os, sys, time
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+arm = sys.argv[1]            # baseline_no_burst|unchunked_burst|chunked_burst
+n_requests = int(sys.argv[2])
+max_new = int(sys.argv[3])
+chunk = int(sys.argv[4])
+
+# The adversarial mix arrives through the declarative fault grammar
+# (docs/adaptation.md): one burst of two 1024-token prompts, fired once
+# the serving tick clears the warmup window. Env must be set before
+# the engine constructs its injector.
+if arm != "baseline_no_burst":
+    os.environ["HOROVOD_TPU_FAULT_SPEC"] = \
+        "rank=*:long_prompt_burst=2x1024:from_step=20"
+if arm == "chunked_burst":
+    os.environ["HOROVOD_TPU_SERVING_TICK_BUDGET_MS"] = "100"
+
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import horovod_tpu as hvd
+from horovod_tpu.models import transformer as tfm
+from horovod_tpu.parallel.mesh import create_mesh
+from horovod_tpu.serving import InferenceEngine, ServingConfig
+from horovod_tpu.observability import histogram_percentiles
+
+# d_model/seq sized so a monolithic 1024-bucket prefill costs several
+# decode ticks even on CPU — the stall the chunked arm must not show.
+cfg = tfm.TransformerConfig(
+    vocab=256, d_model=256, n_heads=2, n_layers=4, d_ff=512,
+    max_seq=1088, dtype=jnp.float32, remat=False)
+params = tfm.init_params(cfg, jax.random.PRNGKey(42))
+mesh = create_mesh(devices=jax.devices()[:1], tp=1)
+engine = InferenceEngine(params, cfg, mesh, ServingConfig(
+    block_size=8, kv_blocks=200, max_batch_slots=8,
+    max_queue=32, max_new_tokens=max_new, min_prefill_bucket=8,
+    prefill_chunk=chunk if arm == "chunked_burst" else None))
+
+rng = np.random.RandomState(7)
+prompts = [list(int(t) for t in rng.randint(0, 256, int(n)))
+           for n in rng.randint(10, 17, n_requests)]
+
+# Warmup compiles every bucket either arm touches — the steady 16
+# bucket, the chunk buckets (32 cap plus the 8/16 the budget policy
+# could halve to), and (unchunked) the 1024 monolithic bucket — so
+# measured tick gaps are scheduling + forwards, not XLA compiles.
+engine.generate([1] * 12, max_new_tokens=2)
+engine.generate([3] * 8, max_new_tokens=2)
+engine.generate([2] * 1024, max_new_tokens=2)
+
+snap0 = hvd.metrics_snapshot()
+t0 = time.perf_counter()
+# Steady arrivals are paced one per tick (open-loop load, not a
+# thundering herd) so the baseline's tick gap reflects steady-state
+# decode + at most one short prefill — the bound the burst arms are
+# measured against. The burst still lands all at once via the fault.
+reqs = []
+for p in prompts:
+    reqs.append(engine.submit(p))
+    engine.step()
+engine.run_until_idle()      # the burst fires and completes mid-run
+wall = time.perf_counter() - t0
+outputs = [r.result() for r in reqs]
+snap = hvd.metrics_snapshot()
+
+def cnt(name, labels=""):
+    v1 = snap.get(name, {"values": {}})["values"].get(labels, 0)
+    v0 = snap0.get(name, {"values": {}})["values"].get(labels, 0)
+    return v1 - v0
+
+def pct(name):
+    h1 = snap[name]["values"][""]
+    h0 = snap0[name]["values"].get("", {"buckets": [], "count": 0,
+                                        "sum": 0.0})
+    prev = {le: c for le, c in h0["buckets"]}
+    diff = {"buckets": [[le, c - prev.get(le, 0)]
+                        for le, c in h1["buckets"]],
+            "count": h1["count"] - h0["count"],
+            "sum": h1["sum"] - h0["sum"]}
+    return {k: round(v * 1e3, 3)
+            for k, v in histogram_percentiles(diff).items()}
+
+checksum = int(sum((i + 1) * t for o in outputs
+               for i, t in enumerate(o)) % (1 << 31))
+print(json.dumps({
+    "arm": arm,
+    "wall_ms": round(wall * 1e3, 3),
+    "steady_outputs_checksum": checksum,
+    "steady_outputs": outputs,
+    "generated_tokens": int(cnt("hvdtpu_serving_tokens_total",
+                                'kind="generated"')),
+    "decode_tick_ms": pct("hvdtpu_serving_decode_tick_seconds"),
+    "decode_ticks": int(snap["hvdtpu_serving_decode_tick_seconds"]
+                        ["values"][""]["count"]
+                        - snap0["hvdtpu_serving_decode_tick_seconds"]
+                        ["values"].get("", {"count": 0})["count"]),
+    "prefill_chunks": int(cnt("hvdtpu_serving_prefill_chunks_total")),
+    "bursts_injected": int(cnt("hvdtpu_fault_injections_total",
+                               'kind="long_prompt_burst"')),
+}))
+"""
+
+
+SESSION_WORKER = r"""
+import json, os, sys, time
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import horovod_tpu as hvd
+from horovod_tpu.models import transformer as tfm
+from horovod_tpu.parallel.mesh import create_mesh
+from horovod_tpu.serving import InferenceEngine, ServingConfig
+
+arm = sys.argv[1]            # "prefix_cache_only" | "session_affinity"
+n_sessions = int(sys.argv[2])
+n_turns = int(sys.argv[3])
+max_new = int(sys.argv[4])
+
+cfg = tfm.TransformerConfig(
+    vocab=256, d_model=64, n_heads=2, n_layers=2, d_ff=128,
+    max_seq=160, dtype=jnp.float32, remat=False)
+params = tfm.init_params(cfg, jax.random.PRNGKey(42))
+mesh = create_mesh(devices=jax.devices()[:1], tp=1)
+engine = InferenceEngine(params, cfg, mesh, ServingConfig(
+    block_size=8, kv_blocks=160, max_batch_slots=8,
+    max_queue=32, max_new_tokens=max_new, min_prefill_bucket=8,
+    prefix_cache=True,
+    session_leases=n_sessions if arm == "session_affinity" else 0))
+
+def replay(base, collect=None):
+    # Multi-turn conversations: every turn's prompt is the FULL prior
+    # context (prompt + reply) plus a fresh user utterance — the shape
+    # where the prefix cache cannot help (it never indexes generated
+    # tokens) but a session lease resumes in place.
+    ctx = {s: [(base + 7 * s + i) % 256 for i in range(24)]
+           for s in range(n_sessions)}
+    for turn in range(n_turns):
+        reqs = {}
+        for s in range(n_sessions):
+            reqs[s] = engine.submit(ctx[s], max_new_tokens=max_new,
+                                    session_id="sess-%d-%d" % (base, s))
+        engine.run_until_idle()
+        for s, r in reqs.items():
+            if collect is not None and turn > 0:
+                collect.append(r.ttft_s)
+            ctx[s] = ctx[s] + r.result() + \
+                [(base + 31 * s + 13 * turn + i) % 256 for i in range(8)]
+    return ctx
+
+replay(100)                   # warmup: compiles every turn's buckets
+snap0 = hvd.metrics_snapshot()
+ttfts = []
+t0 = time.perf_counter()
+final_ctx = replay(200, collect=ttfts)
+wall = time.perf_counter() - t0
+snap = hvd.metrics_snapshot()
+
+def cnt(name, labels=""):
+    v1 = snap.get(name, {"values": {}})["values"].get(labels, 0)
+    v0 = snap0.get(name, {"values": {}})["values"].get(labels, 0)
+    return v1 - v0
+
+ttfts.sort()
+outputs = [final_ctx[s] for s in range(n_sessions)]
+checksum = int(sum((i + 1) * t for o in outputs
+               for i, t in enumerate(o)) % (1 << 31))
+print(json.dumps({
+    "arm": arm,
+    "wall_ms": round(wall * 1e3, 3),
+    "sessions": n_sessions,
+    "turns": n_turns,
+    "followup_ttft_p50_ms": round(
+        ttfts[len(ttfts) // 2] * 1e3, 3),
+    "followup_turns_measured": len(ttfts),
+    "prefill_tokens": int(cnt("hvdtpu_serving_tokens_total",
+                              'kind="prompt"')),
+    "session_hits": int(cnt("hvdtpu_serving_session_hits_total")),
+    "session_leases": int(cnt("hvdtpu_serving_session_leases_total")),
+    "prefix_hits": int(cnt("hvdtpu_serving_prefix_cache_hits_total")),
+    "final_context_checksum": checksum,
+    "final_contexts": outputs,
+}))
+"""
+
+
 SPEED_ARMS = ("baseline", "quantized_kv", "speculative", "prefix_cache",
               "all_on")
 SPEED_REQUESTS = 8
 SPEED_MAX_NEW = 32
 SPEC_TOKENS = 8
+
+CHUNKED_ARMS = ("baseline_no_burst", "unchunked_burst", "chunked_burst")
+CHUNKED_REQUESTS = 12
+CHUNKED_MAX_NEW = 24
+CHUNKED_CHUNK = 32
+
+SESSION_ARMS = ("prefix_cache_only", "session_affinity")
+SESSION_SESSIONS = 4
+SESSION_TURNS = 4
+SESSION_MAX_NEW = 16
 
 
 def run_speed(out_path):
@@ -839,6 +1041,166 @@ def run_spec_adapt(out_path):
     print(json.dumps({"spec_adapt_headlines": headlines}))
 
 
+def _ride_along(out_path, key, row):
+    """Insert ``row`` under ``key`` in BENCH_SPEED.json, preserving the
+    other rows (the spec_adapt pattern: the levers file accretes arms)."""
+    result = None
+    if out_path and os.path.exists(out_path):
+        with open(out_path) as f:
+            result = json.load(f)
+        if result.get("metric") != "serving_speed_levers":
+            result = None
+    if result is None:
+        result = {"metric": "serving_speed_levers"}
+    result[key] = row
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+
+def run_chunked_prefill(out_path):
+    """The --chunked-prefill A/B/C: decode-tick tail latency under an
+    adversarial long-prompt burst (the ``long_prompt_burst`` fault
+    clause), three arms on the same seeded steady load:
+
+      - ``baseline_no_burst``: no long prompts — the clean tick gap.
+      - ``unchunked_burst``: two 1024-token prompts land mid-run and
+        each monolithic prefill stalls every decoding slot.
+      - ``chunked_burst``: same burst with ``prefill_chunk=32`` — at
+        most one chunk runs between ticks, so the gap stays near
+        baseline.
+
+    Headlines: chunked holds decode-tick p99 within 2x the no-burst
+    baseline while unchunked exceeds 2x, and the steady requests stay
+    token-identical across all three arms (greedy decode; chunking
+    only reorders prefill work). Writes/updates the
+    ``chunked_prefill`` row in BENCH_SPEED.json."""
+    env = dict(os.environ)
+    env.pop("HOROVOD_TPU_METRICS", None)
+    env.pop("HOROVOD_TPU_FAULT_SPEC", None)     # the worker sets it
+    env.pop("HOROVOD_TPU_SERVING_TICK_BUDGET_MS", None)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    arms = {}
+    for arm in CHUNKED_ARMS:
+        proc = subprocess.run(
+            [sys.executable, "-c", CHUNKED_WORKER, arm,
+             str(CHUNKED_REQUESTS), str(CHUNKED_MAX_NEW),
+             str(CHUNKED_CHUNK)],
+            env=env, capture_output=True, text=True, timeout=900,
+            cwd=repo)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"chunked-prefill bench arm {arm} failed:\n"
+                f"{proc.stderr[-3000:]}")
+        arms[arm] = json.loads(proc.stdout.strip().splitlines()[-1])
+
+    outputs = {a: arms[a].pop("steady_outputs") for a in arms}
+    base_p99 = arms["baseline_no_burst"]["decode_tick_ms"]["p99"]
+    unchunked_p99 = arms["unchunked_burst"]["decode_tick_ms"]["p99"]
+    chunked_p99 = arms["chunked_burst"]["decode_tick_ms"]["p99"]
+    headlines = {
+        "baseline_tick_p99_ms": base_p99,
+        "unchunked_tick_p99_ms": unchunked_p99,
+        "chunked_tick_p99_ms": chunked_p99,
+        "unchunked_p99_vs_baseline": round(
+            unchunked_p99 / max(base_p99, 1e-9), 3),
+        "chunked_p99_vs_baseline": round(
+            chunked_p99 / max(base_p99, 1e-9), 3),
+        "chunked_holds_2x_baseline": chunked_p99 <= 2.0 * base_p99,
+        "unchunked_exceeds_2x_baseline": unchunked_p99 > 2.0 * base_p99,
+        "steady_outputs_equal_across_arms": (
+            outputs["baseline_no_burst"] == outputs["unchunked_burst"]
+            == outputs["chunked_burst"]),
+    }
+    row = {
+        "requests": CHUNKED_REQUESTS,
+        "max_new_tokens": CHUNKED_MAX_NEW,
+        "prefill_chunk": CHUNKED_CHUNK,
+        "fault": "rank=*:long_prompt_burst=2x1024:from_step=20",
+        "arms": arms,
+        "headlines": headlines,
+        "note": ("Decode-tick gap (hvdtpu_serving_decode_tick_seconds) "
+                 "p99 under an adversarial long-prompt burst. "
+                 "Checksums, token/chunk/burst counts are seeded-"
+                 "deterministic (greedy decode, deterministic "
+                 "scheduler); *_ms are wall-clock. Headlines: with "
+                 "prefill_chunk=32 the burst's 1024-token prefills "
+                 "interleave one bucket-shaped chunk per tick, holding "
+                 "decode-tick p99 within 2x the no-burst baseline, "
+                 "while the unchunked arm's monolithic prefill blows "
+                 "past 2x; the steady requests are token-identical "
+                 "across all arms."),
+    }
+    _ride_along(out_path, "chunked_prefill", row)
+    print(json.dumps({"chunked_prefill_headlines": headlines}))
+
+
+def run_session_affinity(out_path):
+    """The --session-affinity A/B: multi-turn conversation replay,
+    session KV leases (session_leases=4) vs the prefix cache alone.
+    Every follow-up turn resends the full conversation so far plus a
+    fresh utterance; the prefix cache can only re-serve *prompt*
+    blocks from earlier turns (it never indexes generated tokens),
+    while a session lease resumes from the stored context and skips
+    the re-prefill entirely. Headlines: follow-up TTFT p50 below the
+    prefix-only arm, fewer prompt tokens prefilled, and final
+    conversation contexts token-identical. Writes/updates the
+    ``session_affinity`` row in BENCH_SPEED.json."""
+    env = dict(os.environ)
+    env.pop("HOROVOD_TPU_METRICS", None)
+    env.pop("HOROVOD_TPU_FAULT_SPEC", None)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    arms = {}
+    for arm in SESSION_ARMS:
+        proc = subprocess.run(
+            [sys.executable, "-c", SESSION_WORKER, arm,
+             str(SESSION_SESSIONS), str(SESSION_TURNS),
+             str(SESSION_MAX_NEW)],
+            env=env, capture_output=True, text=True, timeout=900,
+            cwd=repo)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"session-affinity bench arm {arm} failed:\n"
+                f"{proc.stderr[-3000:]}")
+        arms[arm] = json.loads(proc.stdout.strip().splitlines()[-1])
+
+    contexts = {a: arms[a].pop("final_contexts") for a in arms}
+    sess = arms["session_affinity"]
+    pfx = arms["prefix_cache_only"]
+    headlines = {
+        "session_ttft_p50_ms": sess["followup_ttft_p50_ms"],
+        "prefix_only_ttft_p50_ms": pfx["followup_ttft_p50_ms"],
+        "session_beats_prefix_ttft": (sess["followup_ttft_p50_ms"]
+                                      < pfx["followup_ttft_p50_ms"]),
+        "prefill_tokens_ratio": round(
+            sess["prefill_tokens"] / max(1, pfx["prefill_tokens"]), 3),
+        "session_hits": sess["session_hits"],
+        "contexts_equal_across_arms": (
+            contexts["session_affinity"]
+            == contexts["prefix_cache_only"]),
+    }
+    row = {
+        "sessions": SESSION_SESSIONS,
+        "turns": SESSION_TURNS,
+        "max_new_tokens": SESSION_MAX_NEW,
+        "arms": arms,
+        "headlines": headlines,
+        "note": ("Multi-turn replay (4 conversations x 4 turns, each "
+                 "turn resends the full context + 8 new tokens). "
+                 "Token counts, hit counts and checksums are seeded-"
+                 "deterministic (greedy decode); *_ms are wall-clock. "
+                 "Headlines: session leases beat the prefix-cache-only "
+                 "arm on follow-up TTFT p50 (the lease resumes past "
+                 "the generated tokens the prefix cache cannot index), "
+                 "prefill a fraction of the prompt tokens, and the "
+                 "final conversation contexts are token-identical "
+                 "across arms."),
+    }
+    _ride_along(out_path, "session_affinity", row)
+    print(json.dumps({"session_affinity_headlines": headlines}))
+
+
 def run_reqtrace(out_path, rounds=6):
     """The --reqtrace A/B: request tracing on vs off under the
     BENCH_SERVING load (8 slots, 8 concurrent requests), toggled
@@ -951,6 +1313,17 @@ def main() -> None:
                          "k with the drafter degraded mid-run; "
                          "writes/updates the spec_adapt row in "
                          "BENCH_SPEED.json (--out)")
+    ap.add_argument("--chunked-prefill", action="store_true",
+                    help="A/B/C decode-tick tail latency under a "
+                         "long_prompt_burst fault: no burst vs "
+                         "monolithic vs chunked prefill; "
+                         "writes/updates the chunked_prefill row in "
+                         "BENCH_SPEED.json (--out)")
+    ap.add_argument("--session-affinity", action="store_true",
+                    help="A/B multi-turn replay: session KV leases vs "
+                         "prefix cache alone; writes/updates the "
+                         "session_affinity row in BENCH_SPEED.json "
+                         "(--out)")
     ap.add_argument("--reqtrace", action="store_true",
                     help="A/B per-request tracing on/off under the "
                          "BENCH_SERVING load; writes "
@@ -968,6 +1341,12 @@ def main() -> None:
         return
     if args.spec_adapt:
         run_spec_adapt(args.out)
+        return
+    if args.chunked_prefill:
+        run_chunked_prefill(args.out)
+        return
+    if args.session_affinity:
+        run_session_affinity(args.out)
         return
     if args.reqtrace:
         run_reqtrace(args.out, rounds=args.reqtrace_rounds)

@@ -38,6 +38,13 @@ Grammar (clauses separated by ``;``, fields by ``:``)::
     drop_health            serving: /healthz and /readyz hang up without
                            answering while active (a live-locked front
                            end only the prober can catch)
+    long_prompt_burst=NxL  serving: once the serving tick enters the
+                           clause window, submit N synthetic requests
+                           with deterministic L-token prompts through
+                           the engine's own admission gate (bare ``=L``
+                           means N=1) — the adversarial long+short
+                           prompt mix the chunked-prefill latency bound
+                           is proven against. Fires once per clause.
 
 A *tick* is one enqueued collective on this rank — for the common
 one-fused-allreduce-per-step training loop, tick == training step. The
@@ -72,7 +79,7 @@ from __future__ import annotations
 import os
 import signal
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 from ..utils.logging import get_logger
 
@@ -99,7 +106,7 @@ class FaultClause:
     __slots__ = ("rank", "gen", "from_step", "until_step", "delay_s",
                  "slow_h2d_s", "crash_at", "drop_announce",
                  "replica_crash_at", "slow_decode_s", "slow_prefill_s",
-                 "drop_health")
+                 "drop_health", "long_prompt_burst")
 
     def __init__(self):
         self.rank: Optional[int] = None        # None == '*'
@@ -114,6 +121,7 @@ class FaultClause:
         self.slow_decode_s = 0.0
         self.slow_prefill_s = 0.0
         self.drop_health = False
+        self.long_prompt_burst: Optional[Tuple[int, int]] = None  # (N, L)
 
     def matches(self, rank: int, generation: int) -> bool:
         if self.rank is not None and self.rank != rank:
@@ -147,6 +155,9 @@ class FaultClause:
             parts.append(f"slow_prefill={self.slow_prefill_s * 1e3:g}ms")
         if self.drop_health:
             parts.append("drop_health")
+        if self.long_prompt_burst is not None:
+            n, plen = self.long_prompt_burst
+            parts.append(f"long_prompt_burst={n}x{plen}")
         if self.from_step:
             parts.append(f"from_step={self.from_step}")
         if self.until_step is not None:
@@ -203,12 +214,28 @@ def parse_spec(text: str) -> List[FaultClause]:
                     raise ValueError(
                         f"drop_health takes no value, got {value!r}")
                 c.drop_health = True
+            elif key == "long_prompt_burst":
+                # "NxL" (N prompts of L tokens) or bare "L" (one).
+                n_s, x, l_s = value.partition("x")
+                try:
+                    n, plen = (int(n_s), int(l_s)) if x \
+                        else (1, int(n_s))
+                except ValueError:
+                    raise ValueError(
+                        f"long_prompt_burst wants NxL or L (prompt "
+                        f"tokens), got {value!r}") from None
+                if n < 1 or plen < 1:
+                    raise ValueError(
+                        f"long_prompt_burst counts must be >= 1, "
+                        f"got {value!r}")
+                c.long_prompt_burst = (n, plen)
             else:
                 raise ValueError(
                     f"unknown fault-spec field {key!r} in clause {raw!r} "
                     "(expected rank/gen/from_step/until_step/delay/"
                     "slow_h2d/crash_at/drop_announce/replica_crash_at/"
-                    "slow_decode/slow_prefill/drop_health)")
+                    "slow_decode/slow_prefill/drop_health/"
+                    "long_prompt_burst)")
         if not saw_rank:
             raise ValueError(
                 f"fault-spec clause {raw!r} is missing the required "
@@ -251,7 +278,9 @@ class FaultInjector:
         self._m = {k: fam.labels(kind=k)
                    for k in ("delay", "slow_h2d", "crash", "drop_announce",
                              "replica_crash", "slow_decode",
-                             "slow_prefill", "drop_health")}
+                             "slow_prefill", "drop_health",
+                             "long_prompt_burst")}
+        self._bursts_fired: set = set()  # clause indices already fired
         if self.clauses:
             _log.warning("fault injection ARMED for rank %d gen %d: %s",
                          self.rank, self.generation,
@@ -351,6 +380,26 @@ class FaultInjector:
                 self._m["slow_prefill"].inc()
                 self._note_fault("slow_prefill", self._serving_tick)
                 time.sleep(c.slow_prefill_s)
+
+    def take_long_prompt_bursts(self) -> List[int]:
+        """Prompt lengths to inject right now: each long_prompt_burst
+        clause fires ONCE, at the first scheduler step whose serving
+        tick falls in its window (the engine consults this at the top
+        of every step and submits the synthetic requests itself — the
+        injector has no engine handle). Windowed on the decode-driven
+        serving tick like every other serving fault."""
+        out: List[int] = []
+        for i, c in enumerate(self.clauses):
+            if c.long_prompt_burst is None or i in self._bursts_fired:
+                continue
+            if not c.in_window(self._serving_tick):
+                continue
+            self._bursts_fired.add(i)
+            n, plen = c.long_prompt_burst
+            self._m["long_prompt_burst"].inc(n)
+            self._note_fault("long_prompt_burst", self._serving_tick)
+            out.extend([plen] * n)
+        return out
 
     def drop_health_active(self) -> bool:
         """True while a drop_health clause covers the current serving

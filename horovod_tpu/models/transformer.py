@@ -542,6 +542,28 @@ def _decode_block(params, x, layer_cache, tables, pos,
     return x + m, out_cache
 
 
+def prefill_spans(n_tokens: int, chunk: int, start: int = 0):
+    """``(start, length)`` spans that consume ``n_tokens`` prompt
+    positions (from absolute position ``start``) in chunks of at most
+    ``chunk`` — the calling convention for multi-chunk prefill through
+    :func:`apply_decode`: feed each span's tokens with ``starts`` set
+    to the span start, same block tables every call. Pure host-side
+    arithmetic; the serving engine's budget policy sizes chunks
+    adaptively instead, but composes calls the same way."""
+    if n_tokens < 0:
+        raise ValueError(f"n_tokens must be >= 0, got {n_tokens}")
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    out = []
+    pos = int(start)
+    end = int(start) + int(n_tokens)
+    while pos < end:
+        n = min(int(chunk), end - pos)
+        out.append((pos, n))
+        pos += n
+    return out
+
+
 def apply_decode(params, tokens, starts, block_tables, cache,
                  cfg: TransformerConfig, kv_quant=None,
                  exact_chunk: bool = False):
@@ -562,6 +584,16 @@ def apply_decode(params, tokens, starts, block_tables, cache,
     ``kv_quant`` must match the ``init_cache`` the pool was built with;
     ``exact_chunk`` (prefill only — see :func:`_decode_block`) keeps a
     from-empty quantized prefill bit-identical to the fp32 pool.
+
+    Multi-chunk prefill: a prompt may be consumed as several calls —
+    ``tokens`` the next span, ``starts`` where the previous call ended
+    (:func:`prefill_spans` computes the split). Each call's causal
+    attention covers its own chunk exactly plus everything already
+    resident in the blocks, so the composition is the same computation
+    as one monolithic call; under ``kv_quant`` the earlier chunks are
+    read back dequantized (``exact_chunk`` covers only the current
+    span), which the serving tier treats like the prefix-cache case:
+    greedy-token-identical in practice, not bitwise on logits.
     """
     if cfg.sp_axis:
         raise ValueError(

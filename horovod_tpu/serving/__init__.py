@@ -21,8 +21,8 @@ from the command line (docs/running.md).
 
 from .engine import (DEADLINE_ERROR, DrainingError, InferenceEngine,
                      QueueFullError, Request, ServingConfig)
-from .kv_cache import (BlockAllocator, PrefixCache, blocks_needed,
-                       prefix_hashes)
+from .kv_cache import (BlockAllocator, PrefixCache, SessionLeaseTable,
+                       blocks_needed, prefix_hashes)
 from .loader import (TORCH_MODEL_PREFIX, config_from_manifest,
                      load_params, serving_config, transformer_extra)
 from .fleet import Fleet, ReplicaEndpoint
@@ -32,7 +32,8 @@ __all__ = [
     "BlockAllocator", "DEADLINE_ERROR", "DrainingError", "Fleet",
     "InferenceEngine", "PrefixCache", "QueueFullError",
     "ReplicaEndpoint", "Request", "Router", "ServingConfig",
-    "StaticBackends", "TORCH_MODEL_PREFIX", "blocks_needed",
+    "SessionLeaseTable", "StaticBackends", "TORCH_MODEL_PREFIX",
+    "blocks_needed",
     "config_from_manifest", "load_params", "prefix_hashes",
     "serving_config", "transformer_extra",
 ]

@@ -48,6 +48,10 @@ def _replica_argv(args) -> list:
         argv += ["--kv-quant", args.kv_quant]
     if args.prefix_cache:
         argv += ["--prefix-cache"]
+    if args.prefill_chunk is not None:
+        argv += ["--prefill-chunk", str(args.prefill_chunk)]
+    if args.session_leases is not None:
+        argv += ["--session-leases", str(args.session_leases)]
     if args.draft_checkpoint_dir is not None:
         argv += ["--draft-checkpoint-dir", args.draft_checkpoint_dir]
         argv += ["--spec-tokens", str(args.spec_tokens)]
@@ -172,6 +176,19 @@ def main(argv=None) -> int:
                         help="share read-only KV blocks between "
                              "requests with a common prompt prefix "
                              "(system prompts prefill once per replica)")
+    parser.add_argument("--prefill-chunk", type=int, default=None,
+                        help="chunked prefill: consume long prompts as "
+                             "chunks of at most this many tokens, at "
+                             "most one chunk between decode ticks — "
+                             "bounds decode-tick tail latency under "
+                             "long-prompt bursts (docs/serving.md#"
+                             "chunked-prefill; budget via "
+                             "$HOROVOD_TPU_SERVING_TICK_BUDGET_MS)")
+    parser.add_argument("--session-leases", type=int, default=None,
+                        help="max session KV leases held per replica "
+                             "(session affinity, docs/serving.md#"
+                             "session-affinity; 0 disables; "
+                             "default 8)")
     parser.add_argument("--draft-checkpoint-dir", default=None,
                         help="drafter checkpoint for speculative "
                              "decoding (a shrunk transformer sharing "
@@ -265,7 +282,10 @@ def main(argv=None) -> int:
         kv_quant=args.kv_quant,
         spec_tokens=(args.spec_tokens if draft_params is not None
                      else 0),
-        prefix_cache=args.prefix_cache)
+        prefix_cache=args.prefix_cache,
+        prefill_chunk=args.prefill_chunk,
+        session_leases=(args.session_leases
+                        if args.session_leases is not None else 8))
     engine = InferenceEngine(params, cfg, mesh, config,
                              draft_params=draft_params,
                              draft_cfg=draft_cfg)

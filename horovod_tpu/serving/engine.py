@@ -73,10 +73,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..models import transformer as tfm
 from ..observability import flight_recorder as _flight
 from ..observability import registry as _obs
+from ..utils import env as _env
 from ..utils.logging import get_logger
 from . import reqtrace as _rt
 from .kv_cache import (SCRATCH_BLOCK, BlockAllocator, PrefixCache,
-                       blocks_needed, prefix_hashes)
+                       SessionLeaseTable, blocks_needed, prefix_hashes)
 
 _log = get_logger("serving")
 
@@ -179,6 +180,31 @@ def _metrics():
             "hvdtpu_serving_draft_accepted_tokens_total",
             "Drafter tokens accepted by the flagship's batched "
             "verification (acceptance rate = accepted/proposed)"),
+        "decode_tick": r.histogram(
+            "hvdtpu_serving_decode_tick_seconds",
+            "Gap between consecutive batched decode ticks (start to "
+            "start) while slots are decoding — the TPOT-tail bound "
+            "chunked prefill holds: with interleaving, at most one "
+            "prefill chunk fits in a gap, so its p99 tracks the chunk "
+            "budget instead of the longest prompt",
+            buckets=_obs.LATENCY_BUCKETS).labels(),
+        "prefill_chunks": r.counter(
+            "hvdtpu_serving_prefill_chunks_total",
+            "Prefill chunks executed by the interleaved chunked-"
+            "prefill path (monolithic prefills don't count here)"),
+        "session_leases": r.counter(
+            "hvdtpu_serving_session_leases_total",
+            "Session KV leases formed at request completion "
+            "(docs/serving.md#session-affinity)"),
+        "session_evictions": r.counter(
+            "hvdtpu_serving_session_evictions_total",
+            "Session leases sacrificed under pool pressure or the "
+            "lease-table cap (demoted to the prefix cache when one "
+            "is configured)"),
+        "session_hits": r.counter(
+            "hvdtpu_serving_session_hits_total",
+            "Admissions that resumed from a live session lease "
+            "(prefill skipped the stored conversation context)"),
     }
 
 
@@ -210,6 +236,14 @@ class ServingConfig:
     prefix_cache: bool = False    # shared prompt-prefix block cache
     prefix_cache_entries: Optional[int] = None  # LRU cap (None: pool-
     #                                             pressure eviction only
+    prefill_chunk: Optional[int] = None  # chunked prefill: cap on the
+    #                               per-chunk bucket (rounded to a
+    #                               power-of-two bucket); the step loop
+    #                               interleaves one chunk per decode
+    #                               tick. None = monolithic prefill.
+    session_leases: int = 8       # max session KV leases held between
+    #                               conversation turns; 0 disables
+    #                               session affinity on this replica
 
 
 class Request:
@@ -229,7 +263,8 @@ class Request:
     def __init__(self, rid: int, prompt: Sequence[int],
                  max_new_tokens: int, temperature: float,
                  deadline: Optional[float] = None,
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None,
+                 session_id: Optional[str] = None):
         self.id = rid
         # One trace id end-to-end (docs/serving.md#request-tracing):
         # the router mints it and ships it via X-Request-Id, so the
@@ -253,7 +288,16 @@ class Request:
         self.slot: Optional[int] = None
         self.blocks: List[int] = []
         self.cached_tokens = 0    # prompt tokens resident via shared
-        #                           prefix blocks (prefill skips them)
+        #                           prefix blocks or a session lease
+        #                           (prefill skips them)
+        self.session_id = str(session_id) if session_id else None
+        self.prefill_pos: Optional[int] = None  # chunked prefill
+        #                           cursor: next prompt position to
+        #                           prefill; None = not mid-prefill
+        self._prefill_s = 0.0     # accumulated chunk prefill seconds
+        self._chunks = 0          # prefill chunks run so far
+        self._hashes: List[bytes] = []  # prefix hashes pending insert
+        self._n_shared = 0        # leading hashes already cached
         self._done = threading.Event()
         self._progress = threading.Condition()
 
@@ -389,8 +433,26 @@ class InferenceEngine:
         self._alloc = BlockAllocator(c.kv_blocks)
         self._prefix = PrefixCache(self._alloc, c.prefix_cache_entries) \
             if c.prefix_cache else None
+        self._sessions = SessionLeaseTable(
+            self._alloc, int(c.session_leases)) \
+            if c.session_leases else None
         self._m["kv_total"].set(self._alloc.total)
         self._m["slots"].set(slots)
+
+        # Chunked prefill (docs/serving.md#chunked-prefill): the cap is
+        # rounded to an existing power-of-two bucket so chunking adds
+        # ZERO new compiled shapes; the budget policy below only ever
+        # halves within the same bucket family.
+        if c.prefill_chunk is not None and int(c.prefill_chunk) < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {c.prefill_chunk}")
+        self._chunk_cap = self._bucket(int(c.prefill_chunk)) \
+            if c.prefill_chunk else 0
+        self._chunk_cost: Dict[int, float] = {}  # bucket -> EWMA secs
+        budget_ms = _env.serving_tick_budget_ms()
+        self._tick_budget_s = None if budget_ms is None \
+            else budget_ms / 1e3
+        self._t_last_tick: Optional[float] = None
 
         # Serving fault injection (docs/adaptation.md): slow_decode /
         # slow_prefill / replica_crash_at ride the same declarative spec
@@ -445,7 +507,8 @@ class InferenceEngine:
                max_new_tokens: Optional[int] = None,
                temperature: Optional[float] = None,
                deadline_s: Optional[float] = None,
-               trace_id: Optional[str] = None) -> Request:
+               trace_id: Optional[str] = None,
+               session_id: Optional[str] = None) -> Request:
         """Enqueue a request; returns immediately with its ticket.
         Raises :exc:`QueueFullError` past ``max_queue`` (the HTTP 429
         path) and :exc:`DrainingError` after drain began.
@@ -455,7 +518,10 @@ class InferenceEngine:
         still queued when it expires fails with ``DEADLINE_ERROR``
         instead of occupying a slot. ``trace_id`` is the caller's
         end-to-end request identity (the router's ``X-Request-Id``);
-        None mints a local one."""
+        None mints a local one. ``session_id`` names a conversation
+        (docs/serving.md#session-affinity): completion stores a KV
+        lease under it, and a later turn whose prompt extends the
+        stored context resumes decoding instead of re-prefilling."""
         c = self.config
         max_new = int(max_new_tokens if max_new_tokens is not None
                       else c.max_new_tokens)
@@ -487,7 +553,8 @@ class InferenceEngine:
             deadline = None if deadline_s is None \
                 else time.monotonic() + float(deadline_s)
             req = Request(self._next_id, prompt, max_new, temp,
-                          deadline=deadline, trace_id=trace_id)
+                          deadline=deadline, trace_id=trace_id,
+                          session_id=session_id)
             self._next_id += 1
             self._queue.append(req)
             self._m["queue_depth"].set(len(self._queue))
@@ -514,6 +581,21 @@ class InferenceEngine:
         return sum(1 for r in self._reqs if r is not None)
 
     @property
+    def _decodable_count(self) -> int:
+        """Live slots past prefill — the batched decode's real width
+        (mid-chunked-prefill slots are masked out of decode calls)."""
+        return sum(1 for r in self._reqs
+                   if r is not None and r.prefill_pos is None)
+
+    def session_ids(self) -> List[str]:
+        """Live session-lease ids (LRU-oldest first) — advertised via
+        ``/healthz`` so the fleet router can pin leased sessions."""
+        with self._lock:
+            if self._sessions is None:
+                return []
+            return self._sessions.ids()
+
+    @property
     def queue_depth(self) -> int:
         return len(self._queue)
 
@@ -525,25 +607,73 @@ class InferenceEngine:
     def retry_after_s(self) -> int:
         """Back-off hint for a 429: how long until the bounded queue
         has plausibly drained, from the measured completion rate (the
-        same 10 s window behind ``hvdtpu_serving_requests_per_second``).
+        same 10 s window behind ``hvdtpu_serving_requests_per_second``)
+        plus — under chunked prefill — the prefill backlog itself.
+        With interleaving, drain is paced by chunks-per-tick, not whole
+        prefills: a queue of long prompts admits fast but takes
+        ``pending_chunks × per-chunk seconds`` to actually prefill, so
+        that term is added on top of the completion-rate estimate.
         Clamped to [1, 60] whole seconds — a cold server (no completions
-        yet) answers 1 rather than guessing."""
+        yet, no chunk backlog) answers 1 rather than guessing."""
         with self._lock:
             depth = len(self._queue) + self.active_count
             rate = len(self._completions) / 10.0
+            chunk_s = self._chunk_backlog_s()
         if rate <= 0.0:
+            if chunk_s > 0.0:
+                return max(1, min(60, math.ceil(chunk_s)))
             return 1
-        return max(1, min(60, math.ceil(depth / rate)))
+        return max(1, min(60, math.ceil(depth / rate + chunk_s)))
+
+    def _chunk_backlog_s(self) -> float:
+        """Estimated seconds of interleaved prefill work outstanding:
+        chunks still owed by mid-prefill slots plus chunks the queued
+        prompts will need, priced at the measured per-chunk cost (the
+        cap bucket's EWMA; the worst measured bucket as fallback).
+        0 when chunking is off or nothing is pending — callers under
+        the engine lock."""
+        cap = self._chunk_cap
+        if not cap:
+            return 0.0
+        cost = self._chunk_cost.get(cap)
+        if cost is None:
+            cost = max(self._chunk_cost.values(), default=0.0)
+        if cost <= 0.0:
+            return 0.0
+        chunks = 0
+        for r in self._reqs:
+            if r is not None and r.prefill_pos is not None:
+                chunks += -(-(len(r.prompt) - r.prefill_pos) // cap)
+        for r in self._queue:
+            chunks += -(-len(r.prompt) // cap)
+        return chunks * cost
 
     def step(self) -> bool:
-        """One scheduler iteration: admit → batched decode → evict.
-        Returns True when any work was done."""
+        """One scheduler iteration: admit → at most ONE prefill chunk →
+        batched decode → evict. Returns True when any work was done.
+
+        The single-chunk rule is the tentpole latency bound: a long
+        prompt's prefill is spread across ticks instead of running
+        start-to-finish between two decode steps, so the decode-tick
+        gap every live slot experiences is bounded by one chunk (the
+        budget policy sizes it under
+        ``HOROVOD_TPU_SERVING_TICK_BUDGET_MS``), not by the longest
+        prompt in the mix."""
         with self._lock:
+            if self._inj is not None:
+                for plen in self._inj.take_long_prompt_bursts():
+                    self._inject_long_prompt(plen)
             admitted = self._admit()
             worked = admitted > 0
-            if self.active_count:
+            if self._prefill_tick():
+                worked = True
+            if self._decodable_count:
                 self._decode_step()
                 worked = True
+            else:
+                # No decode ran: a gap across an idle stretch is not a
+                # tick the histogram should count.
+                self._t_last_tick = None
             self._update_gauges()
             return worked
 
@@ -583,7 +713,8 @@ class InferenceEngine:
                 if self.active_count == 0 and not self._queue:
                     self._update_gauges()
                     break
-                if self.active_count:
+                self._prefill_tick()
+                if self._decodable_count:
                     self._decode_step()
                 self._update_gauges()
         _flight.recorder().note("serving", ("drained", 0))
@@ -630,24 +761,54 @@ class InferenceEngine:
             bs = self.config.block_size
             need = blocks_needed(len(req.prompt), req.max_new_tokens,
                                  bs)
+            # Session-lease probe (docs/serving.md#session-affinity):
+            # a prompt that EXTENDS its session's stored conversation
+            # resumes from the lease's resident blocks — the whole
+            # previous context (generated tokens included, which the
+            # prefix cache never indexes) skips prefill. A divergent
+            # turn releases the stale lease instead: partial reuse
+            # could rewrite blocks the prefix cache shares.
+            lease = None
+            if self._sessions is not None and req.session_id:
+                peek = self._sessions.get(req.session_id)
+                if peek is not None:
+                    ln = peek.n_tokens
+                    if len(req.prompt) >= ln \
+                            and req.prompt[:ln] == peek.tokens:
+                        lease = self._sessions.pop(req.session_id)
+                        self._m["session_hits"].inc()
+                    else:
+                        self._sessions.release(
+                            self._sessions.pop(req.session_id))
+            lease_blocks = lease.blocks if lease is not None else []
+            # Resume must re-run at least one prompt token (its forward
+            # produces the first-token logits), so the cached cursor
+            # stops one short of a prompt that matches end-to-end.
+            lease_tokens = 0 if lease is None \
+                else min(lease.n_tokens, len(req.prompt) - 1)
             # Prefix-cache probe: matching leading FULL prompt blocks
             # are shared (incref'd, read-only) instead of re-prefilled.
+            # Skipped on a lease hit — the lease already covers more.
             hashes: List[bytes] = []
             shared: List[int] = []
-            if self._prefix is not None:
+            if lease is None and self._prefix is not None:
                 hashes = prefix_hashes(req.prompt, bs)
                 shared = self._prefix.lookup(hashes)
-            fresh = self._alloc.alloc(need - len(shared))
-            while fresh is None and self._prefix is not None \
-                    and self._prefix.evict_one():
-                # Pool pressure: cached-but-idle prefix blocks yield to
-                # a live admission, LRU first.
-                fresh = self._alloc.alloc(need - len(shared))
+            fresh = self._alloc.alloc(
+                need - len(shared) - len(lease_blocks))
+            while fresh is None and self._free_pressure():
+                # Pool pressure: cached-but-idle prefix blocks and then
+                # parked session leases yield to a live admission.
+                fresh = self._alloc.alloc(
+                    need - len(shared) - len(lease_blocks))
             if fresh is None:
                 for b in shared:       # roll the probe's holds back
                     self._alloc.decref(b)
+                if lease is not None:  # park the consumed lease again
+                    self._sessions.put(req.session_id, lease.tokens,
+                                       lease.blocks)
                 break    # pool exhausted: nothing admits, nothing evicts
-            if self._prefix is not None:
+            if self._prefix is not None and lease is None:
                 self._m["prefix_hits"].inc(len(shared))
                 self._m["prefix_misses"].inc(len(hashes) - len(shared))
             self._queue.popleft()
@@ -655,8 +816,11 @@ class InferenceEngine:
             self._m["queue_wait"].observe(
                 time.perf_counter() - req.t_submit,
                 exemplar=req.trace_id)
-            req.blocks = shared + fresh
-            req.cached_tokens = len(shared) * bs
+            req.blocks = lease_blocks + shared + fresh
+            req.cached_tokens = lease_tokens if lease is not None \
+                else len(shared) * bs
+            req._hashes = hashes
+            req._n_shared = len(shared)
             req.slot = slot
             req.status = "active"
             self._reqs[slot] = req
@@ -674,13 +838,13 @@ class InferenceEngine:
                                time.monotonic(),
                                {"blocks": need,
                                 "prefix_tokens": req.cached_tokens})
-            self._prefill(req)
-            # Index this prompt's freshly-prefilled full blocks so the
-            # NEXT matching prompt shares them (first writer wins).
-            if self._prefix is not None:
-                for j in range(len(shared), len(hashes)):
-                    self._prefix.insert(hashes[j],
-                                        int(self._tables[slot, j]))
+            if self._chunk_cap:
+                # Chunked prefill: admission only reserves; the chunks
+                # run one per tick from _prefill_tick, interleaved with
+                # everyone else's decode.
+                req.prefill_pos = req.cached_tokens
+            else:
+                self._prefill(req)
             admitted += 1
         self._m["queue_depth"].set(len(self._queue))
         return admitted
@@ -694,24 +858,31 @@ class InferenceEngine:
             self._buckets_seen.add((phase, key))
             self._m["compiles"].labels(phase=phase).inc()
 
-    def _prefill(self, req: Request) -> None:
-        # Span epoch BEFORE the fault hook: an injected slow_prefill is
-        # latency the request experienced — it must land INSIDE the
-        # PREFILL span, or the budget report under-attributes.
-        t0m = time.monotonic()
-        if self._inj is not None:
-            self._inj.on_serving_prefill()
-        t0 = time.perf_counter()
-        n = len(req.prompt)
-        c = req.cached_tokens   # resident via shared prefix blocks
-        suffix = req.prompt[c:]
-        ns = len(suffix)
-        L = self._bucket(ns)
-        compile_new = ("prefill", L) not in self._buckets_seen
+    def _free_pressure(self) -> bool:
+        """Reclaim one cached-but-idle resource under pool pressure:
+        prefix-cache entries first (cheapest to lose — one block each),
+        then whole session leases, LRU first, demoted to the prefix
+        cache as the degraded tier. True while something yielded."""
+        if self._prefix is not None and self._prefix.evict_one():
+            return True
+        if self._sessions is not None and self._sessions.evict_one(
+                self._prefix, self.config.block_size):
+            self._m["session_evictions"].inc()
+            return True
+        return False
+
+    def _run_prefill(self, req: Request, start: int, ns: int,
+                     L: int) -> Any:
+        """One prefill forward over ``prompt[start:start+ns]`` padded
+        to bucket ``L`` — the shared core of monolithic and chunked
+        prefill. The drafter (when present) prefills the same chunk on
+        its own pool, same tables, same positions. Returns the
+        flagship logits (``[1, L, vocab]``; row ``ns-1`` is the
+        distribution after the last real token)."""
         self._record_bucket("prefill", L)
         toks = np.zeros((1, L), np.int32)
-        toks[0, :ns] = suffix
-        starts = jnp.full((1,), c, jnp.int32)
+        toks[0, :ns] = req.prompt[start:start + ns]
+        starts = jnp.full((1,), start, jnp.int32)
         tabs = jnp.asarray(self._tables[req.slot:req.slot + 1])
         logits, self._cache = self._fwd_prefill(
             self.params, self._cache, jnp.asarray(toks), starts, tabs)
@@ -722,37 +893,185 @@ class InferenceEngine:
             _, self._draft_cache = self._dfwd_prefill(
                 self._draft_params, self._draft_cache,
                 jnp.asarray(toks), starts, tabs)
-        slot = req.slot
-        self._lengths[slot] = n
-        first = self._sample(np.asarray(logits[0, ns - 1]), req)
+        self._m["tokens"].labels(kind="prompt").inc(ns)
+        return logits
+
+    def _emit_first_token(self, req: Request,
+                          logits_row: np.ndarray) -> None:
+        """Sample the first token from the final prefill logits row —
+        TTFT ends here for both prefill shapes."""
+        first = self._sample(logits_row, req)
         req.t_first_token = time.perf_counter()
         req.tokens.append(first)
         req._notify()
-        self._last_tok[slot] = first
-        self._m["prefill"].observe(time.perf_counter() - t0)
+        self._last_tok[req.slot] = first
         self._m["ttft"].observe(req.t_first_token - req.t_submit,
                                 exemplar=req.trace_id)
-        self._m["tokens"].labels(kind="prompt").inc(ns)
         self._m["tokens"].labels(kind="generated").inc()
         _flight.recorder().note(
             "request", ("first_token", req.trace_id,
                         f"ttft_ms={round((req.t_first_token - req.t_submit) * 1e3, 1)}"))
+
+    def _index_prefix(self, req: Request) -> None:
+        """Index this prompt's freshly-prefilled full blocks so the
+        NEXT matching prompt shares them (first writer wins). Runs
+        right after the last prefill forward — before _check_finished
+        can evict the slot and hand the blocks back."""
+        if self._prefix is None or not req._hashes:
+            return
+        for j in range(req._n_shared, len(req._hashes)):
+            self._prefix.insert(req._hashes[j],
+                                int(self._tables[req.slot, j]))
+        req._hashes = []
+
+    def _prefill(self, req: Request) -> None:
+        """Monolithic prefill: the whole prompt suffix in one bucketed
+        forward at admission (the chunking-off path)."""
+        # Span epoch BEFORE the fault hook: an injected slow_prefill is
+        # latency the request experienced — it must land INSIDE the
+        # PREFILL span, or the budget report under-attributes.
+        t0m = time.monotonic()
+        if self._inj is not None:
+            self._inj.on_serving_prefill()
+        t0 = time.perf_counter()
+        n = len(req.prompt)
+        c = req.cached_tokens   # resident via prefix blocks or a lease
+        ns = n - c
+        L = self._bucket(ns)
+        compile_new = ("prefill", L) not in self._buckets_seen
+        logits = self._run_prefill(req, c, ns, L)
+        self._lengths[req.slot] = n
+        self._m["prefill"].observe(time.perf_counter() - t0)
+        self._emit_first_token(req, np.asarray(logits[0, ns - 1]))
         w = _rt.writer()
         if w is not None:
             w.request_span(req.trace_id, "PREFILL", t0m,
                            time.monotonic(),
                            {"bucket": L, "tokens": ns, "cached": c,
                             "compile": compile_new})
+        self._index_prefix(req)
         self._check_finished(req)
 
+    def _chunk_len(self, remaining: int) -> int:
+        """Budget policy: the next chunk's bucket. Start from the
+        configured cap (or what's left of the prompt, if smaller) and
+        halve while the bucket's measured cost exceeds the tick budget
+        — never below the engine's smallest prefill bucket, and only
+        through buckets the engine would compile anyway. Unmeasured
+        buckets run optimistically (their first timed run seeds the
+        cost model)."""
+        L = self._bucket(min(remaining, self._chunk_cap))
+        floor = self._bucket(1)
+        if self._tick_budget_s is not None:
+            while L > floor:
+                cost = self._chunk_cost.get(L)
+                if cost is None or cost <= self._tick_budget_s:
+                    break
+                L //= 2
+            L = max(L, floor)
+        return L
+
+    def _note_chunk_cost(self, L: int, dt: float) -> None:
+        prev = self._chunk_cost.get(L)
+        self._chunk_cost[L] = dt if prev is None \
+            else 0.5 * prev + 0.5 * dt
+
+    def _prefill_tick(self) -> bool:
+        """Run at most ONE prefill chunk — the oldest mid-prefill
+        request's next chunk — between decode ticks. Returns True when
+        a chunk ran. The final chunk flips the request live: lengths
+        advance, the first token is sampled from its logits, and the
+        next decode tick picks the slot up."""
+        pending = [r for r in self._reqs
+                   if r is not None and r.prefill_pos is not None]
+        if not pending:
+            return False
+        req = min(pending, key=lambda r: r.id)
+        t0m = time.monotonic()
+        if self._inj is not None:
+            self._inj.on_serving_prefill()
+        t0 = time.perf_counter()
+        n = len(req.prompt)
+        pos = req.prefill_pos
+        remaining = n - pos
+        L = self._chunk_len(remaining)
+        ns = min(remaining, L)
+        compile_new = ("prefill", L) not in self._buckets_seen
+        logits = self._run_prefill(req, pos, ns, L)
+        dt = time.perf_counter() - t0
+        if not compile_new:
+            # First-run compile time is not steady-state chunk cost.
+            self._note_chunk_cost(L, dt)
+        req._prefill_s += dt
+        req._chunks += 1
+        req.prefill_pos = pos + ns
+        self._m["prefill_chunks"].inc()
+        w = _rt.writer()
+        if w is not None:
+            w.request_span(req.trace_id, "PREFILL", t0m,
+                           time.monotonic(),
+                           {"bucket": L, "tokens": ns, "cached": pos,
+                            "compile": compile_new,
+                            "chunk": req._chunks})
+        if req.prefill_pos >= n:
+            req.prefill_pos = None
+            self._lengths[req.slot] = n
+            self._m["prefill"].observe(req._prefill_s)
+            self._emit_first_token(req, np.asarray(logits[0, ns - 1]))
+            self._index_prefix(req)
+            self._check_finished(req)
+        return True
+
+    def _inject_long_prompt(self, plen: int) -> None:
+        """A ``long_prompt_burst`` fault's synthetic request:
+        deterministic oversized prompt, clamped to what this model can
+        hold, submitted through the ordinary admission gate (a full
+        queue drops it with a warning — the burst is adversarial load,
+        not a correctness obligation)."""
+        vocab = self.cfg.vocab
+        plen = max(1, min(int(plen), self.cfg.max_seq - 1))
+        max_new = max(1, min(int(self.config.max_new_tokens),
+                             self.cfg.max_seq - plen))
+        prompt = [(7 + 13 * i) % vocab for i in range(plen)]
+        try:
+            self.submit(prompt, max_new_tokens=max_new,
+                        trace_id=f"fault.burst.{self._next_id:x}")
+        except (QueueFullError, DrainingError, ValueError) as e:
+            _log.warning("long_prompt_burst request dropped: %s", e)
+
+    def _decode_views(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Block tables / lengths for a batched decode call. Slots
+        still mid-chunked-prefill are masked to the empty-slot shape
+        (scratch table, length 0): a decode forward over them would
+        scatter garbage K/V into their REAL blocks at the positions
+        the remaining chunks are about to write."""
+        if not any(r is not None and r.prefill_pos is not None
+                   for r in self._reqs):
+            return self._tables, self._lengths
+        tabs = self._tables.copy()
+        lens = self._lengths.copy()
+        for s, r in enumerate(self._reqs):
+            if r is not None and r.prefill_pos is not None:
+                tabs[s, :] = SCRATCH_BLOCK
+                lens[s] = 0
+        return tabs, lens
+
     def _decode_step(self) -> None:
+        # Tick-gap histogram: start-to-start of consecutive batched
+        # decode ticks — an interleaved prefill chunk lands inside one
+        # gap, which is exactly the tail this PR bounds.
+        now = time.perf_counter()
+        if self._t_last_tick is not None:
+            self._m["decode_tick"].observe(now - self._t_last_tick)
+        self._t_last_tick = now
+        tabs_h, lens_h = self._decode_views()
         if self._draft_params is not None:
             ctl = self._spec_ctl
             if ctl is None:
                 self._spec_decode_step()
                 return
             live = [s for s, r in enumerate(self._reqs)
-                    if r is not None]
+                    if r is not None and r.prefill_pos is None]
             width = ctl.width(live) if live else 1
             if width > 1:
                 # Verify at the widest live slot's k; narrower slots
@@ -768,8 +1087,8 @@ class InferenceEngine:
             _, self._draft_cache = self._dfwd(
                 self._draft_params, self._draft_cache,
                 jnp.asarray(self._last_tok[:, None]),
-                jnp.asarray(self._lengths),
-                jnp.asarray(self._tables))
+                jnp.asarray(lens_h),
+                jnp.asarray(tabs_h))
             for s in live:
                 ctl.note_plain_step(s)
         t0m = time.monotonic()   # before the fault hook (slow_decode
@@ -781,15 +1100,15 @@ class InferenceEngine:
         logits, self._cache = self._fwd(
             self.params, self._cache,
             jnp.asarray(self._last_tok[:, None]),
-            jnp.asarray(self._lengths),
-            jnp.asarray(self._tables))
+            jnp.asarray(lens_h),
+            jnp.asarray(tabs_h))
         lg = np.asarray(logits[:, 0])
         dt = time.perf_counter() - t0
         self._m["decode_step"].observe(dt)
         self._m["decode_steps"].inc()
         w = _rt.writer()
         for slot, req in enumerate(self._reqs):
-            if req is None:
+            if req is None or req.prefill_pos is not None:
                 continue
             # the input token's K/V is cached now; its position is used
             self._lengths[slot] += 1
@@ -837,12 +1156,13 @@ class InferenceEngine:
         if k is None:
             k = self._spec_k
         ctl = self._spec_ctl
-        n_live = self.active_count
-        tabs = jnp.asarray(self._tables)
+        n_live = self._decodable_count
+        tabs_h, lens_h = self._decode_views()
+        tabs = jnp.asarray(tabs_h)
 
         # Drafter proposals: greedy chain on the drafter's own pool,
         # same block tables, same positions.
-        d_len = self._lengths.copy()
+        d_len = lens_h.copy()
         cur = self._last_tok.copy()
         proposals = np.zeros((self._slots, k - 1), np.int32)
         for i in range(k - 1):
@@ -864,7 +1184,7 @@ class InferenceEngine:
         self._record_bucket("decode", (self._slots, k))
         logits, self._cache = self._fwd(
             self.params, self._cache, jnp.asarray(feed),
-            jnp.asarray(self._lengths), tabs)
+            jnp.asarray(lens_h), tabs)
         lg = np.asarray(logits)           # [slots, k, vocab]
         greedy = lg.argmax(axis=-1)       # [slots, k]
         dt = time.perf_counter() - t0
@@ -873,7 +1193,7 @@ class InferenceEngine:
 
         w = _rt.writer()
         for slot, req in enumerate(self._reqs):
-            if req is None:
+            if req is None or req.prefill_pos is not None:
                 continue
             if req.temperature > 0.0:
                 # Sampled slots take one token from the true next-token
@@ -938,18 +1258,43 @@ class InferenceEngine:
     def _evict(self, req: Request, status: str,
                error: Optional[str] = None) -> None:
         """Free the slot mid-stream — the rest of the batch keeps
-        decoding; the blocks return to the pool for the next admit."""
+        decoding; the blocks return to the pool for the next admit
+        (minus any leading blocks a session lease keeps resident)."""
         slot = req.slot
         self._tables[slot, :] = SCRATCH_BLOCK
         self._lengths[slot] = 0
         self._last_tok[slot] = 0
         self._reqs[slot] = None
-        self._alloc.release(req.blocks)
+        kept = 0
+        if status == "completed" and self._sessions is not None \
+                and req.session_id:
+            kept = self._store_lease(req)
+        self._alloc.release(req.blocks[kept:])
         req.blocks = []
         _flight.recorder().note(
             "request", ("evict", req.trace_id,
                         f"{status} tokens={len(req.tokens)}"))
         self._finish(req, status, error=error)
+
+    def _store_lease(self, req: Request) -> int:
+        """Park this conversation's K/V under its session id: the
+        leading blocks covering ``prompt + generated[:-1]`` (every
+        position actually written — the final token was output-only)
+        transfer their reference from the request to the lease table.
+        Returns how many blocks the lease kept."""
+        tokens = req.prompt + req.tokens[:-1]
+        if not tokens:
+            return 0
+        kept = min(-(-len(tokens) // self.config.block_size),
+                   len(req.blocks))
+        self._sessions.put(req.session_id, tokens, req.blocks[:kept])
+        self._m["session_leases"].inc()
+        while self._sessions.max_entries is not None \
+                and len(self._sessions) > self._sessions.max_entries \
+                and self._sessions.evict_one(self._prefix,
+                                             self.config.block_size):
+            self._m["session_evictions"].inc()
+        return kept
 
     def _finish(self, req: Request, status: str,
                 error: Optional[str] = None) -> None:

@@ -401,6 +401,16 @@ class Fleet:
             self._note("drain", index, "sigterm")
             rep.proc.send_signal(signal.SIGTERM)
 
+    def kill_replica(self, index: int) -> None:
+        """Chaos action: SIGKILL one replica mid-flight — no drain, no
+        goodbye. In-flight requests on it are lost at the replica and
+        recovered by the router's failover resume; the supervisor
+        restarts the process like any other crash."""
+        rep = self.replicas[index]
+        if rep.alive:
+            self._note("kill", index, "sigkill")
+            rep.proc.kill()
+
     def stop(self, timeout_s: float = 30.0) -> None:
         """Tear the fleet down: stop restarting, SIGTERM every replica
         (graceful drain), escalate to SIGKILL past the timeout."""

@@ -225,3 +225,99 @@ class PrefixCache:
         _, block = self._map.popitem(last=False)
         self._alloc.decref(block)
         return True
+
+
+class SessionLease:
+    """One conversation's resident KV claim between turns: the token
+    context the blocks encode (``prompt + generated[:-1]`` of the last
+    turn — exactly the positions whose K/V was written) and the leading
+    pool blocks that hold it. The lease owns one reference on each
+    block."""
+
+    __slots__ = ("tokens", "blocks")
+
+    def __init__(self, tokens: List[int], blocks: List[int]):
+        self.tokens = tokens
+        self.blocks = blocks
+
+    @property
+    def n_tokens(self) -> int:
+        return len(self.tokens)
+
+
+class SessionLeaseTable:
+    """LRU map ``session_id -> SessionLease`` — KV-block survival
+    between conversation turns (docs/serving.md#session-affinity).
+
+    Where the prefix cache shares FULL prompt blocks across unrelated
+    requests, a lease keeps a single conversation's *entire* context
+    resident — including generated tokens, which the prefix cache never
+    indexes — so the next turn of that conversation resumes decoding
+    from its stored position instead of re-prefilling the transcript.
+
+    Leases are the first thing sacrificed under pool pressure: eviction
+    *demotes* a lease to the refcounted prefix cache (its full prompt-
+    prefix blocks get indexed there, a degraded-but-still-warm tier)
+    before dropping the lease's references. Not thread-safe —
+    engine-lock discipline, like the allocator."""
+
+    def __init__(self, alloc: BlockAllocator,
+                 max_entries: Optional[int] = None):
+        self._alloc = alloc
+        self._map: "OrderedDict[str, SessionLease]" = OrderedDict()
+        self.max_entries = max_entries
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def ids(self) -> List[str]:
+        """Live session ids, LRU-oldest first — advertised by the
+        replica's ``/healthz`` for router pinning."""
+        return list(self._map)
+
+    def get(self, session_id: str) -> Optional[SessionLease]:
+        """Peek a lease (freshens LRU position; ownership stays with
+        the table). The engine inspects ``tokens`` to decide between
+        resuming from the lease and releasing it as divergent."""
+        lease = self._map.get(session_id)
+        if lease is not None:
+            self._map.move_to_end(session_id)
+        return lease
+
+    def pop(self, session_id: str) -> Optional[SessionLease]:
+        """Remove a lease, transferring its block references to the
+        caller (who must release or re-``put`` them)."""
+        return self._map.pop(session_id, None)
+
+    def put(self, session_id: str, tokens: List[int],
+            blocks: List[int]) -> None:
+        """Store a lease; the table takes over the caller's reference
+        on each block. A superseded lease for the same id is released
+        first."""
+        old = self._map.pop(session_id, None)
+        if old is not None:
+            self.release(old)
+        self._map[session_id] = SessionLease(list(tokens), list(blocks))
+
+    def release(self, lease: SessionLease) -> None:
+        """Drop the lease's reference on every block (blocks shared
+        with the prefix cache or a live sequence stay resident)."""
+        self._alloc.release(lease.blocks)
+        lease.blocks = []
+
+    def evict_one(self, prefix: Optional["PrefixCache"] = None,
+                  block_size: int = 0) -> bool:
+        """Sacrifice the LRU lease under pool pressure; True when one
+        was evicted. With a prefix cache, the lease's FULL prompt-
+        prefix blocks are demoted into it first (the cache increfs what
+        it indexes), so a follow-up turn still skips those chunks via
+        the ordinary shared-prefix path."""
+        if not self._map:
+            return False
+        _, lease = self._map.popitem(last=False)
+        if prefix is not None and block_size > 0:
+            hashes = prefix_hashes(lease.tokens, block_size)
+            for j, h in enumerate(hashes[:len(lease.blocks)]):
+                prefix.insert(h, lease.blocks[j])
+        self.release(lease)
+        return True

@@ -86,6 +86,13 @@ _STREAM_READ_S = 120.0
 # roughly mirrors the replica-side prefix cache, which also evicts LRU
 # under pool pressure — an optimistic shadow, never load-bearing).
 _WARMTH_ENTRIES = 8192
+# Score bonus pinning a leased session to its replica
+# (docs/serving.md#session-affinity): worth two slots of outstanding
+# work — decisively above the prefix-warmth bonus (≤ 1.0), so a leased
+# session sticks through ordinary load imbalance, but a replica that
+# stops being READY (draining, dead) still repels it and failover falls
+# back to normal dispatch.
+_SESSION_PIN_BONUS = 2.0
 
 
 def _metrics():
@@ -152,6 +159,9 @@ class ReplicaView:
     # Prefix hashes this router has routed here (bounded LRU) — the
     # warmth estimate behind prefix-aware admission.
     warm: "OrderedDict" = dataclasses.field(default_factory=OrderedDict)
+    # Session ids holding a KV lease here, from /healthz (plus the
+    # router's own shadow adds between scrapes) — the pin targets.
+    sessions: set = dataclasses.field(default_factory=set)
 
     @property
     def score(self) -> float:
@@ -261,13 +271,15 @@ class Router:
             view.ready = False
             view.ok = False
             return
-        got = False
+        # healthz first, every cycle: besides being the load fallback,
+        # it carries block_size (the prefix-hash granularity) and the
+        # live session-lease ids the pinning policy routes on — both
+        # are healthz-only.
+        got = self._scrape_healthz(view)
         if ep.metrics_port:
-            got = self._scrape_metrics(view)
-        if not got or view.block_size is None:
-            # healthz also carries block_size (the prefix-hash
-            # granularity) — fetched at least once per view.
-            got = self._scrape_healthz(view) or got
+            # The registry gauges stay the primary load signal when a
+            # metrics endpoint exists.
+            got = self._scrape_metrics(view) or got
         view.ok = got
         view.t_scraped = time.monotonic()
 
@@ -332,6 +344,8 @@ class Router:
         view.slots = float(h.get("batch_slots", 1) or 1)
         if h.get("block_size"):
             view.block_size = int(h["block_size"])
+        if "sessions" in h:
+            view.sessions = set(h.get("sessions") or [])
         return True
 
     def _scrape_cycle(self) -> None:
@@ -363,7 +377,8 @@ class Router:
             self._stop.wait(self._scrape_interval)
 
     def _pick(self, exclude: Dict[int, float],
-              prompt: Optional[List[int]] = None) -> Optional[ReplicaView]:
+              prompt: Optional[List[int]] = None,
+              session_id: Optional[str] = None) -> Optional[ReplicaView]:
         now = time.monotonic()
         live = {i for i, until in exclude.items() if until > now}
         with self._views_lock:
@@ -373,6 +388,15 @@ class Router:
             for v in views:
                 hashes = prefix_hashes(prompt, v.block_size or 16)
                 warmth[v.endpoint.index] = v.warmth(hashes)
+        if session_id:
+            # Session pinning rides the warmth channel: the replica
+            # advertising this session's lease gets a bonus big enough
+            # to win any warmth tie, while exclusion (failover) and
+            # readiness still override it unconditionally.
+            for v in views:
+                if session_id in v.sessions:
+                    warmth[v.endpoint.index] = warmth.get(
+                        v.endpoint.index, 0.0) + _SESSION_PIN_BONUS
         self._rr += 1
         view = pick_replica(views, exclude=live, rr=self._rr,
                             warmth=warmth)
@@ -389,7 +413,8 @@ class Router:
 
     def _relay(self, rid: str, prompt: List[int], max_new: int,
                temperature: Optional[float],
-               deadline: Optional[float], emit) -> dict:
+               deadline: Optional[float], emit,
+               session_id: Optional[str] = None) -> dict:
         """Drive one client request across the fleet until it
         completes (see :meth:`_relay_attempts`), timing the wall: the
         ``REQUEST`` trace span and the ``hvdtpu_fleet_request_seconds``
@@ -398,7 +423,8 @@ class Router:
         share divides by."""
         t0m = time.monotonic()
         meta = self._relay_attempts(rid, prompt, max_new, temperature,
-                                    deadline, emit)
+                                    deadline, emit,
+                                    session_id=session_id)
         t1m = time.monotonic()
         self._m["request_s"].observe(t1m - t0m, exemplar=rid)
         _rt.span(rid, "REQUEST", t0m, t1m,
@@ -408,7 +434,8 @@ class Router:
 
     def _relay_attempts(self, rid: str, prompt: List[int],
                         max_new: int, temperature: Optional[float],
-                        deadline: Optional[float], emit) -> dict:
+                        deadline: Optional[float], emit,
+                        session_id: Optional[str] = None) -> dict:
         """Pick → stream → (on death) fail over, until terminal.
         ``emit(tok)`` is called once per generated token in order;
         returns the terminal meta dict {"status": ..., "retries": N,
@@ -457,7 +484,7 @@ class Router:
                         "error": f"no replica completed the request "
                                  f"after {attempts} attempts",
                         "retries": retries, "tokens": emitted}
-            view = self._pick(exclude, prompt)
+            view = self._pick(exclude, prompt, session_id=session_id)
             if view is None:
                 # Nobody ready right now (mass restart, all draining):
                 # wait out a scrape cycle rather than failing a
@@ -477,10 +504,15 @@ class Router:
             outcome = self._stream_from(
                 rid, view.endpoint, prompt + emitted,
                 max_new - len(emitted), temperature, deadline,
-                emitted, emit_observed)
+                emitted, emit_observed, session_id=session_id)
             _rt.span(rid, "DISPATCH", t_att, time.monotonic(),
                      {"replica": idx, "outcome": outcome["kind"]})
             if outcome["kind"] == "done":
+                if session_id:
+                    # Shadow the lease the replica just formed so the
+                    # session's next turn pins here even if it lands
+                    # before the next healthz scrape.
+                    view.sessions.add(session_id)
                 return {"status": "completed", "retries": retries,
                         "tokens": emitted, "replica": idx,
                         **outcome.get("meta", {})}
@@ -510,7 +542,7 @@ class Router:
                      prompt: List[int], max_new: int,
                      temperature: Optional[float],
                      deadline: Optional[float], emitted: List[int],
-                     emit) -> dict:
+                     emit, session_id: Optional[str] = None) -> dict:
         """One dispatch attempt against one replica, streaming. Appends
         to ``emitted`` / calls ``emit`` as tokens land. Returns a
         tagged outcome: done / deadline / bad_request, or a retryable
@@ -519,6 +551,8 @@ class Router:
                 "stream": True}
         if temperature is not None:
             body["temperature"] = temperature
+        if session_id:
+            body["session_id"] = session_id
         if deadline is not None:
             remaining_ms = (deadline - time.monotonic()) * 1e3
             if remaining_ms <= 0:
@@ -679,18 +713,22 @@ class Router:
                 rid = str(self.headers.get("X-Request-Id")
                           or body.get("request_id")
                           or outer._request_id())
+                sid = self.headers.get("X-Session-Id") \
+                    or body.get("session_id")
+                sid = str(sid) if sid else None
                 if stream:
                     self._do_stream(rid, tokens, max_new, temperature,
-                                    deadline)
+                                    deadline, sid)
                 else:
                     self._do_unary(rid, tokens, max_new, temperature,
-                                   deadline)
+                                   deadline, sid)
 
             def _do_unary(self, rid, tokens, max_new, temperature,
-                          deadline) -> None:
+                          deadline, session_id=None) -> None:
                 t0 = time.perf_counter()
                 meta = outer._relay(rid, tokens, max_new, temperature,
-                                    deadline, emit=lambda t: None)
+                                    deadline, emit=lambda t: None,
+                                    session_id=session_id)
                 outer._count(meta["status"])
                 if meta["status"] == "completed":
                     t_egress = time.monotonic()
@@ -718,7 +756,7 @@ class Router:
                                 headers={"Retry-After": 1})
 
             def _do_stream(self, rid, tokens, max_new, temperature,
-                           deadline) -> None:
+                           deadline, session_id=None) -> None:
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "application/x-ndjson")
@@ -735,7 +773,8 @@ class Router:
                     line({"id": rid, "trace_id": rid})
                     meta = outer._relay(
                         rid, tokens, max_new, temperature, deadline,
-                        emit=lambda t: line({"t": t}))
+                        emit=lambda t: line({"t": t}),
+                        session_id=session_id)
                     outer._count(meta["status"])
                     done = {"done": True,
                             "status": ("completed"

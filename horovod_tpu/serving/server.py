@@ -149,6 +149,10 @@ class ServingServer:
                         # this granularity to score cache warmth
                         "block_size": eng.config.block_size,
                         "prefix_cache": eng._prefix is not None,
+                        # live session leases — the router pins these
+                        # sessions here (docs/serving.md#session-affinity)
+                        "sessions": eng.session_ids(),
+                        "session_leases": eng.config.session_leases,
                     }, "healthz")
                     return
                 if path == "/readyz":
@@ -203,13 +207,19 @@ class ServingServer:
                 # (docs/serving.md#request-tracing).
                 trace_id = self.headers.get("X-Request-Id") \
                     or body.get("request_id")
+                # Conversation identity for session affinity: the
+                # router forwards it in X-Session-Id (body
+                # "session_id" for plain clients).
+                session_id = self.headers.get("X-Session-Id") \
+                    or body.get("session_id")
                 try:
                     req = outer.engine.submit(
                         tokens,
                         max_new_tokens=body.get("max_new_tokens"),
                         temperature=body.get("temperature"),
                         deadline_s=deadline_s,
-                        trace_id=trace_id)
+                        trace_id=trace_id,
+                        session_id=session_id)
                 except QueueFullError as e:
                     self._reply(429, {"error": str(e)}, "generate",
                                 headers={"Retry-After":

@@ -401,6 +401,19 @@ def serving_queue() -> int:
     return int(v)
 
 
+def serving_tick_budget_ms() -> Optional[float]:
+    """Target decode-tick gap for chunked prefill (docs/serving.md):
+    when set, the engine's chunk budget policy shrinks prefill-chunk
+    size (down to ``min_prefill_bucket``) until the measured per-chunk
+    prefill time fits under this many milliseconds, bounding how long
+    any live decode slot waits behind an interleaved chunk. None (the
+    default) keeps the configured ``prefill_chunk`` cap as-is."""
+    v = _get("SERVING_TICK_BUDGET_MS")
+    if v in (None, ""):
+        return None
+    return float(v)
+
+
 def reqtrace_dir() -> Optional[str]:
     """Directory for per-process serving request traces
     (docs/serving.md#request-tracing): when set, the fleet router

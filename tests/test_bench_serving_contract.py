@@ -1,0 +1,158 @@
+"""Fast-tier contract on BENCH_SPEED.json (docs/benchmarks.md): the
+serving speed-lever file must keep the arm names and the seeded-
+deterministic evidence fields the acceptance criteria read — the five
+lever arms, the spec_adapt A/B row, and the chunked_prefill /
+session_affinity rows this PR's tentpole claims live in. The numbers
+themselves are re-measured by running bench_serving.py
+(--speed / --spec-adapt / --chunked-prefill / --session-affinity);
+this test pins the schema plus the invariants that must hold for ANY
+honest run (token-identity checksums, counter arithmetic), so a
+regenerated file cannot silently drop the claims."""
+
+import json
+import os
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PATH = os.path.join(ROOT, "BENCH_SPEED.json")
+
+SPEED_ARMS = ("baseline", "quantized_kv", "speculative", "prefix_cache",
+              "all_on")
+# Seeded-deterministic per-arm evidence (greedy decode, deterministic
+# scheduler) — wall-clock fields (*_ms, tokens_per_s) deliberately
+# excluded: they vary run to run and must not be pinned.
+SPEED_ARM_FIELDS = ("decode_steps", "draft_accepted", "draft_proposed",
+                    "generated_tokens", "kv_bytes_resident",
+                    "output_checksum", "prefill_tokens", "prefix_hits",
+                    "prefix_misses")
+
+
+@pytest.fixture(scope="module")
+def bench():
+    if not os.path.exists(PATH):
+        pytest.skip("BENCH_SPEED.json not generated on this checkout")
+    with open(PATH) as f:
+        return json.load(f)
+
+
+def test_metric_name_is_pinned(bench):
+    assert bench["metric"] == "serving_speed_levers"
+
+
+@pytest.mark.parametrize("arm", SPEED_ARMS)
+def test_lever_arms_carry_deterministic_fields(bench, arm):
+    assert arm in bench["arms"], f"lever arm {arm} missing"
+    row = bench["arms"][arm]
+    for key in SPEED_ARM_FIELDS:
+        assert key in row, (arm, key)
+
+
+def test_lever_headlines_hold(bench):
+    h = bench["headlines"]
+    assert h["quantized_outputs_equal_fp32"] is True
+    assert h["speculative_outputs_equal_baseline"] is True
+    assert h["all_on_outputs_equal_quantized"] is True
+    assert h["quantized_kv_bytes_ratio"] < 0.5
+    assert 0 < h["draft_acceptance"] <= 1.0
+    assert h["prefix_prefill_tokens_ratio"] < 1.0
+
+
+def test_spec_adapt_row(bench):
+    row = bench["spec_adapt"]
+    assert set(row["arms"]) == {"adaptive", "static"}
+    h = row["headlines"]
+    assert h["adaptive_backed_off_to_1"] is True
+    assert h["outputs_equal_static"] is True
+
+
+def test_chunked_prefill_arms_and_fields(bench):
+    row = bench["chunked_prefill"]
+    assert set(row["arms"]) == {"baseline_no_burst", "unchunked_burst",
+                                "chunked_burst"}
+    for arm, a in row["arms"].items():
+        for key in ("bursts_injected", "decode_ticks", "decode_tick_ms",
+                    "generated_tokens", "prefill_chunks",
+                    "steady_outputs_checksum"):
+            assert key in a, (arm, key)
+        for p in ("p50", "p90", "p99"):
+            assert a["decode_tick_ms"][p] > 0, (arm, p)
+
+
+def test_chunked_prefill_burst_accounting(bench):
+    """The fault grammar's burst is the experiment: both burst arms
+    must have injected exactly the declared 2 long prompts, the
+    baseline none; only the chunked arm runs the interleaved chunk
+    path (a monolithic prefill never increments the chunk counter)."""
+    arms = bench["chunked_prefill"]["arms"]
+    assert arms["baseline_no_burst"]["bursts_injected"] == 0
+    assert arms["unchunked_burst"]["bursts_injected"] == 2
+    assert arms["chunked_burst"]["bursts_injected"] == 2
+    assert arms["baseline_no_burst"]["prefill_chunks"] == 0
+    assert arms["unchunked_burst"]["prefill_chunks"] == 0
+    assert arms["chunked_burst"]["prefill_chunks"] > 0
+    # Burst arms decode the extra burst tokens on top of the steady
+    # load; their generated totals agree with each other.
+    assert (arms["unchunked_burst"]["generated_tokens"]
+            == arms["chunked_burst"]["generated_tokens"]
+            > arms["baseline_no_burst"]["generated_tokens"])
+
+
+def test_chunked_prefill_token_identity_and_tail_bound(bench):
+    """The tentpole's two claims: chunking only reorders prefill work
+    (steady outputs token-identical across all three arms — seeded,
+    greedy, so the checksums are deterministic), and it bounds the
+    decode-tick tail (chunked p99 within 2x the no-burst baseline
+    while the monolithic arm exceeds 2x)."""
+    row = bench["chunked_prefill"]
+    sums = {a["steady_outputs_checksum"] for a in row["arms"].values()}
+    assert len(sums) == 1, f"steady outputs diverged across arms: {sums}"
+    h = row["headlines"]
+    assert h["steady_outputs_equal_across_arms"] is True
+    assert h["chunked_holds_2x_baseline"] is True
+    assert h["unchunked_exceeds_2x_baseline"] is True
+    assert h["chunked_p99_vs_baseline"] <= 2.0
+    assert h["unchunked_p99_vs_baseline"] > 2.0
+
+
+def test_session_affinity_arms_and_fields(bench):
+    row = bench["session_affinity"]
+    assert set(row["arms"]) == {"prefix_cache_only", "session_affinity"}
+    for arm, a in row["arms"].items():
+        for key in ("final_context_checksum", "followup_ttft_p50_ms",
+                    "followup_turns_measured", "prefill_tokens",
+                    "session_hits", "session_leases"):
+            assert key in a, (arm, key)
+
+
+def test_session_affinity_lease_accounting(bench):
+    """Deterministic counter arithmetic: with leases on, every
+    follow-up turn of every conversation resumes from its session
+    lease (hits == sessions * (turns - 1)); with leases off the
+    session counters stay zero and the prefix cache carries what it
+    can. Leases skip re-prefilling the stored context, so the lease
+    arm prefills strictly fewer prompt tokens."""
+    row = bench["session_affinity"]
+    sess = row["arms"]["session_affinity"]
+    pfx = row["arms"]["prefix_cache_only"]
+    followups = row["sessions"] * (row["turns"] - 1)
+    assert sess["session_hits"] == followups
+    assert sess["session_leases"] >= row["sessions"]
+    assert pfx["session_hits"] == 0
+    assert pfx["session_leases"] == 0
+    assert pfx["prefix_hits"] > 0
+    assert sess["prefill_tokens"] < pfx["prefill_tokens"]
+
+
+def test_session_affinity_token_identity_and_ttft(bench):
+    """Leases must be a pure latency lever: the final conversation
+    contexts (prompt + every generated token, all turns) are
+    token-identical across arms, and the follow-up TTFT p50 beats the
+    prefix-cache-only arm — the headline the acceptance reads."""
+    row = bench["session_affinity"]
+    assert (row["arms"]["session_affinity"]["final_context_checksum"]
+            == row["arms"]["prefix_cache_only"]["final_context_checksum"])
+    h = row["headlines"]
+    assert h["contexts_equal_across_arms"] is True
+    assert h["session_beats_prefix_ttft"] is True
+    assert h["prefill_tokens_ratio"] < 1.0

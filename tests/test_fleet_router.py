@@ -47,6 +47,7 @@ class StubReplica:
         self.token_delay_s = token_delay_s
         self.requests = []              # bodies of /generate calls
         self.request_ids = []           # X-Request-Id header per call
+        self.sessions = []              # leases, like a real replica
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -73,6 +74,7 @@ class StubReplica:
                         "queue_depth": outer.queue_depth,
                         "active_requests": outer.active,
                         "batch_slots": outer.slots,
+                        "sessions": list(outer.sessions),
                     })
                 else:
                     self._json(404, {})
@@ -112,6 +114,9 @@ class StubReplica:
                     {"done": True, "status": "completed",
                      "n": len(toks), "ttft_ms": 1.0,
                      "latency_ms": 2.0}).encode() + b"\n")
+                sid = body.get("session_id")
+                if sid and sid not in outer.sessions:
+                    outer.sessions.append(sid)   # lease formed
 
             def log_message(self, *args):
                 pass
@@ -559,3 +564,146 @@ class TestServingFaultGrammar:
             assert faults.injector() is None   # targets replica 2 only
         finally:
             faults.reset()
+
+
+class TestSessionAffinityRouting:
+    """Session pinning (docs/serving.md#session-affinity): the router
+    learns which replica holds a session's KV lease — from /healthz
+    and from its own completed dispatches — and pins that session's
+    next turn there; failover falls back to normal dispatch and the
+    lease re-forms on the surviving replica."""
+
+    def _wait_scraped(self, router, pred, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with router._views_lock:
+                if pred(router._views):
+                    return
+            time.sleep(0.02)
+        raise AssertionError("scrape never observed the condition")
+
+    def test_session_pins_to_lease_holder_despite_load(self):
+        """The advertised lease outweighs a load gap the plain policy
+        would never cross: the session's turn lands on the busier
+        replica that holds its KV."""
+        busy = StubReplica(queue_depth=5, active=8)   # score 1.625
+        idle = StubReplica(queue_depth=0, active=1)   # score 0.125
+        busy.sessions = ["conv"]
+        router = _router([busy, idle])
+        try:
+            self._wait_scraped(
+                router, lambda vs: "conv" in vs[0].sessions)
+            status, body = _post(router.port,
+                                 {"tokens": [1, 2, 3],
+                                  "max_new_tokens": 2,
+                                  "session_id": "conv"})
+            assert status == 200 and body["replica"] == 0
+            # a session-less request still takes the idle replica
+            status, body = _post(router.port,
+                                 {"tokens": [1, 2, 3],
+                                  "max_new_tokens": 2})
+            assert status == 200 and body["replica"] == 1
+        finally:
+            router.shutdown()
+            busy.stop()
+            idle.stop()
+
+    def test_completed_dispatch_pins_before_next_scrape(self):
+        """The router shadows the lease it just created: turn 2 of a
+        session sticks to turn 1's replica even with equal load and a
+        prompt too short for prefix warmth."""
+        stubs = [StubReplica(), StubReplica()]
+        router = _router(stubs, scrape_interval_s=60.0)
+        try:
+            for _ in range(4):
+                status, _ = _post(router.port,
+                                  {"tokens": [4, 5],
+                                   "max_new_tokens": 2,
+                                   "session_id": "chat-9"})
+                assert status == 200
+            served = [len(s.requests) for s in stubs]
+            assert sorted(served) == [0, 4]   # all four stuck together
+        finally:
+            router.shutdown()
+            for s in stubs:
+                s.stop()
+
+    def test_session_failover_reforms_lease_on_survivor(self):
+        """The pinned replica dies mid-stream: the failover resume
+        completes the reply token-identically on the survivor (the
+        session_id rides the re-dispatch, so the lease re-forms
+        there), and the next turn pins to the survivor."""
+        flaky = StubReplica(die_after=3)              # preferred: idle
+        backup = StubReplica(queue_depth=2, active=4)
+        router = _router([flaky, backup])
+        try:
+            status, body = _post(router.port,
+                                 {"tokens": [1, 2, 3, 4],
+                                  "max_new_tokens": 8,
+                                  "session_id": "conv"})
+            assert status == 200
+            assert body["tokens"] == stub_tokens(4, 8)   # seamless
+            assert body["retries"] >= 1
+            resume = backup.requests[-1]
+            assert resume["session_id"] == "conv"
+            assert backup.sessions == ["conv"]        # lease re-formed
+            flaky.die_after = None
+            status, body = _post(router.port,
+                                 {"tokens": [9, 9],
+                                  "max_new_tokens": 2,
+                                  "session_id": "conv"})
+            assert status == 200 and body["replica"] == 1
+        finally:
+            router.shutdown()
+            flaky.stop()
+            backup.stop()
+
+    def test_header_spelling_reaches_replica(self):
+        stub = StubReplica()
+        router = _router([stub])
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                              timeout=30)
+            conn.request("POST", "/generate",
+                         json.dumps({"tokens": [1],
+                                     "max_new_tokens": 2}),
+                         {"Content-Type": "application/json",
+                          "X-Session-Id": "hdr-sess"})
+            assert conn.getresponse().status == 200
+            assert stub.requests[-1]["session_id"] == "hdr-sess"
+        finally:
+            router.shutdown()
+            stub.stop()
+
+
+class TestLongPromptBurstGrammar:
+    def test_parse_long_prompt_burst(self):
+        from horovod_tpu.adaptation.faults import parse_spec
+        cs = parse_spec("rank=*:long_prompt_burst=2x120:from_step=6; "
+                        "rank=0:long_prompt_burst=64")
+        assert cs[0].long_prompt_burst == (2, 120)
+        assert cs[0].from_step == 6
+        assert cs[1].long_prompt_burst == (1, 64)   # bare count of 1
+        assert "long_prompt_burst=2x120" in repr(cs[0])
+
+    def test_bad_burst_fields_fail_loudly(self):
+        from horovod_tpu.adaptation.faults import parse_spec
+        for bad in ("rank=0:long_prompt_burst=abc",
+                    "rank=0:long_prompt_burst=0x5",
+                    "rank=0:long_prompt_burst=2x0",
+                    "rank=0:long_prompt_burst="):
+            with pytest.raises(ValueError,
+                               match="long_prompt_burst"):
+                parse_spec(bad)
+
+    def test_burst_fires_once_inside_window(self):
+        from horovod_tpu.adaptation.faults import (FaultInjector,
+                                                   parse_spec)
+        inj = FaultInjector(
+            parse_spec("rank=*:long_prompt_burst=3x40:from_step=2"),
+            rank=0)
+        assert inj.take_long_prompt_bursts() == []   # tick 0: early
+        inj.on_serving_decode()
+        inj.on_serving_decode()
+        assert inj.take_long_prompt_bursts() == [40, 40, 40]
+        assert inj.take_long_prompt_bursts() == []   # once only

@@ -1245,3 +1245,386 @@ class TestSpecAdapt:
         p = [1, 2, 3]
         assert eng.generate(p) == ref_eng.generate(p)
         assert all(s.k_eff == 4 for s in eng._spec_ctl._slots.values())
+
+
+class TestChunkedPrefill:
+    """Chunked prefill (docs/serving.md#chunked-prefill): long prompts
+    consumed as bucket-shaped chunks with at most one chunk between
+    consecutive batched decode ticks. Greedy output must be
+    TOKEN-IDENTICAL to the monolithic-prefill engine across every
+    lever combination, including mid-sequence eviction and pool
+    exhaustion."""
+
+    @pytest.fixture(scope="class")
+    def drafter(self):
+        dcfg = tfm.TransformerConfig(
+            vocab=64, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+            max_seq=64, dtype=jnp.float32, remat=False)
+        return dcfg, tfm.init_params(dcfg, jax.random.PRNGKey(9))
+
+    def _prompts(self, seed=7):
+        rng = np.random.RandomState(seed)
+        # multi-chunk (33, 41, 17) and single-chunk (3, 9) prompts mixed
+        return [[int(t) for t in rng.randint(0, 64, n)]
+                for n in (33, 3, 17, 9, 41)]
+
+    @pytest.mark.parametrize("levers", [
+        dict(),
+        dict(kv_quant="int8"),
+        dict(kv_quant="fp8"),
+        dict(prefix_cache=True),
+        dict(spec=True),
+        dict(kv_quant="int8", prefix_cache=True, spec=True),
+    ], ids=["plain", "int8", "fp8", "prefix", "spec", "all_on"])
+    def test_token_identical_to_unchunked(self, model, mesh1, drafter,
+                                          levers):
+        cfg, params = model
+        levers = dict(levers)
+        if levers.pop("spec", False):
+            dcfg, dparams = drafter
+            levers.update(spec_tokens=3, draft_params=dparams,
+                          draft_cfg=dcfg)
+        ref = _engine(params, cfg, mesh1, **levers)
+        chunked = _engine(params, cfg, mesh1, prefill_chunk=8,
+                          kv_blocks=64, **levers)
+        prompts = self._prompts()
+        reqs = [chunked.submit(p, max_new_tokens=6) for p in prompts]
+        chunked.run_until_idle()
+        assert [r.result() for r in reqs] == \
+            [ref.generate(p, max_new_tokens=6) for p in prompts]
+
+    def test_decode_proceeds_between_chunks(self, model, mesh1):
+        """The tentpole property: a 5-chunk prompt never stalls a live
+        decode — the short request emits one token per scheduler step
+        the whole way through the long prompt's chunk sequence."""
+        cfg, params = model
+        ref = _engine(params, cfg, mesh1)
+        eng = _engine(params, cfg, mesh1, prefill_chunk=8)
+        short = eng.submit([5, 6, 7], max_new_tokens=8)
+        eng.step()                          # admit + 1 chunk + token 1
+        long = eng.submit([9] * 33, max_new_tokens=4)
+        eng.step()                          # admit long: chunk 1 of 5
+        assert long.prefill_pos is not None
+        while long.prefill_pos is not None:
+            before = len(short.tokens)
+            eng.step()
+            if not short.done:
+                assert len(short.tokens) == before + 1   # no stall
+        eng.run_until_idle()
+        assert short.result() == ref.generate([5, 6, 7],
+                                              max_new_tokens=8)
+        assert long.result() == ref.generate([9] * 33,
+                                             max_new_tokens=4)
+
+    def test_chunk_metrics_and_tick_histogram(self, model, mesh1):
+        cfg, params = model
+        before = hvd.metrics_snapshot()
+        eng = _engine(params, cfg, mesh1, prefill_chunk=8)
+        eng.generate([1] * 33, max_new_tokens=4)
+        snap = hvd.metrics_snapshot()
+
+        def delta(name):
+            return (snap[name]["values"].get("", 0)
+                    - before.get(name, {"values": {}})["values"]
+                    .get("", 0))
+
+        # 33 tokens at chunk cap 8 → 5 chunks (8+8+8+8+1)
+        assert delta("hvdtpu_serving_prefill_chunks_total") == 5
+        assert snap["hvdtpu_serving_decode_tick_seconds"]["values"][
+            ""]["count"] >= 1
+
+    def test_pool_exhaustion_defers_admission_mid_sequence(
+            self, model, mesh1):
+        """While a long prompt is mid-chunk-sequence, a request the
+        pool cannot cover stays QUEUED; it admits once the long one
+        completes and both outputs match uncontended runs."""
+        cfg, params = model
+        ref = _engine(params, cfg, mesh1)
+        # usable pool 12: long takes ceil((33+4-1)/4)=9, p2 needs 4
+        eng = _engine(params, cfg, mesh1, prefill_chunk=8,
+                      kv_blocks=13)
+        long = eng.submit([3] * 33, max_new_tokens=4)
+        eng.step()
+        assert long.prefill_pos is not None
+        p2 = eng.submit([4] * 9, max_new_tokens=8)
+        eng.step()
+        assert p2.status == "queued"        # 3 free < 4 needed
+        eng.run_until_idle()
+        assert long.result() == ref.generate([3] * 33,
+                                             max_new_tokens=4)
+        assert p2.result() == ref.generate([4] * 9, max_new_tokens=8)
+        assert eng._alloc.in_use == 0
+
+    def test_eviction_mid_chunk_sequence_is_clean(self, model, mesh1):
+        """A live request finishes and is EVICTED (table row reset to
+        scratch) while another is mid-chunk-sequence — the remaining
+        chunks and the final outputs are unperturbed."""
+        cfg, params = model
+        ref = _engine(params, cfg, mesh1)
+        eng = _engine(params, cfg, mesh1, prefill_chunk=8)
+        short = eng.submit([5] * 4, max_new_tokens=3)
+        eng.step()                     # short: prefill + tokens 1, 2
+        long = eng.submit([6] * 41, max_new_tokens=4)
+        steps = 0
+        while not short.done:          # short evicts mid-sequence
+            eng.step()
+            steps += 1
+            assert steps < 50
+        assert long.prefill_pos is not None
+        eng.run_until_idle()
+        assert short.result() == ref.generate([5] * 4,
+                                              max_new_tokens=3)
+        assert long.result() == ref.generate([6] * 41,
+                                             max_new_tokens=4)
+
+    def test_budget_halves_chunk_under_measured_cost(
+            self, model, mesh1, monkeypatch):
+        """The chunk budget policy: with a tick budget set, the next
+        chunk length halves (down to the smallest bucket) while the
+        measured per-bucket prefill cost exceeds the budget."""
+        monkeypatch.setenv("HOROVOD_TPU_SERVING_TICK_BUDGET_MS", "50")
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1, prefill_chunk=32)
+        assert eng._chunk_len(100) == 32    # unmeasured: optimistic
+        eng._note_chunk_cost(32, 0.2)       # 200 ms > 50 ms budget
+        assert eng._chunk_len(100) == 16
+        eng._note_chunk_cost(16, 0.08)
+        assert eng._chunk_len(100) == 8
+        eng._note_chunk_cost(8, 0.2)        # floor: smallest bucket
+        assert eng._chunk_len(100) == 8
+        # EWMA blends; cheap remeasures re-open the larger bucket
+        eng._note_chunk_cost(32, 0.0)
+        eng._note_chunk_cost(32, 0.0)
+        eng._note_chunk_cost(32, 0.0)
+        eng._note_chunk_cost(32, 0.0)
+        assert eng._chunk_cost[32] == pytest.approx(0.0125)
+        assert eng._chunk_len(100) == 32
+
+    def test_retry_after_accounts_chunk_backlog(self, model, mesh1):
+        cfg, params = model
+        cold = _engine(params, cfg, mesh1, prefill_chunk=8,
+                       max_queue=16)
+        # cold engine, measured chunk cost, one queued 5-chunk prompt:
+        # the hint is the chunk backlog alone (no drain rate yet)
+        cold._chunk_cost[8] = 0.5
+        cold.submit([2] * 33, max_new_tokens=2)
+        assert cold.retry_after_s() == 3    # ceil(5 * 0.5)
+        cold.run_until_idle()
+        eng = _engine(params, cfg, mesh1, prefill_chunk=8,
+                      max_queue=16)
+        for _ in range(4):
+            eng.generate([1, 2], max_new_tokens=2)
+        eng._chunk_cost[8] = 0.5
+        for _ in range(2):
+            eng.submit([2] * 33, max_new_tokens=2)
+        # ceil(2 outstanding / 0.4 per s + 10 chunks * 0.5 s) = 10
+        assert eng.retry_after_s() == 10
+        eng.run_until_idle()
+
+    def test_long_prompt_burst_fault_injects_requests(
+            self, model, mesh1, monkeypatch):
+        """The declarative long_prompt_burst clause fires once when
+        the serving tick enters its window: the engine submits the
+        synthetic prompts itself and completes them."""
+        from horovod_tpu.adaptation import faults
+        monkeypatch.setenv("HOROVOD_TPU_FAULT_SPEC",
+                           "rank=*:long_prompt_burst=2x33:from_step=2")
+        monkeypatch.delenv("HOROVOD_TPU_REPLICA_ID", raising=False)
+        faults.reset()
+        try:
+            cfg, params = model
+            eng = _engine(params, cfg, mesh1, prefill_chunk=8,
+                          kv_blocks=64)
+            before = hvd.metrics_snapshot()
+            eng.generate([1, 2, 3], max_new_tokens=8)
+            eng.run_until_idle()            # finish the injected pair
+            snap = hvd.metrics_snapshot()
+            assert snap["hvdtpu_fault_injections_total"]["values"][
+                'kind="long_prompt_burst"'] - before.get(
+                "hvdtpu_fault_injections_total",
+                {"values": {}})["values"].get(
+                'kind="long_prompt_burst"', 0) == 2
+            done = 'status="completed"'
+            fam = "hvdtpu_serving_requests_total"
+            assert snap[fam]["values"][done] \
+                - before[fam]["values"].get(done, 0) == 3
+        finally:
+            faults.reset()
+
+
+class TestPrefillSpans:
+    """The pure chunk-planning helper the engine and benches share."""
+
+    def test_spans_cover_exactly_once(self):
+        spans = tfm.prefill_spans(33, 8)
+        assert spans == [(0, 8), (8, 8), (16, 8), (24, 8), (32, 1)]
+        assert sum(n for _, n in spans) == 33
+        assert tfm.prefill_spans(8, 8) == [(0, 8)]
+        assert tfm.prefill_spans(0, 8) == []
+
+    def test_offset_start(self):
+        assert tfm.prefill_spans(5, 4, start=10) == [(10, 4), (14, 1)]
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            tfm.prefill_spans(-1, 8)
+        with pytest.raises(ValueError):
+            tfm.prefill_spans(8, 0)
+
+
+class TestSessionAffinityEngine:
+    """Session KV leases (docs/serving.md#session-affinity): a
+    completed request tagged with a session_id parks its KV blocks in
+    a lease; the session's next turn resumes from them instead of
+    re-prefilling — token-identically."""
+
+    def test_second_turn_reuses_lease_token_identical(self, model,
+                                                      mesh1):
+        cfg, params = model
+        ref = _engine(params, cfg, mesh1)
+        eng = _engine(params, cfg, mesh1)
+        ctx = [7] * 9
+        r1 = eng.submit(ctx, max_new_tokens=4, session_id="conv")
+        eng.run_until_idle()
+        t1 = r1.result()
+        assert eng.session_ids() == ["conv"]
+        before = hvd.metrics_snapshot()
+        turn2 = ctx + t1 + [9, 11]
+        r2 = eng.submit(turn2, max_new_tokens=4, session_id="conv")
+        eng.run_until_idle()
+        # the lease covers context + every generated token but the
+        # last (never fed back) — strictly more than the prefix cache
+        # could index (it never covers generated tokens)
+        assert r2.cached_tokens == len(ctx) + len(t1) - 1
+        assert r2.result() == ref.generate(turn2, max_new_tokens=4)
+        assert r1.result() == ref.generate(ctx, max_new_tokens=4)
+        snap = hvd.metrics_snapshot()
+        hits = "hvdtpu_serving_session_hits_total"
+        assert snap[hits]["values"].get("", 0) \
+            - before[hits]["values"].get("", 0) == 1
+        assert eng.session_ids() == ["conv"]   # lease re-formed
+
+    def test_divergent_turn_releases_lease_and_matches(self, model,
+                                                       mesh1):
+        cfg, params = model
+        ref = _engine(params, cfg, mesh1)
+        eng = _engine(params, cfg, mesh1)
+        r1 = eng.submit([7] * 9, max_new_tokens=4, session_id="conv")
+        eng.run_until_idle()
+        # a prompt that does NOT extend the lease's tokens: full
+        # re-prefill, stale blocks released, output exact
+        div = [1, 2, 3, 4, 5]
+        r2 = eng.submit(div, max_new_tokens=4, session_id="conv")
+        eng.run_until_idle()
+        assert r2.cached_tokens == 0
+        assert r2.result() == ref.generate(div, max_new_tokens=4)
+        assert eng.session_ids() == ["conv"]   # re-formed on the new turn
+        eng2 = _engine(params, cfg, mesh1)
+        assert eng2._alloc.in_use == 0
+
+    def test_free_pressure_demotes_lease_to_prefix_cache(self, model,
+                                                         mesh1):
+        """Eviction under pool pressure is a DEMOTION: the lease's
+        full blocks become refcounted prefix-cache entries (a later
+        same-context prompt still shares them); the partial tail block
+        returns to the pool."""
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1, prefix_cache=True)
+        r = eng.submit([5] * 6, max_new_tokens=8, session_id="s1")
+        eng.run_until_idle()
+        # lease tokens = 6 + 7 = 13 over 4 blocks; prompt indexed one
+        # full block in the prefix cache at prefill time
+        assert eng.session_ids() == ["s1"]
+        assert len(eng._prefix) == 1 and eng._alloc.in_use == 4
+        assert eng._free_pressure()     # 1st: drops the idle prefix entry
+        assert eng.session_ids() == ["s1"]
+        assert eng._free_pressure()     # 2nd: demotes the lease
+        assert eng.session_ids() == []
+        # 3 full blocks of the 13 lease tokens live on as cache
+        # entries; the tail block was freed
+        assert len(eng._prefix) == 3 and eng._alloc.in_use == 3
+
+    def test_pool_pressure_evicts_lease_end_to_end(self, model, mesh1):
+        cfg, params = model
+        ref = _engine(params, cfg, mesh1)
+        # usable pool 12; the idle lease holds 4; b needs 9 → evict
+        eng = _engine(params, cfg, mesh1, kv_blocks=13)
+        r1 = eng.submit([5] * 13, max_new_tokens=4, session_id="s1")
+        eng.run_until_idle()
+        assert eng.session_ids() == ["s1"] and eng._alloc.in_use == 4
+        before = hvd.metrics_snapshot()
+        b = [6] * 33
+        r2 = eng.submit(b, max_new_tokens=4)
+        eng.run_until_idle()
+        assert r2.result() == ref.generate(b, max_new_tokens=4)
+        assert eng.session_ids() == []
+        snap = hvd.metrics_snapshot()
+        ev = "hvdtpu_serving_session_evictions_total"
+        assert snap[ev]["values"].get("", 0) \
+            - before[ev]["values"].get("", 0) == 1
+
+    def test_lease_cap_evicts_lru(self, model, mesh1):
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1, session_leases=2)
+        for i, sid in enumerate(("a", "b", "c")):
+            eng.submit([i + 1] * 5, max_new_tokens=2, session_id=sid)
+            eng.run_until_idle()
+        assert eng.session_ids() == ["b", "c"]   # LRU-oldest first
+
+    def test_sessions_disabled_by_zero(self, model, mesh1):
+        cfg, params = model
+        ref = _engine(params, cfg, mesh1)
+        eng = _engine(params, cfg, mesh1, session_leases=0)
+        r = eng.submit([3] * 7, max_new_tokens=4, session_id="x")
+        eng.run_until_idle()
+        assert r.result() == ref.generate([3] * 7, max_new_tokens=4)
+        assert eng.session_ids() == [] and eng._alloc.in_use == 0
+
+    def test_lease_composes_with_chunked_prefill(self, model, mesh1):
+        cfg, params = model
+        ref = _engine(params, cfg, mesh1)
+        eng = _engine(params, cfg, mesh1, prefill_chunk=8)
+        ctx = [3] * 20
+        r1 = eng.submit(ctx, max_new_tokens=6, session_id="c")
+        eng.run_until_idle()
+        turn2 = ctx + r1.result() + [1, 2]
+        r2 = eng.submit(turn2, max_new_tokens=6, session_id="c")
+        eng.run_until_idle()
+        assert r2.cached_tokens == len(ctx) + 5   # lease hit
+        assert r2.result() == ref.generate(turn2, max_new_tokens=6)
+        assert r1.result() == ref.generate(ctx, max_new_tokens=6)
+
+
+class TestServerSessionHTTP:
+    def test_session_id_flows_and_healthz_advertises(self, model,
+                                                     mesh1):
+        from horovod_tpu.serving.server import ServingServer
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1, max_new_tokens=4)
+        srv = ServingServer(eng, port=0, host="127.0.0.1")
+        srv.start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=120)
+            conn.request("POST", "/generate",
+                         json.dumps({"tokens": [4] * 9,
+                                     "session_id": "conv-1"}),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 200
+            json.loads(resp.read())
+            conn.request("GET", "/healthz")
+            h = json.loads(conn.getresponse().read())
+            assert h["sessions"] == ["conv-1"]
+            assert h["session_leases"] == 8
+            # the header spelling works too and reuses the lease
+            conn.request("POST", "/generate",
+                         json.dumps({"tokens": [4] * 9}),
+                         {"Content-Type": "application/json",
+                          "X-Session-Id": "conv-2"})
+            assert conn.getresponse().status == 200
+            conn.request("GET", "/healthz")
+            h = json.loads(conn.getresponse().read())
+            assert set(h["sessions"]) == {"conv-1", "conv-2"}
+        finally:
+            srv.shutdown()
