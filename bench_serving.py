@@ -47,6 +47,15 @@ greedy decode; prefix-cache TTFT p50 below baseline with the prefill
 token count to prove why; quantized pool < 0.30x resident KV bytes.
 Writes BENCH_SPEED.json.
 
+``--slo`` runs the open-loop SLO sweep (docs/serving.md#slo): a seeded
+Poisson arrival schedule fires at 4/10/25 req/s against the 3-replica
+fleet — past its ~12 req/s pinned capacity — with fixed TTFT/TPOT
+targets attached to every request, and goodput (SLO-met over OFFERED
+load) develops the knee closed-loop benches structurally hide. A
+two-tenant arm replays the identical interactive schedule with and
+without an overlapping bulk burst and reports the interactive p99
+inflation. Writes BENCH_SLO.json.
+
 ``--reqtrace`` A/Bs the per-request serving trace capture
 (docs/serving.md#request-tracing) on vs off under the same load —
 in-process toggle, alternating-order paired rounds, pooled per-request
@@ -268,6 +277,137 @@ print(json.dumps({
     "replica_restarts": sum(r.restarts for r in fleet.replicas),
     "failover_ms": fo_pct,
     "clean_stop": fleet_stop_ok,
+}))
+"""
+
+
+SLO_WORKER = r"""
+import json, os, sys, tempfile, time
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from horovod_tpu.checkpoint import CheckpointEngine
+from horovod_tpu.models import transformer as tfm
+from horovod_tpu.serving import Router, transformer_extra
+from horovod_tpu.serving import loadgen
+from horovod_tpu.serving.fleet import Fleet
+from horovod_tpu.tools.slo import _arm_from_run
+
+n_replicas = int(sys.argv[1])
+max_new = int(sys.argv[2])
+duration_s = float(sys.argv[3])
+seed = int(sys.argv[4])
+
+SLO = {"ttft_ms": 500.0, "tpot_ms": 100.0}
+SWEEP_RPS = (4, 10, 25)
+
+tmp = tempfile.mkdtemp(prefix="bench_slo_")
+ckpt = os.path.join(tmp, "ckpt")
+cfg = tfm.TransformerConfig(
+    vocab=256, d_model=64, n_heads=2, n_layers=2, d_ff=128,
+    max_seq=128, dtype=jnp.float32, remat=False)
+params = tfm.init_params(cfg, jax.random.PRNGKey(42))
+CheckpointEngine(ckpt, process_count=1, barrier=lambda n: None).save(
+    params, 1, block=True, extra=transformer_extra(cfg))
+
+env = dict(os.environ)
+# CPU decode speed is machine-dependent; pinning the per-token cost
+# with a deterministic slow_decode fault makes fleet capacity — and
+# therefore where the knee lands — an experimental constant
+# (~2 slots x 3 replicas / (max_new x 20ms) ~= 12 req/s).
+env["HOROVOD_TPU_FAULT_SPEC"] = "rank=*:slow_decode=20ms"
+fleet = Fleet(n_replicas,
+              ["--checkpoint-dir", ckpt, "--tp", "1",
+               "--block-size", "8", "--kv-blocks", "64",
+               "--slots", "2", "--max-new-tokens", str(max_new)],
+              env=env)
+router = Router(fleet, port=0, host="127.0.0.1",
+                scrape_interval_s=0.1)
+fleet.start()
+fleet.wait_ready(600.0)
+router.start()
+
+import http.client
+
+def warm(n_tokens, rounds):
+    # Distinct prompts (no prefix-cache shortcut) so every replica
+    # compiles this prefill bucket before the clock starts.
+    for i in range(rounds):
+        conn = http.client.HTTPConnection("127.0.0.1", router.port,
+                                          timeout=300)
+        conn.request("POST", "/generate",
+                     json.dumps({"tokens": [2 + i] * n_tokens,
+                                 "max_new_tokens": 2}),
+                     {"Content-Type": "application/json"})
+        conn.getresponse().read()
+        conn.close()
+
+for n_tokens in (6, 12, 48):   # buckets 8 / 16 / 64
+    warm(n_tokens, 2 * n_replicas)
+
+sweep = {}
+for rps in SWEEP_RPS:
+    tenant = loadgen.TenantSpec("sweep", prompt_len=(8, 16),
+                                max_new_tokens=max_new, slo=SLO)
+    sched = loadgen.build_schedule(rps, duration_s, seed + rps,
+                                   [tenant])
+    run = loadgen.run_schedule(sched, "127.0.0.1", router.port,
+                               max_inflight=256, timeout_s=120.0)
+    arm = _arm_from_run("rps%d" % rps, run, offered_rps=rps)
+    arm["schedule_checksum"] = loadgen.schedule_checksum(sched)
+    arm["duration_s"] = duration_s
+    sweep["rps%d" % rps] = arm
+
+# Two-tenant arm: the interactive tenant keeps the SAME seeded
+# schedule in both runs; the only difference is the bulk burst
+# overlapping the first half. Whatever its p99 does is the bulk
+# tenant's doing.
+interactive = loadgen.TenantSpec("interactive", prompt_len=(8, 16),
+                                 max_new_tokens=8, slo=SLO)
+bulk = loadgen.TenantSpec("bulk", prompt_len=(48, 64),
+                          max_new_tokens=max_new)
+ia = loadgen.build_schedule(3.0, duration_s, seed, [interactive])
+bb = loadgen.build_schedule(6.0, duration_s / 2, seed + 1, [bulk])
+ia_checksum = loadgen.schedule_checksum(ia)
+
+run_a = loadgen.run_schedule(ia, "127.0.0.1", router.port,
+                             max_inflight=256, timeout_s=120.0)
+arm_a = _arm_from_run("interactive_only", run_a, offered_rps=3.0)
+arm_a["schedule_checksum"] = ia_checksum
+
+merged = sorted(ia + bb, key=lambda a: a.t_s)
+run_b = loadgen.run_schedule(merged, "127.0.0.1", router.port,
+                             max_inflight=256, timeout_s=120.0)
+arm_b = _arm_from_run("with_bulk_burst", run_b,
+                      offered_rps=3.0 + 6.0 * 0.5)
+arm_b["interactive_schedule_checksum"] = loadgen.schedule_checksum(
+    [a for a in merged if a.tenant == "interactive"])
+arm_b["bulk_schedule_checksum"] = loadgen.schedule_checksum(bb)
+
+clean_stop = True
+try:
+    router.shutdown()
+    fleet.stop()
+except Exception:
+    clean_stop = False
+
+p99_alone = arm_a["tenants"]["interactive"]["ttft_p99_ms"]
+p99_burst = arm_b["tenants"]["interactive"]["ttft_p99_ms"]
+print(json.dumps({
+    "sweep": sweep,
+    "two_tenant": {
+        "interactive_only": arm_a,
+        "with_bulk_burst": arm_b,
+        "interactive_schedules_identical": (
+            arm_b["interactive_schedule_checksum"] == ia_checksum),
+        "interactive_ttft_p99_alone_ms": p99_alone,
+        "interactive_ttft_p99_under_burst_ms": p99_burst,
+        "interactive_p99_inflation": round(
+            p99_burst / max(p99_alone, 1e-9), 3),
+    },
+    "clean_stop": clean_stop,
 }))
 """
 
@@ -1279,6 +1419,83 @@ def run_fleet(out_path):
     print(json.dumps(result))
 
 
+SLO_MAX_NEW = 24
+SLO_DURATION_S = 6.0
+SLO_SEED = 1000
+
+
+def run_slo(out_path):
+    """The --slo arm: open-loop offered-load sweep against the
+    3-replica fleet at fixed TTFT/TPOT SLOs (writes BENCH_SLO.json).
+    Closed-loop benches adapt their arrival rate to whatever the fleet
+    absorbs, so queueing collapse never shows; the seeded Poisson
+    schedule here keeps firing past saturation, and goodput (requests
+    meeting their SLO, over OFFERED load) develops a measurable knee."""
+    env = dict(os.environ)
+    env.pop("HOROVOD_TPU_METRICS", None)
+    env.pop("HOROVOD_TPU_FAULT_SPEC", None)   # the worker sets its own
+    proc = subprocess.run(
+        [sys.executable, "-c", SLO_WORKER, "3", str(SLO_MAX_NEW),
+         str(SLO_DURATION_S), str(SLO_SEED)],
+        env=env, capture_output=True, text=True, timeout=900,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"slo bench worker failed:\n{proc.stderr[-3000:]}")
+    r = json.loads(proc.stdout.strip().splitlines()[-1])
+
+    from horovod_tpu.tools.slo import find_knee
+    arms = sorted(r["sweep"].values(),
+                  key=lambda a: a.get("offered_rps") or 0.0)
+    knee = find_knee(arms, target_ttft_ms=500.0)
+    result = {
+        "metric": "slo_goodput_vs_offered_load",
+        "model": {"d_model": 64, "n_layers": 2, "n_heads": 2,
+                  "vocab": 256, "dtype": "float32"},
+        "config": {
+            "replicas": 3, "slots_per_replica": 2,
+            "max_new_tokens": SLO_MAX_NEW,
+            "duration_s": SLO_DURATION_S, "seed": SLO_SEED,
+            "arrival_process": "poisson",
+            "slo": {"ttft_ms": 500.0, "tpot_ms": 100.0},
+            "fault": "rank=*:slow_decode=20ms",
+            "sweep_rps": [4, 10, 25],
+            "max_inflight": 256,
+        },
+        "note": ("Open-loop (MLPerf-style, arXiv 1909.09756) offered-"
+                 "load sweep on the 3-replica fleet with per-token "
+                 "cost pinned by a deterministic slow_decode fault "
+                 "(capacity ~12 req/s). Arm names, schedule checksums "
+                 "and offered counts are seeded-deterministic; "
+                 "goodput/percentiles are wall-clock. Headlines: "
+                 "goodput tracks offered load until the knee, then "
+                 "falls below it (has_knee); the two-tenant arm "
+                 "replays the IDENTICAL interactive schedule with and "
+                 "without an overlapping bulk burst and reports the "
+                 "interactive tenant's TTFT p99 inflation — the "
+                 "before-picture priority classes will fix."),
+        "sweep": r["sweep"],
+        "two_tenant": r["two_tenant"],
+        "clean_stop": r["clean_stop"],
+        "headlines": {
+            "has_knee": knee is not None,
+            "knee_rps": None if knee is None
+            else knee.get("offered_rps"),
+            "goodput_frac_at_knee": None if knee is None
+            else knee.get("goodput_frac"),
+            "interactive_schedules_identical":
+                r["two_tenant"]["interactive_schedules_identical"],
+            "interactive_p99_inflation":
+                r["two_tenant"]["interactive_p99_inflation"],
+        },
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+            f.write("\n")
+    print(json.dumps(result))
+
+
 def run_arm(slots: int, concurrency: int) -> dict:
     env = dict(os.environ)
     env.pop("HOROVOD_TPU_METRICS", None)   # percentiles need recording
@@ -1324,6 +1541,11 @@ def main() -> None:
                          "prefix cache alone; writes/updates the "
                          "session_affinity row in BENCH_SPEED.json "
                          "(--out)")
+    ap.add_argument("--slo", action="store_true",
+                    help="open-loop offered-load sweep at fixed "
+                         "TTFT/TPOT SLOs on the 3-replica fleet, plus "
+                         "a two-tenant bulk-burst arm; writes "
+                         "BENCH_SLO.json with --out")
     ap.add_argument("--reqtrace", action="store_true",
                     help="A/B per-request tracing on/off under the "
                          "BENCH_SERVING load; writes "
@@ -1347,6 +1569,9 @@ def main() -> None:
         return
     if args.session_affinity:
         run_session_affinity(args.out)
+        return
+    if args.slo:
+        run_slo(args.out)
         return
     if args.reqtrace:
         run_reqtrace(args.out, rounds=args.reqtrace_rounds)

@@ -200,13 +200,14 @@ def with_percentiles(snap: dict, qs=(0.5, 0.9, 0.99)) -> dict:
 # JSON snapshot file
 # --------------------------------------------------------------------------
 
-def json_safe_snapshot(prefix: Optional[str] = None) -> dict:
+def json_safe_snapshot(prefix=None) -> dict:
     """Registry snapshot with ``inf`` bucket bounds replaced by the
     string "+Inf" — strict JSON (``json.dumps`` would emit the invalid
     bare ``Infinity`` literal otherwise). ``prefix=`` filters families
-    like :func:`registry.snapshot` — per-tick consumers (the fleet
-    history sampler scraping ``/metrics.json?prefix=hvdtpu_serving_``)
-    should never serialize the whole registry."""
+    like :func:`registry.snapshot` (a str or a tuple of prefixes) —
+    per-tick consumers (the fleet history sampler scraping
+    ``/metrics.json?prefix=hvdtpu_serving_,hvdtpu_slo_``) should never
+    serialize the whole registry."""
     snap = _reg.snapshot(prefix=prefix)
     for fam in snap.values():
         if fam["type"] != "histogram":
@@ -291,6 +292,12 @@ class MetricsServer:
                     kv.split("=", 1) for kv in query.split("&")
                     if "=" in kv)
                 prefix = params.get("prefix") or None
+                if prefix and "," in prefix:
+                    # Comma-separated prefixes select a union of
+                    # families (the fleet history sampler scrapes
+                    # ?prefix=hvdtpu_serving_,hvdtpu_slo_) — the
+                    # registry accepts a tuple.
+                    prefix = tuple(p for p in prefix.split(",") if p)
                 if route == "/metrics":
                     # Content negotiation: a scraper that asks for
                     # OpenMetrics gets exemplars (# {trace_id=...}

@@ -76,6 +76,7 @@ from ..observability import registry as _obs
 from ..utils import env as _env
 from ..utils.logging import get_logger
 from . import reqtrace as _rt
+from . import slo as _slo
 from .kv_cache import (SCRATCH_BLOCK, BlockAllocator, PrefixCache,
                        SessionLeaseTable, blocks_needed, prefix_hashes)
 
@@ -123,21 +124,27 @@ def _metrics():
             "hvdtpu_serving_tokens_total",
             "Tokens processed, kind=prompt (prefilled) or "
             "kind=generated"),
+        # queue_wait/ttft/tpot are stored as FAMILIES: tenanted
+        # requests observe into their {tenant=...} child, untenanted
+        # ones into the unlabeled child (_observe_latency), so legacy
+        # consumers of the "" series see exactly the pre-tenant shape.
         "queue_wait": r.histogram(
             "hvdtpu_serving_queue_wait_seconds",
             "Submit → admission wait — the queue share of the "
             "per-request latency budget (exemplar: trace id of the "
-            "worst recent wait)", buckets=_obs.LATENCY_BUCKETS).labels(),
+            "worst recent wait; tenanted requests carry a tenant "
+            "label)", buckets=_obs.LATENCY_BUCKETS),
         "ttft": r.histogram(
             "hvdtpu_serving_ttft_seconds",
             "Time to first token: submit → first sampled token "
             "(includes queue wait; exemplar: trace id of the worst "
-            "recent request)", buckets=_obs.LATENCY_BUCKETS
-        ).labels(),
+            "recent request; tenanted requests carry a tenant label)",
+            buckets=_obs.LATENCY_BUCKETS),
         "tpot": r.histogram(
             "hvdtpu_serving_tpot_seconds",
             "Time per output token after the first (per live slot per "
-            "decode step)", buckets=_obs.LATENCY_BUCKETS).labels(),
+            "decode step; tenanted requests carry a tenant label)",
+            buckets=_obs.LATENCY_BUCKETS),
         "prefill": r.histogram(
             "hvdtpu_serving_prefill_seconds",
             "Prefill forward duration (per admitted request)",
@@ -264,7 +271,9 @@ class Request:
                  max_new_tokens: int, temperature: float,
                  deadline: Optional[float] = None,
                  trace_id: Optional[str] = None,
-                 session_id: Optional[str] = None):
+                 session_id: Optional[str] = None,
+                 tenant: Optional[str] = None,
+                 slo: Optional["_slo.SloTargets"] = None):
         self.id = rid
         # One trace id end-to-end (docs/serving.md#request-tracing):
         # the router mints it and ships it via X-Request-Id, so the
@@ -291,6 +300,13 @@ class Request:
         #                           prefix blocks or a session lease
         #                           (prefill skips them)
         self.session_id = str(session_id) if session_id else None
+        # SLO attribution (docs/serving.md#slo): ``tenant`` is the
+        # RESOLVED bounded-cardinality label (slo.resolve_tenant), or
+        # None for untenanted requests (legacy metric shape); ``slo``
+        # the resolved targets; ``slo_verdict`` is stamped at _finish.
+        self.tenant = tenant
+        self.slo = slo
+        self.slo_verdict: Optional[dict] = None
         self.prefill_pos: Optional[int] = None  # chunked prefill
         #                           cursor: next prompt position to
         #                           prefill; None = not mid-prefill
@@ -508,7 +524,9 @@ class InferenceEngine:
                temperature: Optional[float] = None,
                deadline_s: Optional[float] = None,
                trace_id: Optional[str] = None,
-               session_id: Optional[str] = None) -> Request:
+               session_id: Optional[str] = None,
+               tenant: Optional[str] = None,
+               slo=None) -> Request:
         """Enqueue a request; returns immediately with its ticket.
         Raises :exc:`QueueFullError` past ``max_queue`` (the HTTP 429
         path) and :exc:`DrainingError` after drain began.
@@ -521,8 +539,21 @@ class InferenceEngine:
         None mints a local one. ``session_id`` names a conversation
         (docs/serving.md#session-affinity): completion stores a KV
         lease under it, and a later turn whose prompt extends the
-        stored context resumes decoding instead of re-prefilling."""
+        stored context resumes decoding instead of re-prefilling.
+
+        ``tenant``/``slo`` attach SLO attribution
+        (docs/serving.md#slo): the tenant name is collapsed to a
+        bounded-cardinality label, the targets resolve request-field >
+        tenant config > env defaults, and the completed request is
+        stamped with a ``slo_verdict``."""
         c = self.config
+        # Resolve SLO attribution before validation: a shed (queue
+        # full) request must still be attributable to its tenant.
+        tlabel = _slo.resolve_tenant(tenant) if (tenant or slo) \
+            else None
+        targets = _slo.policy().resolve(tenant, slo)
+        if targets is not None and tlabel is None:
+            tlabel = _slo.resolve_tenant(tenant)
         max_new = int(max_new_tokens if max_new_tokens is not None
                       else c.max_new_tokens)
         temp = float(temperature if temperature is not None
@@ -547,14 +578,19 @@ class InferenceEngine:
             if self._draining:
                 raise DrainingError("server is draining")
             if len(self._queue) >= c.max_queue:
-                self._m["requests"].labels(status="rejected").inc()
+                self._count_request("rejected", tlabel)
+                # Shed load stays visible in goodput math
+                # (docs/serving.md#slo): the 429 path attributes the
+                # rejection to its tenant.
+                _slo.record_shed(tlabel or _slo.DEFAULT_TENANT, "shed")
                 raise QueueFullError(
                     f"admission queue full ({c.max_queue})")
             deadline = None if deadline_s is None \
                 else time.monotonic() + float(deadline_s)
             req = Request(self._next_id, prompt, max_new, temp,
                           deadline=deadline, trace_id=trace_id,
-                          session_id=session_id)
+                          session_id=session_id, tenant=tlabel,
+                          slo=targets)
             self._next_id += 1
             self._queue.append(req)
             self._m["queue_depth"].set(len(self._queue))
@@ -813,9 +849,9 @@ class InferenceEngine:
                 self._m["prefix_misses"].inc(len(hashes) - len(shared))
             self._queue.popleft()
             t_admit_m = time.monotonic()
-            self._m["queue_wait"].observe(
-                time.perf_counter() - req.t_submit,
-                exemplar=req.trace_id)
+            self._observe_latency(
+                "queue_wait", time.perf_counter() - req.t_submit,
+                tenant=req.tenant, exemplar=req.trace_id)
             req.blocks = lease_blocks + shared + fresh
             req.cached_tokens = lease_tokens if lease is not None \
                 else len(shared) * bs
@@ -905,8 +941,8 @@ class InferenceEngine:
         req.tokens.append(first)
         req._notify()
         self._last_tok[req.slot] = first
-        self._m["ttft"].observe(req.t_first_token - req.t_submit,
-                                exemplar=req.trace_id)
+        self._observe_latency("ttft", req.t_first_token - req.t_submit,
+                              tenant=req.tenant, exemplar=req.trace_id)
         self._m["tokens"].labels(kind="generated").inc()
         _flight.recorder().note(
             "request", ("first_token", req.trace_id,
@@ -1116,7 +1152,8 @@ class InferenceEngine:
             req.tokens.append(tok)
             req._notify()
             self._last_tok[slot] = tok
-            self._m["tpot"].observe(dt, exemplar=req.trace_id)
+            self._observe_latency("tpot", dt, tenant=req.tenant,
+                                  exemplar=req.trace_id)
             self._m["tokens"].labels(kind="generated").inc()
             if w is not None:
                 # The step wall as THIS request experienced it — the
@@ -1229,7 +1266,8 @@ class InferenceEngine:
             self._last_tok[slot] = emit[-1]
             for tok in emit:
                 req.tokens.append(int(tok))
-                self._m["tpot"].observe(dt, exemplar=req.trace_id)
+                self._observe_latency("tpot", dt, tenant=req.tenant,
+                                      exemplar=req.trace_id)
                 self._m["tokens"].labels(kind="generated").inc()
             req._notify()
             if w is not None:
@@ -1296,16 +1334,58 @@ class InferenceEngine:
             self._m["session_evictions"].inc()
         return kept
 
+    def _count_request(self, status: str,
+                       tenant: Optional[str] = None) -> None:
+        """Tenanted requests get a {status=, tenant=} child so per-
+        tenant traffic is attributable; untenanted ones keep the
+        pre-tenant {status=} shape (sum over children stays correct)."""
+        if tenant:
+            self._m["requests"].labels(status=status,
+                                       tenant=tenant).inc()
+        else:
+            self._m["requests"].labels(status=status).inc()
+
+    def _observe_latency(self, key: str, value: float,
+                         tenant: Optional[str] = None,
+                         exemplar: Optional[str] = None) -> None:
+        fam = self._m[key]
+        child = fam.labels(tenant=tenant) if tenant else fam.labels()
+        child.observe(value, exemplar=exemplar)
+
+    def _judge_slo(self, req: Request) -> None:
+        """Stamp a completed SLO-attached request with its verdict and
+        count it into the hvdtpu_slo_* families."""
+        ttft_s = req.ttft_s
+        tpot_s = None
+        if (req.t_first_token is not None and req.t_done is not None
+                and len(req.tokens) > 1):
+            tpot_s = ((req.t_done - req.t_first_token)
+                      / (len(req.tokens) - 1))
+        verdict = _slo.judge(req.slo, ttft_s, tpot_s)
+        req.slo_verdict = verdict
+        _slo.record_completion(
+            req.tenant or _slo.DEFAULT_TENANT, verdict,
+            req.t_done - req.t_submit, ttft_s, tpot_s,
+            len(req.tokens), trace_id=req.trace_id)
+
     def _finish(self, req: Request, status: str,
                 error: Optional[str] = None) -> None:
         req.status = status
         req.error = error
         req.t_done = time.perf_counter()
+        if status == "completed" and req.slo is not None:
+            self._judge_slo(req)
+        elif error == DEADLINE_ERROR and (req.tenant
+                                          or req.slo is not None):
+            _slo.record_shed(req.tenant or _slo.DEFAULT_TENANT,
+                             "deadline")
+        note = status if error is None else f"{status}: {error}"[:200]
+        if req.tenant:
+            note += (f" tenant={req.tenant}"
+                     f" slo={_slo.verdict_summary(req.slo_verdict)}")
         _flight.recorder().note(
-            "request", ("finish", req.trace_id,
-                        status if error is None
-                        else f"{status}: {error}"[:200]))
-        self._m["requests"].labels(status=status).inc()
+            "request", ("finish", req.trace_id, note))
+        self._count_request(status, req.tenant)
         if status == "completed":
             now = req.t_done
             self._completions.append(now)

@@ -53,8 +53,10 @@ from ..utils.logging import get_logger
 _log = get_logger("serving.fleet")
 
 # Families the replica history sampler scrapes — the serving signal
-# plane, not the whole registry (docs/health.md#fleet).
-_REPLICA_HISTORY_PREFIX = "hvdtpu_serving_"
+# plane plus the SLO/goodput families, not the whole registry
+# (docs/health.md#fleet, docs/serving.md#slo). Comma-separated: the
+# replica's /metrics.json splits it into a prefix union.
+_REPLICA_HISTORY_PREFIX = "hvdtpu_serving_,hvdtpu_slo_"
 
 # The replica's announce line (serving/__main__.py). The leading
 # ``ready on :PORT`` phrase is load-bearing API — tests and the
@@ -290,7 +292,7 @@ class Fleet:
             webhook_url=url) if detectors else None
         self._history.append(_history.HistorySampler(
             directory, "fleet",
-            prefix="hvdtpu_fleet_",
+            prefix=("hvdtpu_fleet_", "hvdtpu_slo_"),
             monitor=fleet_monitor,
             meta=lambda: {"role": "fleet_supervisor"},
         ).start())

@@ -33,7 +33,10 @@ Each request renders as its own named row (row name == trace id), so
 the merged Perfetto view shows one request's life crossing process
 lanes, and the ``serving`` report (tools/trace.py) computes per-request
 latency-budget tables, slowest-request rankings and failover chains
-from the same files.
+from the same files. Tenant + SLO verdict ride the span args — the
+router's ``REQUEST`` and each egress' ``EGRESS`` carry ``tenant`` and
+``slo_met`` (docs/serving.md#slo), so budget tables attribute per
+tenant and flag the misses.
 
 Clock domain: serving fleets spawned by ``fleet.py`` are same-host
 processes (the supervisor owns local pipes), and ``time.monotonic`` is

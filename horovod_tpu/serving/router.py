@@ -65,6 +65,7 @@ from ..observability import registry as _obs
 from ..utils import env as _env
 from ..utils.logging import get_logger
 from . import reqtrace as _rt
+from . import slo as _slo
 from .engine import DEADLINE_ERROR
 from .fleet import ReplicaEndpoint
 from .kv_cache import prefix_hashes
@@ -414,7 +415,8 @@ class Router:
     def _relay(self, rid: str, prompt: List[int], max_new: int,
                temperature: Optional[float],
                deadline: Optional[float], emit,
-               session_id: Optional[str] = None) -> dict:
+               session_id: Optional[str] = None,
+               tenant: Optional[str] = None, slo=None) -> dict:
         """Drive one client request across the fleet until it
         completes (see :meth:`_relay_attempts`), timing the wall: the
         ``REQUEST`` trace span and the ``hvdtpu_fleet_request_seconds``
@@ -424,18 +426,52 @@ class Router:
         t0m = time.monotonic()
         meta = self._relay_attempts(rid, prompt, max_new, temperature,
                                     deadline, emit,
-                                    session_id=session_id)
+                                    session_id=session_id,
+                                    tenant=tenant, slo=slo)
         t1m = time.monotonic()
         self._m["request_s"].observe(t1m - t0m, exemplar=rid)
-        _rt.span(rid, "REQUEST", t0m, t1m,
-                 {"status": meta["status"], "retries": meta["retries"],
-                  "tokens": len(meta["tokens"])})
+        span_args = {"status": meta["status"],
+                     "retries": meta["retries"],
+                     "tokens": len(meta["tokens"])}
+        if tenant or slo is not None:
+            label = _slo.resolve_tenant(tenant)
+            span_args["tenant"] = meta.get("tenant", label)
+            if isinstance(meta.get("slo"), dict):
+                span_args["slo_met"] = meta["slo"].get("slo_met")
+            self._account_slo(label, meta)
+        _rt.span(rid, "REQUEST", t0m, t1m, span_args)
         return meta
+
+    def _account_slo(self, tenant_label: str, meta: dict) -> None:
+        """Fleet-side goodput recount from the replica's verdict: the
+        router re-counts hvdtpu_slo_* in ITS registry (real fleets
+        keep one registry per process), and is the only place that
+        sees requests no replica ever answered — those land as shed
+        or deadline here (docs/serving.md#slo)."""
+        status = meta.get("status")
+        if status == "completed":
+            verdict = meta.get("slo")
+            if not isinstance(verdict, dict):
+                return
+            m = _slo.metrics()
+            if verdict.get("slo_met"):
+                m["goodput"].labels(tenant=tenant_label).inc()
+                return
+            for dim in ("ttft", "tpot"):
+                if verdict.get(f"{dim}_violation"):
+                    m["violations"].labels(tenant=tenant_label,
+                                           reason=dim).inc()
+        elif status == "expired":
+            _slo.record_shed(tenant_label, "deadline")
+        elif status == "failed":
+            _slo.record_shed(tenant_label, "shed")
 
     def _relay_attempts(self, rid: str, prompt: List[int],
                         max_new: int, temperature: Optional[float],
                         deadline: Optional[float], emit,
-                        session_id: Optional[str] = None) -> dict:
+                        session_id: Optional[str] = None,
+                        tenant: Optional[str] = None,
+                        slo=None) -> dict:
         """Pick → stream → (on death) fail over, until terminal.
         ``emit(tok)`` is called once per generated token in order;
         returns the terminal meta dict {"status": ..., "retries": N,
@@ -504,7 +540,8 @@ class Router:
             outcome = self._stream_from(
                 rid, view.endpoint, prompt + emitted,
                 max_new - len(emitted), temperature, deadline,
-                emitted, emit_observed, session_id=session_id)
+                emitted, emit_observed, session_id=session_id,
+                tenant=tenant, slo=slo)
             _rt.span(rid, "DISPATCH", t_att, time.monotonic(),
                      {"replica": idx, "outcome": outcome["kind"]})
             if outcome["kind"] == "done":
@@ -542,7 +579,8 @@ class Router:
                      prompt: List[int], max_new: int,
                      temperature: Optional[float],
                      deadline: Optional[float], emitted: List[int],
-                     emit, session_id: Optional[str] = None) -> dict:
+                     emit, session_id: Optional[str] = None,
+                     tenant: Optional[str] = None, slo=None) -> dict:
         """One dispatch attempt against one replica, streaming. Appends
         to ``emitted`` / calls ``emit`` as tokens land. Returns a
         tagged outcome: done / deadline / bad_request, or a retryable
@@ -553,6 +591,10 @@ class Router:
             body["temperature"] = temperature
         if session_id:
             body["session_id"] = session_id
+        if tenant:
+            body["tenant"] = tenant
+        if slo is not None:
+            body["slo"] = slo
         if deadline is not None:
             remaining_ms = (deadline - time.monotonic()) * 1e3
             if remaining_ms <= 0:
@@ -603,7 +645,8 @@ class Router:
                         if obj.get("status") == "completed":
                             return {"kind": "done", "meta": {
                                 k: obj[k] for k in ("ttft_ms",
-                                                    "latency_ms")
+                                                    "latency_ms",
+                                                    "tenant", "slo")
                                 if k in obj}}
                         if DEADLINE_ERROR in str(obj.get("error")):
                             return {"kind": "deadline"}
@@ -695,6 +738,13 @@ class Router:
                     deadline_ms = body.get(
                         "deadline_ms",
                         self.headers.get("X-Request-Deadline-Ms"))
+                    # Tenant + SLO attribution (docs/serving.md#slo):
+                    # validated here so a malformed "slo" is a 400 at
+                    # the front door, not a retry storm.
+                    tenant = self.headers.get("X-Tenant") \
+                        or body.get("tenant")
+                    slo_req = body.get("slo")
+                    _slo.parse_slo(slo_req)
                 except (KeyError, ValueError, TypeError,
                         json.JSONDecodeError) as e:
                     outer._m["requests"].labels(
@@ -718,30 +768,43 @@ class Router:
                 sid = str(sid) if sid else None
                 if stream:
                     self._do_stream(rid, tokens, max_new, temperature,
-                                    deadline, sid)
+                                    deadline, sid, tenant, slo_req)
                 else:
                     self._do_unary(rid, tokens, max_new, temperature,
-                                   deadline, sid)
+                                   deadline, sid, tenant, slo_req)
 
             def _do_unary(self, rid, tokens, max_new, temperature,
-                          deadline, session_id=None) -> None:
+                          deadline, session_id=None, tenant=None,
+                          slo=None) -> None:
                 t0 = time.perf_counter()
                 meta = outer._relay(rid, tokens, max_new, temperature,
                                     deadline, emit=lambda t: None,
-                                    session_id=session_id)
+                                    session_id=session_id,
+                                    tenant=tenant, slo=slo)
                 outer._count(meta["status"])
                 if meta["status"] == "completed":
                     t_egress = time.monotonic()
-                    self._reply(200, {
+                    reply = {
                         "id": rid, "trace_id": rid,
                         "tokens": meta["tokens"],
                         "retries": meta["retries"],
                         "replica": meta.get("replica"),
                         "latency_ms": round(
-                            (time.perf_counter() - t0) * 1e3, 3)})
+                            (time.perf_counter() - t0) * 1e3, 3)}
+                    egress_args = {"tokens": len(meta["tokens"])}
+                    if "ttft_ms" in meta:
+                        reply["ttft_ms"] = meta["ttft_ms"]
+                    if "tenant" in meta:
+                        reply["tenant"] = meta["tenant"]
+                        egress_args["tenant"] = meta["tenant"]
+                    if "slo" in meta:
+                        reply["slo"] = meta["slo"]
+                        if isinstance(meta["slo"], dict):
+                            egress_args["slo_met"] = \
+                                meta["slo"].get("slo_met")
+                    self._reply(200, reply)
                     _rt.span(rid, "EGRESS", t_egress,
-                             time.monotonic(),
-                             {"tokens": len(meta["tokens"])})
+                             time.monotonic(), egress_args)
                 elif meta["status"] == "expired":
                     self._reply(504, {"error": DEADLINE_ERROR,
                                       "trace_id": rid,
@@ -756,7 +819,8 @@ class Router:
                                 headers={"Retry-After": 1})
 
             def _do_stream(self, rid, tokens, max_new, temperature,
-                           deadline, session_id=None) -> None:
+                           deadline, session_id=None, tenant=None,
+                           slo=None) -> None:
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "application/x-ndjson")
@@ -774,7 +838,8 @@ class Router:
                     meta = outer._relay(
                         rid, tokens, max_new, temperature, deadline,
                         emit=lambda t: line({"t": t}),
-                        session_id=session_id)
+                        session_id=session_id,
+                        tenant=tenant, slo=slo)
                     outer._count(meta["status"])
                     done = {"done": True,
                             "status": ("completed"
@@ -783,6 +848,10 @@ class Router:
                             "n": len(meta["tokens"]),
                             "trace_id": rid,
                             "retries": meta["retries"]}
+                    for k in ("ttft_ms", "latency_ms", "tenant",
+                              "slo"):
+                        if k in meta:
+                            done[k] = meta[k]
                     if meta["status"] != "completed":
                         done["error"] = meta.get("error")
                     line(done)

@@ -60,6 +60,7 @@ from ..observability import registry as _obs
 from ..utils import env as _env
 from ..utils.logging import get_logger
 from . import reqtrace as _rt
+from . import slo as _slo
 from .engine import (DEADLINE_ERROR, DrainingError, InferenceEngine,
                      QueueFullError)
 
@@ -192,12 +193,22 @@ class ServingServer:
                         self.headers.get("X-Request-Deadline-Ms"))
                     deadline_s = None if deadline_ms in (None, "") \
                         else float(deadline_ms) / 1e3
+                    # Tenant + SLO attribution: router forwards the
+                    # tenant in X-Tenant (body "tenant" for plain
+                    # clients); "slo" is always body-borne
+                    # (docs/serving.md#slo).
+                    tenant = self.headers.get("X-Tenant") \
+                        or body.get("tenant")
+                    _slo.parse_slo(body.get("slo"))
                 except (KeyError, ValueError, TypeError,
                         json.JSONDecodeError) as e:
                     self._reply(400, {"error": f"bad request: {e}"},
                                 "generate")
                     return
                 if deadline_s is not None and deadline_s <= 0:
+                    if tenant or body.get("slo") is not None:
+                        _slo.record_shed(_slo.resolve_tenant(tenant),
+                                         "deadline")
                     self._reply(504, {"error": DEADLINE_ERROR},
                                 "generate")
                     return
@@ -219,7 +230,9 @@ class ServingServer:
                         temperature=body.get("temperature"),
                         deadline_s=deadline_s,
                         trace_id=trace_id,
-                        session_id=session_id)
+                        session_id=session_id,
+                        tenant=tenant,
+                        slo=body.get("slo"))
                 except QueueFullError as e:
                     self._reply(429, {"error": str(e)}, "generate",
                                 headers={"Retry-After":
@@ -251,16 +264,25 @@ class ServingServer:
                     self._reply(code, {"error": str(e)}, "generate")
                     return
                 t_egress = time.monotonic()
-                self._reply(200, {
+                reply = {
                     "id": req.id,
                     "trace_id": req.trace_id,
                     "tokens": out,
                     "ttft_ms": round(req.ttft_s * 1e3, 3),
                     "latency_ms": round(
                         (req.t_done - req.t_submit) * 1e3, 3),
-                }, "generate")
+                }
+                egress_args = {"tokens": len(out)}
+                if req.tenant:
+                    reply["tenant"] = req.tenant
+                    egress_args["tenant"] = req.tenant
+                if req.slo_verdict is not None:
+                    reply["slo"] = req.slo_verdict
+                    egress_args["slo_met"] = \
+                        req.slo_verdict["slo_met"]
+                self._reply(200, reply, "generate")
                 _rt.span(req.trace_id, "EGRESS", t_egress,
-                         time.monotonic(), {"tokens": len(out)})
+                         time.monotonic(), egress_args)
 
             def _stream(self, req, wait_s: float) -> None:
                 """NDJSON token stream: header line, one line per
@@ -298,10 +320,18 @@ class ServingServer:
                             (req.t_done - req.t_submit) * 1e3, 3)
                     else:
                         meta["error"] = req.error
+                    egress_args = {"tokens": idx}
+                    if req.tenant:
+                        meta["tenant"] = req.tenant
+                        egress_args["tenant"] = req.tenant
+                    if req.slo_verdict is not None:
+                        meta["slo"] = req.slo_verdict
+                        egress_args["slo_met"] = \
+                            req.slo_verdict["slo_met"]
                     t_egress = time.monotonic()
                     line(meta)
                     _rt.span(req.trace_id, "EGRESS", t_egress,
-                             time.monotonic(), {"tokens": idx})
+                             time.monotonic(), egress_args)
                 except TimeoutError:
                     line({"done": True, "status": "failed",
                           "error": "stream timed out", "n": idx})

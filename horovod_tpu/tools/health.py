@@ -48,14 +48,18 @@ KEY_SERIES_FAMILIES = (
     "hvdtpu_serving_queue_depth",
     "hvdtpu_fleet_replica_queue_depth",
     "hvdtpu_serving_requests_per_second",
+    "hvdtpu_slo_goodput_total",
+    "hvdtpu_slo_violations_total",
 )
 
 # Direction-aware regression semantics: which way is WORSE.
+# _DOWN_WORSE is checked first, so "goodput" wins over the "_total"
+# suffix a counter family carries.
 _UP_WORSE = ("seconds", "queue_depth", "bytes_in_use", "share",
              "lateness", "restarts_total", "failures_total",
-             "errors_total", "stalled", "blocked")
+             "errors_total", "stalled", "blocked", "violations")
 _DOWN_WORSE = ("mfu", "per_second", "replicas_live", "replicas_ready",
-               "acceptance")
+               "acceptance", "goodput")
 
 
 def _direction(series_key: str) -> int:

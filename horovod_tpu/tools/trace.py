@@ -646,9 +646,23 @@ def serving_report(traces: List[RankTrace], top: int = 10) -> dict:
                 "reprefill_proc": (reprefill["proc"]
                                    if reprefill else None),
             })
+        # Tenant + SLO verdict ride the span args: the router stamps
+        # REQUEST, the replica's egress stamps EGRESS — either names
+        # the tenant, and slo_met is the judged verdict
+        # (docs/serving.md#slo).
+        tenant = None
+        slo_met = None
+        for x in ([request] if request is not None else []) + [
+                s for s in spans if s["name"] == "EGRESS"]:
+            if tenant is None:
+                tenant = x["args"].get("tenant")
+            if slo_met is None:
+                slo_met = x["args"].get("slo_met")
         requests[tid] = {
             "wall_ms": round(wall_us / 1e3, 3),
             "processes": sorted(rec["procs"]),
+            "tenant": tenant,
+            "slo_met": slo_met,
             "spans": len(spans),
             "phase_ms": {p: round(phase_us.get(p, 0.0) / 1e3, 3)
                          for p in REQ_PHASES},
@@ -668,6 +682,8 @@ def serving_report(traces: List[RankTrace], top: int = 10) -> dict:
         "requests": requests,
         "slowest": [{"trace": k, "wall_ms": requests[k]["wall_ms"],
                      "phase_share": requests[k]["phase_share"],
+                     "tenant": requests[k]["tenant"],
+                     "slo_met": requests[k]["slo_met"],
                      "failovers": len(requests[k]["failovers"])}
                     for k in slowest],
         "n_failovers": sum(len(r["failovers"])
@@ -684,17 +700,21 @@ def format_serving_report(report: dict) -> str:
         f"{report['n_failovers']} failover(s)",
         "",
         f"{'trace id':<20}  {'wall':>9}  {'queue':>6} {'prefil':>6} "
-        f"{'decode':>6} {'failov':>6}  {'attrib':>6}  procs",
+        f"{'decode':>6} {'failov':>6}  {'attrib':>6}  {'slo':>4}  "
+        f"procs",
     ]
     for row in report["slowest"]:
         r = report["requests"][row["trace"]]
         sh = r["phase_share"]
+        slo = ("-" if r.get("slo_met") is None
+               else "met" if r["slo_met"] else "MISS")
         lines.append(
             f"{row['trace']:<20}  {r['wall_ms']:>7.1f}ms  "
             f"{sh['queue']:>6.1%} {sh['prefill']:>6.1%} "
             f"{sh['decode']:>6.1%} {sh['failover']:>6.1%}  "
-            f"{r['attributed_frac']:>6.1%}  "
+            f"{r['attributed_frac']:>6.1%}  {slo:>4}  "
             f"{len(r['processes'])}"
+            + (f"  tenant={r['tenant']}" if r.get("tenant") else "")
             + ("  [failover]" if r["failovers"] else ""))
     chains = [(tid, f) for tid, r in report["requests"].items()
               for f in r["failovers"]]

@@ -457,6 +457,48 @@ def fleet_probe_failures() -> int:
     return int(v)
 
 
+def slo_ttft_ms() -> Optional[float]:
+    """Fleet-default time-to-first-token SLO target in milliseconds
+    (docs/serving.md#slo). Used when a request carries no explicit
+    ``slo`` field and its tenant has no entry in the SLO config file.
+    None (the default) attaches no TTFT target."""
+    v = _get("SLO_TTFT_MS")
+    if v in (None, ""):
+        return None
+    return float(v)
+
+
+def slo_tpot_ms() -> Optional[float]:
+    """Fleet-default time-per-output-token SLO target in milliseconds
+    (docs/serving.md#slo), same resolution order as
+    :func:`slo_ttft_ms`. None attaches no TPOT target."""
+    v = _get("SLO_TPOT_MS")
+    if v in (None, ""):
+        return None
+    return float(v)
+
+
+def slo_config() -> Optional[str]:
+    """Path to the fleet SLO config file (docs/serving.md#slo): JSON
+    ``{"tenants": {name: {"ttft_ms", "tpot_ms"}}, "default": {...}}``
+    giving per-tenant default targets. None/empty means no per-tenant
+    defaults — only the env-level targets apply."""
+    v = _get("SLO_CONFIG")
+    return v or None
+
+
+def max_tenants() -> int:
+    """Cardinality cap on the ``tenant`` metric label
+    (docs/serving.md#slo): the first N distinct tenant names keep
+    their own label value; later ones collapse into the ``"other"``
+    overflow bucket so a client fabricating tenant names cannot grow
+    the registry without bound. Default 16."""
+    v = _get("MAX_TENANTS")
+    if v in (None, ""):
+        return 16
+    return max(1, int(v))
+
+
 def timeline_mark_cycles() -> bool:
     return _get("TIMELINE_MARK_CYCLES") not in (None, "", "0")
 

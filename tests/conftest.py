@@ -9,12 +9,25 @@ sharding machinery as a real v5e-8, minus the ICI.
 """
 
 import os
+import tempfile
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Persistent XLA compilation cache, shared by the suite and every
+# subprocess it spawns (env vars are inherited): the suite compiles
+# the same tiny models over and over — each InferenceEngine/worker
+# re-jits identical HLO — and the cache collapses the repeats. Keyed
+# on HLO + compile options, so mixed device counts are safe; set via
+# env (not jax.config) so fleet replicas and bench workers get it too.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(tempfile.gettempdir(), "hvdtpu-test-xla-cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                      "0.5")
 
 import jax  # noqa: E402
 

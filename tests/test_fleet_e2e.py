@@ -400,6 +400,157 @@ class TestRequestTraceE2E:
 
 
 @pytest.mark.slow
+class TestSloObservabilityE2E:
+    def test_slo_attached_request_end_to_end(self, tmp_path):
+        """ACCEPTANCE (docs/serving.md#slo): an SLO-attached request
+        on a 3-replica fleet is followed end to end — the tenant and
+        judged verdict come back in the response body, the router's
+        fleet-side hvdtpu_slo_* counters and the serving replica's own
+        registry both count it (the violation histogram's exemplar
+        linking the violating request's trace id), the merged request
+        trace's budget report names tenant + verdict, and the
+        replica's flight-recorder blackbox carries the request finish
+        event with its tenant and violation summary."""
+        from horovod_tpu.observability import metrics_snapshot
+        from horovod_tpu.serving import reqtrace
+
+        ckpt = str(tmp_path / "ckpt")
+        rt = str(tmp_path / "rt")
+        bb = str(tmp_path / "bb")
+        _write_checkpoint(ckpt)
+
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "HOROVOD_TPU_REQTRACE": rt,
+            "HOROVOD_TPU_BLACKBOX": bb,
+        })
+        fleet = Fleet(3, ["--checkpoint-dir", ckpt, "--tp", "1",
+                          "--block-size", "4", "--kv-blocks", "64",
+                          "--slots", "2", "--max-new-tokens", "8"],
+                      env=env)
+        router = Router(fleet, port=0, host="127.0.0.1",
+                        scrape_interval_s=0.1)
+        os.makedirs(rt, exist_ok=True)
+        reqtrace.start(os.path.join(rt, "reqtrace-router.trace.json"),
+                       rank=0, proc="router")
+        try:
+            fleet.start()
+            fleet.wait_ready(600.0)
+            router.start()
+
+            # Tenant "gold": unreachable targets — judged AND met.
+            status, gold = _post(
+                router.port,
+                {"tokens": [3, 5, 7, 9], "max_new_tokens": 8,
+                 "tenant": "gold",
+                 "slo": {"ttft_ms": 1e6, "tpot_ms": 1e6}})
+            assert status == 200, gold
+            assert gold["tenant"] == "gold"
+            assert gold["slo"]["slo_met"] is True
+            assert gold["slo"]["ttft_violation"] is False
+
+            # Same tenant over the streaming path: the done line
+            # carries the verdict too.
+            conn = http.client.HTTPConnection("127.0.0.1",
+                                              router.port, timeout=300)
+            conn.request("POST", "/generate",
+                         json.dumps({"tokens": [4, 6, 8],
+                                     "max_new_tokens": 8,
+                                     "stream": True, "tenant": "gold",
+                                     "slo": {"ttft_ms": 1e6}}),
+                         {"Content-Type": "application/json"})
+            lines = [json.loads(ln) for ln in
+                     conn.getresponse().read().splitlines()
+                     if ln.strip()]
+            conn.close()
+            done = lines[-1]
+            assert done.get("done") and done["status"] == "completed"
+            assert done["tenant"] == "gold"
+            assert done["slo"]["slo_met"] is True
+
+            # Tenant "bulk": a sub-millisecond TTFT target no real
+            # request can meet — a guaranteed, judged violation.
+            status, bulk = _post(
+                router.port,
+                {"tokens": [11, 13, 17, 19, 23], "max_new_tokens": 8,
+                 "tenant": "bulk", "slo": {"ttft_ms": 0.0001}})
+            assert status == 200, bulk
+            assert bulk["tenant"] == "bulk"
+            assert bulk["slo"]["slo_met"] is False
+            assert bulk["slo"]["ttft_violation"] is True
+            assert bulk["trace_id"]
+
+            # Fleet-side accounting: the ROUTER process (this one)
+            # re-counts verdicts from the replies it relayed.
+            snap = metrics_snapshot()
+            good = snap["hvdtpu_slo_goodput_total"]["values"]
+            assert good.get('tenant="gold"', 0) >= 2, good
+            viol = snap["hvdtpu_slo_violations_total"]["values"]
+            assert viol.get('reason="ttft",tenant="bulk"', 0) >= 1
+
+            # Replica-side: the replica that judged the bulk request
+            # holds the violation counter AND the violation histogram
+            # whose exemplar links the violating trace id.
+            rep = fleet.replicas[bulk["replica"]]
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", rep.metrics_port, timeout=30)
+            conn.request("GET", "/metrics.json")
+            rsnap = json.loads(conn.getresponse().read())
+            conn.close()
+            rviol = rsnap["hvdtpu_slo_violations_total"]["values"]
+            assert rviol.get('reason="ttft",tenant="bulk"', 0) >= 1, \
+                rviol
+            hist = rsnap["hvdtpu_slo_violation_seconds"]["values"]
+            ex = hist['tenant="bulk"']["exemplar"]
+            assert ex["trace_id"] == bulk["trace_id"]
+            # and the per-tenant request histogram saw both tenants
+            reqh = rsnap["hvdtpu_slo_request_seconds"]["values"]
+            assert 'tenant="bulk"' in reqh
+        finally:
+            router.shutdown()
+            fleet.stop()
+            reqtrace.stop()
+
+        # --- merged request trace: the budget report names the tenant
+        # and the judged verdict for both requests.
+        out = tmp_path / "serving_report.json"
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.tools.trace",
+             "serving", rt, "--report", str(out)],
+            capture_output=True, text=True, timeout=300, cwd=ROOT)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        report = json.loads(out.read_text())
+        brow = report["requests"][bulk["trace_id"]]
+        assert brow["tenant"] == "bulk"
+        assert brow["slo_met"] is False
+        grow = report["requests"][gold["trace_id"]]
+        assert grow["tenant"] == "gold"
+        assert grow["slo_met"] is True
+        # the human table renders the verdict column + tenant suffix
+        assert "MISS" in proc.stdout
+        assert "tenant=bulk" in proc.stdout
+
+        # --- flight recorder: the serving replica's blackbox (dumped
+        # at the drained SIGTERM exit, quarantined per incarnation)
+        # carries the request finish event with tenant + violation.
+        gen0 = os.path.join(bb, "gen0")
+        path = os.path.join(
+            gen0, f"blackbox-rank{bulk['replica']}.jsonl")
+        assert os.path.exists(path), os.listdir(bb)
+        events = [json.loads(ln) for ln in open(path)
+                  if ln.strip()]
+        finishes = [e for e in events
+                    if e.get("kind") == "request"
+                    and e.get("event") == "finish"
+                    and e.get("trace") == bulk["trace_id"]]
+        assert finishes, "blackbox must carry the request's finish"
+        assert "tenant=bulk" in finishes[0]["detail"]
+        assert "slo=ttft" in finishes[0]["detail"]
+
+
+@pytest.mark.slow
 class TestFleetBenchReproducible:
     def test_bench_fleet_determinism_and_availability(self, tmp_path):
         """bench_serving.py --fleet regenerates BENCH_FLEET

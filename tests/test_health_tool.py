@@ -116,6 +116,57 @@ class TestBaseline:
         assert b["improvements"]
 
 
+def _write_slo_run(directory, *, goodput_rps=10.0, violations_rps=0.5,
+                   samples=40):
+    """Synthesize a serving replica's history with the hvdtpu_slo_*
+    families the fleet sampler scrapes (counters land as per-second
+    rates under the bare series key)."""
+    w = _history.HistoryWriter(
+        str(directory), "replica0",
+        meta=lambda: {"replica": 0, "generation": 0,
+                      "role": "serving_replica",
+                      "offset_to_rank0_us": 0.0,
+                      "clock_synced": True})
+    for i in range(samples):
+        w.append({"t_us": 1_000_000 + i * 100_000,
+                  "u": 1000.0 + i, "dt_s": 0.1,
+                  "s": {'hvdtpu_slo_goodput_total{tenant="gold"}':
+                            goodput_rps,
+                        'hvdtpu_slo_violations_total'
+                        '{reason="ttft",tenant="gold"}':
+                            violations_rps}})
+    w.close()
+
+
+class TestSloSeries:
+    def test_goodput_series_gets_headline_sparkline(self, tmp_path):
+        _write_slo_run(tmp_path)
+        report = _tool.analyze(_history.load_history([str(tmp_path)]))
+        rows = report["sparklines"]["replica0"]
+        assert 'hvdtpu_slo_goodput_total{tenant="gold"}' in rows
+        assert ('hvdtpu_slo_violations_total'
+                '{reason="ttft",tenant="gold"}') in rows
+
+    def test_direction_semantics(self):
+        # Goodput falling is worse; violations rising is worse. The
+        # goodput marker must win over the generic counter suffix.
+        assert _tool._direction(
+            'hvdtpu_slo_goodput_total{tenant="a"}') == -1
+        assert _tool._direction(
+            'hvdtpu_slo_violations_total{reason="ttft",tenant="a"}') \
+            == 1
+
+    def test_goodput_drop_is_a_baseline_regression(self, tmp_path):
+        _write_slo_run(tmp_path / "base", goodput_rps=10.0)
+        _write_slo_run(tmp_path / "cur", goodput_rps=6.0)
+        cur = _history.load_history([str(tmp_path / "cur")])
+        base = _history.load_history([str(tmp_path / "base")])
+        b = _tool.compare_baseline(cur, base)
+        assert b["verdict"] == "regressions"
+        assert any("slo_goodput" in r["series"]
+                   for r in b["regressions"])
+
+
 class TestCLI:
     def _run(self, *argv):
         proc = subprocess.run(

@@ -1,0 +1,471 @@
+"""SLO observability plane (docs/serving.md#slo): target resolution,
+bounded tenant cardinality, verdict judging, the per-tenant label on
+the serving families, the open-loop load generator's determinism and
+drop accounting, and the goodput report tool. The fleet-level e2e
+(tenant + verdict through router → replica → trace → flight recorder)
+lives in test_fleet_e2e.py (slow tier)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+from horovod_tpu.models import transformer as tfm
+from horovod_tpu.parallel.mesh import create_mesh
+from horovod_tpu.serving import (InferenceEngine, QueueFullError,
+                                 ServingConfig)
+from horovod_tpu.serving import loadgen as _loadgen
+from horovod_tpu.serving import slo as _slo
+from horovod_tpu.tools import slo as _slo_tool
+
+
+@pytest.fixture(autouse=True)
+def _fresh_slo_state():
+    _slo._reset_policy()
+    _slo._reset_tenants()
+    yield
+    _slo._reset_policy()
+    _slo._reset_tenants()
+
+
+# --------------------------------------------------------------------------
+# Target parsing + policy resolution
+# --------------------------------------------------------------------------
+
+class TestParseSlo:
+    def test_none_passes_through(self):
+        assert _slo.parse_slo(None) is None
+
+    def test_valid_dict(self):
+        t = _slo.parse_slo({"ttft_ms": 500, "tpot_ms": 50.5})
+        assert t.ttft_ms == 500.0 and t.tpot_ms == 50.5
+        assert bool(t)
+
+    def test_partial_dict(self):
+        t = _slo.parse_slo({"ttft_ms": 100})
+        assert t.ttft_ms == 100.0 and t.tpot_ms is None
+        assert t.to_dict() == {"ttft_ms": 100.0}
+
+    @pytest.mark.parametrize("bad", [
+        "fast", 42, ["ttft_ms"],
+        {"ttft_ms": 0}, {"ttft_ms": -1}, {"ttft_ms": True},
+        {"ttft_ms": "500"}, {"deadline_ms": 5},
+    ])
+    def test_invalid_raises(self, bad):
+        with pytest.raises(ValueError):
+            _slo.parse_slo(bad)
+
+
+class TestSloPolicy:
+    def test_no_config_no_env_resolves_none(self, monkeypatch):
+        monkeypatch.delenv("HOROVOD_TPU_SLO_TTFT_MS", raising=False)
+        monkeypatch.delenv("HOROVOD_TPU_SLO_TPOT_MS", raising=False)
+        monkeypatch.delenv("HOROVOD_TPU_SLO_CONFIG", raising=False)
+        p = _slo.SloPolicy()
+        assert p.resolve("anyone", None) is None
+
+    def test_request_beats_tenant_beats_default(self, tmp_path,
+                                                monkeypatch):
+        cfg = tmp_path / "slo.json"
+        cfg.write_text(json.dumps({
+            "tenants": {"interactive": {"ttft_ms": 200}},
+            "default": {"ttft_ms": 1000, "tpot_ms": 80},
+        }))
+        monkeypatch.delenv("HOROVOD_TPU_SLO_TTFT_MS", raising=False)
+        monkeypatch.delenv("HOROVOD_TPU_SLO_TPOT_MS", raising=False)
+        p = _slo.SloPolicy(config_path=str(cfg))
+        # Field-wise overlay: tenant names ttft, default fills tpot.
+        t = p.resolve("interactive", None)
+        assert (t.ttft_ms, t.tpot_ms) == (200.0, 80.0)
+        # Request field wins over both.
+        t = p.resolve("interactive", {"ttft_ms": 50})
+        assert (t.ttft_ms, t.tpot_ms) == (50.0, 80.0)
+        # Unknown tenant falls through to default.
+        t = p.resolve("stranger", None)
+        assert (t.ttft_ms, t.tpot_ms) == (1000.0, 80.0)
+
+    def test_env_fills_default(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_TPU_SLO_TTFT_MS", "750")
+        monkeypatch.delenv("HOROVOD_TPU_SLO_TPOT_MS", raising=False)
+        monkeypatch.delenv("HOROVOD_TPU_SLO_CONFIG", raising=False)
+        p = _slo.SloPolicy()
+        t = p.resolve(None, None)
+        assert t.ttft_ms == 750.0 and t.tpot_ms is None
+
+    def test_unreadable_config_is_ignored(self, tmp_path, monkeypatch):
+        bad = tmp_path / "broken.json"
+        bad.write_text("{not json")
+        monkeypatch.delenv("HOROVOD_TPU_SLO_TTFT_MS", raising=False)
+        monkeypatch.delenv("HOROVOD_TPU_SLO_TPOT_MS", raising=False)
+        p = _slo.SloPolicy(config_path=str(bad))
+        assert p.resolve("x", None) is None
+
+
+class TestTenantCardinality:
+    def test_cap_and_overflow(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_TPU_MAX_TENANTS", "2")
+        assert _slo.resolve_tenant("a") == "a"
+        assert _slo.resolve_tenant("b") == "b"
+        assert _slo.resolve_tenant("c") == _slo.OVERFLOW_TENANT
+        # Sticky: tenants that made the table keep their label, the
+        # overflow mapping is remembered too.
+        assert _slo.resolve_tenant("a") == "a"
+        assert _slo.resolve_tenant("c") == _slo.OVERFLOW_TENANT
+
+    def test_no_name_is_default(self):
+        assert _slo.resolve_tenant(None) == _slo.DEFAULT_TENANT
+        assert _slo.resolve_tenant("") == _slo.DEFAULT_TENANT
+
+    def test_registry_cardinality_is_bounded(self, monkeypatch):
+        """The satellite contract: a client fabricating tenant names
+        cannot grow the registry — every name past the cap counts into
+        the one "other" child."""
+        monkeypatch.setenv("HOROVOD_TPU_MAX_TENANTS", "3")
+        fam = _slo.metrics()["goodput"]
+        for i in range(50):
+            fam.labels(tenant=_slo.resolve_tenant(f"attacker{i}")).inc()
+        vals = hvd.metrics_snapshot()["hvdtpu_slo_goodput_total"][
+            "values"]
+        named = {k for k in vals if "attacker" in k}
+        # 3 named tenants + the overflow bucket, never 50 children.
+        assert len(named) == 3
+        assert vals['tenant="other"'] >= 47.0
+
+
+class TestJudge:
+    def test_met(self):
+        t = _slo.SloTargets(ttft_ms=100, tpot_ms=50)
+        v = _slo.judge(t, ttft_s=0.05, tpot_s=0.01)
+        assert v["slo_met"] and not v["ttft_violation"]
+        assert v["ttft_ms"] == 50.0
+        assert v["target_ttft_ms"] == 100
+
+    def test_ttft_miss(self):
+        t = _slo.SloTargets(ttft_ms=10)
+        v = _slo.judge(t, ttft_s=0.05, tpot_s=None)
+        assert not v["slo_met"] and v["ttft_violation"]
+        assert not v["tpot_violation"]
+
+    def test_single_token_tpot_trivially_passes(self):
+        t = _slo.SloTargets(tpot_ms=1)
+        v = _slo.judge(t, ttft_s=0.5, tpot_s=None)
+        assert v["slo_met"]
+
+    def test_verdict_summary(self):
+        assert _slo.verdict_summary(None) == "-"
+        assert _slo.verdict_summary({"slo_met": True}) == "met"
+        assert _slo.verdict_summary(
+            {"slo_met": False, "ttft_violation": True,
+             "tpot_violation": True}) == "ttft,tpot"
+
+
+# --------------------------------------------------------------------------
+# Engine: verdict stamping, per-tenant labels, shed accounting
+# --------------------------------------------------------------------------
+
+def _cfg(**over):
+    kw = dict(vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+              max_seq=64, dtype=jnp.float32, remat=False)
+    kw.update(over)
+    return tfm.TransformerConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    return create_mesh(devices=jax.devices()[:1], tp=1)
+
+
+def _engine(params, cfg, mesh, **over):
+    kw = dict(block_size=4, kv_blocks=40, max_batch_slots=4,
+              max_queue=8, max_new_tokens=8, min_prefill_bucket=8)
+    kw.update(over)
+    return InferenceEngine(params, cfg, mesh, ServingConfig(**kw))
+
+
+class TestEngineSlo:
+    def test_untenanted_request_keeps_pretenant_shape(self, model,
+                                                      mesh1):
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1)
+        req = eng.submit([1, 2, 3])
+        eng.run_until_idle()
+        assert req.status == "completed"
+        assert req.tenant is None and req.slo_verdict is None
+        snap = hvd.metrics_snapshot()
+        assert 'status="completed"' in \
+            snap["hvdtpu_serving_requests_total"]["values"]
+        # The unlabeled ttft child took the observation.
+        assert snap["hvdtpu_serving_ttft_seconds"]["values"][""][
+            "count"] >= 1
+
+    def test_met_and_missed_verdicts(self, model, mesh1):
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1)
+        ok = eng.submit([1, 2, 3], tenant="gold",
+                        slo={"ttft_ms": 1e6, "tpot_ms": 1e6})
+        bad = eng.submit([4, 5, 6], tenant="gold",
+                         slo={"ttft_ms": 1e-4})
+        eng.run_until_idle()
+        assert ok.slo_verdict["slo_met"] is True
+        assert bad.slo_verdict["slo_met"] is False
+        assert bad.slo_verdict["ttft_violation"] is True
+        assert bad.slo_verdict["target_ttft_ms"] == 1e-4
+        snap = hvd.metrics_snapshot()
+        good = snap["hvdtpu_slo_goodput_total"]["values"]
+        viol = snap["hvdtpu_slo_violations_total"]["values"]
+        assert good['tenant="gold"'] >= 1.0
+        assert viol['reason="ttft",tenant="gold"'] >= 1.0
+        # Tenant-labelled children on the serving histograms, and the
+        # violation histogram's exemplar names the violating request.
+        assert snap["hvdtpu_serving_ttft_seconds"]["values"][
+            'tenant="gold"']["count"] >= 2
+        ex = snap["hvdtpu_slo_violation_seconds"]["values"][
+            'tenant="gold"'].get("exemplar")
+        assert ex and ex["trace_id"] == bad.trace_id
+        # Per-tenant token accounting followed the completions.
+        assert snap["hvdtpu_slo_tokens_total"]["values"][
+            'tenant="gold"'] >= 2.0
+
+    def test_tenant_without_slo_is_counted_not_judged(self, model,
+                                                      mesh1,
+                                                      monkeypatch):
+        monkeypatch.delenv("HOROVOD_TPU_SLO_TTFT_MS", raising=False)
+        monkeypatch.delenv("HOROVOD_TPU_SLO_TPOT_MS", raising=False)
+        monkeypatch.delenv("HOROVOD_TPU_SLO_CONFIG", raising=False)
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1)
+        req = eng.submit([7, 8, 9], tenant="bronze")
+        eng.run_until_idle()
+        assert req.tenant == "bronze"
+        assert req.slo is None and req.slo_verdict is None
+        snap = hvd.metrics_snapshot()
+        assert snap["hvdtpu_serving_requests_total"]["values"][
+            'status="completed",tenant="bronze"'] >= 1.0
+
+    def test_queue_full_records_shed_with_tenant(self, model, mesh1):
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1, max_queue=1)
+        # Stall admission by never running the scheduler; fill the
+        # queue, then overflow it with a tenanted request.
+        eng.submit([1, 2, 3])
+        with pytest.raises(QueueFullError):
+            for i in range(10):
+                eng.submit([1, 2, 3 + i], tenant="burst",
+                           slo={"ttft_ms": 100})
+        snap = hvd.metrics_snapshot()
+        viol = snap["hvdtpu_slo_violations_total"]["values"]
+        assert viol['reason="shed",tenant="burst"'] >= 1.0
+        eng.run_until_idle()
+
+
+# --------------------------------------------------------------------------
+# Open-loop load generator
+# --------------------------------------------------------------------------
+
+_MIX = [
+    _loadgen.TenantSpec("interactive", weight=3.0, prompt_len=(4, 8),
+                        max_new_tokens=4, slo={"ttft_ms": 500}),
+    _loadgen.TenantSpec("bulk", weight=1.0, prompt_len=(24, 32),
+                        max_new_tokens=16),
+]
+
+
+class TestLoadgenSchedule:
+    def test_fixed_seed_is_byte_identical(self):
+        a = _loadgen.build_schedule(8.0, 3.0, 123, _MIX)
+        b = _loadgen.build_schedule(8.0, 3.0, 123, _MIX)
+        assert [x.to_dict() for x in a] == [x.to_dict() for x in b]
+        assert _loadgen.schedule_checksum(a) \
+            == _loadgen.schedule_checksum(b)
+        # And a different seed is a different schedule.
+        c = _loadgen.build_schedule(8.0, 3.0, 124, _MIX)
+        assert _loadgen.schedule_checksum(c) \
+            != _loadgen.schedule_checksum(a)
+
+    def test_constant_process_spacing(self):
+        a = _loadgen.build_schedule(4.0, 2.0, 7, _MIX,
+                                    process="constant")
+        gaps = {round(b.t_s - x.t_s, 6) for x, b in zip(a, a[1:])}
+        assert gaps == {0.25}
+
+    def test_mix_and_prompt_shapes(self):
+        a = _loadgen.build_schedule(20.0, 5.0, 99, _MIX)
+        tenants = {x.tenant for x in a}
+        assert tenants == {"interactive", "bulk"}
+        for x in a:
+            spec = next(s for s in _MIX if s.name == x.tenant)
+            lo, hi = spec.prompt_len
+            assert lo <= len(x.tokens) <= hi
+            assert x.slo == spec.slo
+
+    def test_save_load_round_trip(self, tmp_path):
+        a = _loadgen.build_schedule(6.0, 2.0, 11, _MIX)
+        path = str(tmp_path / "sched.jsonl")
+        _loadgen.save_schedule(a, path)
+        b = _loadgen.load_schedule(path)
+        assert b == a
+        assert _loadgen.schedule_checksum(b) \
+            == _loadgen.schedule_checksum(a)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            _loadgen.build_schedule(0.0, 1.0, 1, _MIX)
+        with pytest.raises(ValueError):
+            _loadgen.build_schedule(1.0, 1.0, 1, [])
+        with pytest.raises(ValueError):
+            _loadgen.build_schedule(1.0, 1.0, 1, _MIX,
+                                    process="uniform")
+
+
+class TestLoadgenRun:
+    def test_open_loop_drop_accounting_sums_to_offered(self):
+        """A sender slower than the arrival rate with a 2-wide window
+        MUST drop — and offered == sent + dropped, with every drop
+        accounted by reason."""
+        sched = _loadgen.build_schedule(50.0, 1.0, 5, _MIX,
+                                        process="constant")
+        release = threading.Event()
+
+        def stuck_sender(arrival):
+            release.wait(timeout=10.0)
+            return {"ttft_ms": 1.0, "latency_ms": 2.0}
+
+        t0 = time.perf_counter()
+        # Fire the release after the schedule has fully played out.
+        threading.Timer(1.2, release.set).start()
+        run = _loadgen.run_schedule(sched, sender=stuck_sender,
+                                    max_inflight=2, timeout_s=15.0)
+        assert run["offered"] == len(sched)
+        assert run["sent"] + run["dropped"] == run["offered"]
+        assert run["dropped"] > 0
+        assert run["drop_reasons"] == {
+            _loadgen.DROP_REASON_INFLIGHT: run["dropped"]}
+        dropped_rows = [r for r in run["results"]
+                        if r["status"] == "dropped"]
+        assert len(dropped_rows) == run["dropped"]
+        assert all(r["drop_reason"] == _loadgen.DROP_REASON_INFLIGHT
+                   for r in dropped_rows)
+        # Open loop: the wall tracks the schedule, not the stuck
+        # sender x offered (a closed loop would take ~offered/2 x wait).
+        assert time.perf_counter() - t0 < 10.0
+
+    def test_summarize_goodput_counts_drops_against_offered(self):
+        sched = _loadgen.build_schedule(40.0, 1.0, 6, _MIX,
+                                        process="constant")
+
+        def fast_sender(arrival):
+            out = {"ttft_ms": 5.0, "latency_ms": 9.0,
+                   "tenant": arrival.tenant}
+            if arrival.slo is not None:
+                out["slo"] = {"slo_met": True}
+            return out
+
+        run = _loadgen.run_schedule(sched, sender=fast_sender,
+                                    max_inflight=256, timeout_s=15.0)
+        s = _loadgen.summarize(run)
+        assert s["totals"]["offered"] == run["offered"]
+        assert s["totals"]["dropped"] == 0
+        assert s["totals"]["goodput_frac"] == 1.0
+        offered = sum(t["offered"] for t in s["tenants"].values())
+        assert offered == run["offered"]
+        inter = s["tenants"]["interactive"]
+        assert inter["slo_met"] == inter["completed"]
+        assert inter["ttft_p99_ms"] == 5.0
+
+
+# --------------------------------------------------------------------------
+# Goodput report tool
+# --------------------------------------------------------------------------
+
+class TestSloTool:
+    def _fake_run(self, goodput_frac, p99, rps, name):
+        n = 20
+        good = int(round(n * goodput_frac))
+        results = []
+        for i in range(n):
+            met = i < good
+            results.append({
+                "tenant": "t", "t_s": i * 0.05,
+                "status": "completed",
+                "ttft_ms": p99 if not met else p99 / 10,
+                "latency_ms": p99, "slo": {"slo_met": met}})
+        return {"offered": n, "sent": n, "dropped": 0,
+                "drop_reasons": {}, "wall_s": 1.0,
+                "offered_rps": rps, "name": name,
+                "results": results}
+
+    def test_knee_detection(self, tmp_path):
+        paths = []
+        for i, (frac, p99, rps) in enumerate(
+                [(1.0, 50, 4), (0.95, 200, 10), (0.5, 2000, 25)]):
+            p = tmp_path / f"run{i}.json"
+            p.write_text(json.dumps(
+                self._fake_run(frac, p99, rps, f"rps{rps}")))
+            paths.append(str(p))
+        report = _slo_tool.build_report(paths, target_ttft_ms=500.0)
+        assert [a["name"] for a in report["arms"]] \
+            == ["rps4", "rps10", "rps25"]
+        assert report["knee"]["name"] == "rps25"
+        text = _slo_tool.format_report(report)
+        assert "<-- knee" in text and "rps25" in text
+
+    def test_no_knee(self, tmp_path):
+        p = tmp_path / "run.json"
+        p.write_text(json.dumps(self._fake_run(1.0, 50, 4, "rps4")))
+        report = _slo_tool.build_report([str(p)],
+                                        target_ttft_ms=500.0)
+        assert report["knee"] is None
+        assert "no knee" in _slo_tool.format_report(report)
+
+    def test_baseline_regression_exit_code(self, tmp_path,
+                                           capsys):
+        cur = tmp_path / "cur.json"
+        base = tmp_path / "base.json"
+        cur.write_text(json.dumps(self._fake_run(0.6, 900, 10,
+                                                 "rps10")))
+        base.write_text(json.dumps(self._fake_run(1.0, 60, 10,
+                                                  "rps10")))
+        rc = _slo_tool.main([str(cur), "--baseline", str(base)])
+        assert rc == 3
+        assert "REGRESSED" in capsys.readouterr().out
+        # And the other way round is clean (an improvement).
+        rc = _slo_tool.main([str(base), "--baseline", str(cur)])
+        assert rc == 0
+
+
+# --------------------------------------------------------------------------
+# Export: comma-separated prefix union (the fleet scrape shape)
+# --------------------------------------------------------------------------
+
+class TestPrefixUnion:
+    def test_metrics_json_comma_prefix(self):
+        import urllib.request
+
+        from horovod_tpu.observability import MetricsServer
+        from horovod_tpu.observability import registry as _reg
+        _reg.registry().counter("hvdtpu_slotest_a_total", "x").inc()
+        _reg.registry().counter("hvdtpu_slotest2_b_total", "x").inc()
+        _reg.registry().counter("hvdtpu_slotest3_c_total", "x").inc()
+        srv = MetricsServer(0)
+        try:
+            url = (f"http://127.0.0.1:{srv.port}/metrics.json"
+                   f"?prefix=hvdtpu_slotest_,hvdtpu_slotest2_")
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                snap = json.loads(resp.read())
+            assert "hvdtpu_slotest_a_total" in snap
+            assert "hvdtpu_slotest2_b_total" in snap
+            assert "hvdtpu_slotest3_c_total" not in snap
+        finally:
+            srv.stop()
