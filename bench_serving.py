@@ -331,11 +331,11 @@ router.start()
 
 import http.client
 
-def warm(n_tokens, rounds):
+def warm(n_tokens, rounds, port):
     # Distinct prompts (no prefix-cache shortcut) so every replica
     # compiles this prefill bucket before the clock starts.
     for i in range(rounds):
-        conn = http.client.HTTPConnection("127.0.0.1", router.port,
+        conn = http.client.HTTPConnection("127.0.0.1", port,
                                           timeout=300)
         conn.request("POST", "/generate",
                      json.dumps({"tokens": [2 + i] * n_tokens,
@@ -345,7 +345,7 @@ def warm(n_tokens, rounds):
         conn.close()
 
 for n_tokens in (6, 12, 48):   # buckets 8 / 16 / 64
-    warm(n_tokens, 2 * n_replicas)
+    warm(n_tokens, 2 * n_replicas, router.port)
 
 sweep = {}
 for rps in SWEEP_RPS:
@@ -395,6 +395,132 @@ except Exception:
 
 p99_alone = arm_a["tenants"]["interactive"]["ttft_p99_ms"]
 p99_burst = arm_b["tenants"]["interactive"]["ttft_p99_ms"]
+
+# ---- QoS arm (docs/serving.md#qos): the SAME two-tenant replay —
+# byte-identical interactive schedule, checksum-asserted — against a
+# fleet with priority classes, DWRR weights and a reserved interactive
+# slot. The A/B against the plain fleet above isolates what the QoS
+# plane buys the interactive tenant under the same bulk burst.
+QOS_CLASSES = {"interactive": "interactive", "bulk": "bulk"}
+qos_cfg_path = os.path.join(tmp, "slo_config.json")
+qos_policy = {"tenants": {
+    "interactive": {"priority": "interactive", "weight": 8},
+    "bulk": {"priority": "bulk", "weight": 1}}}
+with open(qos_cfg_path, "w") as f:
+    json.dump(qos_policy, f)
+os.environ["HOROVOD_TPU_SLO_CONFIG"] = qos_cfg_path
+from horovod_tpu.serving import qos as _qosmod
+from horovod_tpu.serving import slo as _slomod
+_qosmod._reset_policy()
+_slomod._reset_policy()
+env_qos = dict(env)
+env_qos["HOROVOD_TPU_SLO_CONFIG"] = qos_cfg_path
+
+fleet2 = Fleet(n_replicas,
+               ["--checkpoint-dir", ckpt, "--tp", "1",
+                "--block-size", "8", "--kv-blocks", "64",
+                "--slots", "2", "--max-new-tokens", str(max_new),
+                "--reserved-slots", "1"],
+               env=env_qos)
+router2 = Router(fleet2, port=0, host="127.0.0.1",
+                 scrape_interval_s=0.1)
+fleet2.start()
+fleet2.wait_ready(600.0)
+router2.start()
+for n_tokens in (6, 12, 48):
+    warm(n_tokens, 2 * n_replicas, router2.port)
+
+run_qa = loadgen.run_schedule(ia, "127.0.0.1", router2.port,
+                              max_inflight=256, timeout_s=120.0)
+run_qa["summary"] = loadgen.summarize(run_qa, classes=QOS_CLASSES)
+arm_qa = _arm_from_run("qos_interactive_only", run_qa,
+                       offered_rps=3.0)
+arm_qa["schedule_checksum"] = loadgen.schedule_checksum(ia)
+
+run_qb = loadgen.run_schedule(merged, "127.0.0.1", router2.port,
+                              max_inflight=256, timeout_s=120.0)
+run_qb["summary"] = loadgen.summarize(run_qb, classes=QOS_CLASSES)
+arm_qb = _arm_from_run("qos_with_bulk_burst", run_qb,
+                       offered_rps=3.0 + 6.0 * 0.5)
+arm_qb["interactive_schedule_checksum"] = loadgen.schedule_checksum(
+    [a for a in merged if a.tenant == "interactive"])
+arm_qb["bulk_schedule_checksum"] = loadgen.schedule_checksum(bb)
+try:
+    router2.shutdown()
+    fleet2.stop()
+except Exception:
+    clean_stop = False
+
+# ---- Autoscaling knee sweep: the same offered-load ladder against a
+# 2-replica fleet allowed to grow to 4 on sustained pressure (and
+# drain back once load clears). Scale decisions land in the artifact.
+from horovod_tpu.serving import AutoscalerConfig, FleetAutoscaler
+fleet3 = Fleet(2,
+               ["--checkpoint-dir", ckpt, "--tp", "1",
+                "--block-size", "8", "--kv-blocks", "64",
+                "--slots", "2", "--max-new-tokens", str(max_new),
+                "--reserved-slots", "1"],
+               env=env_qos)
+router3 = Router(fleet3, port=0, host="127.0.0.1",
+                 scrape_interval_s=0.1)
+fleet3.start()
+fleet3.wait_ready(600.0)
+router3.start()
+for n_tokens in (6, 12, 48):
+    warm(n_tokens, 2 * 2, router3.port)
+scaler = FleetAutoscaler(
+    fleet3,
+    AutoscalerConfig(2, 4, high_load=1.2, low_load=0.3,
+                     sustain_s=1.0, cooldown_s=3.0),
+    signals=router3.qos_signals, interval_s=0.25)
+fleet3.on_alert = scaler.note_alert
+scaler.start()
+auto_sweep = {}
+for rps in SWEEP_RPS:
+    tenant = loadgen.TenantSpec("sweep", prompt_len=(8, 16),
+                                max_new_tokens=max_new, slo=SLO)
+    sched = loadgen.build_schedule(rps, duration_s, seed + rps,
+                                   [tenant])
+    run = loadgen.run_schedule(sched, "127.0.0.1", router3.port,
+                               max_inflight=256, timeout_s=120.0)
+    arm = _arm_from_run("auto_rps%d" % rps, run, offered_rps=rps)
+    arm["schedule_checksum"] = loadgen.schedule_checksum(sched)
+    arm["duration_s"] = duration_s
+    arm["replicas_after"] = fleet3.live_count()
+    auto_sweep["rps%d" % rps] = arm
+# Let any scale-up finish coming online, then re-offer the past-knee
+# rate: goodput with the grown fleet vs the first pass.
+deadline = time.time() + 45.0
+while time.time() < deadline and any(
+        not r.up for r in list(fleet3.replicas) if not r.retiring):
+    time.sleep(0.5)
+sched25 = loadgen.build_schedule(25, duration_s, seed + 25,
+    [loadgen.TenantSpec("sweep", prompt_len=(8, 16),
+                        max_new_tokens=max_new, slo=SLO)])
+run25b = loadgen.run_schedule(sched25, "127.0.0.1", router3.port,
+                              max_inflight=256, timeout_s=120.0)
+arm25b = _arm_from_run("auto_rps25_scaled", run25b, offered_rps=25)
+arm25b["schedule_checksum"] = loadgen.schedule_checksum(sched25)
+arm25b["duration_s"] = duration_s
+arm25b["replicas_after"] = fleet3.live_count()
+auto_sweep["rps25_scaled"] = arm25b
+# Idle: the cooldown drains the fleet back toward the floor.
+deadline = time.time() + 25.0
+while time.time() < deadline and not any(
+        d["direction"] == "down" for d in scaler.decisions):
+    time.sleep(0.5)
+scaler.stop()
+scale_events = [{"direction": d["direction"], "why": d["why"],
+                 "n": d["n"]} for d in scaler.decisions]
+replicas_final = fleet3.live_count()
+try:
+    router3.shutdown()
+    fleet3.stop()
+except Exception:
+    clean_stop = False
+
+qp99_alone = arm_qa["tenants"]["interactive"]["ttft_p99_ms"]
+qp99_burst = arm_qb["tenants"]["interactive"]["ttft_p99_ms"]
 print(json.dumps({
     "sweep": sweep,
     "two_tenant": {
@@ -406,6 +532,32 @@ print(json.dumps({
         "interactive_ttft_p99_under_burst_ms": p99_burst,
         "interactive_p99_inflation": round(
             p99_burst / max(p99_alone, 1e-9), 3),
+    },
+    "qos": {
+        "policy": qos_policy["tenants"],
+        "reserved_slots": 1,
+        "interactive_only": arm_qa,
+        "with_bulk_burst": arm_qb,
+        "interactive_schedules_identical": (
+            arm_qb["interactive_schedule_checksum"] == ia_checksum),
+        "interactive_ttft_p99_alone_ms": qp99_alone,
+        "interactive_ttft_p99_under_burst_ms": qp99_burst,
+        "interactive_p99_inflation_qos": round(
+            qp99_burst / max(qp99_alone, 1e-9), 3),
+        "interactive_p99_inflation_baseline": round(
+            p99_burst / max(p99_alone, 1e-9), 3),
+        "autoscale": {
+            "config": {"min": 2, "max": 4, "high_load": 1.2,
+                       "low_load": 0.3, "sustain_s": 1.0,
+                       "cooldown_s": 3.0},
+            "sweep": auto_sweep,
+            "scale_events": scale_events,
+            "scaled_up": any(e["direction"] == "up"
+                             for e in scale_events),
+            "scaled_back_down": any(e["direction"] == "down"
+                                    for e in scale_events),
+            "replicas_final": replicas_final,
+        },
     },
     "clean_stop": clean_stop,
 }))
@@ -1437,7 +1589,7 @@ def run_slo(out_path):
     proc = subprocess.run(
         [sys.executable, "-c", SLO_WORKER, "3", str(SLO_MAX_NEW),
          str(SLO_DURATION_S), str(SLO_SEED)],
-        env=env, capture_output=True, text=True, timeout=900,
+        env=env, capture_output=True, text=True, timeout=1800,
         cwd=os.path.dirname(os.path.abspath(__file__)))
     if proc.returncode != 0:
         raise RuntimeError(
@@ -1461,6 +1613,9 @@ def run_slo(out_path):
             "fault": "rank=*:slow_decode=20ms",
             "sweep_rps": [4, 10, 25],
             "max_inflight": 256,
+            "qos": {"reserved_slots": 1,
+                    "weights": {"interactive": 8, "bulk": 1},
+                    "autoscale": {"min": 2, "max": 4}},
         },
         "note": ("Open-loop (MLPerf-style, arXiv 1909.09756) offered-"
                  "load sweep on the 3-replica fleet with per-token "
@@ -1473,9 +1628,15 @@ def run_slo(out_path):
                  "replays the IDENTICAL interactive schedule with and "
                  "without an overlapping bulk burst and reports the "
                  "interactive tenant's TTFT p99 inflation — the "
-                 "before-picture priority classes will fix."),
+                 "before-picture. The qos section replays the SAME "
+                 "two-tenant schedules (checksum-asserted) against a "
+                 "fleet with priority classes, DWRR weights 8:1 and a "
+                 "reserved interactive slot (docs/serving.md#qos), "
+                 "then reruns the ladder on a 2-replica fleet allowed "
+                 "to autoscale to 4 on sustained pressure."),
         "sweep": r["sweep"],
         "two_tenant": r["two_tenant"],
+        "qos": r["qos"],
         "clean_stop": r["clean_stop"],
         "headlines": {
             "has_knee": knee is not None,
@@ -1487,6 +1648,13 @@ def run_slo(out_path):
                 r["two_tenant"]["interactive_schedules_identical"],
             "interactive_p99_inflation":
                 r["two_tenant"]["interactive_p99_inflation"],
+            "interactive_p99_inflation_qos":
+                r["qos"]["interactive_p99_inflation_qos"],
+            "qos_schedules_identical":
+                r["qos"]["interactive_schedules_identical"],
+            "fleet_scaled_up": r["qos"]["autoscale"]["scaled_up"],
+            "fleet_scaled_back_down":
+                r["qos"]["autoscale"]["scaled_back_down"],
         },
     }
     if out_path:

@@ -476,11 +476,11 @@ class HealthMonitor:
             alert.replica, alert.value, alert.baseline, alert.window_s)
         if alert.kind in POLICY_ALERT_KINDS:
             queue_policy_alert(alert)
-            if self.alert_sink is not None:
-                try:
-                    self.alert_sink(alert)
-                except Exception as e:  # pragma: no cover - defensive
-                    _log.warning("alert sink failed: %s", e)
+        if self.alert_sink is not None:
+            try:
+                self.alert_sink(alert)
+            except Exception as e:  # pragma: no cover - defensive
+                _log.warning("alert sink failed: %s", e)
         if self.webhook_url:
             post_webhook(self.webhook_url, alert.to_dict())
         return alert
@@ -491,6 +491,8 @@ def _coordinator_alert_sink(alert: Alert) -> None:
     existing control-plane channel (best-effort; docs/health.md#
     adaptation). Only multi-process fallback engines hold a client —
     single-process jobs feed the policy through the local queue."""
+    if alert.kind not in POLICY_ALERT_KINDS:
+        return
     try:
         from ..ops import collective as _coll
         eng = _coll._engine

@@ -26,14 +26,19 @@ from .kv_cache import (BlockAllocator, PrefixCache, SessionLeaseTable,
 from .loader import (TORCH_MODEL_PREFIX, config_from_manifest,
                      load_params, serving_config, transformer_extra)
 from .fleet import Fleet, ReplicaEndpoint
+from .qos import (AutoscalerConfig, AutoscalerState, ClassQueues,
+                  FleetAutoscaler, QosPolicy, QuotaExceededError,
+                  QuotaLedger, TenantQos)
 from .router import Router, StaticBackends
 
 __all__ = [
-    "BlockAllocator", "DEADLINE_ERROR", "DrainingError", "Fleet",
-    "InferenceEngine", "PrefixCache", "QueueFullError",
+    "AutoscalerConfig", "AutoscalerState", "BlockAllocator",
+    "ClassQueues", "DEADLINE_ERROR", "DrainingError", "Fleet",
+    "FleetAutoscaler", "InferenceEngine", "PrefixCache",
+    "QosPolicy", "QueueFullError", "QuotaExceededError", "QuotaLedger",
     "ReplicaEndpoint", "Request", "Router", "ServingConfig",
     "SessionLeaseTable", "StaticBackends", "TORCH_MODEL_PREFIX",
-    "blocks_needed",
+    "TenantQos", "blocks_needed",
     "config_from_manifest", "load_params", "prefix_hashes",
     "serving_config", "transformer_extra",
 ]

@@ -52,6 +52,8 @@ def _replica_argv(args) -> list:
         argv += ["--prefill-chunk", str(args.prefill_chunk)]
     if args.session_leases is not None:
         argv += ["--session-leases", str(args.session_leases)]
+    if args.reserved_slots is not None:
+        argv += ["--reserved-slots", str(args.reserved_slots)]
     if args.draft_checkpoint_dir is not None:
         argv += ["--draft-checkpoint-dir", args.draft_checkpoint_dir]
         argv += ["--spec-tokens", str(args.spec_tokens)]
@@ -82,6 +84,21 @@ def _run_fleet(args, parser) -> int:
     router = Router(fleet, port=(args.port if args.port is not None
                                  else _env.serving_port()),
                     host=args.host)
+    autoscaler = None
+    if args.autoscale_max is not None:
+        from .qos import AutoscalerConfig, FleetAutoscaler
+        amin = args.autoscale_min if args.autoscale_min is not None \
+            else args.fleet
+        cfg = AutoscalerConfig(
+            amin, args.autoscale_max,
+            high_load=_env.qos_scale_high(),
+            low_load=_env.qos_scale_low(),
+            sustain_s=_env.qos_scale_sustain_s(),
+            cooldown_s=_env.qos_scale_cooldown_s())
+        autoscaler = FleetAutoscaler(
+            fleet, cfg, signals=router.qos_signals,
+            interval_s=_env.qos_scale_interval_s())
+        fleet.on_alert = autoscaler.note_alert
     print(f"[fleet] spawning {args.fleet} replica(s) from "
           f"{args.checkpoint_dir}", file=sys.stderr, flush=True)
     fleet.start()
@@ -91,6 +108,11 @@ def _run_fleet(args, parser) -> int:
         fleet.stop()
         parser.error(str(e))
     router.start()
+    if autoscaler is not None:
+        autoscaler.start()
+        print(f"[fleet] autoscaler on: {autoscaler.config.min_replicas}"
+              f"..{autoscaler.config.max_replicas} replicas "
+              "(docs/serving.md#qos)", file=sys.stderr, flush=True)
     print(f"[fleet] routing on :{router.port} across {args.fleet} "
           "replica(s) (/generate, /healthz, /readyz)",
           file=sys.stderr, flush=True)
@@ -104,6 +126,8 @@ def _run_fleet(args, parser) -> int:
         pass
     print("[fleet] stopping: draining replicas", file=sys.stderr,
           flush=True)
+    if autoscaler is not None:
+        autoscaler.stop()
     router.shutdown()
     fleet.stop()
     return 0
@@ -184,6 +208,23 @@ def main(argv=None) -> int:
                              "long-prompt bursts (docs/serving.md#"
                              "chunked-prefill; budget via "
                              "$HOROVOD_TPU_SERVING_TICK_BUDGET_MS)")
+    parser.add_argument("--reserved-slots", type=int, default=None,
+                        help="decode-batch slots reserved for the "
+                             "'interactive' priority class "
+                             "(docs/serving.md#qos): bulk/default "
+                             "admission stops once occupancy would "
+                             "leave fewer than this many free slots "
+                             "(default: $HOROVOD_TPU_SERVING_RESERVED_"
+                             "SLOTS or 0)")
+    parser.add_argument("--autoscale-max", type=int, default=None,
+                        help="with --fleet: enable SLO-driven "
+                             "autoscaling up to this many replicas "
+                             "(docs/serving.md#qos); scale-ups need "
+                             "sustained pressure, scale-downs drain "
+                             "via /readyz")
+    parser.add_argument("--autoscale-min", type=int, default=None,
+                        help="autoscaler floor (default: the --fleet "
+                             "value)")
     parser.add_argument("--session-leases", type=int, default=None,
                         help="max session KV leases held per replica "
                              "(session affinity, docs/serving.md#"
@@ -208,7 +249,16 @@ def main(argv=None) -> int:
         if args.replica_id is not None:
             parser.error("--fleet and --replica-id are mutually "
                          "exclusive (the supervisor assigns ids)")
+        if args.autoscale_max is not None:
+            amin = args.autoscale_min if args.autoscale_min is not None \
+                else args.fleet
+            if not (1 <= amin <= args.fleet <= args.autoscale_max):
+                parser.error(
+                    f"--autoscale-min {amin} <= --fleet {args.fleet} "
+                    f"<= --autoscale-max {args.autoscale_max} required")
         return _run_fleet(args, parser)
+    if args.autoscale_max is not None or args.autoscale_min is not None:
+        parser.error("--autoscale-min/--autoscale-max need --fleet")
 
     replica_id = args.replica_id if args.replica_id is not None \
         else _env.replica_id()
@@ -285,7 +335,10 @@ def main(argv=None) -> int:
         prefix_cache=args.prefix_cache,
         prefill_chunk=args.prefill_chunk,
         session_leases=(args.session_leases
-                        if args.session_leases is not None else 8))
+                        if args.session_leases is not None else 8),
+        reserved_slots=(args.reserved_slots
+                        if args.reserved_slots is not None
+                        else _env.serving_reserved_slots()))
     engine = InferenceEngine(params, cfg, mesh, config,
                              draft_params=draft_params,
                              draft_cfg=draft_cfg)

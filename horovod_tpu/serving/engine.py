@@ -75,6 +75,7 @@ from ..observability import flight_recorder as _flight
 from ..observability import registry as _obs
 from ..utils import env as _env
 from ..utils.logging import get_logger
+from . import qos as _qos
 from . import reqtrace as _rt
 from . import slo as _slo
 from .kv_cache import (SCRATCH_BLOCK, BlockAllocator, PrefixCache,
@@ -212,6 +213,20 @@ def _metrics():
             "hvdtpu_serving_session_hits_total",
             "Admissions that resumed from a live session lease "
             "(prefill skipped the stored conversation context)"),
+        "shed": r.counter(
+            "hvdtpu_serving_shed_total",
+            "Requests shed by the QoS plane before prefill, by reason "
+            "(quota: over the tenant token-rate quota; deadline_pred: "
+            "remaining deadline cannot cover predicted prefill + one "
+            "decode step) (docs/serving.md#qos)"),
+        "class_queue": r.gauge(
+            "hvdtpu_serving_class_queue_depth",
+            "Queued requests per QoS priority class "
+            "(docs/serving.md#qos)"),
+        "class_active": r.gauge(
+            "hvdtpu_serving_class_active",
+            "Batch slots held per QoS priority class "
+            "(docs/serving.md#qos)"),
     }
 
 
@@ -251,6 +266,10 @@ class ServingConfig:
     session_leases: int = 8       # max session KV leases held between
     #                               conversation turns; 0 disables
     #                               session affinity on this replica
+    reserved_slots: int = 0       # batch slots only the top QoS
+    #                               priority class (interactive) may
+    #                               occupy (docs/serving.md#qos);
+    #                               0 = no reservation
 
 
 class Request:
@@ -307,6 +326,11 @@ class Request:
         self.tenant = tenant
         self.slo = slo
         self.slo_verdict: Optional[dict] = None
+        # QoS plane (docs/serving.md#qos): admission class, and
+        # whether a DEADLINE_ERROR came from the predictive shed
+        # (counted under reason="shed") vs an expiry in queue.
+        self.qos_class = _qos.DEFAULT_CLASS
+        self.shed = False
         self.prefill_pos: Optional[int] = None  # chunked prefill
         #                           cursor: next prompt position to
         #                           prefill; None = not mid-prefill
@@ -442,6 +466,11 @@ class InferenceEngine:
             self._spec_ctl = SpecTokensController(self._spec_k)
 
         slots = int(c.max_batch_slots)
+        if c.reserved_slots < 0 or c.reserved_slots >= slots:
+            raise ValueError(
+                f"reserved_slots ({c.reserved_slots}) must be in "
+                f"[0, max_batch_slots) — reserving every slot would "
+                "starve all non-interactive classes")
         max_tab = c.max_blocks_per_seq if c.max_blocks_per_seq \
             else -(-cfg.max_seq // bs)
         self._tab_width = int(max_tab)
@@ -465,6 +494,15 @@ class InferenceEngine:
         self._chunk_cap = self._bucket(int(c.prefill_chunk)) \
             if c.prefill_chunk else 0
         self._chunk_cost: Dict[int, float] = {}  # bucket -> EWMA secs
+        # QoS plane (docs/serving.md#qos): per-class DWRR admission
+        # queues, tenant token-rate quotas, and the measured-cost
+        # models the predictive shed reads (monolithic prefill EWMA by
+        # bucket — the chunked path reuses _chunk_cost — plus a decode
+        # step EWMA as the minimum decode budget).
+        self._qos = _qos.policy()
+        self._quota = _qos.QuotaLedger(self._qos)
+        self._prefill_cost: Dict[int, float] = {}  # bucket -> EWMA s
+        self._decode_cost = 0.0                    # EWMA secs/step
         budget_ms = _env.serving_tick_budget_ms()
         self._tick_budget_s = None if budget_ms is None \
             else budget_ms / 1e3
@@ -496,7 +534,7 @@ class InferenceEngine:
         self._last_tok = np.zeros((slots,), np.int32)   # next input
         self._reqs: List[Optional[Request]] = [None] * slots
 
-        self._queue: deque = deque()
+        self._queue = _qos.ClassQueues(self._qos.class_weights())
         self._lock = threading.RLock()
         self._work = threading.Condition(self._lock)
         self._draining = False
@@ -585,12 +623,24 @@ class InferenceEngine:
                 _slo.record_shed(tlabel or _slo.DEFAULT_TENANT, "shed")
                 raise QueueFullError(
                     f"admission queue full ({c.max_queue})")
+            # Token-rate quota (docs/serving.md#qos): charged AFTER
+            # the queue-full gate so a rejected request never burns
+            # bucket tokens. Retry-After comes from the tenant's own
+            # measured drain rate, not the global queue estimate.
+            retry = self._quota.admit(
+                tlabel, len(prompt) + max_new) if tlabel else None
+            if retry is not None:
+                self._count_request("rejected", tlabel)
+                _slo.record_shed(tlabel or _slo.DEFAULT_TENANT, "shed")
+                self._m["shed"].labels(reason="quota").inc()
+                raise _qos.QuotaExceededError(retry, tenant=tlabel)
             deadline = None if deadline_s is None \
                 else time.monotonic() + float(deadline_s)
             req = Request(self._next_id, prompt, max_new, temp,
                           deadline=deadline, trace_id=trace_id,
                           session_id=session_id, tenant=tlabel,
                           slo=targets)
+            req.qos_class = self._qos.class_of(tlabel)
             self._next_id += 1
             self._queue.append(req)
             self._m["queue_depth"].set(len(self._queue))
@@ -779,21 +829,63 @@ class InferenceEngine:
     def _admit(self) -> int:
         """Move queued requests into free slots while the pool covers
         them, running each prefill immediately (this is the per-step
-        admission that makes the batching *continuous*)."""
+        admission that makes the batching *continuous*).
+
+        Selection is deficit-weighted round robin over the per-class
+        queues (docs/serving.md#qos), with ``reserved_slots`` batch
+        slots only the top priority class may occupy, and a predictive
+        shed at each class head: a deadline that cannot cover the
+        measured prefill cost plus one decode step fails NOW (504)
+        instead of burning a slot on an answer that would miss
+        anyway."""
         admitted = 0
-        while self._queue:
-            req = self._queue[0]
-            if req.deadline is not None \
-                    and time.monotonic() > req.deadline:
-                # Expired while queued: fail instead of burning a slot
-                # on an answer nobody is waiting for (HTTP 504 path).
-                self._queue.popleft()
-                self._finish(req, "failed", error=DEADLINE_ERROR)
+        c = self.config
+        while True:
+            now = time.monotonic()
+            doomed = None
+            for r in self._queue.heads():
+                if r.deadline is None:
+                    continue
+                if now > r.deadline:
+                    # Expired while queued: fail instead of burning a
+                    # slot on an answer nobody waits for (HTTP 504).
+                    doomed = r
+                    break
+                if _qos.shed_decision(r.deadline - now,
+                                      self._predict_prefill_s(r),
+                                      self._decode_cost):
+                    r.shed = True
+                    doomed = r
+                    break
+            if doomed is not None:
+                self._queue.remove(doomed)
+                if doomed.shed:
+                    self._m["shed"].labels(
+                        reason="deadline_pred").inc()
+                    _flight.recorder().note(
+                        "qos", ("shed", doomed.trace_id,
+                                f"class={doomed.qos_class}"))
+                self._finish(doomed, "failed", error=DEADLINE_ERROR)
                 continue
+            if not self._queue:
+                break
             slot = next((i for i, r in enumerate(self._reqs)
                          if r is None), None)
             if slot is None:
                 break
+            # Reserved-slot invariant: non-top classes may never hold
+            # more than max_batch_slots - reserved_slots slots, so a
+            # full bulk backlog still leaves room for interactive.
+            non_top = sum(1 for r in self._reqs if r is not None
+                          and r.qos_class != _qos.TOP_CLASS)
+            cap = self._slots - c.reserved_slots
+
+            def allowed(cls, _non_top=non_top, _cap=cap):
+                return cls == _qos.TOP_CLASS or _non_top < _cap
+
+            req = self._queue.select(allowed)
+            if req is None:
+                break   # only reservation-blocked classes are queued
             bs = self.config.block_size
             need = blocks_needed(len(req.prompt), req.max_new_tokens,
                                  bs)
@@ -843,11 +935,11 @@ class InferenceEngine:
                 if lease is not None:  # park the consumed lease again
                     self._sessions.put(req.session_id, lease.tokens,
                                        lease.blocks)
+                self._queue.pushback(req)  # DWRR deficit refunded
                 break    # pool exhausted: nothing admits, nothing evicts
             if self._prefix is not None and lease is None:
                 self._m["prefix_hits"].inc(len(shared))
                 self._m["prefix_misses"].inc(len(hashes) - len(shared))
-            self._queue.popleft()
             t_admit_m = time.monotonic()
             self._observe_latency(
                 "queue_wait", time.perf_counter() - req.t_submit,
@@ -888,6 +980,19 @@ class InferenceEngine:
     def _bucket(self, n: int) -> int:
         b = max(self.config.min_prefill_bucket, _next_pow2(n))
         return min(b, self.cfg.max_seq)
+
+    def _predict_prefill_s(self, req: Request) -> float:
+        """Predicted prefill seconds for a queued request, from the
+        measured per-bucket EWMA of whichever prefill path this engine
+        runs (docs/serving.md#qos). 0.0 until the model warms up —
+        the predictive shed never fires on a guess."""
+        n = len(req.prompt)
+        if self._chunk_cap:
+            return _qos.predict_prefill_s(
+                n, self._chunk_cost, self._bucket,
+                chunk_tokens=self._chunk_cap)
+        return _qos.predict_prefill_s(
+            n, self._prefill_cost, self._bucket)
 
     def _record_bucket(self, phase: str, key) -> None:
         if (phase, key) not in self._buckets_seen:
@@ -977,7 +1082,14 @@ class InferenceEngine:
         compile_new = ("prefill", L) not in self._buckets_seen
         logits = self._run_prefill(req, c, ns, L)
         self._lengths[req.slot] = n
-        self._m["prefill"].observe(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self._m["prefill"].observe(dt)
+        if not compile_new:
+            # Steady-state per-bucket cost for the predictive shed
+            # (first-run compile time is not prefill cost).
+            prev = self._prefill_cost.get(L)
+            self._prefill_cost[L] = dt if prev is None \
+                else 0.5 * prev + 0.5 * dt
         self._emit_first_token(req, np.asarray(logits[0, ns - 1]))
         w = _rt.writer()
         if w is not None:
@@ -1132,6 +1244,7 @@ class InferenceEngine:
         if self._inj is not None:
             self._inj.on_serving_decode()
         t0 = time.perf_counter()
+        decode_warm = ("decode", self._slots) in self._buckets_seen
         self._record_bucket("decode", self._slots)
         logits, self._cache = self._fwd(
             self.params, self._cache,
@@ -1142,6 +1255,11 @@ class InferenceEngine:
         dt = time.perf_counter() - t0
         self._m["decode_step"].observe(dt)
         self._m["decode_steps"].inc()
+        if decode_warm:
+            # Minimum decode budget for the predictive shed
+            # (docs/serving.md#qos); compile runs excluded.
+            self._decode_cost = dt if self._decode_cost <= 0.0 \
+                else 0.5 * self._decode_cost + 0.5 * dt
         w = _rt.writer()
         for slot, req in enumerate(self._reqs):
             if req is None or req.prefill_pos is not None:
@@ -1377,8 +1495,11 @@ class InferenceEngine:
             self._judge_slo(req)
         elif error == DEADLINE_ERROR and (req.tenant
                                           or req.slo is not None):
+            # Predictive sheds count under reason="shed" (the request
+            # was turned away, not served late); queue expiries stay
+            # under "deadline" (docs/serving.md#qos).
             _slo.record_shed(req.tenant or _slo.DEFAULT_TENANT,
-                             "deadline")
+                             "shed" if req.shed else "deadline")
         note = status if error is None else f"{status}: {error}"[:200]
         if req.tenant:
             note += (f" tenant={req.tenant}"
@@ -1392,6 +1513,11 @@ class InferenceEngine:
             while self._completions and now - self._completions[0] > 10:
                 self._completions.popleft()
             self._m["qps"].set(len(self._completions) / 10.0)
+            if req.tenant:
+                # Tenant drain rate: what quota Retry-After quotes
+                # instead of the global queue estimate.
+                self._quota.note_completion(
+                    req.tenant, len(req.prompt) + len(req.tokens))
         req._done.set()
         req._notify()
 
@@ -1401,3 +1527,28 @@ class InferenceEngine:
         self._m["kv_used"].set(self._alloc.in_use)
         self._m["kv_bytes"].set(self._alloc.in_use
                                 * self._bytes_per_block)
+        depths = self._queue.depths()
+        active = {c: 0 for c in _qos.PRIORITY_CLASSES}
+        for r in self._reqs:
+            if r is not None:
+                active[r.qos_class] = active.get(r.qos_class, 0) + 1
+        for cls in _qos.PRIORITY_CLASSES:
+            self._m["class_queue"].labels(qos_class=cls).set(
+                depths.get(cls, 0))
+            self._m["class_active"].labels(qos_class=cls).set(
+                active.get(cls, 0))
+
+    def class_counts(self) -> Dict[str, Dict[str, int]]:
+        """Per-QoS-class queued/active counts — advertised via
+        ``/healthz`` so the fleet router's class-aware scoring sees
+        each replica's interactive backlog (docs/serving.md#qos)."""
+        with self._lock:
+            depths = self._queue.depths()
+            active = {c: 0 for c in _qos.PRIORITY_CLASSES}
+            for r in self._reqs:
+                if r is not None:
+                    active[r.qos_class] = \
+                        active.get(r.qos_class, 0) + 1
+        return {c: {"queued": depths.get(c, 0),
+                    "active": active.get(c, 0)}
+                for c in _qos.PRIORITY_CLASSES}

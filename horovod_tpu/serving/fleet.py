@@ -107,6 +107,9 @@ class Replica:
         self.probe_failures = 0
         self.t_spawn = 0.0
         self.ready = threading.Event()   # ready line seen (this proc)
+        self.retiring = False        # scale-down drain in progress:
+        #                              exit means RETIRE, not restart
+        #                              (docs/serving.md#qos)
         self._reader: Optional[threading.Thread] = None
 
     @property
@@ -156,6 +159,11 @@ class Fleet:
         self._lock = threading.Lock()
         self._stopping = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # QoS autoscaler hookups (docs/serving.md#qos): health-plane
+        # alerts forwarded via on_alert; _history_armed remembers
+        # whether scale-up replicas need their own history sampler.
+        self.on_alert = None
+        self._history_armed = False
         # Telemetry history (docs/health.md#fleet): the SUPERVISOR
         # samples each replica's scraped serving metrics into its own
         # history-replica{i}.jsonl — replica trends survive replica
@@ -275,27 +283,54 @@ class Fleet:
             return
         from ..observability import health as _health
         from ..observability import history as _history
+        self._history_armed = True
         detectors = _env.health_detectors_enabled()
         url = _env.alert_url()
         for rep in self.replicas:
-            monitor = _health.HealthMonitor(
-                replica=rep.index, webhook_url=url) if detectors else None
-            self._history.append(_history.HistorySampler(
-                directory, f"replica{rep.index}",
-                source=(lambda r=rep: self._scrape_snapshot(r)),
-                monitor=monitor,
-                meta=lambda r=rep: {"replica": r.index,
-                                    "generation": r.generation,
-                                    "role": "serving_replica"},
-            ).start())
+            self._start_replica_history(rep)
         fleet_monitor = _health.HealthMonitor(
-            webhook_url=url) if detectors else None
+            webhook_url=url,
+            alert_sink=self._alert_sink) if detectors else None
         self._history.append(_history.HistorySampler(
             directory, "fleet",
             prefix=("hvdtpu_fleet_", "hvdtpu_slo_"),
             monitor=fleet_monitor,
             meta=lambda: {"role": "fleet_supervisor"},
         ).start())
+
+    def _start_replica_history(self, rep: Replica) -> None:
+        """One replica's history sampler + monitor — factored out so
+        scale-up replicas (docs/serving.md#qos) get the same
+        telemetry as the initial fleet."""
+        if not self._history_armed:
+            return
+        from ..observability import health as _health
+        from ..observability import history as _history
+        directory = _env.history_dir()
+        monitor = _health.HealthMonitor(
+            replica=rep.index, webhook_url=_env.alert_url(),
+            alert_sink=self._alert_sink) \
+            if _env.health_detectors_enabled() else None
+        self._history.append(_history.HistorySampler(
+            directory, f"replica{rep.index}",
+            source=(lambda r=rep: self._scrape_snapshot(r)),
+            monitor=monitor,
+            meta=lambda r=rep: {"replica": r.index,
+                                "generation": r.generation,
+                                "role": "serving_replica"},
+        ).start())
+
+    def _alert_sink(self, alert) -> None:
+        """Forward scale-relevant health alerts (queue_depth_runaway)
+        to the QoS autoscaler when one is attached
+        (docs/serving.md#qos)."""
+        cb = self.on_alert
+        if cb is None or alert.kind != "queue_depth_runaway":
+            return
+        try:
+            cb(alert.kind)
+        except Exception as e:  # pragma: no cover - defensive
+            _log.warning("fleet alert forward failed: %s", e)
 
     def wait_ready(self, timeout_s: float) -> None:
         deadline = time.monotonic() + timeout_s
@@ -310,12 +345,81 @@ class Fleet:
         """Live, port-announced replicas — the router's backend list,
         re-read every scrape cycle so restarts re-enter rotation."""
         out = []
-        for rep in self.replicas:
+        for rep in list(self.replicas):
             if rep.up:
                 out.append(ReplicaEndpoint(
                     index=rep.index, host=self.host, port=rep.port,
                     metrics_port=rep.metrics_port))
         return out
+
+    def live_count(self) -> int:
+        """Replicas currently serving (up, not mid-retirement) — the
+        autoscaler's notion of fleet size."""
+        return sum(1 for r in list(self.replicas)
+                   if r.up and not r.retiring)
+
+    def load_views(self) -> List[dict]:
+        """Supervisor-side load sample: each serving replica's
+        active/queued/slots from /healthz — the autoscaler's fallback
+        signal source when no router is wired in
+        (docs/serving.md#qos)."""
+        import http.client
+        import json as _json
+        out = []
+        for rep in list(self.replicas):
+            if not rep.up or rep.retiring:
+                continue
+            try:
+                conn = http.client.HTTPConnection(
+                    self.host, rep.port, timeout=max(
+                        1.0, self._probe_interval * 4))
+                try:
+                    conn.request("GET", "/healthz")
+                    resp = conn.getresponse()
+                    if resp.status != 200:
+                        continue
+                    h = _json.loads(resp.read())
+                finally:
+                    conn.close()
+            except (OSError, ValueError):
+                continue
+            out.append({
+                "active": float(h.get("active_requests", 0)),
+                "queue_depth": float(h.get("queue_depth", 0)),
+                "slots": float(h.get("batch_slots", 1) or 1)})
+        return out
+
+    def scale_to(self, n: int) -> None:
+        """QoS autoscaler action (docs/serving.md#qos): grow by
+        spawning fresh replicas at new indices; shrink by marking the
+        highest-index serving replicas ``retiring`` and SIGTERMing
+        them into the existing drain path (readyz flips 503, the
+        router stops admitting, every accepted request completes,
+        exit 0) — the supervisor then REMOVES them instead of
+        restarting, so zero requests drop through a scale-down."""
+        if n < 1:
+            raise ValueError(f"fleet needs >= 1 replica, got {n}")
+        with self._lock:
+            serving = [r for r in self.replicas
+                       if r.proc is not None and not r.retiring]
+            cur = len(serving)
+            if n > cur:
+                next_idx = 1 + max(
+                    (r.index for r in self.replicas), default=-1)
+                for i in range(n - cur):
+                    rep = Replica(next_idx + i)
+                    self.replicas.append(rep)
+                    self._spawn(rep)
+                    self._note("scale_up", rep.index, f"fleet={n}")
+                    self._start_replica_history(rep)
+            elif n < cur:
+                doomed = sorted(serving, key=lambda r: -r.index)
+                for rep in doomed[:cur - n]:
+                    rep.retiring = True
+                    self._note("scale_down", rep.index, "drain")
+                    if rep.alive:
+                        rep.proc.send_signal(signal.SIGTERM)
+            self.n = n
 
     def _probe(self, rep: Replica) -> bool:
         """One /healthz liveness probe (readiness is the router's
@@ -335,7 +439,7 @@ class Fleet:
 
     def _supervise(self) -> None:
         while not self._stopping.is_set():
-            for rep in self.replicas:
+            for rep in list(self.replicas):
                 if self._stopping.is_set():
                     break
                 if rep.proc is None:
@@ -363,7 +467,7 @@ class Fleet:
                             except OSError:
                                 pass
             self._m["live"].set(
-                sum(1 for r in self.replicas if r.up))
+                sum(1 for r in list(self.replicas) if r.up))
             self._stopping.wait(self._probe_interval)
 
     def _on_exit(self, rep: Replica, rc: int) -> None:
@@ -374,6 +478,19 @@ class Fleet:
                  rep.generation, "exited" if rc == 0 else "CRASHED", rc)
         if self._stopping.is_set():
             rep.proc = None
+            return
+        if rep.retiring:
+            # Scale-down drain completed: retire instead of restart
+            # (docs/serving.md#qos).
+            self._note("retired", rep.index, f"rc={rc}")
+            _log.info("replica %d retired (scale-down drain done)",
+                      rep.index)
+            rep.proc = None
+            with self._lock:
+                try:
+                    self.replicas.remove(rep)
+                except ValueError:  # pragma: no cover - already gone
+                    pass
             return
         if self.max_restarts is not None \
                 and rep.restarts >= self.max_restarts:

@@ -62,6 +62,11 @@ class TenantSpec:
     max_new_tokens: int = 16
     slo: Optional[dict] = None
     vocab: int = 256
+    # QoS class the tenant maps to server-side (docs/serving.md#qos) —
+    # client-side attribution only; the engine resolves the real class
+    # from the SLO config file. Omitted from the canonical rows when
+    # None so pre-QoS schedule checksums stay byte-identical.
+    priority: Optional[str] = None
 
     def to_dict(self) -> dict:
         d = {"name": self.name, "weight": self.weight,
@@ -70,6 +75,8 @@ class TenantSpec:
              "vocab": self.vocab}
         if self.slo is not None:
             d["slo"] = dict(self.slo)
+        if self.priority is not None:
+            d["priority"] = self.priority
         return d
 
 
@@ -83,6 +90,7 @@ class Arrival:
     tokens: tuple
     max_new_tokens: int
     slo: Optional[dict] = None
+    priority: Optional[str] = None
 
     def to_dict(self) -> dict:
         d = {"t_s": self.t_s, "tenant": self.tenant,
@@ -90,6 +98,8 @@ class Arrival:
              "max_new_tokens": self.max_new_tokens}
         if self.slo is not None:
             d["slo"] = dict(self.slo)
+        if self.priority is not None:
+            d["priority"] = self.priority
         return d
 
 
@@ -128,7 +138,8 @@ def build_schedule(rate_rps: float, duration_s: float, seed: int,
         tokens = tuple(rng.randrange(1, spec.vocab) for _ in range(n))
         out.append(Arrival(
             t_s=round(t, 6), tenant=spec.name, tokens=tokens,
-            max_new_tokens=spec.max_new_tokens, slo=spec.slo))
+            max_new_tokens=spec.max_new_tokens, slo=spec.slo,
+            priority=spec.priority))
     return out
 
 
@@ -159,7 +170,7 @@ def load_schedule(path: str) -> List[Arrival]:
                 t_s=d["t_s"], tenant=d["tenant"],
                 tokens=tuple(d["tokens"]),
                 max_new_tokens=d["max_new_tokens"],
-                slo=d.get("slo")))
+                slo=d.get("slo"), priority=d.get("priority")))
     return out
 
 
@@ -225,6 +236,8 @@ def run_schedule(arrivals: Sequence[Arrival], host: str = "127.0.0.1",
                "t_sent_s": round(t_sent, 6),
                "latency_s": round(time.perf_counter() - t0 - t_sent,
                                   6)}
+        if arrival.priority is not None:
+            row["priority"] = arrival.priority
         if isinstance(reply, dict):
             status = reply.get("_http_status", 200)
             row["http_status"] = status
@@ -263,10 +276,13 @@ def run_schedule(arrivals: Sequence[Arrival], host: str = "127.0.0.1",
             with lock:
                 dropped[DROP_REASON_INFLIGHT] = \
                     dropped.get(DROP_REASON_INFLIGHT, 0) + 1
-                results.append({
+                drop_row = {
                     "tenant": arrival.tenant, "t_s": arrival.t_s,
                     "status": "dropped",
-                    "drop_reason": DROP_REASON_INFLIGHT})
+                    "drop_reason": DROP_REASON_INFLIGHT}
+                if arrival.priority is not None:
+                    drop_row["priority"] = arrival.priority
+                results.append(drop_row)
             continue
         th = threading.Thread(target=fire, args=(arrival,),
                               daemon=True)
@@ -296,52 +312,72 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
     return sorted_vals[idx]
 
 
-def summarize(run: dict) -> dict:
+def _new_rollup() -> dict:
+    return {"offered": 0, "completed": 0, "dropped": 0, "rejected": 0,
+            "deadline": 0, "failed": 0, "slo_met": 0,
+            "slo_violations": 0, "_ttft": [], "_lat": []}
+
+
+def _count_row(t: dict, row: dict) -> None:
+    t["offered"] += 1
+    status = row["status"]
+    if status == "completed":
+        t["completed"] += 1
+        if "ttft_ms" in row:
+            t["_ttft"].append(float(row["ttft_ms"]))
+        if "latency_ms" in row:
+            t["_lat"].append(float(row["latency_ms"]))
+        verdict = row.get("slo")
+        if isinstance(verdict, dict):
+            if verdict.get("slo_met"):
+                t["slo_met"] += 1
+            else:
+                t["slo_violations"] += 1
+    elif status in ("dropped", "rejected", "deadline", "failed",
+                    "error"):
+        t[status if status in ("dropped", "rejected", "deadline")
+          else "failed"] += 1
+
+
+def _finish_rollup(t: dict) -> dict:
+    ttft = sorted(t.pop("_ttft"))
+    lat = sorted(t.pop("_lat"))
+    judged = t["slo_met"] + t["slo_violations"]
+    # Goodput denominator is OFFERED load: every dropped/rejected
+    # request is a miss the client felt.
+    shed = t["offered"] - t["completed"]
+    t["goodput"] = t["slo_met"] if judged else t["completed"]
+    t["goodput_frac"] = round(t["goodput"] / t["offered"], 4) \
+        if t["offered"] else 0.0
+    t["shed"] = shed
+    t["ttft_p50_ms"] = round(_percentile(ttft, 0.50), 3)
+    t["ttft_p99_ms"] = round(_percentile(ttft, 0.99), 3)
+    t["latency_p50_ms"] = round(_percentile(lat, 0.50), 3)
+    t["latency_p99_ms"] = round(_percentile(lat, 0.99), 3)
+    return t
+
+
+def summarize(run: dict,
+              classes: Optional[Dict[str, str]] = None) -> dict:
     """Per-tenant rollup of a :func:`run_schedule` result: counts by
     status, TTFT p50/p99, goodput (completed AND slo_met — a dropped
     or shed request counts against goodput, exactly like the server-
-    side `shed` reason keeps it visible in the counters)."""
+    side `shed` reason keeps it visible in the counters).
+
+    When any row carries a ``priority`` (a :class:`TenantSpec` with one
+    set, docs/serving.md#qos) — or an explicit ``classes`` tenant→class
+    mapping is given — the summary grows a ``by_class`` section with
+    the same rollup shape per priority class."""
     tenants: Dict[str, dict] = {}
+    by_class: Dict[str, dict] = {}
     for row in run["results"]:
-        t = tenants.setdefault(row["tenant"], {
-            "offered": 0, "completed": 0, "dropped": 0, "rejected": 0,
-            "deadline": 0, "failed": 0, "slo_met": 0,
-            "slo_violations": 0, "_ttft": [], "_lat": []})
-        t["offered"] += 1
-        status = row["status"]
-        if status == "completed":
-            t["completed"] += 1
-            if "ttft_ms" in row:
-                t["_ttft"].append(float(row["ttft_ms"]))
-            if "latency_ms" in row:
-                t["_lat"].append(float(row["latency_ms"]))
-            verdict = row.get("slo")
-            if isinstance(verdict, dict):
-                if verdict.get("slo_met"):
-                    t["slo_met"] += 1
-                else:
-                    t["slo_violations"] += 1
-        elif status in ("dropped", "rejected", "deadline", "failed",
-                        "error"):
-            t[status if status in ("dropped", "rejected", "deadline")
-              else "failed"] += 1
-    out = {}
-    for name, t in tenants.items():
-        ttft = sorted(t.pop("_ttft"))
-        lat = sorted(t.pop("_lat"))
-        judged = t["slo_met"] + t["slo_violations"]
-        # Goodput denominator is OFFERED load: every dropped/rejected
-        # request is a miss the client felt.
-        shed = t["offered"] - t["completed"]
-        t["goodput"] = t["slo_met"] if judged else t["completed"]
-        t["goodput_frac"] = round(t["goodput"] / t["offered"], 4) \
-            if t["offered"] else 0.0
-        t["shed"] = shed
-        t["ttft_p50_ms"] = round(_percentile(ttft, 0.50), 3)
-        t["ttft_p99_ms"] = round(_percentile(ttft, 0.99), 3)
-        t["latency_p50_ms"] = round(_percentile(lat, 0.50), 3)
-        t["latency_p99_ms"] = round(_percentile(lat, 0.99), 3)
-        out[name] = t
+        t = tenants.setdefault(row["tenant"], _new_rollup())
+        _count_row(t, row)
+        cls = (classes or {}).get(row["tenant"]) or row.get("priority")
+        if cls is not None:
+            _count_row(by_class.setdefault(str(cls), _new_rollup()),
+                       row)
+    out = {name: _finish_rollup(t) for name, t in tenants.items()}
     totals = {
         "offered": run["offered"], "sent": run["sent"],
         "dropped": run["dropped"],
@@ -351,4 +387,8 @@ def summarize(run: dict) -> dict:
     totals["goodput_frac"] = round(
         totals["goodput"] / totals["offered"], 4) \
         if totals["offered"] else 0.0
-    return {"tenants": out, "totals": totals}
+    summary = {"tenants": out, "totals": totals}
+    if by_class:
+        summary["by_class"] = {cls: _finish_rollup(t)
+                               for cls, t in by_class.items()}
+    return summary

@@ -58,12 +58,13 @@ import json
 import threading
 import time
 import uuid
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Sequence
 
 from ..observability import registry as _obs
 from ..utils import env as _env
 from ..utils.logging import get_logger
+from . import qos as _qos
 from . import reqtrace as _rt
 from . import slo as _slo
 from .engine import DEADLINE_ERROR
@@ -141,6 +142,16 @@ def _metrics():
             "Dispatches by prefix-cache warmth of the chosen replica: "
             "warm (some prompt prefix previously routed there), cold "
             "(none), or unhashed (prompt shorter than one block)"),
+        # Same family the replica engine registers — in a real fleet
+        # the router is its own process, and its front-door quota
+        # sheds must be visible under the same name
+        # (docs/serving.md#qos).
+        "shed": r.counter(
+            "hvdtpu_serving_shed_total",
+            "Requests shed by the QoS plane before prefill, by reason "
+            "(quota: over the tenant token-rate quota; deadline_pred: "
+            "remaining deadline cannot cover predicted prefill + one "
+            "decode step) (docs/serving.md#qos)"),
     }
 
 
@@ -163,11 +174,33 @@ class ReplicaView:
     # Session ids holding a KV lease here, from /healthz (plus the
     # router's own shadow adds between scrapes) — the pin targets.
     sessions: set = dataclasses.field(default_factory=set)
+    # Per-QoS-class queued/active counts from /healthz
+    # (docs/serving.md#qos) — empty until the replica advertises them.
+    qos_classes: Dict[str, dict] = dataclasses.field(
+        default_factory=dict)
+    reserved_slots: float = 0.0
 
     @property
     def score(self) -> float:
         """Outstanding work per decode slot — lower admits first."""
         return (self.active + self.queue_depth) / max(1.0, self.slots)
+
+    def class_score(self, qos_class: Optional[str]) -> float:
+        """Class-aware load score (docs/serving.md#qos): top-priority
+        (interactive) requests are scored by the replica's
+        *interactive-only* backlog — under a fleet-wide bulk backlog
+        every replica's global score saturates equally and placement
+        degenerates to random, which collides interactive requests on
+        one replica's reserved slot; counting only same-class work
+        spreads them instead. The global score stays as a small
+        tiebreak, and other classes keep the global policy."""
+        if qos_class != _qos.TOP_CLASS:
+            return self.score
+        cc = self.qos_classes.get(qos_class)
+        if cc is None:
+            return self.score
+        own = float(cc.get("active", 0)) + float(cc.get("queued", 0))
+        return own / max(1.0, self.slots) + 1e-3 * self.score
 
     def warmth(self, hashes: Sequence[bytes]) -> float:
         """Fraction of the prompt's prefix blocks previously routed to
@@ -205,7 +238,8 @@ class StaticBackends:
 def pick_replica(views: Sequence[ReplicaView],
                  exclude: Optional[set] = None,
                  rr: int = 0,
-                 warmth: Optional[Dict[int, float]] = None
+                 warmth: Optional[Dict[int, float]] = None,
+                 qos_class: Optional[str] = None
                  ) -> Optional[ReplicaView]:
     """The routing policy, isolated for unit testing: among ready,
     scrape-confirmed, non-excluded replicas, the lowest *effective*
@@ -213,7 +247,10 @@ def pick_replica(views: Sequence[ReplicaView],
     prompt (``warmth``: fraction of prefix blocks already routed there,
     worth up to one slot's outstanding work) — ties broken round-robin
     by ``rr``. None when nobody can admit. With no warmth map this is
-    exactly the pre-prefix-cache policy."""
+    exactly the pre-prefix-cache policy. ``qos_class`` makes the load
+    term class-aware (docs/serving.md#qos): an interactive request is
+    scored by each replica's interactive-only backlog, so a fleet-wide
+    bulk backlog cannot starve (or randomize) interactive dispatch."""
     exclude = exclude or set()
     warmth = warmth or {}
     ok = [v for v in views
@@ -222,7 +259,8 @@ def pick_replica(views: Sequence[ReplicaView],
         return None
 
     def eff(v: ReplicaView) -> float:
-        return v.score - warmth.get(v.endpoint.index, 0.0)
+        return v.class_score(qos_class) \
+            - warmth.get(v.endpoint.index, 0.0)
 
     best = min(eff(v) for v in ok)
     tied = [v for v in ok if eff(v) == best]
@@ -253,7 +291,37 @@ class Router:
         self._scrape_thread: Optional[threading.Thread] = None
         self._next_id = 0
         self._id_lock = threading.Lock()
+        # QoS plane (docs/serving.md#qos): front-door token-rate
+        # quotas (the replica-side check still covers single-replica
+        # deployments) and a 429/queue-full pressure window the
+        # autoscaler reads via qos_signals().
+        self._quota = _qos.QuotaLedger(_qos.policy())
+        self._pressure: deque = deque()
+        self._pressure_lock = threading.Lock()
         self._build_http(host, port)
+
+    def _note_pressure(self) -> None:
+        with self._pressure_lock:
+            self._pressure.append(time.monotonic())
+
+    def qos_signals(self) -> dict:
+        """The autoscaler's signal sample (docs/serving.md#qos):
+        fleet-wide outstanding work per slot across ready replicas,
+        the ready count, and recent 429/queue-full pressure per
+        second (10 s window)."""
+        with self._views_lock:
+            views = [v for v in self._views.values()
+                     if v.ready and v.ok]
+        slots = sum(v.slots for v in views)
+        work = sum(v.active + v.queue_depth for v in views)
+        now = time.monotonic()
+        with self._pressure_lock:
+            while self._pressure and self._pressure[0] < now - 10.0:
+                self._pressure.popleft()
+            pressure = len(self._pressure) / 10.0
+        return {"load_per_slot": work / max(1.0, slots),
+                "n_replicas": len(views),
+                "retry_pressure": pressure}
 
     # ------------------------------------------------------ scraping
 
@@ -347,6 +415,9 @@ class Router:
             view.block_size = int(h["block_size"])
         if "sessions" in h:
             view.sessions = set(h.get("sessions") or [])
+        if isinstance(h.get("qos_classes"), dict):
+            view.qos_classes = h["qos_classes"]
+        view.reserved_slots = float(h.get("reserved_slots", 0) or 0)
         return True
 
     def _scrape_cycle(self) -> None:
@@ -379,7 +450,9 @@ class Router:
 
     def _pick(self, exclude: Dict[int, float],
               prompt: Optional[List[int]] = None,
-              session_id: Optional[str] = None) -> Optional[ReplicaView]:
+              session_id: Optional[str] = None,
+              qos_class: Optional[str] = None
+              ) -> Optional[ReplicaView]:
         now = time.monotonic()
         live = {i for i, until in exclude.items() if until > now}
         with self._views_lock:
@@ -400,7 +473,7 @@ class Router:
                         v.endpoint.index, 0.0) + _SESSION_PIN_BONUS
         self._rr += 1
         view = pick_replica(views, exclude=live, rr=self._rr,
-                            warmth=warmth)
+                            warmth=warmth, qos_class=qos_class)
         if view is not None and prompt:
             hashes = prefix_hashes(prompt, view.block_size or 16)
             state = ("unhashed" if not hashes else
@@ -439,6 +512,11 @@ class Router:
             if isinstance(meta.get("slo"), dict):
                 span_args["slo_met"] = meta["slo"].get("slo_met")
             self._account_slo(label, meta)
+            if label and meta["status"] == "completed":
+                # Tenant drain rate: what quota Retry-After quotes
+                # (docs/serving.md#qos).
+                self._quota.note_completion(
+                    label, len(prompt) + len(meta["tokens"]))
         _rt.span(rid, "REQUEST", t0m, t1m, span_args)
         return meta
 
@@ -479,6 +557,8 @@ class Router:
         re-dispatch reuses the identity, never re-mints it."""
         emitted: List[int] = []
         exclude: Dict[int, float] = {}
+        qos_class = _qos.policy().class_of(
+            _slo.resolve_tenant(tenant)) if tenant else None
         attempts = 0
         retries = 0
         t_fail: Optional[float] = None     # failover stopwatch
@@ -495,6 +575,10 @@ class Router:
             nonlocal retries
             retries += 1
             self._m["retries"].labels(reason=reason).inc()
+            if reason == "queue_full":
+                # Retry-After pressure: a scale-up signal for the
+                # QoS autoscaler (docs/serving.md#qos).
+                self._note_pressure()
 
         def emit_observed(tok: int) -> None:
             # First token after a failover closes the detection→resume
@@ -520,7 +604,8 @@ class Router:
                         "error": f"no replica completed the request "
                                  f"after {attempts} attempts",
                         "retries": retries, "tokens": emitted}
-            view = self._pick(exclude, prompt, session_id=session_id)
+            view = self._pick(exclude, prompt, session_id=session_id,
+                              qos_class=qos_class)
             if view is None:
                 # Nobody ready right now (mass restart, all draining):
                 # wait out a scrape cycle rather than failing a
@@ -763,6 +848,26 @@ class Router:
                 rid = str(self.headers.get("X-Request-Id")
                           or body.get("request_id")
                           or outer._request_id())
+                if tenant:
+                    # Front-door token-rate quota (docs/serving.md#
+                    # qos): enforced here so a fleet of N replicas
+                    # cannot multiply a tenant's quota by N via
+                    # retries; Retry-After from the tenant's own
+                    # measured drain rate.
+                    label = _slo.resolve_tenant(tenant)
+                    retry = outer._quota.admit(
+                        label, len(tokens) + max_new)
+                    if retry is not None:
+                        outer._m["requests"].labels(
+                            outcome="rejected").inc()
+                        outer._m["shed"].labels(reason="quota").inc()
+                        _slo.record_shed(label, "shed")
+                        self._reply(
+                            429,
+                            {"error": "tenant over token-rate quota",
+                             "trace_id": rid},
+                            headers={"Retry-After": retry})
+                        return
                 sid = self.headers.get("X-Session-Id") \
                     or body.get("session_id")
                 sid = str(sid) if sid else None

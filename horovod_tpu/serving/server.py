@@ -63,6 +63,7 @@ from . import reqtrace as _rt
 from . import slo as _slo
 from .engine import (DEADLINE_ERROR, DrainingError, InferenceEngine,
                      QueueFullError)
+from .qos import QuotaExceededError
 
 _log = get_logger("serving.server")
 
@@ -154,6 +155,12 @@ class ServingServer:
                         # sessions here (docs/serving.md#session-affinity)
                         "sessions": eng.session_ids(),
                         "session_leases": eng.config.session_leases,
+                        # per-QoS-class queued/active counts + the
+                        # interactive slot reservation — the router's
+                        # class-aware scoring reads these
+                        # (docs/serving.md#qos)
+                        "qos_classes": eng.class_counts(),
+                        "reserved_slots": eng.config.reserved_slots,
                     }, "healthz")
                     return
                 if path == "/readyz":
@@ -233,6 +240,14 @@ class ServingServer:
                         session_id=session_id,
                         tenant=tenant,
                         slo=body.get("slo"))
+                except QuotaExceededError as e:
+                    # Quota 429: Retry-After from the tenant's own
+                    # measured drain rate (docs/serving.md#qos), not
+                    # the global queue estimate.
+                    self._reply(429, {"error": str(e)}, "generate",
+                                headers={"Retry-After":
+                                         e.retry_after_s})
+                    return
                 except QueueFullError as e:
                     self._reply(429, {"error": str(e)}, "generate",
                                 headers={"Retry-After":

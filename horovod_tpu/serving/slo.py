@@ -146,6 +146,17 @@ def parse_slo(obj) -> Optional[SloTargets]:
     return SloTargets(**out)
 
 
+def _strip_qos(row):
+    """Drop the QoS plane's tenant-row fields (priority/weight/quota,
+    docs/serving.md#qos) before SLO validation — the two planes share
+    one config file and parse_slo rejects unknown keys."""
+    if not isinstance(row, dict):
+        return row
+    from . import qos as _qos
+    return {k: v for k, v in row.items()
+            if k not in _qos.QOS_CONFIG_FIELDS}
+
+
 class SloPolicy:
     """Target resolution: request field > tenant config entry >
     config ``default`` entry > env defaults. The config file
@@ -163,10 +174,11 @@ class SloPolicy:
                 with open(path) as f:
                     cfg = json.load(f)
                 for name, row in (cfg.get("tenants") or {}).items():
-                    self._tenants[str(name)] = parse_slo(row) \
-                        or SloTargets()
+                    self._tenants[str(name)] = \
+                        parse_slo(_strip_qos(row)) or SloTargets()
                 if cfg.get("default") is not None:
-                    self._default = parse_slo(cfg["default"])
+                    self._default = parse_slo(
+                        _strip_qos(cfg["default"]))
             except (OSError, ValueError) as e:
                 _log.warning("SLO config %s unreadable: %s", path, e)
         env_ttft = _env.slo_ttft_ms()

@@ -50,6 +50,9 @@ KEY_SERIES_FAMILIES = (
     "hvdtpu_serving_requests_per_second",
     "hvdtpu_slo_goodput_total",
     "hvdtpu_slo_violations_total",
+    "hvdtpu_serving_shed_total",
+    "hvdtpu_fleet_scale_events_total",
+    "hvdtpu_fleet_target_replicas",
 )
 
 # Direction-aware regression semantics: which way is WORSE.
@@ -57,7 +60,8 @@ KEY_SERIES_FAMILIES = (
 # suffix a counter family carries.
 _UP_WORSE = ("seconds", "queue_depth", "bytes_in_use", "share",
              "lateness", "restarts_total", "failures_total",
-             "errors_total", "stalled", "blocked", "violations")
+             "errors_total", "stalled", "blocked", "violations",
+             "shed", "scale_events")
 _DOWN_WORSE = ("mfu", "per_second", "replicas_live", "replicas_ready",
                "acceptance", "goodput")
 

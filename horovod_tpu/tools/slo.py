@@ -63,6 +63,8 @@ def _arm_from_run(name: str, run: dict,
         "ttft_p50_ms": round(_loadgen._percentile(ttft, 0.50), 3),
         "ttft_p99_ms": round(_loadgen._percentile(ttft, 0.99), 3),
         "tenants": summary["tenants"],
+        **({"by_class": summary["by_class"]}
+           if "by_class" in summary else {}),
     }
 
 
@@ -113,6 +115,27 @@ def violation_breakdown(arms: List[dict]) -> Dict[str, dict]:
     for arm in arms:
         for name, t in (arm.get("tenants") or {}).items():
             agg = out.setdefault(name, {
+                "offered": 0, "goodput": 0, "slo_violations": 0,
+                "shed": 0})
+            agg["offered"] += t.get("offered", 0)
+            agg["goodput"] += t.get("goodput", 0)
+            agg["slo_violations"] += t.get("slo_violations", 0)
+            agg["shed"] += t.get("shed", 0)
+    for agg in out.values():
+        agg["goodput_frac"] = round(
+            agg["goodput"] / agg["offered"], 4) if agg["offered"] \
+            else 0.0
+    return out
+
+
+def class_breakdown(arms: List[dict]) -> Dict[str, dict]:
+    """Per-priority-class rollup across every arm that carries a
+    ``by_class`` section (docs/serving.md#qos) — empty when no arm was
+    run with class-tagged tenants."""
+    out: Dict[str, dict] = {}
+    for arm in arms:
+        for cls, t in (arm.get("by_class") or {}).items():
+            agg = out.setdefault(cls, {
                 "offered": 0, "goodput": 0, "slo_violations": 0,
                 "shed": 0})
             agg["offered"] += t.get("offered", 0)
@@ -185,9 +208,51 @@ def compare_baseline(cur: List[dict], base: List[dict],
             "improvements": improvements}
 
 
+def qos_sections(paths: List[str]) -> List[dict]:
+    """The ``qos`` blocks of any BENCH_SLO.json inputs — the
+    priority-plane bench arm (docs/serving.md#qos): interactive
+    TTFT-inflation headline, shed/quota counts, scale events."""
+    out = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(doc, dict) and isinstance(doc.get("qos"), dict):
+            q = {"source": path, **doc["qos"]}
+            # Summary counters the report prints: shed per arm (QoS
+            # replay arms + the autoscale ladder) and scale decisions
+            # by direction/why.
+            shed = {}
+            arms = [q.get("interactive_only"),
+                    q.get("with_bulk_burst")]
+            auto = q.get("autoscale")
+            if isinstance(auto, dict):
+                arms += list((auto.get("sweep") or {}).values())
+            for arm in arms:
+                if not isinstance(arm, dict):
+                    continue
+                n = sum(t.get("shed", 0) for t in
+                        (arm.get("tenants") or {}).values())
+                if n:
+                    shed[arm.get("name", "?")] = n
+            if shed:
+                q["shed"] = shed
+            if isinstance(auto, dict) and auto.get("scale_events"):
+                counts = {}
+                for e in auto["scale_events"]:
+                    key = f"{e.get('direction')}/{e.get('why')}"
+                    counts[key] = counts.get(key, 0) + 1
+                q["scale_events"] = counts
+            out.append(q)
+    return out
+
+
 def build_report(paths: List[str],
                  target_ttft_ms: Optional[float] = None,
-                 history_dir: Optional[str] = None) -> dict:
+                 history_dir: Optional[str] = None,
+                 qos: bool = False) -> dict:
     arms = load_arms(paths)
     knee = find_knee(arms, target_ttft_ms)
     report = {
@@ -200,6 +265,11 @@ def build_report(paths: List[str],
         "target_ttft_ms": target_ttft_ms,
         "tenants": violation_breakdown(arms),
     }
+    classes = class_breakdown(arms)
+    if classes:
+        report["classes"] = classes
+    if qos:
+        report["qos"] = qos_sections(paths)
     if history_dir:
         report["history"] = history_slo_summary(history_dir)
     return report
@@ -232,6 +302,27 @@ def format_report(report: dict) -> str:
             f"{name:<16} {t['offered']:>8} {t['goodput']:>8} "
             f"{t['goodput_frac']:>6.1%} {t['slo_violations']:>10} "
             f"{t['shed']:>6}")
+    if report.get("classes"):
+        lines += ["", "Per-class (docs/serving.md#qos):",
+                  f"{'class':<16} {'offered':>8} {'goodput':>8} "
+                  f"{'frac':>6} {'violations':>10} {'shed':>6}"]
+        for name, t in sorted(report["classes"].items()):
+            lines.append(
+                f"{name:<16} {t['offered']:>8} {t['goodput']:>8} "
+                f"{t['goodput_frac']:>6.1%} {t['slo_violations']:>10} "
+                f"{t['shed']:>6}")
+    for q in report.get("qos") or []:
+        lines.append("")
+        lines.append(f"QoS arm [{q.get('source', '-')}]")
+        for key in ("interactive_p99_inflation_qos",
+                    "interactive_p99_inflation_baseline",
+                    "reserved_slots", "schedule_checksum"):
+            if key in q:
+                lines.append(f"  {key:<36} {q[key]}")
+        for key in ("shed", "scale_events"):
+            if isinstance(q.get(key), dict):
+                for k, v in sorted(q[key].items()):
+                    lines.append(f"  {key}.{k:<30} {v}")
     for row in report.get("history", []):
         lines.append("")
         lines.append(f"History [{row['label']}]"
@@ -255,6 +346,10 @@ def main(argv=None) -> int:
                     help="TTFT target for knee detection")
     ap.add_argument("--history", default=None,
                     help="fleet history directory to fold in")
+    ap.add_argument("--qos", action="store_true",
+                    help="include the QoS sections of BENCH_SLO.json "
+                         "inputs (priority-plane headlines, shed and "
+                         "scale-event counts; docs/serving.md#qos)")
     ap.add_argument("--baseline", default=None,
                     help="baseline report/bench JSON to A/B against "
                          "(exit 3 on goodput regression)")
@@ -264,7 +359,7 @@ def main(argv=None) -> int:
 
     report = build_report(args.results,
                           target_ttft_ms=args.target_ttft_ms,
-                          history_dir=args.history)
+                          history_dir=args.history, qos=args.qos)
     rc = 0
     if args.baseline:
         base = load_arms([args.baseline])

@@ -487,6 +487,66 @@ def slo_config() -> Optional[str]:
     return v or None
 
 
+def serving_reserved_slots() -> int:
+    """Decode-batch slots reserved for the top priority class
+    (docs/serving.md#qos): bulk/default admissions stop once occupancy
+    would leave fewer than this many slots for ``interactive`` work.
+    Default 0 — no reservation."""
+    v = _get("SERVING_RESERVED_SLOTS")
+    if v in (None, ""):
+        return 0
+    return max(0, int(v))
+
+
+def qos_scale_high() -> float:
+    """Autoscaler scale-up threshold: fleet queued+active work per
+    decode slot above which sustained load triggers a scale-up
+    (docs/serving.md#qos). Default 1.5."""
+    v = _get("QOS_SCALE_HIGH")
+    if v in (None, ""):
+        return 1.5
+    return float(v)
+
+
+def qos_scale_low() -> float:
+    """Autoscaler scale-down threshold: load per slot below which the
+    fleet shrinks after the cooldown (docs/serving.md#qos).
+    Default 0.25."""
+    v = _get("QOS_SCALE_LOW")
+    if v in (None, ""):
+        return 0.25
+    return float(v)
+
+
+def qos_scale_sustain_s() -> float:
+    """Seconds the scale-up pressure must hold before the autoscaler
+    acts (docs/serving.md#qos) — brief spikes don't grow the fleet.
+    Default 3."""
+    v = _get("QOS_SCALE_SUSTAIN_S")
+    if v in (None, ""):
+        return 3.0
+    return float(v)
+
+
+def qos_scale_cooldown_s() -> float:
+    """Seconds of continuously low load before the autoscaler drains a
+    replica, and the minimum gap after any scale action before the next
+    (docs/serving.md#qos). Default 15."""
+    v = _get("QOS_SCALE_COOLDOWN_S")
+    if v in (None, ""):
+        return 15.0
+    return float(v)
+
+
+def qos_scale_interval_s() -> float:
+    """Autoscaler observation period in seconds (docs/serving.md#qos).
+    Default 1."""
+    v = _get("QOS_SCALE_INTERVAL_S")
+    if v in (None, ""):
+        return 1.0
+    return float(v)
+
+
 def max_tenants() -> int:
     """Cardinality cap on the ``tenant`` metric label
     (docs/serving.md#slo): the first N distinct tenant names keep

@@ -44,6 +44,23 @@ def _deterministic_view(bench):
         "offered_alone": tt["interactive_only"]["offered"],
         "offered_burst": tt["with_bulk_burst"]["offered"],
     }
+    q = bench["qos"]
+    view["qos"] = {
+        "policy": q["policy"],
+        "reserved_slots": q["reserved_slots"],
+        "interactive_schedule_checksum":
+            q["with_bulk_burst"]["interactive_schedule_checksum"],
+        "bulk_schedule_checksum":
+            q["with_bulk_burst"]["bulk_schedule_checksum"],
+        "interactive_only_checksum":
+            q["interactive_only"]["schedule_checksum"],
+        "interactive_schedules_identical":
+            q["interactive_schedules_identical"],
+        "autoscale_config": q["autoscale"]["config"],
+        "autoscale_checksums": {
+            name: arm["schedule_checksum"]
+            for name, arm in q["autoscale"]["sweep"].items()},
+    }
     return view
 
 
@@ -119,6 +136,75 @@ def test_headlines_hold(bench):
         bench["two_tenant"]["interactive_p99_inflation"]
 
 
+def test_qos_replay_is_byte_identical(bench):
+    """ACCEPTANCE (docs/serving.md#qos): the QoS arm replays the SAME
+    interactive schedule as the plain two-tenant A/B — identical
+    checksum across all three runs — so the inflation numbers compare
+    like for like."""
+    q = bench["qos"]
+    tt = bench["two_tenant"]
+    assert q["interactive_schedules_identical"] is True
+    assert (q["with_bulk_burst"]["interactive_schedule_checksum"]
+            == q["interactive_only"]["schedule_checksum"])
+    # Same schedule the PLAIN fleet saw: priority lives server-side in
+    # the SLO config file, never in the arrival rows.
+    assert (q["interactive_only"]["schedule_checksum"]
+            == tt["interactive_only"]["schedule_checksum"])
+    assert (q["with_bulk_burst"]["bulk_schedule_checksum"]
+            == tt["with_bulk_burst"]["bulk_schedule_checksum"])
+
+
+def test_qos_policy_and_classes_are_pinned(bench):
+    q = bench["qos"]
+    assert q["reserved_slots"] == 1
+    assert q["policy"]["interactive"]["priority"] == "interactive"
+    assert q["policy"]["bulk"]["priority"] == "bulk"
+    assert q["policy"]["interactive"]["weight"] > \
+        q["policy"]["bulk"]["weight"]
+    # The class-tagged client rollup rode along.
+    assert "interactive" in q["with_bulk_burst"]["by_class"]
+    assert "bulk" in q["with_bulk_burst"]["by_class"]
+
+
+def test_qos_bounds_interactive_inflation(bench):
+    """ACCEPTANCE: with priority classes + reserved slot + class-aware
+    routing on, the interactive tenant's burst TTFT p99 inflation is
+    bounded (<= 3x its own-fleet alone run) instead of the unbounded
+    queueing the plain fleet shows; bulk degrades gracefully (completes
+    work, never starves interactive)."""
+    q = bench["qos"]
+    assert q["interactive_p99_inflation_qos"] > 0
+    assert q["interactive_p99_inflation_qos"] <= 3.0, q
+    bulk = q["with_bulk_burst"]["by_class"]["bulk"]
+    assert bulk["completed"] > 0, bulk
+
+
+def test_qos_headlines_hold(bench):
+    h = bench["headlines"]
+    q = bench["qos"]
+    assert h["interactive_p99_inflation_qos"] == \
+        q["interactive_p99_inflation_qos"]
+    assert h["qos_schedules_identical"] is True
+    assert h["fleet_scaled_up"] is True
+    assert h["fleet_scaled_back_down"] is True
+
+
+def test_autoscale_sweep_recorded(bench):
+    a = bench["qos"]["autoscale"]
+    assert a["config"]["min"] == 2
+    assert a["config"]["max"] == 4
+    for name in ("rps4", "rps10", "rps25", "rps25_scaled"):
+        assert name in a["sweep"], name
+    assert a["scaled_up"] is True
+    ups = [e for e in a["scale_events"] if e["direction"] == "up"]
+    assert ups and all(
+        e["why"] in ("queue_runaway", "ttft_trend", "retry_pressure",
+                     "queue_depth") for e in ups), a["scale_events"]
+    assert all(2 <= e["n"] <= 4 for e in a["scale_events"])
+    # The grown fleet is never below the floor.
+    assert a["replicas_final"] >= 2
+
+
 def test_past_knee_arm_sheds_or_violates(bench):
     """rps25 is ~2x pinned capacity: the fleet cannot be meeting every
     SLO there. Some of the offered load shows up as violations, shed,
@@ -144,7 +230,7 @@ class TestBenchSloReproducible:
                 [sys.executable, os.path.join(ROOT, "bench_serving.py"),
                  "--slo", "--out", str(out)],
                 check=True, capture_output=True, text=True,
-                timeout=1200, cwd=ROOT)
+                timeout=2400, cwd=ROOT)
             bench = json.loads(out.read_text())
             assert bench["clean_stop"] is True
             views.append(_deterministic_view(bench))

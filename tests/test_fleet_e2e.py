@@ -579,3 +579,145 @@ class TestFleetBenchReproducible:
                     "requests_failed", "output_checksum", "replicas",
                     "fault", "outputs_equal_uncontended"):
             assert a[key] == b[key], key
+
+
+@pytest.mark.slow
+class TestFleetAutoscaleE2E:
+    def test_fleet_scales_up_under_load_and_drains_back(
+            self, tmp_path):
+        """QoS autoscaling acceptance (docs/serving.md#qos): a
+        2-replica fleet with decode capacity pinned by a slow_decode
+        fault grows to 3 under sustained over-capacity load (scale
+        event recorded with a valid why), then — once load subsides —
+        drains the extra replica back down to the floor with ZERO
+        dropped requests: every request fired during the load phase
+        AND during the scale-down drain completes with 200."""
+        import threading
+
+        from horovod_tpu.observability import registry as _reg
+        from horovod_tpu.serving import AutoscalerConfig, FleetAutoscaler
+
+        ckpt = str(tmp_path / "ckpt")
+        _write_checkpoint(ckpt)
+
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            # Pin capacity: every decode tick costs >= 20ms, so two
+            # 2-slot replicas sustain ~12 tok-bursts/s and a 12-wide
+            # closed loop holds load_per_slot ~3 >> high_load.
+            "HOROVOD_TPU_FAULT_SPEC": "rank=*:slow_decode=20ms",
+        })
+        fleet = Fleet(2, ["--checkpoint-dir", ckpt, "--tp", "1",
+                          "--block-size", "4", "--kv-blocks", "64",
+                          "--slots", "2",
+                          "--max-new-tokens", "16"],
+                      env=env)
+        router = Router(fleet, port=0, host="127.0.0.1",
+                        scrape_interval_s=0.1)
+        scaler = FleetAutoscaler(
+            fleet,
+            AutoscalerConfig(2, 3, high_load=1.2, low_load=0.3,
+                             sustain_s=1.0, cooldown_s=3.0,
+                             alert_hold_s=2.0),
+            signals=router.qos_signals, interval_s=0.25)
+        fleet.on_alert = scaler.note_alert
+        fleet.start()
+        try:
+            fleet.wait_ready(600.0)
+            router.start()
+            scaler.start()
+
+            stop = threading.Event()
+            lock = threading.Lock()
+            load_results = []
+
+            def pound(seed):
+                rng = np.random.RandomState(seed)
+                while not stop.is_set():
+                    toks = [int(t) for t in rng.randint(0, 64, 6)]
+                    status, body = _post(
+                        router.port,
+                        {"tokens": toks, "max_new_tokens": 16},
+                        timeout=180)
+                    with lock:
+                        load_results.append(
+                            (status, body.get("error")))
+
+            # --- phase 1: sustained over-capacity load -> scale up
+            with ThreadPoolExecutor(max_workers=12) as pool:
+                futs = [pool.submit(pound, 100 + i)
+                        for i in range(12)]
+                deadline = time.monotonic() + 240.0
+                grown = False
+                while time.monotonic() < deadline:
+                    ups = [d for d in scaler.decisions
+                           if d["direction"] == "up"]
+                    if ups and \
+                            router.qos_signals()["n_replicas"] >= 3:
+                        grown = True
+                        break
+                    time.sleep(0.5)
+                assert grown, (scaler.decisions,
+                               router.qos_signals())
+                # goodput on the grown fleet: keep pounding briefly
+                time.sleep(3.0)
+                stop.set()
+                for f in futs:
+                    f.result(timeout=300)
+
+            assert load_results, "load phase produced no requests"
+            bad = [r for r in load_results if r[0] != 200]
+            assert not bad, f"dropped during scale-up: {bad[:5]}"
+            ups = [d for d in scaler.decisions
+                   if d["direction"] == "up"]
+            assert ups and all(
+                d["why"] in ("queue_runaway", "ttft_trend",
+                             "retry_pressure", "queue_depth")
+                for d in ups), scaler.decisions
+            assert all(2 <= d["n"] <= 3
+                       for d in scaler.decisions), scaler.decisions
+
+            # --- phase 2: load subsides -> drain back to the floor,
+            # with a live trickle riding through the drain.
+            trickle = []
+            deadline = time.monotonic() + 180.0
+            shrunk = False
+            while time.monotonic() < deadline:
+                downs = [d for d in scaler.decisions
+                         if d["direction"] == "down"]
+                if downs and fleet.live_count() == 2 and \
+                        len(fleet.replicas) == 2:
+                    shrunk = True
+                    break
+                status, _body = _post(
+                    router.port,
+                    {"tokens": [1, 2, 3], "max_new_tokens": 4},
+                    timeout=60)
+                trickle.append(status)
+                time.sleep(0.5)
+            assert shrunk, (scaler.decisions, fleet.live_count(),
+                            len(fleet.replicas))
+            assert trickle, "no trickle requests rode the drain"
+            assert all(s == 200 for s in trickle), trickle
+
+            # the fleet still serves at the floor
+            status, body = _post(
+                router.port,
+                {"tokens": [4, 5, 6], "max_new_tokens": 4},
+                timeout=60)
+            assert status == 200, body
+
+            # supervisor-side evidence: the scale-event counter saw
+            # both directions.
+            snap = _reg.registry().snapshot(
+                "hvdtpu_fleet_scale_events_total")
+            keys = list(snap["hvdtpu_fleet_scale_events_total"]
+                        ["values"])
+            assert any('direction="up"' in k for k in keys), keys
+            assert any('direction="down"' in k for k in keys), keys
+        finally:
+            scaler.stop()
+            router.shutdown()
+            fleet.stop()

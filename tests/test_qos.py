@@ -1,0 +1,569 @@
+"""Multi-tenant QoS plane (docs/serving.md#qos): DWRR class queues,
+reserved batch slots, deadline-aware predictive shedding, token-rate
+quotas with drain-rate Retry-After, and the autoscaler's hysteresis
+state machine. The fleet-level scale-up/scale-down e2e lives in
+test_fleet_e2e.py (slow tier)."""
+
+import json
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.models import transformer as tfm
+from horovod_tpu.parallel.mesh import create_mesh
+from horovod_tpu.serving import (InferenceEngine, QuotaExceededError,
+                                 ServingConfig)
+from horovod_tpu.serving import loadgen
+from horovod_tpu.serving import qos
+from horovod_tpu.serving import slo as _slo
+
+
+class _Item:
+    def __init__(self, name, qos_class=None):
+        self.name = name
+        if qos_class is not None:
+            self.qos_class = qos_class
+
+    def __repr__(self):
+        return f"_Item({self.name})"
+
+
+def _loaded_queues(weights=None, per_class=50):
+    q = qos.ClassQueues(weights)
+    for c in qos.PRIORITY_CLASSES:
+        for i in range(per_class):
+            q.append(_Item(f"{c}{i}"), qos_class=c)
+    return q
+
+
+class TestClassQueues:
+    def test_weight_proportionality_under_saturation(self):
+        """Deep backlogs in every class: admissions converge to the
+        exact weight ratio (4:2:1 -> 40/20/10 over 70 picks)."""
+        q = _loaded_queues({"interactive": 4, "default": 2, "bulk": 1})
+        picks = {c: 0 for c in qos.PRIORITY_CLASSES}
+        for _ in range(70):
+            req = q.select()
+            assert req is not None
+            picks[req.qos_class] += 1
+        assert picks == {"interactive": 40, "default": 20, "bulk": 10}
+
+    def test_no_starvation(self):
+        """Any backlogged class with weight > 0 is served within one
+        replenish round — bulk appears in the first weight-sum picks."""
+        q = _loaded_queues({"interactive": 4, "default": 2, "bulk": 1})
+        first = [q.select().qos_class for _ in range(7)]
+        assert "bulk" in first and "default" in first
+
+    def test_fractional_weights_do_not_stall(self):
+        q = qos.ClassQueues({"interactive": 0.4, "default": 0.2,
+                             "bulk": 0.1})
+        q.append(_Item("b0"), qos_class="bulk")
+        req = q.select()
+        assert req is not None and req.qos_class == "bulk"
+
+    def test_fifo_within_class(self):
+        q = qos.ClassQueues()
+        for i in range(3):
+            q.append(_Item(f"d{i}"), qos_class="default")
+        assert [q.select().name for _ in range(3)] == \
+            ["d0", "d1", "d2"]
+
+    def test_allowed_predicate_filters_classes(self):
+        q = _loaded_queues(per_class=2)
+        only_top = q.select(lambda c: c == qos.TOP_CLASS)
+        assert only_top.qos_class == "interactive"
+        none = q.select(lambda c: False)
+        assert none is None
+        assert len(q) == 5   # nothing popped by the refused select
+
+    def test_pushback_restores_head_and_deficit(self):
+        q = qos.ClassQueues()
+        q.append(_Item("a"), qos_class="default")
+        q.append(_Item("b"), qos_class="default")
+        first = q.select()
+        assert first.name == "a"
+        q.pushback(first)
+        assert q.select().name == "a"   # back at the head, not the tail
+
+    def test_remove_and_len_and_iter(self):
+        q = _loaded_queues(per_class=1)
+        assert len(q) == 3 and bool(q)
+        victim = q.heads()[-1]
+        assert q.remove(victim) is True
+        assert q.remove(victim) is False
+        assert len(q) == 2
+        assert [getattr(r, "name") for r in q] == \
+            ["interactive0", "default0"]
+
+    def test_reserved_slot_simulation_bulk_cannot_squeeze_top(self):
+        """The engine's _admit predicate over a full bulk backlog:
+        non-top occupancy never exceeds slots - reserved, and an
+        interactive arrival is admitted immediately even when bulk
+        queued first."""
+        slots, reserved = 4, 2
+        q = qos.ClassQueues()
+        for i in range(16):
+            q.append(_Item(f"b{i}"), qos_class="bulk")
+        active = []
+        while len(active) < slots:
+            non_top = sum(1 for r in active
+                          if r.qos_class != qos.TOP_CLASS)
+            req = q.select(
+                lambda c, n=non_top: c == qos.TOP_CLASS
+                or n < slots - reserved)
+            if req is None:
+                break
+            active.append(req)
+        assert len(active) == 2   # bulk stops at the reservation line
+        q.append(_Item("vip"), qos_class="interactive")
+        non_top = sum(1 for r in active
+                      if r.qos_class != qos.TOP_CLASS)
+        req = q.select(lambda c, n=non_top: c == qos.TOP_CLASS
+                       or n < slots - reserved)
+        assert req is not None and req.qos_class == "interactive"
+
+
+class TestQosPolicy:
+    def test_config_rows_parse_and_default(self, tmp_path):
+        p = tmp_path / "slo.json"
+        p.write_text(json.dumps({"tenants": {
+            "vip": {"priority": "interactive", "weight": 8,
+                    "ttft_ms": 100},
+            "batch": {"priority": "bulk",
+                      "quota_tokens_per_s": 500},
+            "plain": {"ttft_ms": 200},
+        }, "default": {"priority": "default", "weight": 3}}))
+        pol = qos.QosPolicy(str(p))
+        assert pol.class_of("vip") == "interactive"
+        assert pol.spec_of("vip").weight == 8.0
+        assert pol.class_of("batch") == "bulk"
+        assert pol.quota_of("batch") == 500.0
+        assert pol.spec_of("batch").weight == \
+            qos.DEFAULT_WEIGHTS["bulk"]
+        # A row with no QoS fields rides the default spec.
+        assert pol.class_of("plain") == "default"
+        assert pol.spec_of("plain").weight == 3.0
+        assert pol.class_of(None) == "default"
+        w = pol.class_weights()
+        assert w["interactive"] == 8.0 and w["bulk"] == 1.0
+
+    def test_malformed_file_degrades_to_default(self, tmp_path):
+        p = tmp_path / "slo.json"
+        p.write_text("{nope")
+        pol = qos.QosPolicy(str(p))
+        assert pol.class_of("anyone") == "default"
+
+    def test_unknown_priority_rejected(self):
+        with pytest.raises(ValueError):
+            qos.TenantQos(priority="platinum")
+        with pytest.raises(ValueError):
+            qos.TenantQos(weight=0)
+
+    def test_slo_policy_strips_qos_fields(self, tmp_path,
+                                          monkeypatch):
+        """The two planes share one config file: QoS fields must not
+        invalidate SLO parsing, and SLO targets still resolve."""
+        p = tmp_path / "slo.json"
+        p.write_text(json.dumps({"tenants": {
+            "vip": {"priority": "interactive", "weight": 8,
+                    "ttft_ms": 123}}}))
+        monkeypatch.delenv("HOROVOD_TPU_SLO_TTFT_MS", raising=False)
+        monkeypatch.delenv("HOROVOD_TPU_SLO_TPOT_MS", raising=False)
+        sp = _slo.SloPolicy(str(p))
+        t = sp.resolve("vip")
+        assert t is not None and t.ttft_ms == 123.0
+
+
+class TestPredictiveShed:
+    BUCKETS = {8: 0.010, 16: 0.022}
+
+    @staticmethod
+    def _bucket(n):
+        b = 8
+        while b < n:
+            b *= 2
+        return b
+
+    def test_measured_bucket_and_fallback(self):
+        f = qos.predict_prefill_s
+        assert f(6, self.BUCKETS, self._bucket) == 0.010
+        assert f(12, self.BUCKETS, self._bucket) == 0.022
+        # Unmeasured 32-bucket: largest measured scaled by ratio.
+        assert f(30, self.BUCKETS, self._bucket) == \
+            pytest.approx(0.044)
+        assert f(30, {}, self._bucket) == 0.0
+        assert f(0, self.BUCKETS, self._bucket) == 0.0
+
+    def test_chunked_path_multiplies_chunks(self):
+        got = qos.predict_prefill_s(40, self.BUCKETS, self._bucket,
+                                    chunk_tokens=16)
+        assert got == pytest.approx(3 * 0.022)
+
+    def test_shed_decision_semantics(self):
+        # Cannot make it: remaining < prefill + decode budget.
+        assert qos.shed_decision(0.02, 0.05, 0.01) is True
+        assert qos.shed_decision(0.10, 0.05, 0.01) is False
+        # No measurements yet -> never shed on a guess.
+        assert qos.shed_decision(-5.0, 0.0, 0.0) is False
+
+
+class TestQuotaLedger:
+    def _policy(self, tmp_path, quota=100, priority="default"):
+        p = tmp_path / "slo.json"
+        p.write_text(json.dumps({"tenants": {
+            "t": {"priority": priority,
+                  "quota_tokens_per_s": quota}}}))
+        return qos.QosPolicy(str(p))
+
+    def test_burst_admits_then_rejects(self, tmp_path):
+        led = qos.QuotaLedger(self._policy(tmp_path, quota=100))
+        # Burst = 2s of rate = 200 tokens.
+        assert led.admit("t", 150, now=0.0) is None
+        assert led.admit("t", 50, now=0.0) is None
+        retry = led.admit("t", 50, now=0.0)
+        assert retry is not None and retry >= 1
+        # Refill restores admission.
+        assert led.admit("t", 50, now=1.0) is None
+
+    def test_no_quota_tenant_always_admitted(self, tmp_path):
+        led = qos.QuotaLedger(self._policy(tmp_path))
+        assert led.admit("unknown", 10**9, now=0.0) is None
+        assert led.admit(None, 10**9, now=0.0) is None
+
+    def test_rejection_does_not_burn_tokens(self, tmp_path):
+        led = qos.QuotaLedger(self._policy(tmp_path, quota=100))
+        assert led.admit("t", 200, now=0.0) is None   # drain burst
+        assert led.admit("t", 150, now=0.0) is not None
+        # The failed take deducted nothing: 1s refill = 100 tokens.
+        assert led.admit("t", 100, now=1.0) is None
+
+    def test_retry_after_uses_measured_drain_rate(self, tmp_path):
+        """ACCEPTANCE (satellite): Retry-After = deficit over the
+        tenant's own completion rate, not the quota rate."""
+        led = qos.QuotaLedger(self._policy(tmp_path, quota=100))
+        # 10s window, 500 tokens completed -> ~50 tokens/s measured.
+        for i in range(10):
+            led.note_completion("t", 50, now=float(i))
+        rate = led.drain_rate("t", now=10.0)
+        assert rate == pytest.approx(500 / 10.0, rel=0.15)
+        got = led.retry_after_s("t", deficit=100.0, now=10.0)
+        assert got == int(-(-100.0 // rate))   # ceil(deficit/measured)
+        # Fallback with no completions: the quota rate.
+        led2 = qos.QuotaLedger(self._policy(tmp_path, quota=100))
+        assert led2.retry_after_s("t", deficit=100.0, now=0.0) == 1
+
+    def test_retry_after_clamps_floor_and_cap(self, tmp_path):
+        bulk = qos.QuotaLedger(
+            self._policy(tmp_path, quota=1000, priority="bulk"))
+        # Tiny deficit still honors the bulk back-off floor.
+        assert bulk.retry_after_s("t", deficit=1.0, now=0.0) == \
+            qos.RETRY_AFTER_FLOOR_S["bulk"]
+        slow = qos.QuotaLedger(self._policy(tmp_path, quota=1))
+        assert slow.retry_after_s("t", deficit=10**6, now=0.0) == \
+            qos.RETRY_AFTER_CAP_S
+
+    def test_drain_window_expires(self, tmp_path):
+        led = qos.QuotaLedger(self._policy(tmp_path, quota=100))
+        led.note_completion("t", 100, now=0.0)
+        assert led.drain_rate("t", now=1.0) is not None
+        assert led.drain_rate("t", now=100.0) is None
+
+
+class TestAutoscalerState:
+    CFG = dict(high_load=1.5, low_load=0.25, sustain_s=3.0,
+               cooldown_s=10.0, alert_hold_s=5.0)
+
+    def _state(self, **over):
+        kw = dict(self.CFG)
+        kw.update(over)
+        return qos.AutoscalerState(qos.AutoscalerConfig(2, 4, **kw))
+
+    def test_up_needs_sustained_pressure(self):
+        s = self._state()
+        assert s.observe(0.0, 2, 2.0) is None
+        assert s.observe(2.0, 2, 2.0) is None      # < sustain_s
+        d = s.observe(3.5, 2, 2.0)
+        assert d == {"direction": "up", "why": "queue_depth", "n": 3}
+        # Clock reset: the next up needs a fresh sustain window.
+        assert s.observe(4.0, 3, 2.0) is None
+
+    def test_pressure_blip_resets_sustain(self):
+        s = self._state()
+        assert s.observe(0.0, 2, 2.0) is None
+        assert s.observe(1.0, 2, 1.0) is None      # pressure cleared
+        assert s.observe(2.0, 2, 2.0) is None
+        assert s.observe(4.9, 2, 2.0) is None      # only 2.9s sustained
+        assert s.observe(5.1, 2, 2.0) is not None
+
+    def test_up_clamps_at_max(self):
+        s = self._state()
+        s.observe(0.0, 4, 2.0)
+        assert s.observe(10.0, 4, 2.0) is None
+
+    def test_down_needs_cooldown_and_respects_min(self):
+        s = self._state()
+        assert s.observe(0.0, 3, 0.1) is None
+        assert s.observe(9.0, 3, 0.1) is None
+        d = s.observe(10.5, 3, 0.1)
+        assert d == {"direction": "down", "why": "recovered", "n": 2}
+        s2 = self._state()
+        s2.observe(0.0, 2, 0.1)
+        assert s2.observe(100.0, 2, 0.1) is None   # at the floor
+
+    def test_midband_load_resets_both_clocks(self):
+        s = self._state()
+        s.observe(0.0, 3, 0.1)
+        assert s.observe(5.0, 3, 1.0) is None      # between thresholds
+        assert s.observe(11.0, 3, 0.1) is None     # cooldown restarted
+
+    def test_alert_hold_outranks_load(self):
+        s = self._state()
+        s.note_alert("queue_depth_runaway", 0.0)
+        assert s.observe(0.0, 2, 0.0) is None
+        d = s.observe(3.5, 2, 0.0)
+        assert d is not None and d["why"] == "queue_runaway"
+        # Hold expired: low load is low load again.
+        s2 = self._state()
+        s2.note_alert("queue_depth_runaway", 0.0)
+        assert s2.observe(6.0, 2, 0.0) is None
+
+    def test_retry_pressure_and_ttft_trend_reasons(self):
+        s = self._state()
+        s.observe(0.0, 2, 0.0, retry_pressure=2.0)
+        d = s.observe(3.5, 2, 0.0, retry_pressure=2.0)
+        assert d is not None and d["why"] == "retry_pressure"
+        s2 = self._state(ttft_target_ms=500.0)
+        s2.observe(0.0, 2, 0.0, ttft_p99_ms=900.0)
+        d2 = s2.observe(3.5, 2, 0.0, ttft_p99_ms=900.0)
+        assert d2 is not None and d2["why"] == "ttft_trend"
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            qos.AutoscalerConfig(0, 2)
+        with pytest.raises(ValueError):
+            qos.AutoscalerConfig(3, 2)
+
+
+class _FakeFleet:
+    def __init__(self, n=2):
+        self.n = n
+        self.calls = []
+
+    def live_count(self):
+        return self.n
+
+    def load_views(self):
+        return [{"active": 2, "queue_depth": 6, "slots": 2}
+                for _ in range(self.n)]
+
+    def scale_to(self, n):
+        self.calls.append(n)
+        self.n = n
+
+
+class TestFleetAutoscaler:
+    def test_tick_applies_decision_and_records(self):
+        fleet = _FakeFleet(2)
+        a = qos.FleetAutoscaler(
+            fleet, qos.AutoscalerConfig(2, 4, sustain_s=1.0))
+        assert a.tick(now=0.0) is None
+        d = a.tick(now=1.5)
+        assert d is not None and d["direction"] == "up"
+        assert fleet.calls == [3]
+        assert a.decisions == [d]
+
+    def test_signal_source_injection(self):
+        fleet = _FakeFleet(2)
+        sig = {"load_per_slot": 0.0, "n_replicas": 2}
+        a = qos.FleetAutoscaler(
+            fleet, qos.AutoscalerConfig(2, 4, sustain_s=1.0),
+            signals=lambda: sig)
+        assert a.tick(now=0.0) is None
+        assert a.tick(now=5.0) is None   # injected load is calm
+        sig["load_per_slot"] = 9.0
+        assert a.tick(now=6.0) is None
+        assert a.tick(now=7.5)["direction"] == "up"
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=4,
+                                n_layers=2, d_ff=64, max_seq=64,
+                                dtype=jnp.float32, remat=False)
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    return create_mesh(devices=jax.devices()[:1], tp=1)
+
+
+@pytest.fixture
+def qos_config(tmp_path, monkeypatch):
+    p = tmp_path / "slo.json"
+    p.write_text(json.dumps({"tenants": {
+        "vip": {"priority": "interactive", "weight": 4},
+        "batch": {"priority": "bulk", "weight": 1},
+        "capped": {"priority": "default",
+                   "quota_tokens_per_s": 20}}}))
+    monkeypatch.setenv("HOROVOD_TPU_SLO_CONFIG", str(p))
+    qos._reset_policy()
+    _slo._reset_policy()
+    _slo._reset_tenants()
+    yield str(p)
+    qos._reset_policy()
+    _slo._reset_policy()
+    _slo._reset_tenants()
+
+
+def _engine(params, cfg, mesh, **over):
+    kw = dict(block_size=4, kv_blocks=40, max_batch_slots=4,
+              max_queue=16, max_new_tokens=8, min_prefill_bucket=8)
+    kw.update(over)
+    return InferenceEngine(params, cfg, mesh, ServingConfig(**kw))
+
+
+class TestEngineQos:
+    def test_reserved_slots_validation(self, model, mesh1):
+        cfg, params = model
+        with pytest.raises(ValueError):
+            _engine(params, cfg, mesh1, reserved_slots=4)
+
+    def test_reserved_slot_invariant_under_bulk_backlog(
+            self, model, mesh1, qos_config):
+        """ACCEPTANCE (tentpole): with 2 of 4 slots reserved, a deep
+        bulk backlog occupies at most 2 slots, and interactive
+        arrivals land in the reserve immediately."""
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1, reserved_slots=2)
+        for _ in range(8):
+            eng.submit([1, 2, 3], max_new_tokens=6, tenant="batch")
+        eng.step()
+        counts = eng.class_counts()
+        assert counts["bulk"]["active"] == 2
+        assert counts["interactive"]["active"] == 0
+        vips = [eng.submit([4, 5, 6], max_new_tokens=6, tenant="vip")
+                for _ in range(2)]
+        eng.step()
+        counts = eng.class_counts()
+        assert counts["interactive"]["active"] == 2
+        assert counts["bulk"]["active"] == 2
+        # Run to completion: nobody deadlocks under the reservation.
+        for _ in range(200):
+            if all(r.done for r in vips):
+                break
+            eng.step()
+        assert all(r.status == "completed" for r in vips)
+
+    def test_dwrr_admission_prefers_interactive(self, model, mesh1,
+                                                qos_config):
+        """Mixed backlog, no reservation: DWRR admits interactive
+        ahead of an earlier-queued equal-length bulk run."""
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1, max_batch_slots=2)
+        bulk = [eng.submit([1, 2], max_new_tokens=4, tenant="batch")
+                for _ in range(4)]
+        vip = eng.submit([3, 4], max_new_tokens=4, tenant="vip")
+        eng.step()
+        counts = eng.class_counts()
+        assert counts["interactive"]["active"] == 1, counts
+        for _ in range(300):
+            if all(r.done for r in bulk + [vip]):
+                break
+            eng.step()
+        assert all(r.status == "completed" for r in bulk + [vip])
+
+    def test_quota_429_with_retry_after(self, model, mesh1,
+                                        qos_config):
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1)
+        # quota 20 tok/s, burst 40: prompt 3 + max_new 8 = 11 each.
+        eng.submit([1, 2, 3], max_new_tokens=8, tenant="capped")
+        eng.submit([1, 2, 3], max_new_tokens=8, tenant="capped")
+        eng.submit([1, 2, 3], max_new_tokens=8, tenant="capped")
+        with pytest.raises(QuotaExceededError) as ei:
+            eng.submit([1, 2, 3], max_new_tokens=8, tenant="capped")
+        assert ei.value.retry_after_s >= 1
+        # Unquota'd tenants are untouched.
+        eng.submit([1, 2, 3], max_new_tokens=8, tenant="vip")
+
+    def test_predictive_shed_fails_hopeless_deadline(
+            self, model, mesh1, qos_config):
+        """Once the prefill EWMA warms up, a queued request whose
+        deadline cannot cover prefill + one decode step is shed at
+        admission with the 504 error (counted reason=shed)."""
+        cfg, params = model
+        eng = _engine(params, cfg, mesh1)
+        warm = eng.submit([1] * 6, max_new_tokens=2)
+        for _ in range(60):
+            if warm.done:
+                break
+            eng.step()
+        assert warm.status == "completed"
+        # A second warm run: the EWMA skips compile runs, so it only
+        # records once the bucket recompiles nothing.
+        warm2 = eng.submit([2] * 6, max_new_tokens=2)
+        for _ in range(60):
+            if warm2.done:
+                break
+            eng.step()
+        assert eng._prefill_cost, "prefill EWMA did not warm up"
+        doomed = eng.submit([3] * 6, max_new_tokens=2,
+                            deadline_s=1e-9, tenant="vip")
+        eng.step()
+        assert doomed.done
+        assert doomed.status == "failed"
+        assert doomed.shed or "deadline" in (doomed.error or "")
+
+
+class TestLoadgenQos:
+    def test_priority_field_omitted_when_none(self):
+        """Checksum stability: pre-QoS schedules serialize byte-
+        identically — priority only appears when set."""
+        spec = loadgen.TenantSpec("t")
+        arr = loadgen.Arrival(t_s=0.1, tenant="t", tokens=(1, 2),
+                              max_new_tokens=4)
+        assert "priority" not in spec.to_dict()
+        assert "priority" not in arr.to_dict()
+        tagged = loadgen.TenantSpec("t", priority="bulk")
+        assert tagged.to_dict()["priority"] == "bulk"
+
+    def test_schedule_roundtrip_preserves_priority(self, tmp_path):
+        sched = loadgen.build_schedule(
+            10.0, 1.0, 7, [loadgen.TenantSpec("t", priority="bulk")])
+        assert all(a.priority == "bulk" for a in sched)
+        path = tmp_path / "sched.jsonl"
+        loadgen.save_schedule(sched, str(path))
+        back = loadgen.load_schedule(str(path))
+        assert loadgen.schedule_checksum(back) == \
+            loadgen.schedule_checksum(sched)
+        assert back[0].priority == "bulk"
+
+    def test_summarize_by_class(self):
+        run = {
+            "offered": 4, "sent": 4, "dropped": 0,
+            "results": [
+                {"tenant": "a", "status": "completed",
+                 "priority": "interactive", "ttft_ms": 5.0},
+                {"tenant": "a", "status": "completed",
+                 "priority": "interactive", "ttft_ms": 7.0},
+                {"tenant": "b", "status": "rejected",
+                 "priority": "bulk"},
+                {"tenant": "b", "status": "completed",
+                 "priority": "bulk", "ttft_ms": 50.0},
+            ]}
+        s = loadgen.summarize(run)
+        assert s["by_class"]["interactive"]["completed"] == 2
+        assert s["by_class"]["bulk"]["rejected"] == 1
+        assert s["by_class"]["bulk"]["goodput_frac"] == 0.5
+
+    def test_summarize_classes_mapping_overrides(self):
+        run = {"offered": 1, "sent": 1, "dropped": 0,
+               "results": [{"tenant": "a", "status": "completed"}]}
+        s = loadgen.summarize(run, classes={"a": "interactive"})
+        assert s["by_class"]["interactive"]["completed"] == 1
+        assert loadgen.summarize(run).get("by_class") is None
