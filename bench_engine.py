@@ -808,6 +808,198 @@ def main_health(out_path: str, rounds: int = HEALTH_ROUNDS) -> dict:
 
 
 # --------------------------------------------------------------------------
+# Numerics-plane overhead A/B (--numerics): the nonfinite payload
+# sentinel (docs/numerics.md) adds one np.isfinite pass over each fused
+# collective buffer — bytes the pack loop just touched, so the pass
+# should ride the cache — plus a single flag check everywhere else.
+# A 2-process fused-allreduce loop runs with the plane enabled vs
+# disabled, toggled in-process with alternating order per round (the
+# BENCH_METRICS method), p25 of pooled per-step wall times. Budget: the
+# acceptance bar is < 1% of step time. A seeded numerics_smoke section
+# pins the plane's headline behaviours (a crafted NaN/Inf buffer counts
+# exactly, a single flipped mantissa bit changes the value fingerprint
+# and the majority-compare names the flipped rank, the nonfinite-rate
+# detector fires on the first event) so the artifact documents more
+# than a timing.
+# --------------------------------------------------------------------------
+
+NUMERICS_STEPS = 40
+NUMERICS_ROUNDS = 6
+NUMERICS_WARMUP = 8
+NUMERICS_BUDGET = 0.01
+
+
+def run_numerics_job(steps: int, warmup: int, rounds: int) -> dict:
+    """One 2-process job; returns pooled per-step wall times per mode
+    plus the nonfinite counter total (must stay 0 on an all-ones
+    payload — a nonzero count here means the sentinel miscounts)."""
+    from horovod_tpu.runner.api import run as hvd_run
+
+    def worker(steps, warmup, rounds):
+        import time
+
+        import jax.numpy as jnp
+
+        import horovod_tpu as hvd
+        from horovod_tpu.observability import numerics as _numerics
+        from horovod_tpu.ops import collective as _coll
+
+        hvd.init()
+        eng = _coll.engine()
+        xs = [jnp.ones((256,), jnp.float32) for _ in range(8)]
+
+        def hot(tag, n):
+            out = []
+            for step in range(n):
+                t0 = time.perf_counter()
+                with eng.burst():
+                    hs = [hvd.allreduce_async(
+                        x, average=False,
+                        name=f"nm.{tag}.{step}.{i}")
+                        for i, x in enumerate(xs)]
+                for h in hs:
+                    h.wait()
+                out.append(time.perf_counter() - t0)
+            return out
+
+        hot("w", warmup)               # compile + engine bring-up
+        # STEP-level interleave, not the --health block interleave: the
+        # plane toggles with one module flag, so each on-step can run
+        # back-to-back with its off-step twin ~4 ms later — any load
+        # swing on a shared box hits both halves of a pair and cancels
+        # in the per-pair ratio. Order flips each round.
+        times = {"rounds": []}
+        for rep in range(rounds):
+            order = (("on", "off") if rep % 2 == 0 else ("off", "on"))
+            row = {"on": [], "off": []}
+            for step in range(steps):
+                for mode in order:
+                    _numerics.set_enabled(mode == "on")
+                    row[mode].extend(hot(f"{rep}.{mode}.{step}", 1))
+            times["rounds"].append(row)
+        _numerics.set_enabled(False)
+        snap = hvd.metrics_snapshot(prefix="hvdtpu_numerics_")
+        times["nonfinite"] = sum(
+            (snap.get("hvdtpu_numerics_nonfinite_total") or
+             {"values": {}})["values"].values())
+        eng.shutdown()
+        return times
+
+    env = {"JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+           "HOROVOD_TPU_DISABLE_NATIVE": "1",
+           "HOROVOD_CYCLE_TIME": "1"}
+    results = hvd_run(worker, args=(steps, warmup, rounds), np=2,
+                      extra_env=env, start_timeout=300)
+    # Pool the two ranks' samples round-by-round: collectives step in
+    # lockstep, so round r on rank 0 and round r on rank 1 are the same
+    # wall-clock window.
+    pooled = {"rounds": [], "nonfinite": 0}
+    for i in range(rounds):
+        row = {"on": [], "off": []}
+        for r in results:
+            row["on"].extend(r["rounds"][i]["on"])
+            row["off"].extend(r["rounds"][i]["off"])
+        pooled["rounds"].append(row)
+    for r in results:
+        pooled["nonfinite"] += r["nonfinite"]
+    return pooled
+
+
+def run_numerics_smoke() -> dict:
+    """Seeded, deterministic numerics behaviour pinned into the
+    artifact: exact nonfinite accounting, single-bitflip fingerprint
+    sensitivity + majority blame, and the windowed nonfinite-rate
+    detector's time-to-fire."""
+    import numpy as np
+
+    from horovod_tpu.observability import health as _health
+    from horovod_tpu.observability import numerics as _numerics
+
+    bad = np.arange(64, dtype=np.float32)
+    bad[3] = np.nan
+    bad[10], bad[11] = np.inf, -np.inf
+    counted = int(_numerics.count_nonfinite(bad))
+
+    clean = np.arange(4096, dtype=np.float32) / 7.0
+    fp = _numerics.fingerprint_leaf("w", clean)
+    fp_flipped = _numerics.fingerprint_leaf(
+        "w", _numerics.flip_mantissa_bit(clean, index=2048, bit=3))
+    divergent = _numerics.compare_fingerprints(
+        {0: {"w": fp}, 1: {"w": fp_flipped}, 2: {"w": fp}})
+
+    det = next(s for s in _health.default_specs()
+               if s.kind == "nonfinite_rate").factory()
+    fired_at = None
+    for t in range(10):
+        # A counter-rate series that records one nonfinite event at
+        # t=3s and is otherwise silent.
+        if det.update(float(t), 1.0 if t == 3 else 0.0) \
+                and fired_at is None:
+            fired_at = t
+    return {
+        "nonfinite_elements_counted": counted,
+        "nonfinite_elements_expected": 3,
+        "bitflip_changes_fingerprint": fp != fp_flipped,
+        "bitflip_blamed": [[leaf, rank] for leaf, rank in divergent],
+        "nonfinite_rate_first_fired_at_sample": fired_at,
+        "nonfinite_event_at_sample": 3,
+    }
+
+
+def main_numerics(out_path: str, rounds: int = NUMERICS_ROUNDS) -> dict:
+    times = run_numerics_job(NUMERICS_STEPS, NUMERICS_WARMUP, rounds)
+    p25 = lambda xs: sorted(xs)[len(xs) // 4]  # noqa: E731
+    med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+    # Paired estimator: each on-step ran back-to-back with its
+    # off-step twin, so the per-pair ratio cancels whatever the box
+    # was doing at that instant; the median over all pairs rejects the
+    # pairs a load spike still split. Block-level A/B (the --health
+    # method) was tried first and wandered ±4% on a busy box — 50x the
+    # plane's true measured cost (~3 us of np.isfinite per fused
+    # buffer).
+    ratios = [on / off
+              for r in times["rounds"]
+              for on, off in zip(r["on"], r["off"])]
+    overhead = med(ratios) - 1.0
+    per_round = [round(med([on / off
+                            for on, off in zip(r["on"], r["off"])]), 5)
+                 for r in times["rounds"]]
+    all_on = [t for r in times["rounds"] for t in r["on"]]
+    all_off = [t for r in times["rounds"] for t in r["off"]]
+    t_on, t_off = p25(all_on), p25(all_off)
+    result = {
+        "metric": "numerics_plane_overhead",
+        "note": ("2-process fused-allreduce loop, nonfinite payload "
+                 "sentinel + numerics plane enabled vs disabled, "
+                 "toggled in-process PER STEP so each on-step runs "
+                 "back-to-back with its off-step twin (order flips "
+                 "each round); overhead_frac is the median over all "
+                 "paired on/off step-time ratios (wall-clock, "
+                 "informational); the slow-tier guard asserts "
+                 "overhead_frac < 0.01; numerics_smoke fields are "
+                 "seeded-deterministic"),
+        "steps_per_mode_per_round": NUMERICS_STEPS,
+        "rounds": rounds,
+        "tensors_per_step": 8,
+        "nonfinite_false_positives": times["nonfinite"],
+        "rows": {
+            "numerics_on": {"step_time_ms": round(t_on * 1e3, 4)},
+            "numerics_off": {"step_time_ms": round(t_off * 1e3, 4)},
+        },
+        "round_ratios": per_round,
+        "overhead_frac": round(overhead, 6),
+        "budget_frac": NUMERICS_BUDGET,
+        "numerics_smoke": run_numerics_smoke(),
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(result))
+    return result
+
+
+# --------------------------------------------------------------------------
 # Straggler A/B (--straggler): a 4-process job with one rank delayed via
 # HOROVOD_TPU_FAULT_SPEC, run WITHOUT adaptation (every fused collective
 # stalls behind the slow rank for the whole job) and WITH the adaptation
@@ -1694,6 +1886,14 @@ if __name__ == "__main__":
                          "and write BENCH_HEALTH.json")
     ap.add_argument("--health-rounds", type=int, default=HEALTH_ROUNDS,
                     help="alternating on/off rounds for --health")
+    ap.add_argument("--numerics", action="store_true",
+                    help="run the numerics-plane overhead A/B "
+                         "(nonfinite payload sentinel enabled vs "
+                         "disabled) plus the seeded fingerprint/"
+                         "detector smoke, and write BENCH_NUMERICS.json")
+    ap.add_argument("--numerics-rounds", type=int,
+                    default=NUMERICS_ROUNDS,
+                    help="alternating on/off rounds for --numerics")
     ap.add_argument("--recorder-rounds", type=int,
                     default=RECORDER_ROUNDS,
                     help="alternating on/off rounds for --recorder")
@@ -1728,6 +1928,10 @@ if __name__ == "__main__":
     elif args.health:
         main_health(args.out or os.path.join(here, "BENCH_HEALTH.json"),
                     rounds=args.health_rounds)
+    elif args.numerics:
+        main_numerics(args.out or os.path.join(here,
+                                               "BENCH_NUMERICS.json"),
+                      rounds=args.numerics_rounds)
     elif args.pipeline:
         main_pipeline(args.out or os.path.join(here,
                                                "BENCH_PIPELINE.json"),

@@ -45,6 +45,20 @@ Grammar (clauses separated by ``;``, fields by ``:``)::
                            means N=1) — the adversarial long+short
                            prompt mix the chunked-prefill latency bound
                            is proven against. Fires once per clause.
+    nan_at=N               poison ONE element of the gradient tensor
+                           enqueued at tick N with NaN (the overnight-
+                           NaN corruption the numerics plane's same-
+                           step sentinel is proven against,
+                           docs/numerics.md). Fires once per clause.
+    bitflip_param=N        flip one mantissa bit of element 0 of a
+                           param leaf at training step N — the silent-
+                           data-corruption fault the cross-rank
+                           fingerprint compare catches. ``leaf=NAME``
+                           picks the first leaf whose path contains
+                           NAME (default: the first leaf). Fires once
+                           per clause; applied by the training loop's
+                           numerics hook (observability/numerics.py).
+    leaf=NAME              target-leaf substring for bitflip_param.
 
 A *tick* is one enqueued collective on this rank — for the common
 one-fused-allreduce-per-step training loop, tick == training step. The
@@ -106,7 +120,8 @@ class FaultClause:
     __slots__ = ("rank", "gen", "from_step", "until_step", "delay_s",
                  "slow_h2d_s", "crash_at", "drop_announce",
                  "replica_crash_at", "slow_decode_s", "slow_prefill_s",
-                 "drop_health", "long_prompt_burst")
+                 "drop_health", "long_prompt_burst", "nan_at",
+                 "bitflip_param", "leaf")
 
     def __init__(self):
         self.rank: Optional[int] = None        # None == '*'
@@ -122,6 +137,9 @@ class FaultClause:
         self.slow_prefill_s = 0.0
         self.drop_health = False
         self.long_prompt_burst: Optional[Tuple[int, int]] = None  # (N, L)
+        self.nan_at: Optional[int] = None
+        self.bitflip_param: Optional[int] = None
+        self.leaf = ""                         # bitflip target substring
 
     def matches(self, rank: int, generation: int) -> bool:
         if self.rank is not None and self.rank != rank:
@@ -158,6 +176,12 @@ class FaultClause:
         if self.long_prompt_burst is not None:
             n, plen = self.long_prompt_burst
             parts.append(f"long_prompt_burst={n}x{plen}")
+        if self.nan_at is not None:
+            parts.append(f"nan_at={self.nan_at}")
+        if self.bitflip_param is not None:
+            parts.append(f"bitflip_param={self.bitflip_param}")
+            if self.leaf:
+                parts.append(f"leaf={self.leaf}")
         if self.from_step:
             parts.append(f"from_step={self.from_step}")
         if self.until_step is not None:
@@ -229,19 +253,41 @@ def parse_spec(text: str) -> List[FaultClause]:
                         f"long_prompt_burst counts must be >= 1, "
                         f"got {value!r}")
                 c.long_prompt_burst = (n, plen)
+            elif key == "nan_at":
+                c.nan_at = int(value)
+            elif key == "bitflip_param":
+                c.bitflip_param = int(value)
+            elif key == "leaf":
+                c.leaf = value
             else:
                 raise ValueError(
                     f"unknown fault-spec field {key!r} in clause {raw!r} "
                     "(expected rank/gen/from_step/until_step/delay/"
                     "slow_h2d/crash_at/drop_announce/replica_crash_at/"
                     "slow_decode/slow_prefill/drop_health/"
-                    "long_prompt_burst)")
+                    "long_prompt_burst/nan_at/bitflip_param/leaf)")
         if not saw_rank:
             raise ValueError(
                 f"fault-spec clause {raw!r} is missing the required "
                 "rank= field (use rank=* to target every rank)")
         clauses.append(c)
     return clauses
+
+
+def _poison_one_nan(tensor):
+    """Copy ``tensor`` with element 0 set to NaN, preserving the
+    caller's array flavor (numpy stays numpy; anything else — a jax
+    array — comes back as a jax array). Integer payloads cannot carry
+    a NaN and return None (the clause is a silent no-op on them)."""
+    import numpy as np
+    a = np.array(np.asarray(tensor), copy=True)
+    if not np.issubdtype(a.dtype, np.floating):
+        return None
+    a.reshape(-1)[0] = np.nan
+    if isinstance(tensor, np.ndarray):
+        return a
+    import jax.numpy as jnp
+    return jnp.asarray(a)
 
 
 class FaultInjector:
@@ -279,8 +325,10 @@ class FaultInjector:
                    for k in ("delay", "slow_h2d", "crash", "drop_announce",
                              "replica_crash", "slow_decode",
                              "slow_prefill", "drop_health",
-                             "long_prompt_burst")}
+                             "long_prompt_burst", "nan", "bitflip")}
         self._bursts_fired: set = set()  # clause indices already fired
+        self._nans_fired: set = set()    # nan_at clause indices fired
+        self._flips_fired: set = set()   # bitflip clause indices fired
         if self.clauses:
             _log.warning("fault injection ARMED for rank %d gen %d: %s",
                          self.rank, self.generation,
@@ -298,11 +346,28 @@ class FaultInjector:
             from ..observability import flight_recorder as _flight
             _flight.recorder().note("fault", (kind, tick))
 
-    def on_enqueue(self) -> None:
+    def on_enqueue(self, tensor=None):
         """One collective enqueued: advance the tick and apply any
-        active delay/slow_h2d/crash faults."""
+        active delay/slow_h2d/crash/nan_at faults. When a ``nan_at``
+        clause fires and the engine handed us its payload ``tensor``,
+        returns a poisoned replacement (one element set to NaN) the
+        engine assigns back; returns None otherwise — callers that
+        pass no tensor keep the legacy no-return contract."""
         t = self._tick
         self._tick = t + 1
+        poisoned = None
+        for i, c in enumerate(self.clauses):
+            if (c.nan_at is not None and t == c.nan_at
+                    and i not in self._nans_fired
+                    and tensor is not None):
+                self._nans_fired.add(i)
+                self._m["nan"].inc()
+                _log.error("fault injection: nan_at=%d reached on "
+                           "rank %d — poisoning one gradient element",
+                           t, self.rank)
+                from ..observability import flight_recorder as _flight
+                _flight.recorder().note("fault", ("nan", t))
+                poisoned = _poison_one_nan(tensor)
         for c in self.clauses:
             if c.crash_at is not None and t == c.crash_at:
                 self._m["crash"].inc()
@@ -327,6 +392,25 @@ class FaultInjector:
                 self._m["slow_h2d"].inc()
                 self._note_fault("slow_h2d", t)
                 time.sleep(c.slow_h2d_s)
+        return poisoned
+
+    def take_bitflips(self, step: int) -> List[str]:
+        """Target-leaf patterns of ``bitflip_param`` clauses firing at
+        this training step — each fires ONCE; the numerics plane's
+        training hook (observability/numerics.py ``maybe_bitflip``)
+        applies the flip, since only it holds the param tree."""
+        out: List[str] = []
+        for i, c in enumerate(self.clauses):
+            if c.bitflip_param is None or i in self._flips_fired:
+                continue
+            if step != c.bitflip_param:
+                continue
+            self._flips_fired.add(i)
+            self._m["bitflip"].inc()
+            from ..observability import flight_recorder as _flight
+            _flight.recorder().note("fault", ("bitflip", step))
+            out.append(c.leaf)
+        return out
 
     def drop_announce_active(self) -> bool:
         """True while a drop_announce clause's window covers the current

@@ -137,6 +137,10 @@ class AdaptationPolicy:
         # lateness at the threshold for alert_hold_s — it can START
         # the sustain clock but never bypass the hysteresis.
         self._alert_until: Dict[Tuple[str, int], float] = {}
+        # quantization_drift quality backoff (docs/numerics.md#drift):
+        # while this clock runs, the ladder refuses to re-enter a wire
+        # tier — the lossy transport stays off until drift clears.
+        self._wire_block_until: float = 0.0
 
     # ----------------------------------------------------------- derived
 
@@ -169,7 +173,17 @@ class AdaptationPolicy:
         hysteresis-guarded ladder as measured negotiate lateness — and
         a one-off alert that is not renewed decays without ever
         escalating. Unknown kinds are accepted (forward compat) but
-        only regression/leak kinds are ever forwarded here."""
+        only regression/leak kinds are ever forwarded here.
+
+        ``quantization_drift`` is special-cased as the QUALITY
+        direction (docs/numerics.md#drift): the quantized wire is the
+        suspected *cause*, so instead of adding escalation pressure the
+        policy unwinds every active wire tier back to the raw fp32
+        transport and blocks wire re-escalation for ``alert_hold_s``."""
+        if str(kind) == "quantization_drift":
+            self._m_alert_inputs.labels(kind=str(kind)).inc()
+            self._quality_backoff(int(rank), now)
+            return
         self._alert_until[(str(kind), int(rank))] = \
             now + self.config.alert_hold_s
         self._m_alert_inputs.labels(kind=str(kind)).inc()
@@ -178,6 +192,37 @@ class AdaptationPolicy:
             kind, rank)
         _flight.recorder().note("adapt", (
             "alert_input", self.tier, str(kind), int(rank), 0.0))
+
+    def _quality_backoff(self, rank: int, now: float) -> None:
+        """Back the quantized wire off to raw fp32: drop ladder tiers
+        until no wire entry is active (structural tiers such as
+        ``shrink`` below the wire rungs survive), and refuse to
+        re-enter a wire tier until the block window expires. Repeated
+        drift alerts renew the window, so a genuinely lossy wire stays
+        off as long as the detector keeps firing."""
+        self._wire_block_until = now + self.config.alert_hold_s
+        new_tier = self.tier
+        while new_tier > 0 and any(
+                t in _WIRE_TIERS for t in self.config.tiers[:new_tier]):
+            new_tier -= 1
+        if new_tier == self.tier:
+            _log.warning(
+                "adaptation_event action=quality_block rank=%d "
+                "hold_s=%.1f", rank, self.config.alert_hold_s)
+            return
+        dropped = self.config.tiers[new_tier:self.tier]
+        self.tier = new_tier
+        self._m_tier.set(self.tier)
+        for name in dropped:
+            self._m_transitions.labels(
+                action="quality_backoff", tier=name).inc()
+        self._set_wire_gauge()
+        _log.warning(
+            "adaptation_event action=quality_backoff tier=%d dropped=%s "
+            "rank=%d hold_s=%.1f", self.tier, ",".join(dropped), rank,
+            self.config.alert_hold_s)
+        _flight.recorder().note("adapt", (
+            "quality_backoff", self.tier, ",".join(dropped), rank, 0.0))
 
     def _alert_pressure(self, now: float) -> Dict[int, float]:
         """Per-rank synthetic lateness from alerts still inside their
@@ -243,6 +288,10 @@ class AdaptationPolicy:
         if self.tier >= len(self.config.tiers):
             return None
         name = self.config.tiers[self.tier]
+        if name in _WIRE_TIERS and now < self._wire_block_until:
+            # Quality backoff in force: the ladder is capped below the
+            # wire rungs until the drift hold window expires.
+            return None
         if name == "evict":
             if not self.allow_evict or rank < 0:
                 return None   # ladder capped below eviction

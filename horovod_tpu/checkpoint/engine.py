@@ -88,6 +88,29 @@ def _metrics():
     }
 
 
+def verify_fingerprint(key: str, arr, man: dict, where: str = "") -> None:
+    """Recompute one leaf's value fingerprint and check it against the
+    manifest (docs/numerics.md#checkpoint). No-op for manifests without
+    fingerprints (pre-fingerprint checkpoints stay restorable) or for
+    keys the manifest does not digest. Raises
+    :exc:`~horovod_tpu.checkpoint.reader.CorruptShardError` on
+    mismatch — the shard bytes matched their crc32, but the VALUES are
+    not what was saved (corruption upstream of serialization)."""
+    fps = man.get("fingerprints") or {}
+    want = fps.get(key)
+    if want is None:
+        return
+    from ..observability import numerics as _numerics
+    got = _numerics.fingerprint_leaf(key, arr)
+    if (got[0] != float(want[0]) or got[1] != int(want[1])
+            or got[2] != int(want[2])):
+        raise CorruptShardError(
+            os.path.join(where, key) if where else key,
+            f"value fingerprint mismatch: got [norm={got[0]!r}, "
+            f"crc={got[1]}, n={got[2]}], manifest says [norm="
+            f"{float(want[0])!r}, crc={int(want[1])}, n={int(want[2])}]")
+
+
 class SaveHandle:
     """Ticket for one in-flight save; resolved by engine.wait()."""
 
@@ -157,6 +180,16 @@ class CheckpointEngine:
                     continue
                 mine.append((_manifest.shard_filename(i, j),
                              shard_data(values[key], shard)))
+        # Per-leaf VALUE fingerprints for the manifest
+        # (docs/numerics.md#checkpoint) — rank 0 only (it writes the
+        # manifest and, per the engine contract, holds the full host
+        # tree). Computed from the snapshot the shards came from, so a
+        # later in-memory corruption cannot retroactively "verify".
+        fps = None
+        if self.process_index == 0:
+            from ..observability import numerics as _numerics
+            fps = {key: _numerics.fingerprint_leaf(key, values[key])
+                   for key in layouts}
         step = int(step)
         sdir = _manifest.step_dir(self.directory, step)
         os.makedirs(sdir, exist_ok=True)
@@ -167,7 +200,7 @@ class CheckpointEngine:
 
         def _job():
             self._write_and_commit(handle, layouts, mine, pcount, extra,
-                                   t0)
+                                   t0, fps)
 
         self._writer.submit(_job)
         blocked = time.perf_counter() - t0
@@ -179,7 +212,8 @@ class CheckpointEngine:
     def _write_and_commit(self, handle: SaveHandle,
                           layouts: Dict[str, LeafLayout],
                           mine: List[Tuple[str, np.ndarray]],
-                          pcount: int, extra: dict, t0: float) -> None:
+                          pcount: int, extra: dict, t0: float,
+                          fps: Optional[Dict[str, list]] = None) -> None:
         written = 0
         for filename, arr in mine:
             crc, nbytes = write_shard(handle.directory, filename, arr)
@@ -190,7 +224,7 @@ class CheckpointEngine:
         self._barrier(f"ckpt.shards.{handle.step}")
         if self.process_index == 0:
             man_bytes = self._commit_rank0(handle, layouts, pcount,
-                                           extra)
+                                           extra, fps)
             written += man_bytes
         self._barrier(f"ckpt.commit.{handle.step}")
         handle.committed = True
@@ -203,7 +237,8 @@ class CheckpointEngine:
 
     def _commit_rank0(self, handle: SaveHandle,
                       layouts: Dict[str, LeafLayout], pcount: int,
-                      extra: dict) -> int:
+                      extra: dict,
+                      fps: Optional[Dict[str, list]] = None) -> int:
         shard_meta: Dict[str, List[dict]] = {}
         for i, (key, ll) in enumerate(layouts.items()):
             metas = []
@@ -215,7 +250,7 @@ class CheckpointEngine:
             shard_meta[key] = metas
         man = _manifest.manifest_dict(
             handle.step, pcount, layouts, shard_meta,
-            mesh_axes=self.mesh_axes, extra=extra)
+            mesh_axes=self.mesh_axes, extra=extra, fingerprints=fps)
         data = _manifest.dumps(man)
         atomic_write_bytes(
             os.path.join(handle.directory, _manifest.MANIFEST), data)
@@ -260,8 +295,9 @@ class CheckpointEngine:
         for cand, last in self._candidates(step, strict):
             try:
                 man = _manifest.read_manifest(self.directory, cand)
-                tree = read_tree(_manifest.step_dir(self.directory, cand),
-                                 man, template=template)
+                sdir = _manifest.step_dir(self.directory, cand)
+                tree = read_tree(sdir, man, template=template)
+                self._verify_tree_fingerprints(tree, man, sdir)
                 self._m["restore"].observe(time.perf_counter() - t0)
                 return tree
             except CorruptShardError as e:
@@ -300,14 +336,38 @@ class CheckpointEngine:
                     wanted = ll.shards if ll.replicated else \
                         ll.shards_of(proc)
                     blocks = []
+                    saved_shape = tuple(
+                        int(d) for d in entries[key]["shape"])
                     for shard in wanted:
-                        blocks.append((shard, read_block(
-                            sdir, entries[key], shard.index or None)))
+                        block = read_block(sdir, entries[key],
+                                           shard.index or None)
+                        # Fingerprint verification needs the WHOLE leaf
+                        # value; a resharded read only materializes it
+                        # when this block covers the full saved shape
+                        # (replicated leaves, single-shard leaves).
+                        if (not shard.index
+                                or tuple((a, b) for a, b in shard.index)
+                                == tuple((0, d) for d in saved_shape)):
+                            verify_fingerprint(key, block, man, sdir)
+                        blocks.append((shard, block))
                     out[key] = blocks
                 self._m["restore"].observe(time.perf_counter() - t0)
                 return out
             except CorruptShardError as e:
                 self._corrupt(e, cand, strict or last)
+
+    def _verify_tree_fingerprints(self, tree: Any, man: dict,
+                                  sdir: str) -> None:
+        """Check every restored leaf's value digest against the
+        manifest (docs/numerics.md#checkpoint); raises
+        CorruptShardError so the restore loop falls back exactly like
+        a crc failure."""
+        if not man.get("fingerprints"):
+            return
+        import jax
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            verify_fingerprint(jax.tree_util.keystr(path), leaf, man,
+                               sdir)
 
     def _resolve(self, step: Optional[int]) -> int:
         if step is not None:

@@ -25,7 +25,11 @@ Schema (JSON, no pickle anywhere in the metadata path)::
                     ...]},
         ...
       ],
-      "extra": {...}                 # JSON-able caller payload
+      "extra": {...},                # JSON-able caller payload
+      "fingerprints": {              # per-leaf VALUE digests
+        "['params']['w']": [12.5, 317488301, 4096],   # [norm, crc, n]
+        ...                          # (docs/numerics.md#checkpoint)
+      }
     }
 
 ``key`` uses the tree-path string so restore can address leaves of any
@@ -92,9 +96,18 @@ def manifest_dict(step: int, process_count: int,
                   layouts: Dict[str, LeafLayout],
                   shard_meta: Dict[str, List[dict]],
                   mesh_axes: Optional[Dict[str, int]] = None,
-                  extra: Optional[dict] = None) -> dict:
+                  extra: Optional[dict] = None,
+                  fingerprints: Optional[Dict[str, list]] = None) -> dict:
     """Assemble the manifest from layouts + per-shard file metadata
-    (``shard_meta[key][shard_idx]`` = {"file", "crc32", "nbytes"})."""
+    (``shard_meta[key][shard_idx]`` = {"file", "crc32", "nbytes"}).
+
+    ``fingerprints`` maps leaf key -> ``[norm, crc, n]`` value digests
+    (observability/numerics.fingerprint_leaf, docs/numerics.md#checkpoint):
+    where the per-shard crc32 certifies the BYTES of each file, the
+    fingerprint certifies the assembled leaf VALUES — restore recomputes
+    and raises CorruptShardError on mismatch, catching corruption that
+    happened before serialization (e.g. an in-memory bitflip the shard
+    crc faithfully preserved)."""
     leaves = []
     for key, ll in layouts.items():
         shards = []
@@ -110,10 +123,15 @@ def manifest_dict(step: int, process_count: int,
         leaves.append({"key": key, "shape": list(ll.shape),
                        "dtype": ll.dtype, "replicated": ll.replicated,
                        "shards": shards})
-    return {"format": FORMAT, "step": int(step),
-            "process_count": int(process_count),
-            "mesh_axes": dict(mesh_axes or {}),
-            "leaves": leaves, "extra": extra if extra is not None else {}}
+    man = {"format": FORMAT, "step": int(step),
+           "process_count": int(process_count),
+           "mesh_axes": dict(mesh_axes or {}),
+           "leaves": leaves, "extra": extra if extra is not None else {}}
+    if fingerprints is not None:
+        man["fingerprints"] = {
+            k: [float(v[0]), int(v[1]), int(v[2])]
+            for k, v in fingerprints.items()}
+    return man
 
 
 def parse_index(entry: List[List[int]]) -> Index:

@@ -48,6 +48,7 @@ import time
 
 from . import quantization as _quant
 from . import topology as _topo
+from .observability import numerics as _numerics
 from .observability import registry as _obs
 
 
@@ -966,6 +967,14 @@ class CollectiveExecutor:
                 flat = arrs[i].ravel()
                 buf[off:off + flat.size] = flat.astype(buf_dt)
                 off += _quant.padded_size(int(flat.size), align)
+
+            # Numerics sentinel (docs/numerics.md): the pack above just
+            # touched every byte, so one isfinite pass over the same
+            # contiguous LOCAL buffer is the cheapest possible place to
+            # catch a NaN *before* the reduction spreads it to every
+            # rank. Single flag check when the plane is off.
+            if _numerics.enabled():
+                _numerics.scan_payload(buf)
 
             if host_op is not None:
                 # The reduced buffer is HOST memory (the shm plane's

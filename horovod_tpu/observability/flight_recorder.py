@@ -69,6 +69,13 @@ Event kinds (payload fields):
                     health-detector alert fired (docs/health.md; the
                     dump shows what the anomaly plane saw before a
                     death)
+  ``numerics``      event, step, who, value, detail — numerics-plane
+                    evidence (docs/numerics.md): ``nonfinite`` (who =
+                    producing rank, value = element count, detail =
+                    source) and ``divergence`` (who = divergent rank,
+                    detail = leaf) — the postmortem names the first
+                    nonfinite step/rank and the divergence chain from
+                    these
   ================  ========================================================
 """
 
@@ -116,6 +123,7 @@ _FIELDS = {
     "data": ("event", "epoch", "offset", "detail"),
     "alert": ("alert", "severity", "series", "who", "value", "baseline"),
     "autotune": ("event", "knob", "value", "score", "baseline", "detail"),
+    "numerics": ("event", "step", "who", "value", "detail"),
 }
 
 # Recording lever — module-global single check like registry._enabled.

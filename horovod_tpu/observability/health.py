@@ -68,10 +68,19 @@ ALERT_KINDS = (
     "hbm_leak",               # device memory in monotone growth
     "queue_depth_runaway",    # serving queue depth in monotone growth
     "restart_spike",          # replica restarts / worker failures spiking
+    "nonfinite_rate",         # NaN/Inf elements seen in local payloads
+    "grad_norm_explosion",    # global gradient norm shifted up
+    "loss_spike",             # loss value shifted up
+    "rank_divergence",        # param fingerprints disagree across ranks
+    "quantization_drift",     # EF residual norm in monotone growth
 )
 
 # Kinds the adaptation policy consumes as ladder inputs.
-POLICY_ALERT_KINDS = ("step_time_regression", "hbm_leak")
+# quantization_drift is the QUALITY direction: instead of clamping
+# lateness, the policy backs the quantized wire off to fp32
+# (adaptation/policy.py, docs/numerics.md#drift).
+POLICY_ALERT_KINDS = ("step_time_regression", "hbm_leak",
+                      "quantization_drift")
 
 
 @dataclasses.dataclass
@@ -301,9 +310,12 @@ class DetectorSpec:
     families: Tuple[str, ...]     # exact family names
     suffix: str                   # "" for gauges/counters, "mean"/...
     factory: Callable[[], object]
+    labels: str = ""              # required label fragment ("" = any)
 
     def matches(self, key: str) -> bool:
-        fam, _, suffix = split_series_key(key)
+        fam, label_block, suffix = split_series_key(key)
+        if self.labels and self.labels not in label_block:
+            return False
         return fam in self.families and suffix == self.suffix
 
 
@@ -338,6 +350,36 @@ def default_specs() -> List[DetectorSpec]:
             ("hvdtpu_fleet_replica_restarts_total",
              "hvdtpu_elastic_worker_failures_total"), "",
             lambda: RateDetector(threshold=3.0, window_s=600.0)),
+        # ---- numerics plane (docs/numerics.md#detectors) ----
+        # The windowed twin of the same-step sentinel: even if the
+        # immediate alert was refire-suppressed, a sustained nonfinite
+        # stream shows up in the counter's rate series.
+        DetectorSpec(
+            "nonfinite_rate", "critical",
+            ("hvdtpu_numerics_nonfinite_total",), "",
+            lambda: RateDetector(threshold=1.0, window_s=120.0)),
+        DetectorSpec(
+            "grad_norm_explosion", "critical",
+            ("hvdtpu_numerics_grad_norm",), "",
+            lambda: EwmaDetector("up", min_rel=1.0, z_threshold=6.0)),
+        DetectorSpec(
+            "loss_spike", "warning",
+            ("hvdtpu_numerics_loss",), "",
+            lambda: EwmaDetector("up", min_rel=0.5, z_threshold=6.0)),
+        DetectorSpec(
+            "quantization_drift", "warning",
+            ("hvdtpu_numerics_ef_residual_norm",), "",
+            lambda: TrendDetector(min_rel=0.2)),
+        # Windowed backstop for the same-step divergence alert rank 0
+        # fires from record_fingerprint: any mismatch event in the
+        # counter's rate series pages, even if the immediate alert was
+        # refire-suppressed. The label filter keeps the routine
+        # computed/compared event rates from matching.
+        DetectorSpec(
+            "rank_divergence", "critical",
+            ("hvdtpu_numerics_fingerprints_total",), "",
+            lambda: RateDetector(threshold=1.0, window_s=600.0),
+            labels='event="mismatch"'),
     ]
 
 
@@ -448,6 +490,29 @@ class HealthMonitor:
                                         t_unix if t_unix is not None
                                         else time.time()))
         return fired
+
+    def fire(self, kind: str, severity: str, series: str, value: float,
+             *, baseline: float = 0.0,
+             evidence: Optional[dict] = None,
+             t: Optional[float] = None,
+             t_unix: Optional[float] = None) -> Optional[Alert]:
+        """Fire a typed alert directly, bypassing the detector plane —
+        the same fan-out (metric/recorder/log/policy/webhook) with the
+        same per-(kind, series) refire suppression. The numerics
+        plane's same-step sentinels (nonfinite payloads, fingerprint
+        divergence) use this: their evidence is exact, not statistical,
+        so no windowed detector should gate them. Returns None when
+        refire-suppressed."""
+        t = time.monotonic() if t is None else t
+        last = self._last_fire.get((kind, series))
+        if last is not None and t - last < self.refire_s:
+            return None
+        self._last_fire[(kind, series)] = t
+        ev = dict(evidence or {})
+        ev.setdefault("baseline", baseline)
+        spec = DetectorSpec(kind, severity, (), "", lambda: None)
+        return self._fire(spec, series, float(value), ev,
+                          t_unix if t_unix is not None else time.time())
 
     def _fire(self, spec: DetectorSpec, key: str, value: float,
               evidence: dict, t_unix: float) -> Alert:

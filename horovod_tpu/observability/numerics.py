@@ -1,0 +1,575 @@
+"""Numerics observability plane — the plane that watches the *numbers*
+(docs/numerics.md).
+
+Every plane so far watches *time* (step latency, TTFT, HBM, queue
+depth); nothing watches whether the values flowing through the job are
+still finite, still sane, still identical across ranks. This module is
+that plane:
+
+  - **Step telemetry** — global gradient norm, per-source nonfinite
+    element counts, loss value and update/param-norm ratio, computed
+    *in-graph* by ``build_train_step`` as one small piggybacked
+    reduction and read back with a one-step deferral (the host never
+    blocks on the current step's device values), exported as the
+    ``hvdtpu_numerics_*`` families the history store samples.
+  - **Nonfinite sentinels** — the engine's fused-pack path and the
+    torch shim's bucket fill count nonfinite elements on the LOCAL,
+    pre-reduction payload (a post-allreduce NaN has already spread to
+    every rank — only the local count can name the producer), and
+    :func:`note_nonfinite` fires a same-step ``nonfinite_rate`` alert
+    through the health plane's own fan-out (metric + flight recorder +
+    log + webhook) the moment a count lands.
+  - **Cross-rank divergence fingerprints** — :func:`fingerprint_tree`
+    reduces a param tree to per-leaf ``(norm, crc-of-seeded-subsample)``
+    digests; ranks ship them over the existing coordinator channel
+    (``note_fingerprint``) and rank 0 majority-compares each step's set
+    (:func:`record_fingerprint`), firing a typed ``rank_divergence``
+    alert naming the first divergent leaf and rank.
+  - **Quantization drift** — per-group error-feedback residual norms
+    land in ``hvdtpu_numerics_ef_residual_norm`` via
+    :func:`note_ef_residual`; a trend detector
+    (observability/health.py) watches the series and a sustained drift
+    alert lets the adaptation policy back a quantized wire off to fp32
+    (docs/adaptation.md).
+
+Design constraints (same bar as the registry / flight recorder):
+
+  - OFF BY DEFAULT, SINGLE-FLAG NO-OP: everything here is gated on the
+    module-global ``_enabled`` (armed by ``HOROVOD_TPU_NUMERICS=1`` at
+    ``hvd.init()`` or ``set_enabled(True)``); a disabled plane costs
+    one flag check at each hook site.
+  - NO EXTRA HOST SYNC: in-graph stats ride the step's own jitted
+    program as extra replicated outputs; the host materializes step
+    N's stats while step N+1 runs (:class:`StepStats`).
+  - ATTRIBUTABLE: nonfinite counts are measured pre-reduction, and the
+    in-graph counter returns a per-rank vector (each shard deposits
+    its local count at its own linear mesh index) so the alert can say
+    *which rank* produced the first NaN.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import env as _env
+from ..utils.logging import get_logger
+from . import registry as _reg
+
+_log = get_logger("observability.numerics")
+
+# How many elements the fingerprint subsample covers per leaf. Index 0
+# is always included — the deterministic corruption clause
+# (``bitflip_param``, adaptation/faults.py) flips element 0, so the crc
+# catches it with certainty; the remaining indices are drawn from a
+# per-leaf seeded generator so two leaves never share a sample pattern.
+FINGERPRINT_SAMPLE = 16
+
+# Recording lever — module-global single check like registry._enabled,
+# but OFF by default: numerics telemetry is opt-in
+# (HOROVOD_TPU_NUMERICS=1), unlike the always-on metrics registry.
+_enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(value: bool) -> None:
+    global _enabled
+    _enabled = bool(value)
+
+
+def maybe_enable_from_env() -> bool:
+    """Arm the plane from ``HOROVOD_TPU_NUMERICS`` (called by
+    ``hvd.init()``; idempotent)."""
+    if _env.numerics_enabled():
+        set_enabled(True)
+    return _enabled
+
+
+# --------------------------------------------------------------------------
+# Metric families (docs/metrics.md) — resolved lazily, cached.
+# --------------------------------------------------------------------------
+
+_fams: Optional[dict] = None
+_fams_lock = threading.Lock()
+
+
+def _families() -> dict:
+    global _fams
+    if _fams is None:
+        with _fams_lock:
+            if _fams is None:
+                r = _reg.registry()
+                _fams = {
+                    "nonfinite": r.counter(
+                        "hvdtpu_numerics_nonfinite_total",
+                        "Nonfinite (NaN/Inf) elements observed in local "
+                        "pre-reduction payloads, by source "
+                        "(docs/numerics.md)"),
+                    "grad_norm": r.gauge(
+                        "hvdtpu_numerics_grad_norm",
+                        "Global (post-reduction) gradient L2 norm of the "
+                        "last completed training step"),
+                    "loss": r.gauge(
+                        "hvdtpu_numerics_loss",
+                        "Loss value of the last completed training step"),
+                    "update_ratio": r.gauge(
+                        "hvdtpu_numerics_update_ratio",
+                        "Update-norm / param-norm ratio of the last "
+                        "completed training step (learning-rate "
+                        "sanity signal)"),
+                    "ef_residual": r.gauge(
+                        "hvdtpu_numerics_ef_residual_norm",
+                        "Error-feedback residual L2 norm per quantized "
+                        "group — the live quantization-drift signal, "
+                        "by group"),
+                    "fingerprints": r.counter(
+                        "hvdtpu_numerics_fingerprints_total",
+                        "Cross-rank param fingerprint events, by event "
+                        "(computed/compared/mismatch)"),
+                }
+    return _fams
+
+
+# --------------------------------------------------------------------------
+# Immediate alerts — the health plane's fan-out, without a detector
+# --------------------------------------------------------------------------
+
+_monitor = None
+_monitor_lock = threading.Lock()
+
+
+def _alert_monitor():
+    """A spec-less HealthMonitor used purely for its alert fan-out
+    (metric + recorder + log + policy + webhook) — one implementation
+    of "fire a typed alert" shared with the windowed detector plane.
+    Prefers the sampler's live monitor (so e2e surfaces like
+    ``monitor.alerts`` see immediate alerts too) and falls back to a
+    private one when no sampler is running."""
+    global _monitor
+    from . import history as _history
+    s = _history.sampler()
+    if s is not None and s.monitor is not None:
+        return s.monitor
+    if _monitor is None:
+        with _monitor_lock:
+            if _monitor is None:
+                from . import health as _health
+                _monitor = _health.HealthMonitor(
+                    specs=[], rank=_process_index(),
+                    alert_sink=_health._coordinator_alert_sink)
+    return _monitor
+
+
+def fire_alert(kind: str, severity: str, series: str, value: float, *,
+               baseline: float = 0.0, evidence: Optional[dict] = None):
+    """Fire a typed health alert NOW (same-step path — no detector
+    window). Refire-suppressed per (kind, series) like the detector
+    plane, so a NaN that persists for 500 steps pages once per window,
+    not 500 times. Returns the Alert or None (suppressed)."""
+    try:
+        return _alert_monitor().fire(kind, severity, series, value,
+                                     baseline=baseline,
+                                     evidence=evidence)
+    except Exception as e:  # telemetry must never kill the step
+        _log.warning("numerics alert failed: %s", e)
+        return None
+
+
+# --------------------------------------------------------------------------
+# Nonfinite sentinels
+# --------------------------------------------------------------------------
+
+def count_nonfinite(buf) -> int:
+    """Nonfinite element count of a host buffer (numpy view; no copy).
+    Integer dtypes are finite by construction and return 0.
+
+    Clean-path cost matters: this runs on the engine cycle thread while
+    the training thread spins in ``wait()``, so every extra Python-level
+    numpy call ping-pongs the GIL (measured ~10x the isolated cost).
+    The fast path is ONE dot product — BLAS releases the GIL, and a
+    finite sum of squares proves every element finite (squares are
+    non-negative, so infinities cannot cancel; any NaN/Inf element
+    forces a NaN/Inf dot). A finite-but-overflowing buffer merely falls
+    through to the exact count, which answers 0."""
+    a = np.asarray(buf)
+    if a.dtype.kind != "f":
+        return 0
+    if a.ndim == 1 and math.isfinite(float(np.dot(a, a))):
+        return 0
+    return int(a.size - np.count_nonzero(np.isfinite(a)))
+
+
+def note_nonfinite(count: int, *, source: str, step: int = -1,
+                   rank: Optional[int] = None, detail: str = "") -> None:
+    """Record nonfinite elements observed in a local payload: counter +
+    flight-recorder ``numerics`` event + same-step ``nonfinite_rate``
+    alert. No-ops on count<=0 — call sites pass raw counts and this
+    stays the single branch on the clean path."""
+    if count <= 0 or not _enabled:
+        return
+    who = rank if rank is not None else _process_index()
+    _families()["nonfinite"].labels(source=source).inc(count)
+    from . import flight_recorder as _flight
+    _flight.recorder().note("numerics", (
+        "nonfinite", step, who, count, (detail or source)[:120]))
+    fire_alert(
+        "nonfinite_rate", "critical",
+        f'hvdtpu_numerics_nonfinite_total{{source="{source}"}}',
+        float(count),
+        evidence={"step": step, "rank": who, "source": source,
+                  "detail": detail})
+
+
+# One sentinel tick per scanned fusion buffer — for the common
+# one-fused-allreduce-per-step loop this counts training steps, the
+# same convention the fault injector's tick stream uses.
+_scan_tick = 0
+
+
+def scan_payload(buf, *, source: str = "collective") -> int:
+    """Nonfinite sentinel for the engine's fused-pack path: count
+    nonfinite elements in an already-packed LOCAL buffer (one
+    ``np.isfinite`` pass over contiguous host memory, piggybacked on
+    the pack the engine just paid for) and raise the same-step alert
+    if any. Returns the count. Gated on :func:`enabled` — the caller
+    only pays one flag check when the plane is off."""
+    global _scan_tick
+    if not _enabled:
+        return 0
+    t = _scan_tick
+    _scan_tick = t + 1
+    c = count_nonfinite(buf)
+    if c:
+        note_nonfinite(c, source=source, step=t)
+    return c
+
+
+def note_loss(step: int, loss: float) -> None:
+    """Record a completed step's loss; a nonfinite loss is itself a
+    sentinel (the classic overnight-NaN page)."""
+    if not _enabled:
+        return
+    if math.isfinite(loss):
+        _families()["loss"].set(loss)
+    else:
+        note_nonfinite(1, source="loss", step=step, detail="loss")
+
+
+def note_ef_residual(group: str, norm: float) -> None:
+    """Per-group error-feedback residual norm — the quantization-drift
+    series the trend detector watches (docs/numerics.md#drift)."""
+    if not _enabled or not math.isfinite(norm):
+        return
+    _families()["ef_residual"].labels(group=str(group)[:60]).set(norm)
+
+
+# --------------------------------------------------------------------------
+# Deferred in-graph step stats (build_train_step aux channel)
+# --------------------------------------------------------------------------
+
+class StepStats:
+    """Host-side sink for the train step's in-graph numerics aux.
+
+    ``note(step, loss, aux)`` stores the CURRENT step's device values
+    and materializes the PREVIOUS step's (whose program has long since
+    finished) — the host never blocks on in-flight device work, so the
+    plane adds no synchronization to the step loop. ``flush()`` drains
+    the last pending step (end of training / final gasp)."""
+
+    def __init__(self):
+        self._pending: Optional[Tuple[int, object, dict]] = None
+        self._lock = threading.Lock()
+
+    def note(self, step: int, loss, aux: dict) -> None:
+        if not _enabled:
+            return
+        with self._lock:
+            prev, self._pending = self._pending, (step, loss, aux)
+        if prev is not None:
+            self._materialize(*prev)
+
+    def flush(self) -> None:
+        with self._lock:
+            prev, self._pending = self._pending, None
+        if prev is not None:
+            self._materialize(*prev)
+
+    def _materialize(self, step: int, loss, aux: dict) -> None:
+        try:
+            fams = _families()
+            loss_v = float(np.asarray(loss))
+            note_loss(step, loss_v)
+            gn = aux.get("grad_norm")
+            if gn is not None:
+                gn = float(np.asarray(gn))
+                if math.isfinite(gn):
+                    fams["grad_norm"].set(gn)
+            ur = aux.get("update_ratio")
+            if ur is not None:
+                ur = float(np.asarray(ur))
+                if math.isfinite(ur):
+                    fams["update_ratio"].set(ur)
+            nf = aux.get("nonfinite_by_rank")
+            if nf is not None:
+                nf = np.asarray(nf)
+                for r in np.nonzero(nf)[0]:
+                    note_nonfinite(int(nf[r]), source="grad", step=step,
+                                   rank=int(r), detail="train_step")
+        except Exception as e:  # pragma: no cover - defensive
+            _log.warning("numerics step stats failed: %s", e)
+
+
+_step_stats = StepStats()
+
+
+def step_stats() -> StepStats:
+    """The process-global step-stats sink ``build_train_step`` feeds."""
+    return _step_stats
+
+
+# --------------------------------------------------------------------------
+# Param-tree fingerprints (divergence + checkpoint integrity)
+# --------------------------------------------------------------------------
+
+def _leaf_paths(tree) -> List[Tuple[str, object]]:
+    """Stable ``(path, leaf)`` pairs — jax keypath rendering, sorted by
+    path so every rank enumerates identically."""
+    import jax
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
+    out.sort(key=lambda kv: kv[0])
+    return out
+
+
+def _sample_indices(name: str, n: int, k: int, seed: int) -> np.ndarray:
+    rng = np.random.default_rng(
+        (zlib.crc32(name.encode()) ^ (seed & 0xFFFFFFFF)) & 0xFFFFFFFF)
+    if n <= k:
+        return np.arange(n)
+    idx = rng.integers(1, n, size=k - 1)
+    return np.concatenate(([0], idx))  # element 0 always sampled
+
+
+def fingerprint_leaf(name: str, arr, *, k: int = FINGERPRINT_SAMPLE,
+                     seed: int = 0) -> List:
+    """``[norm, crc, n]`` digest of one leaf: float64 L2 norm (host
+    accumulation — deterministic given identical values) + crc32 of the
+    raw bytes of a seeded deterministic ``k``-element subsample. Two
+    replicas holding bitwise-identical leaves produce identical
+    digests; a single flipped mantissa bit changes the norm and — for
+    element 0 or any sampled element — the crc."""
+    a = np.asarray(arr).reshape(-1)
+    if a.size == 0:
+        return [0.0, 0, 0]
+    norm = float(np.sqrt(np.sum(np.square(a.astype(np.float64)))))
+    idx = _sample_indices(name, a.size, k, seed)
+    crc = zlib.crc32(np.ascontiguousarray(a[idx]).tobytes())
+    return [norm, int(crc), int(a.size)]
+
+
+def fingerprint_tree(tree, *, seed: int = 0) -> Dict[str, List]:
+    """Per-leaf digests of a whole param tree, keyed by jax keypath.
+    Pulls each leaf to host — cheap at fingerprint cadence (default
+    every ``HOROVOD_TPU_NUMERICS_FP_INTERVAL`` steps), not a hot-path
+    call."""
+    out = {}
+    for name, leaf in _leaf_paths(tree):
+        out[name] = fingerprint_leaf(name, leaf, seed=seed)
+    if _enabled:
+        _families()["fingerprints"].labels(event="computed").inc()
+    return out
+
+
+def compare_fingerprints(by_rank: Dict[int, Dict[str, List]]
+                         ) -> List[Tuple[str, int]]:
+    """Majority-compare one step's per-rank digests. Returns
+    ``(leaf, rank)`` mismatches — every rank whose digest for a leaf
+    disagrees with the majority value, first divergent leaf first
+    (path-sorted, matching :func:`_leaf_paths` order)."""
+    if len(by_rank) < 2:
+        return []
+    leaves = sorted({leaf for d in by_rank.values() for leaf in d})
+    out: List[Tuple[str, int]] = []
+    for leaf in leaves:
+        votes: Dict[tuple, List[int]] = {}
+        for rank, digests in by_rank.items():
+            key = tuple(digests.get(leaf, []))
+            votes.setdefault(key, []).append(rank)
+        if len(votes) <= 1:
+            continue
+        majority = max(votes.values(), key=len)
+        for key, ranks in votes.items():
+            if ranks is majority:
+                continue
+            out.extend((leaf, r) for r in sorted(ranks))
+    return out
+
+
+# ---- rank-0 collection point (the coordinator service feeds this) -------
+
+_fp_lock = threading.Lock()
+_fp_pending: Dict[int, Dict[int, Dict[str, List]]] = {}  # step -> rank -> d
+
+
+def record_fingerprint(rank: int, step: int, digests: Dict[str, List],
+                       world: int) -> List[Tuple[str, int]]:
+    """Rank-0 side of the divergence check: stash one rank's digests
+    for a step and, once all ``world`` ranks reported (or a newer step
+    starts arriving), majority-compare and fire one typed
+    ``rank_divergence`` alert per divergent (leaf, rank). Returns the
+    mismatches (tests / the coordinator's log line)."""
+    ready: Optional[Dict[int, Dict[str, List]]] = None
+    ready_step = step
+    with _fp_lock:
+        _fp_pending.setdefault(step, {})[rank] = digests
+        if len(_fp_pending[step]) >= max(world, 2):
+            ready = _fp_pending.pop(step)
+        elif len(_fp_pending) > 4:
+            # The oldest pending step can no longer complete (a rank
+            # died or skipped its probe) — compare what did arrive so
+            # a divergence is still caught, and stop accumulating.
+            ready_step = min(_fp_pending)
+            ready = _fp_pending.pop(ready_step)
+    if ready is None:
+        return []
+    mismatches = compare_fingerprints(ready)
+    fams = _families()
+    fams["fingerprints"].labels(event="compared").inc()
+    if not mismatches:
+        return []
+    fams["fingerprints"].labels(event="mismatch").inc(len(mismatches))
+    from . import flight_recorder as _flight
+    for leaf, bad_rank in mismatches:
+        _flight.recorder().note("numerics", (
+            "divergence", ready_step, bad_rank, 1, leaf[:120]))
+        fire_alert(
+            "rank_divergence", "critical",
+            f"hvdtpu_numerics_fingerprint:{leaf}", 1.0,
+            evidence={"step": ready_step, "rank": bad_rank,
+                      "leaf": leaf,
+                      "ranks_reporting": sorted(ready)})
+    first_leaf, first_rank = mismatches[0]
+    _log.error("rank_divergence at step %d: leaf %s on rank %d "
+               "disagrees with the majority fingerprint "
+               "(%d mismatch(es) total)", ready_step, first_leaf,
+               first_rank, len(mismatches))
+    return mismatches
+
+
+def reset_fingerprints() -> None:
+    """Test hook: forget pending per-step digests."""
+    with _fp_lock:
+        _fp_pending.clear()
+
+
+def maybe_send_fingerprint(tree, step: int) -> Optional[Dict[str, List]]:
+    """Periodic divergence probe for a training loop: at the configured
+    cadence, digest the param tree and ship it to rank 0 over the
+    existing coordinator channel (best-effort, single attempt — exactly
+    like ``note_alert``). Single-process jobs (no coordinator client)
+    feed :func:`record_fingerprint` directly, which is a no-op below
+    two ranks. Returns the digests when a probe ran (tests)."""
+    if not _enabled:
+        return None
+    interval = _env.numerics_fp_interval()
+    if interval <= 0 or step % interval != 0:
+        return None
+    digests = fingerprint_tree(tree)
+    rank, world = _process_rank_world()
+    client = _coordinator_client()
+    if client is not None and rank > 0:
+        client.note_fingerprint(step, digests)
+    else:
+        record_fingerprint(rank, step, digests, world)
+    return digests
+
+
+def _coordinator_client():
+    try:
+        from ..ops import collective as _coll
+        eng = _coll._engine
+        return getattr(eng, "_mp_client", None) if eng else None
+    except Exception:
+        return None
+
+
+def _process_index() -> int:
+    import os
+    try:
+        from .. import topology as _topo
+        return _topo._get().process_index
+    except Exception:
+        return int(os.environ.get("HOROVOD_TPU_PROCESS_ID", "0") or 0)
+
+
+def _process_rank_world() -> Tuple[int, int]:
+    import os
+    try:
+        from .. import topology as _topo
+        t = _topo._get()
+        return t.process_index, t.process_count
+    except Exception:
+        return (int(os.environ.get("HOROVOD_TPU_PROCESS_ID", "0") or 0),
+                int(os.environ.get("HOROVOD_TPU_NPROCS", "1") or 1))
+
+
+# --------------------------------------------------------------------------
+# Deterministic corruption (the bitflip_param fault clause)
+# --------------------------------------------------------------------------
+
+def flip_mantissa_bit(arr, index: int = 0, bit: int = 0):
+    """Return a copy of ``arr`` with one mantissa bit of element
+    ``index`` flipped — the minimal silent-data-corruption primitive
+    the fingerprint compare is proven against. Works on any float
+    dtype via its same-width unsigned view."""
+    a = np.array(np.asarray(arr), copy=True)
+    flat = a.reshape(-1)
+    u = flat.view(f"u{a.dtype.itemsize}")
+    u[index] ^= np.array(1 << bit, dtype=u.dtype)
+    return a
+
+
+def maybe_bitflip(tree, step: int):
+    """Apply any armed ``bitflip_param`` fault clause to the tree at
+    its chosen step (adaptation/faults.py). Zero cost when no injector
+    is armed (one ``is None`` check); returns the (possibly corrupted)
+    tree. The flip targets element 0 of the first leaf whose path
+    contains the clause's ``leaf=`` substring (first leaf overall when
+    unnamed) — element 0 is always in the fingerprint subsample, so
+    the compare at rank 0 names the leaf with certainty."""
+    from ..adaptation import faults as _faults_mod
+    inj = _faults_mod.injector()
+    if inj is None:
+        return tree
+    patterns = inj.take_bitflips(step)
+    if not patterns:
+        return tree
+    import jax
+    for pattern in patterns:
+        flat = _leaf_paths(tree)
+        target = None
+        for name, leaf in flat:
+            if not pattern or pattern in name:
+                target = name
+                break
+        if target is None:
+            _log.warning("bitflip_param: no leaf matches %r", pattern)
+            continue
+
+        def _rewrite(path, leaf, _target=target):
+            name = jax.tree_util.keystr(path)
+            if name == _target:
+                return flip_mantissa_bit(leaf)
+            return leaf
+
+        tree = jax.tree_util.tree_map_with_path(_rewrite, tree)
+        _log.error("fault injection: bitflip_param at step %d flipped "
+                   "one mantissa bit in leaf %s", step, target)
+    return tree

@@ -769,7 +769,13 @@ class CollectiveEngine:
             from ..adaptation import faults as _faults_mod
             self._faults = _faults_mod.injector()
         if self._faults is not None:
-            self._faults.on_enqueue()
+            poisoned = self._faults.on_enqueue(tensor=req.tensor)
+            if poisoned is not None:
+                # nan_at clause fired: the engine carries the poisoned
+                # payload from here on, exactly as if the producer had
+                # computed a NaN — detection happens downstream in the
+                # numerics sentinel, not here (docs/numerics.md).
+                req.tensor = poisoned
         self.wire_bytes_enqueued += req.nbytes
         self._metrics.wire_bytes(req.wire, req.nbytes)
         self._metrics.ops[req.op].inc()

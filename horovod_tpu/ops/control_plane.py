@@ -165,6 +165,21 @@ class AlertNoteRequest:
         self.value = value
 
 
+class FingerprintRequest:
+    """One rank's param-tree fingerprint digests for one training step
+    (docs/numerics.md#fingerprints): per-leaf ``[norm, crc, n]`` from
+    ``observability.numerics.fingerprint_tree``. The rank-0 coordinator
+    collects a step's set and majority-compares it — a mismatch fires
+    the typed ``rank_divergence`` alert naming the first divergent leaf
+    and rank. Best-effort like AlertNoteRequest: a dropped probe means
+    a skipped compare, never a stalled worker."""
+
+    def __init__(self, rank: int, step: int, digests: dict):
+        self.rank = rank
+        self.step = step
+        self.digests = digests
+
+
 class TunerMoveRequest:
     """One global-autotuner move proposal (docs/autotune.md): the tuner
     asks the rank-0 coordinator to stamp a knob change — a wire spec or
@@ -638,6 +653,18 @@ class CoordinatorService(BasicService):
             return AnnounceResponse()
         if isinstance(req, TunerMoveRequest):
             return self._tuner_move(req)
+        if isinstance(req, FingerprintRequest):
+            # Divergence probe (docs/numerics.md#fingerprints): stash
+            # this rank's digests; the numerics plane compares once the
+            # step's set is complete and fires rank_divergence itself.
+            try:
+                from ..observability import numerics as _numerics
+                _numerics.record_fingerprint(
+                    int(req.rank), int(req.step), dict(req.digests),
+                    self._nproc)
+            except Exception as e:  # telemetry never breaks the plane
+                _log.warning("fingerprint compare failed: %s", e)
+            return AnnounceResponse()
         return super()._handle(req, client_address)
 
     def _announce(self, req: AnnounceRequest) -> AnnounceResponse:
@@ -1409,6 +1436,16 @@ class CoordinatorClient:
             self._client.request(AlertNoteRequest(
                 self._rank if rank is None else int(rank), str(kind),
                 str(severity), float(value)))
+        except Exception:
+            pass
+
+    def note_fingerprint(self, step: int, digests: dict) -> None:
+        """Ship this rank's param fingerprints for ``step`` to the
+        rank-0 collector (docs/numerics.md#fingerprints). ONE attempt,
+        errors swallowed — a dropped probe is a skipped compare."""
+        try:
+            self._client.request(FingerprintRequest(
+                self._rank, int(step), dict(digests)))
         except Exception:
             pass
 

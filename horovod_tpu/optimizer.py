@@ -207,7 +207,26 @@ class DistributedGradientTransformation:
             lambda g, e: g + e.astype(g.dtype), grads, residual)
         new_residual = jax.tree_util.tree_map(
             lambda d: d - self._roundtrip(d), delta)
+        self._note_ef_residual(new_residual)
         return delta, new_residual
+
+    def _note_ef_residual(self, residual) -> None:
+        """Quantization-drift telemetry (docs/numerics.md#drift): the
+        global residual L2 norm is exactly what this step's wire
+        dropped. Eager path only — under jit the tree holds tracers and
+        the sample is skipped (the torch shim's per-bucket hook covers
+        the compiled story there)."""
+        from .observability import numerics as _numerics
+        if not _numerics.enabled() or _is_tracing(residual):
+            return
+        try:
+            total = 0.0
+            for leaf in jax.tree_util.tree_leaves(residual):
+                a = np.asarray(leaf, dtype=np.float64)
+                total += float(np.sum(a * a))
+            _numerics.note_ef_residual("jax", float(np.sqrt(total)))
+        except Exception:   # telemetry must never kill the update
+            pass
 
     # optax GradientTransformation interface -------------------------------
 

@@ -29,6 +29,7 @@ for tied parameters), with no extra scaling anywhere.
 from __future__ import annotations
 
 import functools
+import itertools
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -122,7 +123,50 @@ def build_train_step(cfg: tfm.TransformerConfig, mesh: Mesh, optimizer,
                   else ("dp" if "dp" in axis_names else None))
     data_spec = P(batch_axes, cfg.sp_axis if cfg.sp_axis else None)
 
-    def _per_shard_step(zero1_mode):
+    world = 1
+    for _ax in mesh.axis_names:
+        world *= int(mesh.shape[_ax])
+
+    def _dedup_sq(tree):
+        """Global squared L2 norm contribution of this shard: per-leaf
+        local sum-of-squares divided by the leaf's replication factor
+        (product of mesh axes NOT in its spec), so a psum over every
+        axis counts each unique element exactly once."""
+        def leaf_sq(x, s):
+            d = 1
+            have = _spec_axes(s)
+            for ax in mesh.axis_names:
+                if ax not in have:
+                    d *= int(mesh.shape[ax])
+            return jnp.sum(jnp.square(x.astype(jnp.float32))) / d
+        parts = jax.tree_util.tree_map(
+            leaf_sq, tree, specs, is_leaf=lambda x: isinstance(x, P))
+        return sum(jax.tree_util.tree_leaves(parts))
+
+    def _numerics_aux(g_for_norm, updates, params, nf_local):
+        """In-graph numerics telemetry (docs/numerics.md): ONE small
+        psum of a [3 + world] vector piggybacked on the step — global
+        grad/update/param squared norms plus a per-device nonfinite
+        vector (each shard deposits its LOCAL pre-reduction count at
+        its linear mesh index, so the host alert can name the producing
+        rank)."""
+        idx = jnp.int32(0)
+        for ax in mesh.axis_names:
+            idx = idx * int(mesh.shape[ax]) + lax.axis_index(ax)
+        nf_vec = jnp.zeros((world,), jnp.float32).at[idx].set(
+            nf_local.astype(jnp.float32))
+        packed = jnp.concatenate([
+            jnp.stack([_dedup_sq(g_for_norm), _dedup_sq(updates),
+                       _dedup_sq(params)]), nf_vec])
+        packed = lax.psum(packed, tuple(mesh.axis_names))
+        return {
+            "grad_norm": jnp.sqrt(packed[0]),
+            "update_ratio": jnp.sqrt(packed[1])
+            / jnp.maximum(jnp.sqrt(packed[2]), 1e-12),
+            "nonfinite_by_rank": packed[3:],
+        }
+
+    def _per_shard_step(zero1_mode, with_numerics=False):
         from .zero import zero1_update
 
         def per_shard_step(params, opt_state, tokens, targets):
@@ -144,6 +188,13 @@ def build_train_step(cfg: tfm.TransformerConfig, mesh: Mesh, optimizer,
                 return loss
 
             loss, grads = jax.value_and_grad(local_loss)(params)
+            if with_numerics:
+                # Count on the LOCAL, pre-reduction gradients — after
+                # the psum a NaN has spread to every shard and the
+                # producer is unidentifiable.
+                nf_local = sum(
+                    jnp.sum(~jnp.isfinite(g)) for g in
+                    jax.tree_util.tree_leaves(grads))
             if zero1_mode:
                 # ZeRO-1 (parallel/zero.py): reduce over every missing
                 # axis EXCEPT 'dp' — the wrapper's psum_scatter does the
@@ -162,10 +213,26 @@ def build_train_step(cfg: tfm.TransformerConfig, mesh: Mesh, optimizer,
                                          dcn_wire=dcn_wire)
                 updates, opt_state = optimizer.update(grads, opt_state,
                                                       params)
+            aux = None
+            if with_numerics:
+                g_for_norm = grads
+                if zero1_mode:
+                    # ZeRO-1 grads skipped the 'dp' sum (the wrapper's
+                    # psum_scatter owns it) — finish it here so the
+                    # telemetry norm is the true global gradient norm.
+                    g_for_norm = jax.tree_util.tree_map(
+                        lambda g, s: g if "dp" in _spec_axes(s)
+                        else lax.psum(g, "dp"),
+                        grads, specs,
+                        is_leaf=lambda x: isinstance(x, P))
+                aux = _numerics_aux(g_for_norm, updates, params,
+                                    nf_local)
             import optax
             params = optax.apply_updates(params, updates)
             # Reported loss: global mean (sum of masked, scaled shards).
             loss = lax.psum(loss, tuple(mesh.axis_names))
+            if with_numerics:
+                return params, opt_state, loss, aux
             return params, opt_state, loss
 
         return per_shard_step
@@ -216,11 +283,23 @@ def build_train_step(cfg: tfm.TransformerConfig, mesh: Mesh, optimizer,
             from .zero import state_specs_by_structure
             opt_specs = state_specs_by_structure(opt_state, params,
                                                  specs)
+        from ..observability import numerics as _numerics
+        numerics_on = _numerics.enabled()
+        out_specs = (specs, opt_specs, P())
+        if numerics_on:
+            # Aux leaves are psum'ed over every axis inside the step —
+            # replicated outputs, so plain P() specs.
+            out_specs = out_specs + ({"grad_norm": P(),
+                                      "update_ratio": P(),
+                                      "nonfinite_by_rank": P()},)
         step = jax.jit(jax.shard_map(
-            _per_shard_step(zero1_mode), mesh=mesh,
+            _per_shard_step(zero1_mode, with_numerics=numerics_on),
+            mesh=mesh,
             in_specs=(specs, opt_specs, data_spec, data_spec),
-            out_specs=(specs, opt_specs, P()),
+            out_specs=out_specs,
             check_vma=False))
+        if numerics_on:
+            step = _wrap_numerics_step(step)
         return step, opt_specs
 
     def shard_params(params):
@@ -230,6 +309,29 @@ def build_train_step(cfg: tfm.TransformerConfig, mesh: Mesh, optimizer,
         return jax.device_put(batch, NamedSharding(mesh, data_spec))
 
     return make, shard_params, shard_batch
+
+
+def _wrap_numerics_step(inner):
+    """Host-side shell of the numerics aux channel (docs/numerics.md):
+    keeps the public ``(params, opt_state, loss)`` contract while
+    feeding the deferred :class:`~horovod_tpu.observability.numerics
+    .StepStats` sink (step N's device scalars materialize while step
+    N+1 runs — no added host sync), running the periodic cross-rank
+    fingerprint probe, and honoring an armed ``bitflip_param`` fault
+    clause."""
+    from ..observability import numerics as _numerics
+    counter = itertools.count()
+
+    def step(params, opt_state, tokens, targets):
+        i = next(counter)
+        params = _numerics.maybe_bitflip(params, i)
+        params, opt_state, loss, aux = inner(params, opt_state,
+                                             tokens, targets)
+        _numerics.step_stats().note(i, loss, aux)
+        _numerics.maybe_send_fingerprint(params, i)
+        return params, opt_state, loss
+
+    return step
 
 
 def _put_tree(tree, specs, mesh: Mesh):

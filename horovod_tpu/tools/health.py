@@ -53,6 +53,13 @@ KEY_SERIES_FAMILIES = (
     "hvdtpu_serving_shed_total",
     "hvdtpu_fleet_scale_events_total",
     "hvdtpu_fleet_target_replicas",
+    # Numerics plane (docs/numerics.md): grad norm and loss trend
+    # lines are the first thing to eyeball after a NaN page, and the
+    # nonfinite counter's sparkline shows when the cascade started.
+    "hvdtpu_numerics_grad_norm",
+    "hvdtpu_numerics_loss",
+    "hvdtpu_numerics_update_ratio",
+    "hvdtpu_numerics_nonfinite_total",
 )
 
 # Direction-aware regression semantics: which way is WORSE.
@@ -61,7 +68,8 @@ KEY_SERIES_FAMILIES = (
 _UP_WORSE = ("seconds", "queue_depth", "bytes_in_use", "share",
              "lateness", "restarts_total", "failures_total",
              "errors_total", "stalled", "blocked", "violations",
-             "shed", "scale_events")
+             "shed", "scale_events", "nonfinite", "ef_residual",
+             "skipped_steps")
 _DOWN_WORSE = ("mfu", "per_second", "replicas_live", "replicas_ready",
                "acceptance", "goodput")
 

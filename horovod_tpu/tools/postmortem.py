@@ -17,6 +17,9 @@ questions:
   - Which rank died (or stalled) FIRST, and in which phase (inside a
     collective, mid-step in compute/input, at a fault injection)?
   - What was the adaptation ladder doing at the time of death?
+  - Did the NUMBERS go bad before the process did — the first
+    nonfinite (step, rank) and any cross-rank divergence fingerprint
+    mismatches the numerics plane recorded (docs/numerics.md)?
 
 Usage::
 
@@ -236,6 +239,53 @@ def _data_cursor(dump: RankDump) -> Optional[dict]:
     return None
 
 
+def _numerics_evidence(dumps: List[RankDump]) -> Optional[dict]:
+    """Numerics-plane evidence chain (docs/numerics.md#postmortem).
+
+    In a NaN cascade every rank eventually reports nonfinite payloads —
+    the poisoned gradient propagates through the next allreduce — so
+    the ORIGIN is the numerically FIRST observation (lowest step, then
+    earliest aligned time), not the loudest rank. Divergence rows come
+    from rank 0's fingerprint comparisons: each names the leaf and the
+    outvoted rank, which is the bitflip/corruption story in one line."""
+    nonfinite: List[dict] = []
+    divergence: List[dict] = []
+    for d in dumps:
+        for e in d.events:
+            if e.get("kind") != "numerics":
+                continue
+            row = {"step": e.get("step"), "rank": e.get("who"),
+                   "observed_by": d.rank,
+                   "t_rank0_us": d.aligned_us(e)}
+            if str(e.get("event")) == "nonfinite":
+                row["elements"] = e.get("value")
+                row["source"] = e.get("detail")
+                nonfinite.append(row)
+            elif str(e.get("event")) == "divergence":
+                row["leaf"] = e.get("detail")
+                divergence.append(row)
+    if not nonfinite and not divergence:
+        return None
+
+    def _order(row: dict) -> Tuple[float, float]:
+        # step -1 means "observed outside a numbered step" (e.g. a
+        # collective payload scan) — order those by aligned time only.
+        step = row.get("step")
+        step = float(step) if isinstance(step, (int, float)) \
+            and step >= 0 else float("inf")
+        return (step, row["t_rank0_us"])
+
+    nonfinite.sort(key=_order)
+    divergence.sort(key=_order)
+    return {
+        "first_nonfinite": nonfinite[0] if nonfinite else None,
+        "nonfinite_events": len(nonfinite),
+        "nonfinite_ranks": sorted({r["rank"] for r in nonfinite
+                                   if r.get("rank") is not None}),
+        "divergence": divergence,
+    }
+
+
 def _blamed_ranks(dumps: List[RankDump]) -> Dict[int, int]:
     """Votes per rank from survivors' recorded failure events."""
     votes: Dict[int, int] = {}
@@ -390,6 +440,7 @@ def analyze(dumps: List[RankDump]) -> dict:
                        "phase": death_phase},
         "failure_votes": {str(r): v for r, v in sorted(votes.items())},
         "adaptation_at_death": ladder,
+        "numerics": _numerics_evidence(dumps),
         "clock_unsynced_ranks": unsynced,
     }
 
@@ -428,6 +479,25 @@ def format_report(report: dict) -> str:
         lines.append(
             f"No divergence recorded: every dumped rank stopped at "
             f"group seq {report['common_last_group_seq']}")
+    num = report.get("numerics")
+    if num:
+        first = num.get("first_nonfinite")
+        if first is not None:
+            step = first.get("step")
+            lines.append(
+                "First nonfinite: "
+                + (f"step {step}" if isinstance(step, (int, float))
+                   and step >= 0 else "outside a numbered step")
+                + f" on rank {first.get('rank')} "
+                f"({first.get('elements')} element(s), source "
+                f"{first.get('source')}); {num['nonfinite_events']} "
+                f"nonfinite event(s) total across ranks "
+                f"{num['nonfinite_ranks']}")
+        for q in num.get("divergence", []):
+            lines.append(
+                f"Cross-rank divergence at step {q.get('step')}: rank "
+                f"{q.get('rank')} disagrees on leaf {q.get('leaf')} "
+                f"(fingerprint comparison on rank {q.get('observed_by')})")
     inflight = {r: row["inflight_requests"]
                 for r, row in report["per_rank"].items()
                 if row.get("inflight_requests")}

@@ -259,6 +259,15 @@ def init(*, coordinator_address: Optional[str] = None,
         from .utils.logging import get_logger
         get_logger("topology").warning("history sampler not started: %s",
                                        e)
+    # Numerics plane (docs/numerics.md): env-driven single-flag arm —
+    # nonfinite sentinels, gradient telemetry and fingerprint probes
+    # all hang off this one module flag.
+    try:
+        from .observability import numerics as _numerics
+        _numerics.maybe_enable_from_env()
+    except Exception as e:  # never fail init over telemetry
+        from .utils.logging import get_logger
+        get_logger("topology").warning("numerics plane not armed: %s", e)
     return _topology
 
 

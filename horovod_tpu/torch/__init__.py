@@ -30,6 +30,7 @@ from .. import ops as _ops
 from ..topology import (init, shutdown, is_initialized, rank, local_rank,
                         size, local_size, mpi_threads_supported)
 from ..observability import StepTimer as _StepTimer
+from ..observability import numerics as _numerics
 from ..observability import registry as _obs
 from ..utils import env as _env
 from .compression import Compression
@@ -113,6 +114,11 @@ class _ShimMetrics:
             "hvdtpu_torch_grad_view_params",
             "Parameters whose .grad is aliased into a bucket buffer by "
             "the most recently constructed DistributedOptimizer").labels()
+        self.skipped_steps = r.counter(
+            "hvdtpu_torch_skipped_steps_total",
+            "Optimizer steps skipped by skip_nonfinite_steps because "
+            "the bucket pack observed nonfinite gradient elements "
+            "(docs/numerics.md#torch)").labels()
 
     @classmethod
     def get(cls) -> "_ShimMetrics":
@@ -207,12 +213,16 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
     def __init__(self, params, named_parameters, compression,
                  backward_passes_per_step=1, bucket_cap_mb=None,
-                 gradient_as_bucket_view=None):
+                 gradient_as_bucket_view=None, skip_nonfinite_steps=None):
         super(self.__class__, self).__init__(params)
         self._compression = compression
         self.backward_passes_per_step = backward_passes_per_step
         self._synchronized = False
         self._should_synchronize = True
+        if skip_nonfinite_steps is None:
+            skip_nonfinite_steps = _env.torch_skip_nonfinite()
+        self._skip_nonfinite = bool(skip_nonfinite_steps)
+        self._saw_nonfinite = False
 
         if named_parameters is not None:
             named_parameters = list(named_parameters)
@@ -381,6 +391,15 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             self._compression, "wire_spec", None) is not None else None
         if blockwise is not None and b.buffer.dtype == torch.float32:
             self._apply_error_feedback(b, blockwise.wire_spec)
+        if _numerics.enabled() and b.buffer.dtype.is_floating_point:
+            # Nonfinite sentinel on the just-packed LOCAL payload — the
+            # buffer is hot from the pack memcpy, and post-allreduce the
+            # producer is unidentifiable (docs/numerics.md#torch).
+            nf = b.numel - int(torch.isfinite(b.buffer).sum().item())
+            if nf:
+                self._saw_nonfinite = True
+                _numerics.note_nonfinite(nf, source="torch_bucket",
+                                         detail=b.name)
         self._metrics.fires[trigger].inc()
         self._metrics.bucket_bytes.inc(b.numel * b.buffer.element_size())
         self._handles[b.index] = allreduce_async_(
@@ -413,6 +432,11 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         # Write the residual through numpy views — no writable-flag
         # dance, no extra staging copy of a bucket-sized array.
         np.subtract(b.buffer.numpy(), np.asarray(rt), out=res.numpy())
+        if _numerics.enabled():
+            # Quantization-drift signal: the residual norm is exactly
+            # what the wire dropped this step (docs/numerics.md#drift).
+            _numerics.note_ef_residual(
+                b.name, float(np.linalg.norm(res.numpy())))
 
     # --------------------------------------------------------------- hooks
 
@@ -582,6 +606,19 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                     "optimizer.skip_synchronize() context.")
             self.synchronize()
         self._synchronized = False
+        if self._skip_nonfinite and self._saw_nonfinite:
+            # Opt-in NaN guard (docs/numerics.md#torch): the collective
+            # already ran (every rank stays in lockstep), but the inner
+            # update is skipped so the corrupted averaged gradients
+            # never touch the weights.
+            self._saw_nonfinite = False
+            self._metrics.skipped_steps.inc()
+            warnings.warn(
+                "skip_nonfinite_steps: nonfinite gradient elements "
+                "observed this step; optimizer update skipped "
+                "(docs/numerics.md#torch)")
+            return None
+        self._saw_nonfinite = False
         return super(self.__class__, self).step(closure)
 
     def zero_grad(self, *args, **kwargs):
@@ -607,7 +644,8 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
                          compression=Compression.none,
                          backward_passes_per_step: int = 1,
                          bucket_cap_mb: Optional[float] = None,
-                         gradient_as_bucket_view: Optional[bool] = None):
+                         gradient_as_bucket_view: Optional[bool] = None,
+                         skip_nonfinite_steps: Optional[bool] = None):
     """Wrap a torch optimizer so ``step()`` applies allreduce-averaged
     gradients — the reference builds a dynamic subclass of the wrapped
     optimizer's class so isinstance() and LR schedulers keep working
@@ -623,12 +661,19 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
     accumulates directly into the collective payload, dropping the
     hook-time pack memcpy and the scatter-back; bitwise-identical
     results to the copying path. None reads HOROVOD_TPU_TORCH_GRAD_VIEW
-    (default off)."""
+    (default off).
+
+    ``skip_nonfinite_steps`` (docs/numerics.md#torch): when the bucket
+    pack's nonfinite sentinel (HOROVOD_TPU_NUMERICS=1) counted NaN/Inf
+    gradient elements this step, ``step()`` still synchronizes — every
+    rank runs the same collectives — but skips the inner optimizer
+    update. None reads HOROVOD_TPU_TORCH_SKIP_NONFINITE (default
+    off)."""
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                dict(_DistributedOptimizer.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression,
                backward_passes_per_step, bucket_cap_mb,
-               gradient_as_bucket_view)
+               gradient_as_bucket_view, skip_nonfinite_steps)
 
 
 def broadcast_parameters(params, root_rank: int = 0) -> None:

@@ -57,6 +57,17 @@ def torch_grad_view() -> bool:
     return _get("TORCH_GRAD_VIEW") not in (None, "", "0")
 
 
+def torch_skip_nonfinite() -> bool:
+    """Default for the torch DistributedOptimizer's
+    ``skip_nonfinite_steps`` (docs/numerics.md#torch): when the bucket
+    pack observed nonfinite gradient elements this step, ``step()``
+    still synchronizes (collective parity across ranks) but skips the
+    inner optimizer update, so one rank's NaN batch does not poison
+    the weights. Off by default; needs HOROVOD_TPU_NUMERICS=1 for the
+    counts to exist."""
+    return _get("TORCH_SKIP_NONFINITE") not in (None, "", "0")
+
+
 def cycle_time_ms() -> float:
     v = _get("CYCLE_TIME")
     if v is not None:
@@ -249,6 +260,24 @@ def health_detectors_enabled() -> bool:
     (docs/health.md). Default on whenever the history sampler runs;
     HOROVOD_TPU_HEALTH=0 keeps the history file but fires no alerts."""
     return _get("HEALTH") not in ("0",)
+
+
+def numerics_enabled() -> bool:
+    """Numerics observability plane (docs/numerics.md):
+    HOROVOD_TPU_NUMERICS=1 arms the nonfinite sentinels, gradient/loss
+    telemetry and fingerprint probes at hvd.init(). Default off — every
+    hook site then carries a single flag check."""
+    return _get("NUMERICS") in ("1",)
+
+
+def numerics_fp_interval() -> int:
+    """Cross-rank param-fingerprint cadence in training steps
+    (docs/numerics.md#fingerprints). 0 disables the probe while keeping
+    the rest of the numerics plane armed."""
+    v = _get("NUMERICS_FP_INTERVAL")
+    if v in (None, ""):
+        return 50
+    return int(v)
 
 
 def alert_url() -> Optional[str]:

@@ -1,0 +1,271 @@
+"""Numerics-plane acceptance (ISSUE 20, docs/numerics.md) — slow tier.
+
+1. Injected-NaN postmortem story: a 4-process job with a ``nan_at``
+   fault poisoning rank 1's payload at a known step, the result fed
+   back so the NaN cascades to every rank one step later. The
+   same-step ``nonfinite_rate`` alert names rank 1 AND the injection
+   step; the flight-recorder dump carries the ``numerics`` event; and
+   ``tools/postmortem`` over the merged dumps attributes the first
+   nonfinite observation to (step, rank 1) — not to the louder ranks
+   that caught the cascade a step later.
+2. Injected-bitflip divergence story: identical param trees on all
+   ranks, one mantissa bit flipped on rank 1 mid-run by
+   ``bitflip_param``. The periodic fingerprint probe ships digests to
+   rank 0 over the coordinator channel, and the majority compare fires
+   a ``rank_divergence`` alert naming the corrupted leaf and rank.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from horovod_tpu.runner.api import run as plain_run  # noqa: E402
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_BASE_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    "HOROVOD_TPU_DISABLE_NATIVE": "1",
+    "HOROVOD_CYCLE_TIME": "1",
+    "HOROVOD_TPU_NUMERICS": "1",
+}
+
+
+def _make_nan_worker():
+    """Worker built inside a closure so cloudpickle ships it by value
+    (the test module is not importable from the spawned workers)."""
+
+    def worker(steps, nan_at):
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        import horovod_tpu as hvd
+        from horovod_tpu.observability import history as _history
+
+        hvd.init()
+        x = jnp.ones((128,), jnp.float32)
+        for step in range(steps):
+            # ONE collective per step so the fault injector's enqueue
+            # tick counter AND the numerics scan tick == the step
+            # counter. Feeding the reduction back makes the injected
+            # NaN cascade: rank 1 packs it at step nan_at, every other
+            # rank first sees it in its own payload at nan_at + 1.
+            x = hvd.allreduce(x, name=f"ne2e.{step}", average=True)
+        nan_after = int(np.sum(~np.isfinite(np.asarray(x))))
+        sampler = _history.sampler()
+        if sampler is not None:
+            sampler.final_flush()
+        snap = hvd.metrics_snapshot(prefix="hvdtpu_")
+        nonf = (snap.get("hvdtpu_numerics_nonfinite_total")
+                or {"values": {}})["values"]
+        monitor = sampler.monitor if sampler is not None else None
+        return {
+            "rank": hvd.process_rank(),
+            "nonfinite_counts": nonf,
+            "alerts": ([a.to_dict() for a in monitor.alerts]
+                       if monitor is not None else []),
+            "x_nonfinite_after": nan_after,
+        }
+
+    return worker
+
+
+class TestInjectedNanPostmortemE2E:
+    def test_nan_at_names_rank_and_step_everywhere(self, tmp_path):
+        """ACCEPTANCE: the same-step alert, the flight-recorder dump,
+        and the tools/postmortem attribution all name (step, rank 1)
+        for an injected NaN — while the cascade pages every rank."""
+        hist = tmp_path / "hist"
+        blackbox = tmp_path / "blackbox"
+        steps, nan_at = 14, 7
+        env = dict(_BASE_ENV)
+        env.update({
+            "HOROVOD_TPU_HISTORY": str(hist),
+            "HOROVOD_TPU_HISTORY_INTERVAL": "0.2",
+            "HOROVOD_TPU_BLACKBOX": str(blackbox),
+            "HOROVOD_TPU_FAULT_SPEC": f"rank=1:nan_at={nan_at}",
+        })
+        results = plain_run(_make_nan_worker(), args=(steps, nan_at),
+                            np=4, extra_env=env, start_timeout=600)
+        by_rank = {r["rank"]: r for r in results}
+
+        # The cascade happened: averaging a NaN poisons the feedback
+        # tensor on every rank.
+        assert all(r["x_nonfinite_after"] >= 1 for r in results)
+
+        # (1) SAME-STEP detection on the injected rank: the alert's
+        # evidence names the exact injection step and rank 1 itself —
+        # not a later step where the page would be ambiguous.
+        r1 = by_rank[1]
+        nf1 = [a for a in r1["alerts"] if a["kind"] == "nonfinite_rate"]
+        assert nf1, f"rank 1 fired no nonfinite alert: {r1['alerts']}"
+        assert nf1[0]["evidence"]["step"] == nan_at
+        assert nf1[0]["evidence"]["rank"] == 1
+        assert nf1[0]["evidence"]["source"] == "collective"
+        assert r1["nonfinite_counts"].get('source="collective"', 0) >= 1
+
+        # Every OTHER rank first observes the NaN one step later, in
+        # its own fed-back payload — the louder-but-later evidence the
+        # postmortem attribution must rank below rank 1's.
+        for rank in (0, 2, 3):
+            alerts = [a for a in by_rank[rank]["alerts"]
+                      if a["kind"] == "nonfinite_rate"]
+            assert alerts, f"rank {rank} never saw the cascade"
+            assert alerts[0]["evidence"]["step"] == nan_at + 1
+
+        # (2) Flight-recorder dump: rank 1's ring carries the numerics
+        # event with the injection step.
+        dump = blackbox / "blackbox-rank1.jsonl"
+        assert dump.exists()
+        events = [json.loads(line) for line in open(dump)][1:]
+        numerics_ev = [e for e in events if e.get("kind") == "numerics"]
+        assert any(e["event"] == "nonfinite" and e["step"] == nan_at
+                   and e["who"] == 1 for e in numerics_ev), numerics_ev
+
+        # ... and the injection itself is on the record (fault event),
+        # so a postmortem reader can tell injected from organic.
+        fault_ev = [e for e in events if e.get("kind") == "fault"]
+        assert any(e.get("fault") == "nan" and e.get("tick") == nan_at
+                   for e in fault_ev), fault_ev
+
+        # (3) tools/postmortem over the merged dumps: first_nonfinite
+        # is (step nan_at, rank 1) even though three other ranks
+        # reported nonfinite payloads too.
+        out_json = tmp_path / "postmortem.json"
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.tools.postmortem",
+             str(blackbox), "--json", str(out_json)],
+            capture_output=True, text=True, timeout=300, cwd=ROOT)
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        report = json.loads(out_json.read_text())
+        numerics = report["numerics"]
+        assert numerics is not None
+        first = numerics["first_nonfinite"]
+        assert first["step"] == nan_at
+        assert first["rank"] == 1
+        assert numerics["nonfinite_events"] >= 4
+        assert set(numerics["nonfinite_ranks"]) == {0, 1, 2, 3}
+
+        # Human rendering states the verdict.
+        assert "First nonfinite" in proc.stdout
+        assert f"step {nan_at} on rank 1" in proc.stdout
+
+
+def _make_bitflip_worker():
+    def worker(steps):
+        import time
+
+        import jax.numpy as jnp
+
+        import horovod_tpu as hvd
+        from horovod_tpu.observability import history as _history
+        from horovod_tpu.observability import numerics as _numerics
+
+        hvd.init()
+        # Identical param trees on every rank; only the injected flip
+        # on rank 1 may make them diverge.
+        params = {"w": jnp.arange(1.0, 257.0, dtype=jnp.float32),
+                  "b": jnp.zeros((16,), jnp.float32)}
+        x = jnp.ones((64,), jnp.float32)
+        for step in range(steps):
+            hvd.allreduce(x, name=f"fp.{step}", average=False)
+            params = _numerics.maybe_bitflip(params, step)
+            _numerics.maybe_send_fingerprint(params, step)
+        # Give rank 0's coordinator thread time to drain the last
+        # probe messages before reading alert state.
+        time.sleep(1.5)
+        sampler = _history.sampler()
+        if sampler is not None:
+            sampler.final_flush()
+        snap = hvd.metrics_snapshot(prefix="hvdtpu_")
+        fp = (snap.get("hvdtpu_numerics_fingerprints_total")
+              or {"values": {}})["values"]
+        monitor = sampler.monitor if sampler is not None else None
+        return {
+            "rank": hvd.process_rank(),
+            "fingerprint_counts": fp,
+            "alerts": ([a.to_dict() for a in monitor.alerts]
+                       if monitor is not None else []),
+        }
+
+    return worker
+
+
+class TestInjectedBitflipDivergenceE2E:
+    def test_bitflip_fires_rank_divergence_naming_leaf(self, tmp_path):
+        """ACCEPTANCE: a single flipped mantissa bit on rank 1 is
+        caught by the cross-rank fingerprint compare at rank 0, which
+        names the corrupted leaf and the divergent rank."""
+        hist = tmp_path / "hist"
+        blackbox = tmp_path / "blackbox"
+        steps, flip_at, interval = 16, 10, 5
+        env = dict(_BASE_ENV)
+        env.update({
+            "HOROVOD_TPU_HISTORY": str(hist),
+            "HOROVOD_TPU_HISTORY_INTERVAL": "0.2",
+            "HOROVOD_TPU_BLACKBOX": str(blackbox),
+            "HOROVOD_TPU_NUMERICS_FP_INTERVAL": str(interval),
+            "HOROVOD_TPU_FAULT_SPEC":
+                f"rank=1:bitflip_param={flip_at}:leaf=w",
+        })
+        results = plain_run(_make_bitflip_worker(), args=(steps,),
+                            np=4, extra_env=env, start_timeout=600)
+        by_rank = {r["rank"]: r for r in results}
+
+        # Probes ran on every rank (steps 0, 5, 10, 15).
+        for r in results:
+            assert r["fingerprint_counts"].get('event="computed"',
+                                               0) >= 4, r
+
+        # Rank 0 is the collection point: it compared complete sets
+        # and flagged the post-flip probes as mismatched.
+        r0 = by_rank[0]
+        assert r0["fingerprint_counts"].get('event="compared"', 0) >= 3
+        assert r0["fingerprint_counts"].get('event="mismatch"', 0) >= 1
+
+        # The typed alert names the corrupted leaf AND rank 1, at the
+        # first probe step on/after the flip.
+        div = [a for a in r0["alerts"] if a["kind"] == "rank_divergence"]
+        assert div, f"rank 0 fired no divergence alert: {r0['alerts']}"
+        ev = div[0]["evidence"]
+        assert ev["rank"] == 1
+        assert "w" in ev["leaf"]
+        assert ev["step"] == flip_at
+        assert sorted(ev["ranks_reporting"]) == [0, 1, 2, 3]
+
+        # Clean ranks raised nothing.
+        for rank in (2, 3):
+            assert not [a for a in by_rank[rank]["alerts"]
+                        if a["kind"] == "rank_divergence"]
+
+        # The flight recorder on rank 0 carries the divergence event,
+        # so tools/postmortem can attribute it after the fact.
+        dump = blackbox / "blackbox-rank0.jsonl"
+        assert dump.exists()
+        events = [json.loads(line) for line in open(dump)][1:]
+        div_ev = [e for e in events if e.get("kind") == "numerics"
+                  and e.get("event") == "divergence"]
+        assert any(e["who"] == 1 and "w" in str(e["detail"])
+                   for e in div_ev), div_ev
+
+        out_json = tmp_path / "postmortem.json"
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.tools.postmortem",
+             str(blackbox), "--json", str(out_json)],
+            capture_output=True, text=True, timeout=300, cwd=ROOT)
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        report = json.loads(out_json.read_text())
+        rows = report["numerics"]["divergence"]
+        assert rows and rows[0]["rank"] == 1
+        assert "w" in rows[0]["leaf"]
+        assert "Cross-rank divergence" in proc.stdout

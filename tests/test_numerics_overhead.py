@@ -1,0 +1,70 @@
+"""Numerics-plane overhead guard (slow tier) — the sentinels piggyback
+on packs the engine already pays for, so the armed plane must cost
+under 1% of step time: ``bench_engine.py --numerics`` runs a 2-process
+fused-allreduce loop toggling the plane PER STEP (each on-step paired
+with its off-step twin; overhead is the median over paired step-time
+ratios, which cancels the load drift that block-level A/Bs suffer on
+a shared box), and this guard holds the overhead under 1%,
+regenerating ``BENCH_NUMERICS.json``.
+
+One re-measure is allowed before failing — a shared CI box can stay
+saturated through one window (the BENCH_METRICS precedent)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+BUDGET = 0.01
+
+
+def _run_bench(out_path: str, rounds: int) -> dict:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "bench_engine.py"),
+         "--numerics", "--numerics-rounds", str(rounds),
+         "--out", out_path],
+        capture_output=True, text=True, timeout=600, cwd=root)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    return json.loads(open(out_path).read())
+
+
+def test_numerics_overhead_under_1_percent(tmp_path):
+    out = tmp_path / "bench_numerics.json"
+    result = _run_bench(str(out), rounds=6)
+    if result["overhead_frac"] >= BUDGET:   # one re-measure
+        result = _run_bench(str(out), rounds=6)
+
+    # Regenerate the committed artifact from the accepted run.
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "BENCH_NUMERICS.json"), "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+    assert result["rows"]["numerics_on"]["step_time_ms"] > 0
+    # The sentinel must never cry wolf on clean payloads: the bench
+    # ships all-finite tensors, so any nonfinite count is a bug (e.g.
+    # the dot-product fast path misreading overflow).
+    assert result["nonfinite_false_positives"] == 0
+    assert result["overhead_frac"] < BUDGET, (
+        f"armed numerics plane costs {result['overhead_frac']:.2%} of "
+        f"the 2-process step time "
+        f"(on {result['rows']['numerics_on']['step_time_ms']} ms vs "
+        f"off {result['rows']['numerics_off']['step_time_ms']} ms; "
+        f"budget {BUDGET:.0%})")
+
+    # The seeded numerics smoke is deterministic: the sentinel counts
+    # exactly the crafted NaN/Inf elements, the fingerprint catches a
+    # single mantissa bitflip and blames the right rank, and the
+    # nonfinite_rate detector fires on the sample carrying the event.
+    smoke = result["numerics_smoke"]
+    assert (smoke["nonfinite_elements_counted"]
+            == smoke["nonfinite_elements_expected"])
+    assert smoke["bitflip_changes_fingerprint"]
+    assert smoke["bitflip_blamed"] == [["w", 1]]
+    assert (smoke["nonfinite_rate_first_fired_at_sample"]
+            == smoke["nonfinite_event_at_sample"])
